@@ -1,0 +1,44 @@
+"""srnn_tpu — a TPU-native framework for self-replicating neural networks.
+
+A from-scratch JAX/XLA rebuild of the capabilities of
+``illiumst/self-replicating-neural-networks`` (mounted read-only at
+/root/reference): networks that consume their own weights and emit new
+weights, fixpoint analysis of repeated self-application, and population
+("Soup") dynamics — redesigned for TPU:
+
+  * a particle is a row of a struct-of-arrays pytree, not an object holding
+    a keras model;
+  * self-application, predicates, training and soup evolution are pure
+    jitted functions; ``vmap`` supplies the population axis and
+    ``shard_map`` over a ``jax.sharding.Mesh`` supplies ICI scale-out;
+  * the reference's per-scalar ``model.predict`` hot loop (SURVEY §3.1)
+    becomes one batched matmul chain on the MXU.
+"""
+
+from .topology import Topology
+from .init import init_flat, init_population
+from .nets import apply_to_weights, compute_samples, apply_fn, samples_fn
+from .ops import (
+    CLASS_NAMES,
+    classify,
+    is_diverged,
+    is_fixpoint,
+    is_zero,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Topology",
+    "init_flat",
+    "init_population",
+    "apply_to_weights",
+    "compute_samples",
+    "apply_fn",
+    "samples_fn",
+    "CLASS_NAMES",
+    "classify",
+    "is_diverged",
+    "is_fixpoint",
+    "is_zero",
+]

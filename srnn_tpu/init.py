@@ -1,0 +1,43 @@
+"""Weight initialization matching keras defaults.
+
+The reference never sets initializers, so it inherits keras defaults
+(``network.py:226-230,329-333,531-535``): Dense kernels are glorot_uniform;
+SimpleRNN input kernels are glorot_uniform and recurrent kernels orthogonal.
+Matching these distributions matters — the fixpoint-density experiment
+(``setups/fixpoint-density.py``) classifies *untrained random* nets, so its
+statistics are a direct function of the init law.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .topology import Topology
+
+
+def _glorot_uniform(key, shape, dtype):
+    fan_in, fan_out = shape
+    limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+
+def _orthogonal(key, shape, dtype):
+    return jax.nn.initializers.orthogonal()(key, shape, dtype)
+
+
+def init_flat(topo: Topology, key: jax.Array, dtype=jnp.float32) -> jnp.ndarray:
+    """Sample one particle's flat weight vector ``(P,)``."""
+    shapes = topo.layer_shapes
+    keys = jax.random.split(key, len(shapes))
+    parts = []
+    for i, (shape, k) in enumerate(zip(shapes, keys)):
+        if topo.variant == "recurrent" and i % 2 == 1:
+            # odd entries are SimpleRNN recurrent kernels
+            parts.append(_orthogonal(k, shape, dtype).reshape(-1))
+        else:
+            parts.append(_glorot_uniform(k, shape, dtype).reshape(-1))
+    return jnp.concatenate(parts)
+
+
+def init_population(topo: Topology, key: jax.Array, n: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Sample ``n`` particles -> (n, P). vmap of :func:`init_flat`."""
+    return jax.vmap(lambda k: init_flat(topo, k, dtype))(jax.random.split(key, n))

@@ -1,0 +1,3 @@
+from .dispatch import apply_fn, samples_fn, apply_to_weights, compute_samples
+
+__all__ = ["apply_fn", "samples_fn", "apply_to_weights", "compute_samples"]

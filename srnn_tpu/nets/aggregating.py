@@ -1,0 +1,128 @@
+"""Aggregating variant: an MLP f: R^k -> R^k over k weight aggregates.
+
+Reference: ``AggregatingNeuralNetwork`` (``network.py:292-439``).  The P
+weights are chunked (in flat enumeration order) into k collections of
+``P // k`` elements, trailing leftovers appended to the LAST collection
+(``collect_weights``, ``network.py:388-403``); each collection is reduced to
+one aggregate (default: average), the k-vector goes through the net once, and
+each output aggregate is replicated back over its collection
+(``deaggregate_identically``, ``network.py:310-312``).
+
+TPU-native form: the segment structure is a constant one-hot matrix, so
+collect = one matmul, deaggregate = its transpose — no gathers in the hot
+path and everything fuses into the MLP matmul chain.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.activations import resolve_activation
+from ..ops.flatten import unflatten
+from ..ops.linalg import matmul
+from ..topology import Topology, aggregation_segments
+
+
+@functools.lru_cache(maxsize=None)
+def _segment_onehot(topo: Topology) -> np.ndarray:
+    """(P, k) one-hot membership matrix in float32."""
+    seg, _ = aggregation_segments(topo)
+    k = topo.aggregates
+    return np.eye(k, dtype=np.float32)[seg]
+
+
+def aggregate(topo: Topology, target_flat: jnp.ndarray) -> jnp.ndarray:
+    """Reduce (P,) weights -> (k,) aggregates under ``topo.aggregator``."""
+    seg, counts = aggregation_segments(topo)
+    if topo.aggregator == "average":
+        onehot = jnp.asarray(_segment_onehot(topo), dtype=target_flat.dtype)
+        return matmul(topo, target_flat, onehot) / jnp.asarray(counts, dtype=target_flat.dtype)
+    if topo.aggregator == "max":
+        # deliberate fix of the reference's falsy-max quirk (network.py:303-308)
+        return jax.ops.segment_max(
+            target_flat, jnp.asarray(seg), num_segments=topo.aggregates,
+            indices_are_sorted=True)
+    if topo.aggregator == "max_buggy":
+        # bit-faithful replication of ``aggregate_max``: a candidate only
+        # replaces the running max when it is greater AND truthy (!= 0.0),
+        # so a positive max of exactly 0.0 can never win (network.py:303-308).
+        seg_arr = jnp.asarray(seg)
+        starts = jnp.asarray(
+            np.searchsorted(seg, np.arange(topo.aggregates)), dtype=jnp.int32)
+        init = target_flat[starts]
+
+        def step(m, wi):
+            w, s = wi
+            cand = m[s]
+            new = jnp.where((w > cand) & (w != 0.0), w, cand)
+            return m.at[s].set(new), None
+
+        out, _ = jax.lax.scan(step, init, (target_flat, seg_arr))
+        return out
+    raise ValueError(f"unknown aggregator {topo.aggregator!r}")
+
+
+def forward(topo: Topology, self_flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """MLP forward (..., k) -> (..., k); activation after every layer."""
+    act = resolve_activation(topo.activation)
+    h = x
+    for m in unflatten(topo, self_flat):
+        h = act(matmul(topo, h, m))
+    return h
+
+
+def deaggregate(topo: Topology, aggs: jnp.ndarray, key=None) -> jnp.ndarray:
+    """Replicate (k,) aggregates back over their collections -> (P,).
+
+    With ``topo.shuffler == 'random'`` the replicated list is permuted, the
+    functional analog of ``shuffle_random`` (``network.py:318-322``); a PRNG
+    key is then required.
+    """
+    onehot = jnp.asarray(_segment_onehot(topo), dtype=aggs.dtype)
+    flat = matmul(topo, onehot, aggs)
+    if topo.shuffler == "random":
+        if key is None:
+            raise ValueError("shuffler='random' requires a PRNG key")
+        flat = jax.random.permutation(key, flat)
+    elif topo.shuffler != "not":
+        raise ValueError(f"unknown shuffler {topo.shuffler!r}")
+    return flat
+
+
+def apply(topo: Topology, self_flat: jnp.ndarray, target_flat: jnp.ndarray,
+          key=None) -> jnp.ndarray:
+    """collect -> aggregate -> one forward -> deaggregate -> write back.
+
+    Equivalent of ``apply_to_weights`` (``network.py:359-386``).
+    """
+    aggs = aggregate(topo, target_flat)
+    new_aggs = forward(topo, self_flat, aggs[None, :])[0]
+    return deaggregate(topo, new_aggs, key)
+
+
+def samples(topo: Topology, flat: jnp.ndarray):
+    """x = y = the (1, k) aggregate vector (``compute_samples``,
+    ``network.py:414-417``): self-training seeks a fixpoint in aggregate
+    space."""
+    aggs = aggregate(topo, flat)[None, :]
+    return aggs, aggs
+
+
+def is_fixpoint_after_aggregation(
+    topo: Topology, flat: jnp.ndarray, degree: int = 1, epsilon: float = 1e-4
+):
+    """Fixpoint test in aggregate space (``network.py:419-439``).
+
+    Returns ``(ok, new_aggregations)`` where ok is False on divergence —
+    unlike the reference, the return type is uniform (quirk §2.4.4 fixed).
+    """
+    old_aggs = aggregate(topo, flat)
+    new = flat
+    for _ in range(degree):
+        new = apply(topo, flat, new)
+    new_aggs = aggregate(topo, new)
+    diverged = jnp.any(~jnp.isfinite(new))
+    close = jnp.all(jnp.abs(new_aggs - old_aggs) < epsilon)
+    return ~diverged & close, new_aggs

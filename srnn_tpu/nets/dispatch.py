@@ -1,0 +1,40 @@
+"""Variant dispatch: map a Topology to its pure transform functions.
+
+All variants share the same functional surface:
+
+  ``apply_to_weights(topo, self_flat, target_flat, key=None) -> new_target``
+      the self-application operator (reference ``apply_to_weights``,
+      dispatched per class at ``network.py:265/359/494/544``).
+
+  ``compute_samples(topo, flat) -> (x, y)``
+      the self-training data (reference ``compute_samples`` per class).
+
+Dispatch happens on the static ``topo.variant`` string at trace time, so jit
+sees a single fused computation per topology.
+"""
+
+from .. import topology as _topology
+from . import aggregating, fft, recurrent, weightwise
+
+_MODULES = {
+    "weightwise": weightwise,
+    "aggregating": aggregating,
+    "fft": fft,
+    "recurrent": recurrent,
+}
+
+
+def apply_fn(topo: "_topology.Topology"):
+    return _MODULES[topo.variant].apply
+
+
+def samples_fn(topo: "_topology.Topology"):
+    return _MODULES[topo.variant].samples
+
+
+def apply_to_weights(topo, self_flat, target_flat, key=None):
+    return _MODULES[topo.variant].apply(topo, self_flat, target_flat, key)
+
+
+def compute_samples(topo, flat):
+    return _MODULES[topo.variant].samples(topo, flat)

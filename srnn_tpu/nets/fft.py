@@ -1,0 +1,72 @@
+"""FFT variant: an MLP f: R^k -> R^k over truncated Fourier coefficients.
+
+Reference: ``FFTNeuralNetwork`` (``network.py:442-521``).  Semantics tracked
+deliberately (SURVEY §2.4.2):
+
+  * The transform FFTs the net's **own current** flat weights — the
+    ``old_weights`` argument is ignored for the input (``network.py:494-499``)
+    — so ``attack(other)`` writes self-derived values into the victim.  We
+    keep that as the default (``topo.fft_use_target=False``) and offer the
+    fixed behavior behind the flag.
+  * keras ``predict`` on a complex array casts to float32, silently dropping
+    the imaginary part; likewise ``ifftn`` output written back into float32
+    weight arrays keeps only the real part (``network.py:503-508``).  We make
+    both casts explicit (``.real``).
+
+The forward FFT truncates to k coefficients (``np.fft.fftn(flat, k)``); the
+inverse expands back to P samples (``np.fft.ifftn(agg, P)``), i.e. a
+low-pass reconstruction of the weight vector.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.activations import resolve_activation
+from ..ops.flatten import unflatten
+from ..ops.linalg import matmul
+from ..topology import Topology
+
+
+def coefficients(topo: Topology, flat: jnp.ndarray) -> jnp.ndarray:
+    """Real parts of the first k DFT coefficients (``aggregate_fft``,
+    ``network.py:444-448`` + the keras complex->float32 cast)."""
+    return jnp.fft.fft(flat, n=topo.aggregates).real.astype(flat.dtype)
+
+
+def forward(topo: Topology, self_flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    act = resolve_activation(topo.activation)
+    h = x
+    for m in unflatten(topo, self_flat):
+        h = act(matmul(topo, h, m))
+    return h
+
+
+def apply(topo: Topology, self_flat: jnp.ndarray, target_flat: jnp.ndarray,
+          key=None) -> jnp.ndarray:
+    """FFT -> one forward over k coefficients -> inverse FFT to P weights.
+
+    Equivalent of ``apply_to_weights`` (``network.py:494-516``).
+    """
+    src = target_flat if topo.fft_use_target else self_flat
+    coeffs = coefficients(topo, src)
+    new_coeffs = forward(topo, self_flat, coeffs[None, :])[0]
+    new_flat = jnp.fft.ifft(new_coeffs, n=topo.num_weights).real.astype(target_flat.dtype)
+    if topo.shuffler == "random":
+        if key is None:
+            raise ValueError("shuffler='random' requires a PRNG key")
+        new_flat = jax.random.permutation(key, new_flat)
+    return new_flat
+
+
+def samples(topo: Topology, flat: jnp.ndarray):
+    """x = y = the (1, k) coefficient vector.
+
+    Deliberate deviation: the reference's ``compute_samples``
+    (``network.py:518-521``) builds ``np.asarray(list_of_ragged_kernels)``,
+    which produces an object array that keras cannot fit — dead-on-arrival
+    code (the repo's own ``fixpoint-density.py:34-35`` notes "FFT doesn't
+    work though").  Training on the coefficient vector is the consistent
+    analog of the aggregating variant's aggregate-space self-training.
+    """
+    coeffs = coefficients(topo, flat)[None, :]
+    return coeffs, coeffs

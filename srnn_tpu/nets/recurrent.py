@@ -1,0 +1,55 @@
+"""Recurrent variant: a SimpleRNN stack consuming the weight vector as a
+length-P sequence.
+
+Reference: ``RecurrentNeuralNetwork`` (``network.py:524-574``).  The target's
+flat weights become a (T=P, features=1) sequence; the stack (units=width per
+layer, final layer units=1, ``return_sequences=True`` everywhere,
+``network.py:526-535``) maps it to a new length-P sequence written back
+positionally.
+
+TPU-native form: one ``lax.scan`` per RNN layer over the time axis.  The
+per-step recurrence is sequential by nature; for long sequences the
+context-parallel ring decomposition lives in ``srnn_tpu.parallel.ring_rnn``.
+Note keras' SimpleRNN state update is h_t = act(x_t @ K + h_{t-1} @ R) with
+no bias here; the reference's ``keras_params`` (activation='linear',
+use_bias=False, ``network.py:80``) applies to every layer.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.activations import resolve_activation
+from ..ops.flatten import unflatten
+from ..ops.linalg import matmul
+from ..topology import Topology
+
+
+def forward(topo: Topology, self_flat: jnp.ndarray, seq: jnp.ndarray) -> jnp.ndarray:
+    """Run the stacked RNN over seq (T, 1) -> (T, 1)."""
+    act = resolve_activation(topo.activation)
+    mats = unflatten(topo, self_flat)
+    x = seq
+    for layer, (_, units) in enumerate(topo.rnn_layer_dims):
+        kernel, recurrent = mats[2 * layer], mats[2 * layer + 1]
+
+        def step(h, xt, kernel=kernel, recurrent=recurrent, act=act):
+            h_new = act(matmul(topo, xt, kernel) + matmul(topo, h, recurrent))
+            return h_new, h_new
+
+        h0 = jnp.zeros((units,), dtype=seq.dtype)
+        _, x = jax.lax.scan(step, h0, x)
+    return x
+
+
+def apply(topo: Topology, self_flat: jnp.ndarray, target_flat: jnp.ndarray,
+          key=None) -> jnp.ndarray:
+    """One predict over the whole weight sequence (``network.py:544-564``)."""
+    del key
+    return forward(topo, self_flat, target_flat[:, None])[:, 0]
+
+
+def samples(topo: Topology, flat: jnp.ndarray):
+    """x = y = the (1, T, 1) weight sequence (``compute_samples``,
+    ``network.py:566-574``)."""
+    seq = flat[None, :, None]
+    return seq, seq

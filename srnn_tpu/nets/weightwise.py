@@ -1,0 +1,62 @@
+"""Weightwise variant: an MLP f: R^4 -> R^1 applied once per scalar weight.
+
+Reference: ``WeightwiseNeuralNetwork`` (``network.py:213-289``).  There, each
+weight produces a point ``[w, layer_id, cell_id, weight_id]`` (ids normalized
+per ``normalize_id``) and is rewritten by **one ``model.predict`` call per
+scalar** — the dominant cost of the whole reference codebase (SURVEY §3.1).
+
+TPU-native form: the (P, 3) normalized-coordinate table is a trace-time
+constant; self-application is ONE batched forward over all P points, which
+vmaps across particles into a single ``(N*P, 4) @ ...`` matmul chain on the
+MXU.
+"""
+
+import jax.numpy as jnp
+
+from ..ops.activations import resolve_activation
+from ..ops.flatten import unflatten
+from ..ops.linalg import matmul
+from ..topology import Topology, normalized_weight_coords
+
+
+def forward(topo: Topology, self_flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Batched MLP forward: x (..., 4) -> (..., 1).
+
+    The activation applies after *every* layer (keras builds each Dense with
+    the same ``keras_params``, ``network.py:226-230``).
+    """
+    act = resolve_activation(topo.activation)
+    h = x
+    for m in unflatten(topo, self_flat):
+        h = act(matmul(topo, h, m))
+    return h
+
+
+def points(topo: Topology, target_flat: jnp.ndarray) -> jnp.ndarray:
+    """Normalized duplex weight points (P, 4): [w, layer, cell, weight].
+
+    Matches ``compute_all_duplex_weight_points`` (``network.py:239-255``).
+    """
+    coords = jnp.asarray(normalized_weight_coords(topo), dtype=target_flat.dtype)
+    return jnp.concatenate([target_flat[:, None], coords], axis=1)
+
+
+def apply(topo: Topology, self_flat: jnp.ndarray, target_flat: jnp.ndarray,
+          key=None) -> jnp.ndarray:
+    """Self-application: rewrite every target weight via the net.
+
+    Equivalent of ``apply_to_weights`` (``network.py:265-279``) minus the
+    per-scalar predict loop.
+    """
+    del key
+    return forward(topo, self_flat, points(topo, target_flat))[:, 0]
+
+
+def samples(topo: Topology, flat: jnp.ndarray):
+    """Training pairs: x = all normalized points, y = current weights.
+
+    ``compute_samples`` (``network.py:281-289``) — regressing your own
+    weights is "learn to be a fixpoint".
+    """
+    x = points(topo, flat)
+    return x, flat

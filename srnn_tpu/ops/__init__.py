@@ -1,0 +1,30 @@
+from .activations import resolve_activation
+from .flatten import unflatten, flatten_mats
+from .predicates import (
+    is_diverged,
+    is_zero,
+    is_fixpoint,
+    classify,
+    CLASS_NAMES,
+    CLS_DIVERGENT,
+    CLS_FIX_ZERO,
+    CLS_FIX_OTHER,
+    CLS_FIX_SEC,
+    CLS_OTHER,
+)
+
+__all__ = [
+    "resolve_activation",
+    "unflatten",
+    "flatten_mats",
+    "is_diverged",
+    "is_zero",
+    "is_fixpoint",
+    "classify",
+    "CLASS_NAMES",
+    "CLS_DIVERGENT",
+    "CLS_FIX_ZERO",
+    "CLS_FIX_OTHER",
+    "CLS_FIX_SEC",
+    "CLS_OTHER",
+]

@@ -1,0 +1,32 @@
+"""Activation registry matching keras activation-string semantics
+(reference passes activation names through ``keras_params``, ``network.py:80``)."""
+
+import jax.numpy as jnp
+import jax.nn
+
+
+def _linear(x):
+    return x
+
+
+_ACTIVATIONS = {
+    "linear": _linear,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "elu": jax.nn.elu,
+    "softmax": jax.nn.softmax,
+    "swish": jax.nn.swish,
+    "gelu": jax.nn.gelu,
+}
+
+
+def resolve_activation(name):
+    if callable(name):
+        return name
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}"
+        ) from None

@@ -1,0 +1,34 @@
+"""Flat-vector <-> kernel-matrix conversion.
+
+The reference keeps weights as keras' list of 2-D kernels and flattens with
+``np.hstack([w.flatten() for w in weights])`` (``network.py:103-104``); its
+``fill_weights`` writes a flat list back in layer -> row -> column order
+(``network.py:64-74``).  Here the flat ``(P,)`` vector *is* the canonical
+representation and these helpers materialize the per-layer matrix views
+inside jitted transforms.  Slicing uses static offsets so XLA sees fixed
+shapes.
+"""
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ..topology import Topology
+
+
+def unflatten(topo: Topology, flat: jnp.ndarray) -> List[jnp.ndarray]:
+    """Split a ``(P,)`` (or ``(..., P)``) vector into kernel matrices.
+
+    Row-major reshape reproduces the reference's layer->cell->weight
+    enumeration (``network.py:64-74``).
+    """
+    mats = []
+    for (a, b), start in zip(topo.layer_shapes, topo.offsets):
+        mats.append(flat[..., start : start + a * b].reshape(*flat.shape[:-1], a, b))
+    return mats
+
+
+def flatten_mats(mats: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Inverse of :func:`unflatten` (``get_weights_flat``, ``network.py:103-104``)."""
+    lead = mats[0].shape[:-2]
+    return jnp.concatenate([m.reshape(*lead, -1) for m in mats], axis=-1)

@@ -1,0 +1,21 @@
+"""Precision-aware matmul used by every variant forward.
+
+Tiny self-replicating nets operate at epsilon=1e-4 fixpoint resolution
+(reference overrides, e.g. ``training-fixpoints.py:38``); default TPU bf16
+matmul passes introduce ~3e-3 error at unit scale, which would flip fixpoint
+predicates.  All transforms therefore default to f32 accumulation
+(``Topology.precision='highest'``).
+"""
+
+import jax.lax
+import jax.numpy as jnp
+
+_PRECISIONS = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+}
+
+
+def matmul(topo, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.matmul(a, b, precision=_PRECISIONS[topo.precision])

@@ -1,0 +1,94 @@
+"""Jittable fixpoint predicates and the 5-way classification.
+
+Semantics tracked from the reference:
+  - ``are_weights_diverged``: any NaN/Inf anywhere      (``network.py:43-52``)
+  - ``is_zero``: every weight within [-eps, +eps], *inclusive* bounds
+    (``network.py:54-62,136-138``); NaN weights are never "zero" because the
+    chained comparison fails.
+  - ``is_fixpoint(degree)``: apply the net ``degree`` times to its own
+    weights; False if the result diverged, else True iff every
+    ``|new - old| < eps`` (strict — a delta of exactly eps fails)
+    (``network.py:140-157``).
+  - classification order: divergent > fix_zero > fix_other > fix_sec > other
+    (``experiment.py:79-91``, duplicated at ``soup.py:89-103``).
+
+All functions are branchless array ops so they vmap/shard cleanly.
+"""
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+CLASS_NAMES = ("divergent", "fix_zero", "fix_other", "fix_sec", "other")
+CLS_DIVERGENT, CLS_FIX_ZERO, CLS_FIX_OTHER, CLS_FIX_SEC, CLS_OTHER = range(5)
+
+DEFAULT_EPSILON = 1e-4  # every reference experiment overrides the 1e-14
+                        # constructor default to 1e-4 (e.g. training-fixpoints.py:38)
+
+
+def is_diverged(flat: jnp.ndarray) -> jnp.ndarray:
+    """True if any weight is NaN or +-Inf. Reduces over the last axis."""
+    return jnp.any(~jnp.isfinite(flat), axis=-1)
+
+
+def is_zero(flat: jnp.ndarray, epsilon: float = DEFAULT_EPSILON) -> jnp.ndarray:
+    """True if all weights lie in the closed interval [-eps, eps]."""
+    return jnp.all((flat >= -epsilon) & (flat <= epsilon), axis=-1)
+
+
+def is_fixpoint(
+    apply_self: Callable[[jnp.ndarray], jnp.ndarray],
+    flat: jnp.ndarray,
+    degree: int = 1,
+    epsilon: float = DEFAULT_EPSILON,
+) -> jnp.ndarray:
+    """Degree-d fixpoint test for a single flat weight vector.
+
+    ``apply_self`` must be the net's self-application with its *own* weights
+    bound, i.e. ``target -> f_w(target)``; it is iterated ``degree`` times
+    starting from ``flat`` while the net itself stays fixed
+    (``network.py:140-157``).
+    """
+    assert degree >= 1, "degree must be >= 1"
+    new = flat
+    for _ in range(degree):
+        new = apply_self(new)
+    close = jnp.all(jnp.abs(new - flat) < epsilon, axis=-1)
+    return ~is_diverged(new) & close
+
+
+def classify(
+    apply_self: Callable[[jnp.ndarray], jnp.ndarray],
+    flat: jnp.ndarray,
+    epsilon: float = DEFAULT_EPSILON,
+) -> jnp.ndarray:
+    """5-way class id for one particle (int32 scalar).
+
+    Evaluates both degree-1 and degree-2 applications once and resolves the
+    reference's elif-chain as nested ``where`` so the whole thing stays
+    branchless and vmappable.
+    """
+    new1 = apply_self(flat)
+    new2 = apply_self(new1)
+    div = is_diverged(flat)
+    fix1 = ~is_diverged(new1) & jnp.all(jnp.abs(new1 - flat) < epsilon, axis=-1)
+    fix2 = ~is_diverged(new2) & jnp.all(jnp.abs(new2 - flat) < epsilon, axis=-1)
+    zero = is_zero(flat, epsilon)
+    return jnp.where(
+        div,
+        CLS_DIVERGENT,
+        jnp.where(
+            fix1 & zero,
+            CLS_FIX_ZERO,
+            jnp.where(fix1, CLS_FIX_OTHER, jnp.where(fix2, CLS_FIX_SEC, CLS_OTHER)),
+        ),
+    ).astype(jnp.int32)
+
+
+def count_classes(class_ids: jnp.ndarray) -> jnp.ndarray:
+    """Histogram of class ids -> (5,) int32 counter vector.
+
+    The array analog of the reference's counter dicts
+    (``experiment.py:67``, ``soup.py:90``).
+    """
+    return (class_ids[..., None] == jnp.arange(5)).sum(axis=tuple(range(class_ids.ndim))).astype(jnp.int32)

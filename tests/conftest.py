@@ -1,0 +1,16 @@
+"""Test env: force an 8-device virtual CPU platform so sharding/mesh logic is
+exercised without TPU hardware (SURVEY §4 implication (c)).  Must run before
+jax initializes its backends, hence top of conftest."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env presets a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

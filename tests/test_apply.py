@@ -1,0 +1,238 @@
+"""Golden tests for the four self-application transforms against hand-rolled
+numpy implementations of the reference semantics (network.py:265-279, 359-386,
+494-516, 544-564)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology, apply_to_weights, init_flat
+from srnn_tpu.nets import aggregating, fft, recurrent, weightwise
+from srnn_tpu.ops.flatten import flatten_mats, unflatten
+from srnn_tpu.topology import aggregation_segments, normalized_weight_coords
+
+WW = Topology("weightwise", width=2, depth=2)
+
+
+def identity_fixpoint_flat():
+    """The analytically-known identity fixpoint for the linear weightwise net
+    (known-fixpoint-variation.py:20-25): kernels [[1,0],...] selecting the
+    weight feature straight through."""
+    mats = [
+        np.array([[1.0, 0.0], [0, 0], [0, 0], [0, 0]], np.float32),
+        np.array([[1.0, 0.0], [0, 0]], np.float32),
+        np.array([[1.0], [0.0]], np.float32),
+    ]
+    return np.concatenate([m.ravel() for m in mats])
+
+
+def np_mlp(mats, x, act=lambda v: v):
+    h = x
+    for m in mats:
+        h = act(h @ m)
+    return h
+
+
+# ---------------------------------------------------------------- weightwise
+
+def test_ww_identity_is_exact_fixpoint():
+    w = jnp.asarray(identity_fixpoint_flat())
+    out = apply_to_weights(WW, w, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(w), atol=0)
+
+
+def test_ww_identity_maps_any_target_to_itself():
+    w = jnp.asarray(identity_fixpoint_flat())
+    tgt = jnp.asarray(np.random.default_rng(0).normal(size=14).astype(np.float32))
+    out = apply_to_weights(WW, w, tgt)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(tgt), rtol=1e-6)
+
+
+def test_ww_apply_matches_numpy_reference():
+    rng = np.random.default_rng(1)
+    self_flat = rng.normal(size=14).astype(np.float32)
+    target = rng.normal(size=14).astype(np.float32)
+    coords = normalized_weight_coords(WW)
+    x = np.concatenate([target[:, None], coords], axis=1)
+    mats = [np.asarray(m) for m in unflatten(WW, jnp.asarray(self_flat))]
+    expected = np_mlp(mats, x)[:, 0]
+    got = apply_to_weights(WW, jnp.asarray(self_flat), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5)
+
+
+def test_ww_apply_sigmoid():
+    topo = WW.with_(activation="sigmoid")
+    rng = np.random.default_rng(2)
+    self_flat = rng.normal(size=14).astype(np.float32)
+    target = rng.normal(size=14).astype(np.float32)
+    coords = normalized_weight_coords(topo)
+    x = np.concatenate([target[:, None], coords], axis=1)
+    mats = [np.asarray(m) for m in unflatten(topo, jnp.asarray(self_flat))]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    expected = np_mlp(mats, x, sig)[:, 0]
+    got = apply_to_weights(topo, jnp.asarray(self_flat), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5)
+
+
+# --------------------------------------------------------------- aggregating
+
+AGG = Topology("aggregating", width=2, depth=2, aggregates=4)
+
+
+def test_agg_apply_matches_numpy_reference():
+    rng = np.random.default_rng(3)
+    p = AGG.num_weights
+    self_flat = rng.normal(size=p).astype(np.float32)
+    target = rng.normal(size=p).astype(np.float32)
+    seg, counts = aggregation_segments(AGG)
+    aggs = np.array([target[seg == s].mean() for s in range(4)], np.float32)
+    mats = [np.asarray(m) for m in unflatten(AGG, jnp.asarray(self_flat))]
+    new_aggs = np_mlp(mats, aggs[None, :])[0]
+    expected = new_aggs[seg]
+    got = apply_to_weights(AGG, jnp.asarray(self_flat), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5)
+
+
+def test_agg_leftovers_go_to_last_collection():
+    topo = Topology("aggregating", width=2, depth=2, aggregates=3)  # P=16
+    rng = np.random.default_rng(4)
+    target = rng.normal(size=16).astype(np.float32)
+    aggs = aggregating.aggregate(topo, jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(aggs)[2], target[10:].mean(), rtol=1e-6)
+
+
+def test_agg_max_aggregators():
+    topo = AGG.with_(aggregator="max")
+    vals = np.arange(20, dtype=np.float32) - 10.0
+    aggs = aggregating.aggregate(topo, jnp.asarray(vals))
+    np.testing.assert_allclose(np.asarray(aggs), [-6, -1, 4, 9])
+
+    # buggy max: a zero candidate never replaces the running max
+    topo_b = AGG.with_(aggregator="max_buggy")
+    vals = np.full(20, -5.0, np.float32)
+    vals[7] = 0.0  # true max of collection 1 is 0.0 but starts at -5
+    aggs_true = aggregating.aggregate(topo, jnp.asarray(vals))
+    aggs_bug = aggregating.aggregate(topo_b, jnp.asarray(vals))
+    assert np.asarray(aggs_true)[1] == 0.0
+    assert np.asarray(aggs_bug)[1] == -5.0
+
+
+def test_agg_shuffle_random_is_permutation():
+    topo = AGG.with_(shuffler="random")
+    rng = np.random.default_rng(5)
+    self_flat = jnp.asarray(rng.normal(size=20).astype(np.float32))
+    target = jnp.asarray(rng.normal(size=20).astype(np.float32))
+    base = apply_to_weights(AGG, self_flat, target)
+    shuf = apply_to_weights(topo, self_flat, target, key=jax.random.key(0))
+    assert sorted(np.asarray(base).tolist()) == pytest.approx(
+        sorted(np.asarray(shuf).tolist()))
+
+
+# ----------------------------------------------------------------------- fft
+
+FFT = Topology("fft", width=2, depth=2, aggregates=4)
+
+
+def test_fft_apply_matches_numpy_reference():
+    rng = np.random.default_rng(6)
+    p = FFT.num_weights
+    self_flat = rng.normal(size=p).astype(np.float32)
+    target = rng.normal(size=p).astype(np.float32)
+    coeffs = np.fft.fft(self_flat, n=4).real.astype(np.float32)  # quirk: self, not target
+    mats = [np.asarray(m) for m in unflatten(FFT, jnp.asarray(self_flat))]
+    new_coeffs = np_mlp(mats, coeffs[None, :])[0]
+    expected = np.fft.ifft(new_coeffs, n=p).real
+    got = apply_to_weights(FFT, jnp.asarray(self_flat), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-6)
+
+
+def test_fft_quirk_ignores_target_by_default():
+    rng = np.random.default_rng(7)
+    p = FFT.num_weights
+    self_flat = jnp.asarray(rng.normal(size=p).astype(np.float32))
+    t1 = jnp.asarray(rng.normal(size=p).astype(np.float32))
+    t2 = jnp.asarray(rng.normal(size=p).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(apply_to_weights(FFT, self_flat, t1)),
+        np.asarray(apply_to_weights(FFT, self_flat, t2)))
+    fixed = FFT.with_(fft_use_target=True)
+    assert not np.allclose(
+        np.asarray(apply_to_weights(fixed, self_flat, t1)),
+        np.asarray(apply_to_weights(fixed, self_flat, t2)))
+
+
+# ----------------------------------------------------------------- recurrent
+
+RNN = Topology("recurrent", width=2, depth=2)
+
+
+def np_rnn(mats, dims, seq, act=lambda v: v):
+    x = seq
+    for layer, (_, units) in enumerate(dims):
+        k, r = np.asarray(mats[2 * layer]), np.asarray(mats[2 * layer + 1])
+        h = np.zeros(units, dtype=seq.dtype)
+        outs = []
+        for t in range(x.shape[0]):
+            h = act(x[t] @ k + h @ r)
+            outs.append(h)
+        x = np.stack(outs)
+    return x
+
+
+def test_rnn_apply_matches_numpy_reference():
+    rng = np.random.default_rng(8)
+    p = RNN.num_weights
+    self_flat = rng.normal(size=p).astype(np.float32) * 0.3
+    target = rng.normal(size=p).astype(np.float32)
+    mats = unflatten(RNN, jnp.asarray(self_flat))
+    expected = np_rnn(mats, RNN.rnn_layer_dims, target[:, None])[:, 0]
+    got = apply_to_weights(RNN, jnp.asarray(self_flat), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-6)
+
+
+def test_rnn_apply_tanh():
+    topo = RNN.with_(activation="tanh")
+    rng = np.random.default_rng(9)
+    p = topo.num_weights
+    self_flat = rng.normal(size=p).astype(np.float32) * 0.3
+    target = rng.normal(size=p).astype(np.float32)
+    mats = unflatten(topo, jnp.asarray(self_flat))
+    expected = np_rnn(mats, topo.rnn_layer_dims, target[:, None], np.tanh)[:, 0]
+    got = apply_to_weights(topo, jnp.asarray(self_flat), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------------------- generic
+
+@pytest.mark.parametrize("topo", [WW, AGG, FFT, RNN])
+def test_apply_is_jittable_and_vmappable(topo):
+    n = 5
+    keys = jax.random.split(jax.random.key(0), n)
+    pop = jax.vmap(lambda k: init_flat(topo, k))(keys)
+    fn = jax.jit(jax.vmap(lambda s: apply_to_weights(topo, s, s)))
+    out = fn(pop)
+    assert out.shape == (n, topo.num_weights)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+@pytest.mark.parametrize("topo", [WW, AGG, FFT, RNN])
+def test_init_shapes_and_finiteness(topo):
+    flat = init_flat(topo, jax.random.key(1))
+    assert flat.shape == (topo.num_weights,)
+    assert np.all(np.isfinite(np.asarray(flat)))
+
+
+def test_init_recurrent_kernels_orthogonal():
+    topo = Topology("recurrent", width=8, depth=2)
+    flat = init_flat(topo, jax.random.key(2))
+    mats = unflatten(topo, flat)
+    r = np.asarray(mats[1])  # first recurrent kernel (8,8)
+    np.testing.assert_allclose(r @ r.T, np.eye(8), atol=1e-5)
+
+
+def test_init_glorot_bounds():
+    flat = np.asarray(init_flat(WW, jax.random.key(3)))
+    mats = unflatten(WW, jnp.asarray(flat))
+    m0 = np.asarray(mats[0])  # (4,2): limit sqrt(6/6)=1
+    assert np.all(np.abs(m0) <= 1.0)

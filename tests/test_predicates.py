@@ -1,0 +1,79 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srnn_tpu import Topology, apply_to_weights, classify, is_diverged, is_fixpoint, is_zero
+from srnn_tpu.ops.predicates import (
+    CLS_DIVERGENT,
+    CLS_FIX_OTHER,
+    CLS_FIX_ZERO,
+    CLS_OTHER,
+    count_classes,
+)
+from tests.test_apply import WW, identity_fixpoint_flat
+
+
+def self_apply(topo, flat_self):
+    return functools.partial(apply_to_weights, topo, flat_self)
+
+
+def test_is_diverged():
+    w = jnp.ones(14)
+    assert not bool(is_diverged(w))
+    assert bool(is_diverged(w.at[3].set(jnp.nan)))
+    assert bool(is_diverged(w.at[3].set(jnp.inf)))
+    assert bool(is_diverged(w.at[3].set(-jnp.inf)))
+
+
+def test_is_zero_inclusive_bounds():
+    eps = 1e-4
+    w = jnp.full(14, eps)  # exactly eps is still "zero" (<= bound)
+    assert bool(is_zero(w, eps))
+    assert not bool(is_zero(w.at[0].set(eps * 1.01), eps))
+    assert not bool(is_zero(w.at[0].set(jnp.nan), eps))
+
+
+def test_identity_is_fixpoint():
+    w = jnp.asarray(identity_fixpoint_flat())
+    f = self_apply(WW, w)
+    assert bool(is_fixpoint(f, w))
+    assert bool(is_fixpoint(f, w, degree=2))
+
+
+def test_is_fixpoint_strict_epsilon():
+    # zero weights under linear WW map to exactly zero -> fixpoint
+    w = jnp.zeros(14)
+    f = self_apply(WW, w)
+    assert bool(is_fixpoint(f, w, epsilon=1e-10))
+
+
+def test_classify_basic_classes():
+    eps = 1e-4
+    ident = jnp.asarray(identity_fixpoint_flat())
+    assert int(classify(self_apply(WW, ident), ident, eps)) == CLS_FIX_OTHER
+
+    zero = jnp.zeros(14)
+    assert int(classify(self_apply(WW, zero), zero, eps)) == CLS_FIX_ZERO
+
+    nanw = zero.at[0].set(jnp.nan)
+    assert int(classify(self_apply(WW, nanw), nanw, eps)) == CLS_DIVERGENT
+
+    # a generic random net is almost surely not a fixpoint
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=14).astype(np.float32))
+    assert int(classify(self_apply(WW, w), w, eps)) in (CLS_OTHER, CLS_DIVERGENT)
+
+
+def test_classify_vmapped_and_counts():
+    ident = jnp.asarray(identity_fixpoint_flat())
+    pop = jnp.stack([ident, jnp.zeros(14), jnp.full(14, jnp.nan)])
+
+    def cls(w):
+        return classify(self_apply(WW, w), w, 1e-4)
+
+    ids = jax.vmap(cls)(pop)
+    assert ids.tolist() == [CLS_FIX_OTHER, CLS_FIX_ZERO, CLS_DIVERGENT]
+    counts = count_classes(ids)
+    assert counts.tolist() == [1, 1, 1, 0, 0]

@@ -1,0 +1,83 @@
+import numpy as np
+import pytest
+
+from srnn_tpu.topology import (
+    Topology,
+    aggregation_segments,
+    normalized_weight_coords,
+    weight_coords,
+)
+
+
+def test_weightwise_shapes():
+    t = Topology("weightwise", width=2, depth=2)
+    assert t.layer_shapes == ((4, 2), (2, 2), (2, 1))
+    assert t.num_weights == 14
+    assert t.offsets == (0, 8, 12, 14)
+
+
+def test_aggregating_shapes():
+    t = Topology("aggregating", width=2, depth=2, aggregates=4)
+    assert t.layer_shapes == ((4, 2), (2, 2), (2, 4))
+    assert t.num_weights == 20
+
+
+def test_recurrent_shapes():
+    # SimpleRNN(2) -> SimpleRNN(2) -> SimpleRNN(1), each with (kernel, recurrent)
+    t = Topology("recurrent", width=2, depth=2)
+    assert t.layer_shapes == ((1, 2), (2, 2), (2, 2), (2, 2), (2, 1), (1, 1))
+    assert t.num_weights == 17
+    assert t.rnn_layer_dims == ((1, 2), (2, 2), (2, 1))
+
+
+def test_unknown_variant_rejected():
+    with pytest.raises(ValueError):
+        Topology("banana")
+
+
+def test_weight_coords_enumeration_order():
+    t = Topology("weightwise", width=2, depth=2)
+    c = weight_coords(t)
+    assert c.shape == (14, 3)
+    # first kernel (4,2): layer 0, cells 0..3, weights 0..1, row-major
+    assert c[0].tolist() == [0, 0, 0]
+    assert c[1].tolist() == [0, 0, 1]
+    assert c[2].tolist() == [0, 1, 0]
+    assert c[7].tolist() == [0, 3, 1]
+    # second kernel starts at flat index 8
+    assert c[8].tolist() == [1, 0, 0]
+    # last kernel (2,1)
+    assert c[12].tolist() == [2, 0, 0]
+    assert c[13].tolist() == [2, 1, 0]
+
+
+def test_normalized_coords_match_reference_rule():
+    # normalize_id divides only when the max id > 1 (network.py:215-220)
+    t = Topology("weightwise", width=2, depth=2)
+    n = normalized_weight_coords(t)
+    # layer ids: max 2 -> divided by 2
+    assert n[0, 0] == 0.0 and n[8, 0] == pytest.approx(0.5) and n[12, 0] == 1.0
+    # layer0 cells: max 3 -> divided by 3
+    assert n[2, 1] == pytest.approx(1 / 3)
+    assert n[7, 1] == 1.0
+    # layer0 weight ids: max 1 -> NOT divided (norm=1 fails `norm > 1`)
+    assert n[1, 2] == 1.0
+    # layer2 (2,1): weight id max 0 -> raw 0
+    assert n[12, 2] == 0.0
+
+
+def test_aggregation_segments_leftover_rule():
+    # P=16 with k=3: size 5, leftover 1 appended to LAST collection
+    t = Topology("aggregating", width=2, depth=2, aggregates=3)
+    assert t.num_weights == 16
+    seg, counts = aggregation_segments(t)
+    assert counts.tolist() == [5, 5, 6]
+    assert seg[:5].tolist() == [0] * 5
+    assert seg[-6:].tolist() == [2] * 6
+
+
+def test_aggregation_segments_exact_division():
+    t = Topology("aggregating", width=2, depth=2, aggregates=4)
+    seg, counts = aggregation_segments(t)
+    assert counts.tolist() == [5, 5, 5, 5]
+    assert seg.tolist() == sorted(seg.tolist())
