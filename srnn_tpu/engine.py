@@ -1,0 +1,181 @@
+"""Vectorized experiment engines.
+
+The reference drives each net through a Python while-loop, one at a time
+(``FixpointExperiment.run_net``, ``experiment.py:70-77``;
+``MixedFixpointExperiment.run_net``, ``experiment.py:94-109``;
+``known-fixpoint-variation.py:66-87``).  Here a whole population of trials
+runs as ONE ``lax.scan`` with per-trial active masks — the while-loop's
+early-exit becomes a mask update, so every trial retires at exactly the
+same step it would have in the reference while the batch stays static-shaped
+for XLA.
+
+All engines return plain pytrees of arrays; persistence/logging lives in
+``srnn_tpu.experiment`` (the runtime layer), not here.
+"""
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .nets import apply_to_weights
+from .ops.predicates import DEFAULT_EPSILON, classify, count_classes, is_diverged, is_fixpoint, is_zero
+from .topology import Topology
+from .train import DEFAULT_LR, train_step
+
+
+class FixpointRunResult(NamedTuple):
+    weights: jnp.ndarray      # (N, P) final weights
+    steps: jnp.ndarray        # (N,) self-attacks actually executed per trial
+    classes: jnp.ndarray      # (N,) 5-way class ids
+    counts: jnp.ndarray       # (5,) class histogram
+    trajectory: Optional[jnp.ndarray]  # (steps+1, N, P) weight history or None
+
+
+def _apply_self_batch(topo: Topology, w: jnp.ndarray) -> jnp.ndarray:
+    """vmapped self-application: each row applied to itself."""
+    return jax.vmap(lambda wi: apply_to_weights(topo, wi, wi))(w)
+
+
+def _is_fixpoint_batch(topo: Topology, w: jnp.ndarray, epsilon: float) -> jnp.ndarray:
+    return jax.vmap(
+        lambda wi: is_fixpoint(functools.partial(apply_to_weights, topo, wi), wi, 1, epsilon)
+    )(w)
+
+
+def classify_batch(topo: Topology, w: jnp.ndarray, epsilon: float = DEFAULT_EPSILON) -> jnp.ndarray:
+    """(N, P) -> (N,) class ids (the reference's ``count``, ``experiment.py:79-91``)."""
+    return jax.vmap(
+        lambda wi: classify(functools.partial(apply_to_weights, topo, wi), wi, epsilon)
+    )(w)
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "step_limit", "record"))
+def run_fixpoint(
+    topo: Topology,
+    pop: jnp.ndarray,
+    step_limit: int = 100,
+    epsilon: float = DEFAULT_EPSILON,
+    record: bool = False,
+) -> FixpointRunResult:
+    """Pure self-application to fixpoint, vectorized over trials.
+
+    Per reference ``run_net`` (``experiment.py:70-77``): while under the step
+    limit and neither diverged nor a (degree-1) fixpoint, self-attack.  The
+    predicates are evaluated at the top of every iteration, exactly as the
+    reference does.
+    """
+
+    def step(carry, _):
+        w, steps = carry
+        active = ~is_diverged(w) & ~_is_fixpoint_batch(topo, w, epsilon)
+        new_w = jnp.where(active[:, None], _apply_self_batch(topo, w), w)
+        out = new_w if record else None
+        return (new_w, steps + active), out
+
+    (w, steps), traj = jax.lax.scan(step, (pop, jnp.zeros(pop.shape[0], jnp.int32)),
+                                    None, length=step_limit)
+    classes = classify_batch(topo, w, epsilon)
+    trajectory = jnp.concatenate([pop[None], traj], axis=0) if record else None
+    return FixpointRunResult(w, steps, classes, count_classes(classes), trajectory)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("topo", "trains_per_application", "step_limit", "train_mode", "record"),
+)
+def run_mixed_fixpoint(
+    topo: Topology,
+    pop: jnp.ndarray,
+    trains_per_application: int = 100,
+    step_limit: int = 100,
+    epsilon: float = DEFAULT_EPSILON,
+    lr: float = DEFAULT_LR,
+    train_mode: str = "sequential",
+    record: bool = False,
+) -> FixpointRunResult:
+    """Interleaved self-attack + self-training
+    (``MixedFixpointExperiment.run_net``, ``experiment.py:94-109``):
+    each outer step is one self-attack followed by ``trains_per_application``
+    train epochs, gated by the same diverged/fixpoint mask."""
+
+    def train_n(w):
+        def one(wi):
+            def body(x, _):
+                new_x, loss = train_step(topo, x, lr, train_mode)
+                return new_x, loss
+            out, losses = jax.lax.scan(body, wi, None, length=trains_per_application)
+            return out, losses[-1] if trains_per_application else jnp.float32(0)
+        return jax.vmap(one)(w)
+
+    def step(carry, _):
+        w, steps, loss = carry
+        active = ~is_diverged(w) & ~_is_fixpoint_batch(topo, w, epsilon)
+        attacked = _apply_self_batch(topo, w)
+        trained, new_loss = train_n(attacked) if trains_per_application else (attacked, loss)
+        new_w = jnp.where(active[:, None], trained, w)
+        out = new_w if record else None
+        return (new_w, steps + active, jnp.where(active, new_loss, loss)), out
+
+    n = pop.shape[0]
+    init = (pop, jnp.zeros(n, jnp.int32), jnp.zeros(n, pop.dtype))
+    (w, steps, _), traj = jax.lax.scan(step, init, None, length=step_limit)
+    classes = classify_batch(topo, w, epsilon)
+    trajectory = jnp.concatenate([pop[None], traj], axis=0) if record else None
+    return FixpointRunResult(w, steps, classes, count_classes(classes), trajectory)
+
+
+class VariationResult(NamedTuple):
+    time_to_vergence: jnp.ndarray   # (N,) steps until zero/divergence (or max)
+    time_as_fixpoint: jnp.ndarray   # (N,) steps still classified as the initial fixpoint
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "max_steps"))
+def run_known_fixpoint_variation(
+    topo: Topology,
+    pop: jnp.ndarray,
+    max_steps: int = 100,
+    epsilon: float = DEFAULT_EPSILON,
+) -> VariationResult:
+    """Perturbed-fixpoint decay measurement (``known-fixpoint-variation.py:66-87``).
+
+    Per trial: self-attack up to ``max_steps``; break on zero/divergence;
+    count ``time_to_something`` (steps before vergence) and
+    ``time_as_fixpoint`` (steps counted only while the ``still_fixpoint``
+    flag holds, with the reference's silent re-entry behavior preserved).
+    """
+
+    def step(carry, _):
+        w, alive, still_fix, t_some, t_fix = carry
+        new_w = jnp.where(alive[:, None], _apply_self_batch(topo, w), w)
+        verged = is_zero(new_w, epsilon) | is_diverged(new_w)
+        # predicates evaluated on the post-attack net, as in the reference
+        fix_now = _is_fixpoint_batch(topo, new_w, epsilon)
+        counted = alive & ~verged
+        t_fix = t_fix + (counted & fix_now & still_fix)
+        # reference flag algebra collapses to: after a counted step the flag
+        # equals fix_now (re-entry sets it True without counting, loss of
+        # fixpointness clears it; 'remarkable' logging is handled upstream)
+        still_fix = jnp.where(counted, fix_now, still_fix)
+        t_some = t_some + counted
+        alive = alive & ~verged
+        return (new_w, alive, still_fix, t_some, t_fix), None
+
+    n = pop.shape[0]
+    init = (
+        pop,
+        jnp.ones(n, bool),
+        jnp.ones(n, bool),  # starts True: the unperturbed net is the known fixpoint
+        jnp.zeros(n, jnp.int32),
+        jnp.zeros(n, jnp.int32),
+    )
+    (w, alive, still_fix, t_some, t_fix), _ = jax.lax.scan(step, init, None, length=max_steps)
+    return VariationResult(t_some, t_fix)
+
+
+@functools.partial(jax.jit, static_argnames=("topo",))
+def fixpoint_density(topo: Topology, pop: jnp.ndarray, epsilon: float = DEFAULT_EPSILON) -> jnp.ndarray:
+    """Immediate classification of freshly-initialized nets, no dynamics
+    (``fixpoint-density.py``). Returns the (5,) class histogram."""
+    return count_classes(classify_batch(topo, pop, epsilon))

@@ -19,9 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.activations import resolve_activation
-from ..ops.flatten import unflatten
 from ..ops.linalg import matmul
+from ..ops.mlp import mlp_forward
 from ..topology import Topology, aggregation_segments
 
 
@@ -65,12 +64,8 @@ def aggregate(topo: Topology, target_flat: jnp.ndarray) -> jnp.ndarray:
 
 
 def forward(topo: Topology, self_flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
-    """MLP forward (..., k) -> (..., k); activation after every layer."""
-    act = resolve_activation(topo.activation)
-    h = x
-    for m in unflatten(topo, self_flat):
-        h = act(matmul(topo, h, m))
-    return h
+    """MLP forward (..., k) -> (..., k)."""
+    return mlp_forward(topo, self_flat, x)
 
 
 def deaggregate(topo: Topology, aggs: jnp.ndarray, key=None) -> jnp.ndarray:
@@ -111,7 +106,8 @@ def samples(topo: Topology, flat: jnp.ndarray):
 
 
 def is_fixpoint_after_aggregation(
-    topo: Topology, flat: jnp.ndarray, degree: int = 1, epsilon: float = 1e-4
+    topo: Topology, flat: jnp.ndarray, degree: int = 1, epsilon: float = 1e-4,
+    key=None,
 ):
     """Fixpoint test in aggregate space (``network.py:419-439``).
 
@@ -120,8 +116,9 @@ def is_fixpoint_after_aggregation(
     """
     old_aggs = aggregate(topo, flat)
     new = flat
-    for _ in range(degree):
-        new = apply(topo, flat, new)
+    keys = [None] * degree if key is None else list(jax.random.split(key, degree))
+    for k in keys:
+        new = apply(topo, flat, new, k)
     new_aggs = aggregate(topo, new)
     diverged = jnp.any(~jnp.isfinite(new))
     close = jnp.all(jnp.abs(new_aggs - old_aggs) < epsilon)

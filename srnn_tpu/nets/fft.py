@@ -21,9 +21,7 @@ low-pass reconstruction of the weight vector.
 import jax
 import jax.numpy as jnp
 
-from ..ops.activations import resolve_activation
-from ..ops.flatten import unflatten
-from ..ops.linalg import matmul
+from ..ops.mlp import mlp_forward
 from ..topology import Topology
 
 
@@ -34,11 +32,7 @@ def coefficients(topo: Topology, flat: jnp.ndarray) -> jnp.ndarray:
 
 
 def forward(topo: Topology, self_flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
-    act = resolve_activation(topo.activation)
-    h = x
-    for m in unflatten(topo, self_flat):
-        h = act(matmul(topo, h, m))
-    return h
+    return mlp_forward(topo, self_flat, x)
 
 
 def apply(topo: Topology, self_flat: jnp.ndarray, target_flat: jnp.ndarray,

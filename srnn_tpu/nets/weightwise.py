@@ -13,23 +13,13 @@ MXU.
 
 import jax.numpy as jnp
 
-from ..ops.activations import resolve_activation
-from ..ops.flatten import unflatten
-from ..ops.linalg import matmul
+from ..ops.mlp import mlp_forward
 from ..topology import Topology, normalized_weight_coords
 
 
 def forward(topo: Topology, self_flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
-    """Batched MLP forward: x (..., 4) -> (..., 1).
-
-    The activation applies after *every* layer (keras builds each Dense with
-    the same ``keras_params``, ``network.py:226-230``).
-    """
-    act = resolve_activation(topo.activation)
-    h = x
-    for m in unflatten(topo, self_flat):
-        h = act(matmul(topo, h, m))
-    return h
+    """Batched MLP forward: x (..., 4) -> (..., 1)."""
+    return mlp_forward(topo, self_flat, x)
 
 
 def points(topo: Topology, target_flat: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,20 @@
+"""Shared MLP forward used by the weightwise / aggregating / fft variants.
+
+One matmul chain with the topology's activation after every layer (keras
+builds each Dense with the same ``keras_params`` — reference
+``network.py:226-230``, ``:329-333``, ``:470-474``).
+"""
+
+import jax.numpy as jnp
+
+from .activations import resolve_activation
+from .flatten import unflatten
+from .linalg import matmul
+
+
+def mlp_forward(topo, self_flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    act = resolve_activation(topo.activation)
+    h = x
+    for m in unflatten(topo, self_flat):
+        h = act(matmul(topo, h, m))
+    return h

@@ -1,0 +1,321 @@
+"""The Soup: population dynamics of self-replicating particles.
+
+Reference: ``Soup`` (``soup.py:10-108``).  Per generation, per particle:
+with p=attacking_rate pick a uniform random other (possibly self) and
+*attack* it (overwrite the victim's weights with self applied to them,
+``soup.py:56-61``); with p=learn_from_rate imitate a random other for
+``learn_from_severity`` SGD epochs (``soup.py:62-68``); run ``train``
+self-training epochs (``soup.py:69-76``); respawn dead particles in place —
+divergent first, then zero — with fresh uids (``soup.py:77-86``).  Rates
+<= 0 disable a phase (sentinel -1 convention, ``mixed-soup.py:83``).
+
+TPU-native redesign: the population is a struct-of-arrays ``SoupState``
+pytree and one generation is a pure jitted function.  Two fidelity modes:
+
+  * ``parallel`` (default): all particles step simultaneously from the
+    start-of-phase state.  Attack conflicts (several attackers picking one
+    victim) resolve **last-attacker-wins**: the highest-indexed attacker's
+    result stands and earlier attackers' effects on that victim are dropped —
+    a documented deviation from the reference, where colliding attacks
+    compose in index order.  Collisions are rare at the paper's rates.  This
+    is the mode that scales (vmap -> shard_map); the per-generation phase
+    ORDER (attack -> learn_from -> train -> respawn) is preserved exactly
+    because ordering changes the science (SURVEY §7 hard parts).
+  * ``sequential``: a ``lax.scan`` over particles reproducing the
+    reference's particle-by-particle in-place mutation (particle i+1 can be
+    attacked by the already-updated particle i, ``soup.py:54-59``).  For
+    validation at small N; identical phase semantics, no parallel speedup.
+
+Event capture: each generation emits per-particle ``action`` codes and
+``counterpart`` uids mirroring ``ParticleDecorator.save_state`` description
+dicts, with the reference's keep-only-last-action quirk (``soup.py:55-87``)
+preserved by construction (precedence respawn > train > learn_from > attack).
+"""
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .init import init_population
+from .nets import apply_to_weights, compute_samples
+from .ops.predicates import DEFAULT_EPSILON, count_classes, is_diverged, is_zero
+from .topology import Topology
+from .train import DEFAULT_LR, fit_epoch
+from .engine import classify_batch
+
+# action codes for the event log (reference action strings, soup.py:60-85;
+# 'zweo_dead' [sic] is the reference's persisted typo for the zero respawn)
+ACTION_NAMES = ("none", "init", "attacking", "learn_from", "train_self",
+                "divergent_dead", "zweo_dead")
+(ACT_NONE, ACT_INIT, ACT_ATTACK, ACT_LEARN, ACT_TRAIN,
+ ACT_DIV_DEAD, ACT_ZERO_DEAD) = range(7)
+
+
+class SoupConfig(NamedTuple):
+    """Static soup hyperparameters (reference ``Soup.params``, ``soup.py:17-18``)."""
+    topo: Topology
+    size: int
+    attacking_rate: float = 0.1
+    learn_from_rate: float = 0.1
+    train: int = 0
+    learn_from_severity: int = 1
+    remove_divergent: bool = False
+    remove_zero: bool = False
+    epsilon: float = DEFAULT_EPSILON
+    lr: float = DEFAULT_LR
+    train_mode: str = "sequential"
+    mode: str = "parallel"          # 'parallel' | 'sequential'
+
+
+class SoupState(NamedTuple):
+    """Population as struct-of-arrays; the whole soup is one pytree."""
+    weights: jnp.ndarray   # (N, P)
+    uids: jnp.ndarray      # (N,) int32 — stable particle identity across respawns
+    next_uid: jnp.ndarray  # () int32
+    time: jnp.ndarray      # () int32 generation counter
+    key: jax.Array         # PRNG state for this soup
+
+
+class SoupEvents(NamedTuple):
+    """Per-generation event record (one row per particle)."""
+    action: jnp.ndarray       # (N,) int32 action code (last action of the step)
+    counterpart: jnp.ndarray  # (N,) int32 counterpart uid or -1
+    loss: jnp.ndarray         # (N,) f32 last train loss or 0
+
+
+def seed(config: SoupConfig, key: jax.Array) -> SoupState:
+    """Create the initial population (``Soup.seed``, ``soup.py:45-49``)."""
+    k_init, k_state = jax.random.split(key)
+    w = init_population(config.topo, k_init, config.size)
+    return SoupState(
+        weights=w,
+        uids=jnp.arange(config.size, dtype=jnp.int32),
+        next_uid=jnp.int32(config.size),
+        time=jnp.int32(0),
+        key=k_state,
+    )
+
+
+def _learn_epochs(config: SoupConfig, w: jnp.ndarray, other_w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``learn_from_severity`` imitation epochs toward other's samples
+    (recomputed from other's fixed weights each epoch, as the reference
+    recomputes per ``learn_from`` call, ``network.py:620-626``)."""
+    x, y = compute_samples(config.topo, other_w)
+
+    def body(wi, _):
+        new_w, loss = fit_epoch(config.topo, wi, x, y, config.lr, config.train_mode)
+        return new_w, loss
+
+    new_w, losses = jax.lax.scan(body, w, None, length=max(config.learn_from_severity, 0))
+    return new_w, losses[-1] if config.learn_from_severity > 0 else jnp.zeros((), w.dtype)
+
+
+def _train_epochs(config: SoupConfig, w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``train`` self-training epochs; samples are recomputed from the
+    current weights before every epoch (``soup.py:69-76`` calls ``train()``
+    repeatedly, and each call recomputes samples)."""
+
+    def body(wi, _):
+        x, y = compute_samples(config.topo, wi)
+        new_w, loss = fit_epoch(config.topo, wi, x, y, config.lr, config.train_mode)
+        return new_w, loss
+
+    new_w, losses = jax.lax.scan(body, w, None, length=max(config.train, 0))
+    return new_w, losses[-1] if config.train > 0 else jnp.zeros((), w.dtype)
+
+
+def _respawn(config: SoupConfig, w, uids, next_uid, key):
+    """Replace dead particles in place with fresh nets and fresh uids
+    (``soup.py:77-86``). Divergent check precedes zero check; both act on the
+    particle's end-of-step weights."""
+    action = jnp.full(w.shape[0], ACT_NONE, jnp.int32)
+    dead_div = is_diverged(w) if config.remove_divergent else jnp.zeros(w.shape[0], bool)
+    dead_zero = (is_zero(w, config.epsilon) & ~dead_div) if config.remove_zero else jnp.zeros(w.shape[0], bool)
+    dead = dead_div | dead_zero
+    fresh = init_population(config.topo, key, w.shape[0])
+    new_w = jnp.where(dead[:, None], fresh, w)
+    # fresh uids: rank among the dead, offset by the running counter
+    rank = jnp.cumsum(dead) - 1
+    new_uids = jnp.where(dead, next_uid + rank.astype(jnp.int32), uids)
+    next_uid = next_uid + dead.sum(dtype=jnp.int32)
+    action = jnp.where(dead_div, ACT_DIV_DEAD, action)
+    action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
+    # counterpart of a death event is the replacement's uid (soup.py:81,86)
+    counterpart = jnp.where(dead, new_uids, -1)
+    return new_w, new_uids, next_uid, action, counterpart
+
+
+def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
+    n = config.size
+    topo = config.topo
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    w = state.weights
+
+    # --- attack phase (soup.py:56-61) ---------------------------------
+    if config.attacking_rate > 0:
+        attack_gate = (jax.random.uniform(k_ag, (n,)) < config.attacking_rate)
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        # victim-side resolution: the highest-indexed attacker targeting v
+        # wins outright.  NOTE this is a documented deviation from the
+        # reference for multi-attacker collisions: there, attacks compose in
+        # index order (victim 7 hit by 2 then 5 ends as f_w5(f_w2(w7)),
+        # soup.py:56-61); here earlier attackers' effects are dropped
+        # (f_w5(w7_start)).  Collisions are rare at the paper's rates
+        # (Binomial(N, rate/N)); use mode='sequential' for exact composition.
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+        has_attacker = att_idx >= 0  # un-targeted victims get the int identity (min) or -1
+        attacker_w = w[jnp.clip(att_idx, 0)]
+        attacked = jax.vmap(lambda s, t: apply_to_weights(topo, s, t))(attacker_w, w)
+        w = jnp.where(has_attacker[:, None], attacked, w)
+    else:
+        attack_gate = jnp.zeros(n, bool)
+        attack_tgt = jnp.zeros(n, jnp.int32)
+
+    # --- learn_from phase (soup.py:62-68) ------------------------------
+    if config.learn_from_rate > 0:
+        # the gate (and its event-log entry) fires independently of severity,
+        # like the reference, where severity=0 still logs 'learn_from'
+        learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
+        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+        if config.learn_from_severity > 0:
+            learned, _ = jax.vmap(lambda wi, ow: _learn_epochs(config, wi, ow))(w, w[learn_tgt])
+            w = jnp.where(learn_gate[:, None], learned, w)
+    else:
+        learn_gate = jnp.zeros(n, bool)
+        learn_tgt = jnp.zeros(n, jnp.int32)
+
+    # --- train phase (soup.py:69-76) -----------------------------------
+    if config.train > 0:
+        w, train_loss = jax.vmap(lambda wi: _train_epochs(config, wi))(w)
+    else:
+        train_loss = jnp.zeros(n, w.dtype)
+
+    # --- respawn (soup.py:77-86) ---------------------------------------
+    w, uids, next_uid, death_action, death_cp = _respawn(
+        config, w, state.uids, state.next_uid, k_re)
+
+    # --- event record: last action wins (soup.py:55-87 quirk) ----------
+    action = jnp.full(n, ACT_NONE, jnp.int32)
+    counterpart = jnp.full(n, -1, jnp.int32)
+    # the reference logs 'attacking' on the ATTACKER; victims log nothing
+    action = jnp.where(attack_gate, ACT_ATTACK, action)
+    counterpart = jnp.where(attack_gate, state.uids[attack_tgt], counterpart)
+    action = jnp.where(learn_gate, ACT_LEARN, action)
+    counterpart = jnp.where(learn_gate, state.uids[learn_tgt], counterpart)
+    if config.train > 0:
+        action = jnp.full(n, ACT_TRAIN, jnp.int32)
+        counterpart = jnp.full(n, -1, jnp.int32)
+    action = jnp.where(death_action != ACT_NONE, death_action, action)
+    counterpart = jnp.where(death_action != ACT_NONE, death_cp, counterpart)
+
+    new_state = SoupState(w, uids, next_uid, state.time + 1, key)
+    return new_state, SoupEvents(action, counterpart, train_loss)
+
+
+def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
+    """Particle-by-particle in-place mutation (reference semantics,
+    ``soup.py:51-87``): particle i's action sees all mutations made by
+    particles < i this generation."""
+    n = config.size
+    topo = config.topo
+    key, k_gen = jax.random.split(state.key)
+    pkeys = jax.random.split(k_gen, n)
+
+    def per_particle(carry, inp):
+        w, uids, next_uid = carry
+        i, pk = inp
+        k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(pk, 5)
+        wi = w[i]
+
+        # attack: overwrite the VICTIM's row
+        attack = jax.random.uniform(k_ag) < config.attacking_rate
+        tgt = jax.random.randint(k_at, (), 0, n)
+        new_victim = apply_to_weights(topo, wi, w[tgt])
+        w = jnp.where(attack, w.at[tgt].set(new_victim), w)
+
+        # learn_from: mutate SELF toward other's samples
+        wi = w[i]
+        learn = jax.random.uniform(k_lg) < config.learn_from_rate
+        ltgt = jax.random.randint(k_lt, (), 0, n)
+        if config.learn_from_rate > 0 and config.learn_from_severity > 0:
+            learned, _ = _learn_epochs(config, wi, w[ltgt])
+            wi = jnp.where(learn, learned, wi)
+
+        # train
+        if config.train > 0:
+            wi, loss = _train_epochs(config, wi)
+        else:
+            loss = jnp.zeros((), w.dtype)
+
+        # respawn self
+        dead_div = is_diverged(wi) & config.remove_divergent
+        dead_zero = is_zero(wi, config.epsilon) & ~dead_div & config.remove_zero
+        dead = dead_div | dead_zero
+        fresh = init_population(topo, k_re, 1)[0]
+        wi = jnp.where(dead, fresh, wi)
+        new_uid = jnp.where(dead, next_uid, uids[i])
+        next_uid = next_uid + dead.astype(jnp.int32)
+
+        w = w.at[i].set(wi)
+        uids = uids.at[i].set(new_uid)
+
+        action = jnp.where(attack, ACT_ATTACK, ACT_NONE)
+        cp = jnp.where(attack, uids[tgt], -1)
+        action = jnp.where(learn, ACT_LEARN, action)
+        cp = jnp.where(learn, uids[ltgt], cp)
+        if config.train > 0:
+            action, cp = jnp.full_like(action, ACT_TRAIN), jnp.full_like(cp, -1)
+        action = jnp.where(dead_div, ACT_DIV_DEAD, action)
+        action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
+        cp = jnp.where(dead, new_uid, cp)
+        return (w, uids, next_uid), (action, cp, loss)
+
+    init = (state.weights, state.uids, state.next_uid)
+    (w, uids, next_uid), (action, cp, loss) = jax.lax.scan(
+        per_particle, init, (jnp.arange(n), pkeys))
+    new_state = SoupState(w, uids, next_uid, state.time + 1, key)
+    return new_state, SoupEvents(action, cp, loss)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
+    """One generation (``Soup.evolve`` body, ``soup.py:51-87``)."""
+    if config.mode == "sequential":
+        return _evolve_sequential(config, state)
+    if config.mode != "parallel":
+        raise ValueError(f"unknown soup mode {config.mode!r}")
+    return _evolve_parallel(config, state)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "generations", "record"))
+def evolve(
+    config: SoupConfig,
+    state: SoupState,
+    generations: int = 1,
+    record: bool = False,
+):
+    """Evolve ``generations`` steps as one scan.
+
+    With ``record=True`` also returns stacked per-generation
+    ``(SoupEvents, weights (G, N, P), uids (G, N))`` for trajectory analysis
+    (the vectorized stand-in for ``ParticleDecorator.save_state`` histories,
+    ``network.py:193-198``).
+    """
+
+    def step(s, _):
+        new_s, ev = evolve_step(config, s)
+        out = (ev, new_s.weights, new_s.uids) if record else None
+        return new_s, out
+
+    final, recs = jax.lax.scan(step, state, None, length=generations)
+    return (final, recs) if record else final
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def count(config: SoupConfig, state: SoupState) -> jnp.ndarray:
+    """(5,) class histogram of the current population
+    (``Soup.count``, ``soup.py:89-103``)."""
+    return count_classes(classify_batch(config.topo, state.weights, config.epsilon))

@@ -71,6 +71,12 @@ class Topology:
             raise ValueError("width and depth must be >= 1")
         if self.variant in ("aggregating", "fft") and self.aggregates < 1:
             raise ValueError("aggregates must be >= 1")
+        if self.precision not in ("default", "high", "highest"):
+            raise ValueError(f"unknown precision {self.precision!r}")
+        if self.aggregator not in ("average", "max", "max_buggy"):
+            raise ValueError(f"unknown aggregator {self.aggregator!r}")
+        if self.shuffler not in ("not", "random"):
+            raise ValueError(f"unknown shuffler {self.shuffler!r}")
 
     # ---- shape metadata -------------------------------------------------
 

@@ -1,0 +1,108 @@
+"""Self-training and imitation ("learn_from") as pure SGD steps.
+
+Reference semantics (``TrainingNeuralNetworkDecorator``, ``network.py:577-626``):
+
+  * ``train()`` = one keras ``fit`` epoch on ``compute_samples()`` with
+    ``loss='mse'``, plain SGD (keras default lr=0.01) and **batch_size=1**
+    (``network.py:613-618``): one sequential gradient step per sample, with
+    x/y computed ONCE from the current weights at call time (a moving
+    target across calls, frozen within a call).
+  * ``learn_from(other)`` = the same single epoch but on *other's* samples
+    (imitation, ``network.py:620-626``).
+  * the reported loss is the mean of per-batch losses over the epoch, each
+    evaluated at the weights *before* that batch's update (keras history
+    semantics).
+
+Modes:
+  * ``'sequential'`` (default) — ``lax.scan`` of per-sample SGD updates in
+    enumeration order, the faithful batch_size=1 analog (SURVEY §2.4.10).
+    keras ``fit`` actually shuffles by default with an unseeded numpy RNG,
+    so exact order parity with any particular reference run is impossible;
+    pass ``key`` to shuffle functionally, or leave None for deterministic
+    enumeration order.
+  * ``'full_batch'`` — a single gradient step on the mean loss over all
+    samples; changes semantics (documented deviation) but runs as one fused
+    matmul — the fast path for mega-soups.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .nets import compute_samples
+from .nets.dispatch import _MODULES
+from .topology import Topology
+
+DEFAULT_LR = 0.01  # keras SGD default learning rate
+
+
+def predict(topo: Topology, flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Batched forward pass on training samples, per variant.
+
+    weightwise: x (B, 4) -> (B, 1); aggregating/fft: x (B, k) -> (B, k);
+    recurrent: x (B, T, 1) -> (B, T, 1).
+    """
+    mod = _MODULES[topo.variant]
+    if topo.variant == "recurrent":
+        return jax.vmap(lambda seq: mod.forward(topo, flat, seq))(x)
+    return mod.forward(topo, flat, x)
+
+
+def _mse(topo: Topology, flat: jnp.ndarray, xb: jnp.ndarray, yb: jnp.ndarray) -> jnp.ndarray:
+    pred = predict(topo, flat, xb)
+    return jnp.mean((pred - yb.reshape(pred.shape)) ** 2)
+
+
+def fit_epoch(
+    topo: Topology,
+    flat: jnp.ndarray,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+    key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One epoch of mse-SGD on fixed (x, y). Returns (new_flat, epoch_loss)."""
+    x = jax.lax.stop_gradient(x)
+    y = jax.lax.stop_gradient(y)
+    if mode == "full_batch":
+        loss, grad = jax.value_and_grad(_mse, argnums=1)(topo, flat, x, y)
+        return flat - lr * grad, loss
+    if mode != "sequential":
+        raise ValueError(f"unknown train mode {mode!r}")
+    n = x.shape[0]
+    order = jnp.arange(n) if key is None else jax.random.permutation(key, n)
+
+    def step(w, i):
+        loss, grad = jax.value_and_grad(_mse, argnums=1)(topo, w, x[i][None], y[i][None])
+        return w - lr * grad, loss
+
+    flat, losses = jax.lax.scan(step, flat, order)
+    return flat, losses.mean()
+
+
+def train_step(
+    topo: Topology,
+    flat: jnp.ndarray,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+    key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One ``train()`` call: fit one epoch on the net's own samples
+    (self-training toward being a fixpoint)."""
+    x, y = compute_samples(topo, flat)
+    return fit_epoch(topo, flat, x, y, lr, mode, key)
+
+
+def learn_from(
+    topo: Topology,
+    flat: jnp.ndarray,
+    other_flat: jnp.ndarray,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+    key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One ``learn_from(other)`` call: fit one epoch on *other's* samples."""
+    x, y = compute_samples(topo, other_flat)
+    return fit_epoch(topo, flat, x, y, lr, mode, key)

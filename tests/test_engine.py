@@ -1,0 +1,99 @@
+"""Engine tests: vectorized fixpoint runs reproduce the reference's
+qualitative distributions (BASELINE.md) at reduced trial counts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srnn_tpu import Topology, init_population
+from srnn_tpu.engine import (
+    classify_batch,
+    fixpoint_density,
+    run_fixpoint,
+    run_known_fixpoint_variation,
+    run_mixed_fixpoint,
+)
+from srnn_tpu.ops.predicates import CLS_DIVERGENT, CLS_FIX_OTHER, CLS_FIX_ZERO
+from tests.test_apply import WW, AGG, RNN, identity_fixpoint_flat
+
+
+def test_run_fixpoint_ww_distribution():
+    # BASELINE: WW 23 divergent / 27 fix_zero of 50 — everything diverges or zeroes
+    pop = init_population(WW, jax.random.key(0), 30)
+    res = run_fixpoint(WW, pop, step_limit=100)
+    counts = res.counts.tolist()
+    assert counts[CLS_DIVERGENT] + counts[CLS_FIX_ZERO] == 30
+    assert counts[CLS_DIVERGENT] > 0 and counts[CLS_FIX_ZERO] > 0
+
+
+def test_run_fixpoint_rnn_mostly_diverges():
+    # BASELINE: RNN 46 divergent / 4 fix_zero of 50
+    pop = init_population(RNN, jax.random.key(1), 20)
+    res = run_fixpoint(RNN, pop, step_limit=100)
+    assert res.counts[CLS_DIVERGENT] > res.counts[CLS_FIX_ZERO]
+
+
+def test_run_fixpoint_freezes_retired_trials():
+    ident = jnp.asarray(identity_fixpoint_flat())
+    pop = jnp.stack([ident, jnp.zeros(14)])
+    res = run_fixpoint(WW, pop, step_limit=50)
+    # both are fixpoints from step 0: no steps taken, weights unchanged
+    assert res.steps.tolist() == [0, 0]
+    np.testing.assert_array_equal(np.asarray(res.weights), np.asarray(pop))
+    assert res.classes.tolist() == [CLS_FIX_OTHER, CLS_FIX_ZERO]
+
+
+def test_run_fixpoint_trajectory_recording():
+    pop = init_population(WW, jax.random.key(2), 4)
+    res = run_fixpoint(WW, pop, step_limit=10, record=True)
+    assert res.trajectory.shape == (11, 4, 14)
+    np.testing.assert_array_equal(np.asarray(res.trajectory[0]), np.asarray(pop))
+
+
+def test_mixed_fixpoint_training_rescues_ww():
+    """mixed-self-fixpoints.py headline: enough training between attacks
+    pushes WW fixpoint rate toward 1.0 (BASELINE: 0.2 -> 1.0)."""
+    pop = init_population(WW, jax.random.key(3), 6)
+    res_none = run_mixed_fixpoint(WW, pop, trains_per_application=0, step_limit=4)
+    res_many = run_mixed_fixpoint(WW, pop, trains_per_application=300, step_limit=4)
+    fixed_none = int(res_none.counts[CLS_FIX_ZERO] + res_none.counts[CLS_FIX_OTHER])
+    fixed_many = int(res_many.counts[CLS_FIX_ZERO] + res_many.counts[CLS_FIX_OTHER])
+    assert fixed_many > fixed_none
+    assert int(res_many.counts[CLS_FIX_OTHER]) > 0  # non-trivial fixpoints
+
+
+def test_known_fixpoint_variation_scale_monotonicity():
+    """known-fixpoint-variation: smaller perturbations survive longer
+    (BASELINE: 3.63 steps @1e0 -> 26.45 @1e-9).
+
+    Note: the reference script *appears* to use sigmoid but its
+    ``with_keras_params`` call never rebuilds the model, so the effective
+    activation is linear (SURVEY quirk 2.4.11) — we test the effective
+    behavior."""
+    topo = WW
+    ident = jnp.asarray(identity_fixpoint_flat())
+    key = jax.random.key(4)
+    results = {}
+    for scale in (1.0, 1e-6):
+        ks = jax.random.split(key, 20)
+        pert = jax.vmap(
+            lambda k: ident + jax.random.uniform(k, ident.shape, minval=-scale, maxval=scale)
+        )(ks)
+        res = run_known_fixpoint_variation(topo, pert, max_steps=50)
+        results[scale] = float(res.time_to_vergence.mean())
+    assert results[1e-6] > results[1.0]
+
+
+def test_fixpoint_density_immediate_classification():
+    """fixpoint-density.py: random inits classified with no dynamics —
+    at eps=1e-4 virtually everything is 'other'."""
+    pop = init_population(WW, jax.random.key(5), 1000)
+    counts = fixpoint_density(WW, pop)
+    assert int(counts.sum()) == 1000
+    assert int(counts[4]) > 900  # 'other' dominates for untrained nets
+
+
+def test_classify_batch_matches_scalar_classify():
+    pop = jnp.stack([jnp.asarray(identity_fixpoint_flat()), jnp.zeros(14)])
+    ids = classify_batch(WW, pop)
+    assert ids.tolist() == [CLS_FIX_OTHER, CLS_FIX_ZERO]

@@ -1,0 +1,172 @@
+"""Soup population dynamics (reference soup.py:10-108)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology
+from srnn_tpu.ops.predicates import CLS_DIVERGENT, CLS_FIX_OTHER, CLS_FIX_ZERO, CLS_OTHER
+from srnn_tpu.soup import (
+    ACT_ATTACK,
+    ACT_DIV_DEAD,
+    ACT_LEARN,
+    ACT_NONE,
+    ACT_TRAIN,
+    ACT_ZERO_DEAD,
+    SoupConfig,
+    SoupState,
+    count,
+    evolve,
+    evolve_step,
+    seed,
+)
+from tests.test_apply import WW
+
+
+def mkconfig(**kw):
+    base = dict(topo=WW, size=10)
+    base.update(kw)
+    return SoupConfig(**base)
+
+
+def test_seed_population():
+    cfg = mkconfig(size=7)
+    s = seed(cfg, jax.random.key(0))
+    assert s.weights.shape == (7, 14)
+    assert s.uids.tolist() == list(range(7))
+    assert int(s.next_uid) == 7
+    assert int(s.time) == 0
+
+
+@pytest.mark.parametrize("mode", ["parallel", "sequential"])
+def test_evolve_advances_time_and_stays_finite_shape(mode):
+    cfg = mkconfig(mode=mode, attacking_rate=0.3, learn_from_rate=0.0, train=0)
+    s = seed(cfg, jax.random.key(1))
+    s2, ev = evolve_step(cfg, s)
+    assert int(s2.time) == 1
+    assert s2.weights.shape == s.weights.shape
+    assert ev.action.shape == (10,)
+
+
+def test_attack_changes_victims_only():
+    cfg = mkconfig(attacking_rate=1.0, learn_from_rate=0.0, train=0, size=6)
+    s = seed(cfg, jax.random.key(2))
+    s2, ev = evolve_step(cfg, s)
+    # with rate 1.0 everyone attacks someone; attackers all log 'attacking'
+    assert ev.action.tolist() == [ACT_ATTACK] * 6
+    assert np.all(np.asarray(ev.counterpart) >= 0)
+
+
+def test_no_action_soup_is_static():
+    cfg = mkconfig(attacking_rate=0.0, learn_from_rate=0.0, train=0)
+    s = seed(cfg, jax.random.key(3))
+    s2, ev = evolve_step(cfg, s)
+    np.testing.assert_array_equal(np.asarray(s2.weights), np.asarray(s.weights))
+    assert ev.action.tolist() == [ACT_NONE] * 10
+    assert ev.counterpart.tolist() == [-1] * 10
+
+
+def test_negative_rates_disable_phases():
+    # sentinel -1 disables a phase (mixed-soup.py:83)
+    cfg = mkconfig(attacking_rate=-1, learn_from_rate=-1, train=0)
+    s = seed(cfg, jax.random.key(4))
+    s2, ev = evolve_step(cfg, s)
+    np.testing.assert_array_equal(np.asarray(s2.weights), np.asarray(s.weights))
+
+
+def test_respawn_divergent_and_zero():
+    cfg = mkconfig(size=4, attacking_rate=0.0, learn_from_rate=0.0, train=0,
+                   remove_divergent=True, remove_zero=True)
+    s = seed(cfg, jax.random.key(5))
+    w = s.weights.at[0].set(jnp.nan).at[1].set(0.0)
+    s = SoupState(w, s.uids, s.next_uid, s.time, s.key)
+    s2, ev = evolve_step(cfg, s)
+    assert ev.action.tolist()[:2] == [ACT_DIV_DEAD, ACT_ZERO_DEAD]
+    # respawned rows are finite, non-zero, with fresh uids
+    assert np.all(np.isfinite(np.asarray(s2.weights[0])))
+    assert float(jnp.abs(s2.weights[1]).max()) > 1e-4
+    assert s2.uids.tolist()[:2] == [4, 5]
+    assert int(s2.next_uid) == 6
+    assert ev.counterpart.tolist()[:2] == [4, 5]
+    # survivors keep uid and weights
+    assert s2.uids.tolist()[2:] == [2, 3]
+    np.testing.assert_array_equal(np.asarray(s2.weights[2:]), np.asarray(s.weights[2:]))
+
+
+def test_respawn_disabled_keeps_dead():
+    cfg = mkconfig(size=3, attacking_rate=0.0, learn_from_rate=0.0)
+    s = seed(cfg, jax.random.key(6))
+    w = s.weights.at[0].set(jnp.nan)
+    s = SoupState(w, s.uids, s.next_uid, s.time, s.key)
+    s2, _ = evolve_step(cfg, s)
+    assert bool(jnp.isnan(s2.weights[0]).any())
+    assert int(s2.next_uid) == 3
+
+
+def test_train_phase_trains_everyone():
+    cfg = mkconfig(size=5, attacking_rate=0.0, learn_from_rate=0.0, train=3)
+    s = seed(cfg, jax.random.key(7))
+    s2, ev = evolve_step(cfg, s)
+    assert ev.action.tolist() == [ACT_TRAIN] * 5
+    assert not np.allclose(np.asarray(s2.weights), np.asarray(s.weights))
+    assert np.all(np.isfinite(np.asarray(ev.loss)))
+
+
+def test_learn_from_moves_learner():
+    cfg = mkconfig(size=4, attacking_rate=0.0, learn_from_rate=1.0,
+                   learn_from_severity=2, train=0)
+    s = seed(cfg, jax.random.key(8))
+    s2, ev = evolve_step(cfg, s)
+    assert ev.action.tolist() == [ACT_LEARN] * 4
+    assert not np.allclose(np.asarray(s2.weights), np.asarray(s.weights))
+
+
+def test_soup_trajectory_run_reaches_nontrivial_fixpoints():
+    """The BASELINE soup_trajectorys.py result: Soup(20, train=30,
+    no attack/learn, 100 gen) -> majority fix_other, zero divergent/zero.
+    Scaled down (train=30, 25 gen, N=8) for CI speed; self-training alone
+    should already produce some non-trivial fixpoints and no deaths."""
+    cfg = mkconfig(size=8, attacking_rate=-1, learn_from_rate=-1, train=30,
+                   remove_divergent=True, remove_zero=True)
+    s = seed(cfg, jax.random.key(9))
+    final = evolve(cfg, s, generations=25)
+    counts = count(cfg, final)
+    assert int(counts[CLS_DIVERGENT]) == 0
+    assert int(counts[CLS_FIX_ZERO]) == 0
+    assert int(counts[CLS_FIX_OTHER]) > 0
+
+
+def test_evolve_record_shapes():
+    cfg = mkconfig(size=6, attacking_rate=0.5)
+    s = seed(cfg, jax.random.key(10))
+    final, (events, weights, uids) = evolve(cfg, s, generations=5, record=True)
+    assert weights.shape == (5, 6, 14)
+    assert uids.shape == (5, 6)
+    assert events.action.shape == (5, 6)
+    assert int(final.time) == 5
+
+
+def test_sequential_mode_in_generation_attack_chain():
+    """Sequential parity: an earlier particle's attack this generation is
+    visible to later particles (reference in-order mutation)."""
+    cfg = mkconfig(size=12, mode="sequential", attacking_rate=1.0,
+                   learn_from_rate=0.0, train=0)
+    s = seed(cfg, jax.random.key(11))
+    s2, ev = evolve_step(cfg, s)
+    assert ev.action.tolist() == [ACT_ATTACK] * 12
+    assert s2.weights.shape == (12, 14)
+
+
+@pytest.mark.parametrize("mode", ["parallel", "sequential"])
+def test_modes_distributionally_similar(mode):
+    """Both modes must drive an attack-only WW soup the same way
+    statistically: without respawn, repeated attack converges the
+    population to zero/divergence (BASELINE applying-fixpoint behavior)."""
+    cfg = mkconfig(size=16, mode=mode, attacking_rate=0.5, learn_from_rate=0.0,
+                   train=0)
+    s = seed(cfg, jax.random.key(12))
+    final = evolve(cfg, s, generations=60)
+    counts = count(cfg, final)
+    # most particles should have left 'other' by now
+    assert int(counts[CLS_OTHER]) < 8

@@ -1,0 +1,116 @@
+"""Training semantics: keras fit(batch_size=1) parity via lax.scan
+(network.py:613-626, SURVEY §2.4.10)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology, apply_to_weights, compute_samples, init_flat, is_fixpoint
+from srnn_tpu.train import fit_epoch, learn_from, predict, train_step
+from tests.test_apply import WW, AGG, FFT, RNN
+
+
+def np_sequential_sgd_ww(flat, lr=0.01):
+    """Hand-rolled batch-1 SGD epoch for the linear weightwise net."""
+    from srnn_tpu.topology import normalized_weight_coords
+    from srnn_tpu.ops.flatten import unflatten
+
+    coords = normalized_weight_coords(WW)
+    x = np.concatenate([flat[:, None], coords], axis=1).astype(np.float64)
+    y = flat.astype(np.float64).copy()
+    w = flat.astype(np.float64).copy()
+    losses = []
+    for i in range(x.shape[0]):
+        mats = [np.asarray(m, np.float64) for m in unflatten(WW, jnp.asarray(w.astype(np.float32)))]
+        # forward with intermediates
+        h = [x[i : i + 1]]
+        for m in mats:
+            h.append(h[-1] @ m)
+        pred = h[-1][0, 0]
+        loss = (pred - y[i]) ** 2
+        losses.append(loss)
+        # backward
+        g_out = 2.0 * (pred - y[i])  # dL/dpred
+        grad_mats = [np.zeros_like(m) for m in mats]
+        gh = np.array([[g_out]])
+        for li in reversed(range(len(mats))):
+            grad_mats[li] = h[li].T @ gh
+            gh = gh @ mats[li].T
+        gflat = np.concatenate([g.ravel() for g in grad_mats])
+        w = w - lr * gflat
+    return w.astype(np.float32), float(np.mean(losses))
+
+
+def test_ww_sequential_epoch_matches_numpy_backprop():
+    rng = np.random.default_rng(0)
+    flat = (rng.normal(size=14) * 0.5).astype(np.float32)
+    expected_w, expected_loss = np_sequential_sgd_ww(flat)
+    got_w, got_loss = train_step(WW, jnp.asarray(flat))
+    np.testing.assert_allclose(np.asarray(got_w), expected_w, rtol=1e-4, atol=1e-6)
+    assert float(got_loss) == pytest.approx(expected_loss, rel=1e-4)
+
+
+def test_sequential_does_n_updates_full_batch_does_one():
+    rng = np.random.default_rng(1)
+    flat = jnp.asarray((rng.normal(size=14) * 0.5).astype(np.float32))
+    seq_w, _ = train_step(WW, flat, mode="sequential")
+    fb_w, _ = train_step(WW, flat, mode="full_batch")
+    # both must move the weights, and differently (different semantics)
+    assert not np.allclose(np.asarray(seq_w), np.asarray(flat))
+    assert not np.allclose(np.asarray(fb_w), np.asarray(flat))
+    assert not np.allclose(np.asarray(seq_w), np.asarray(fb_w))
+
+
+def test_self_training_approaches_fixpoint():
+    """1000 self-train epochs drive a WW net to a non-trivial fixpoint —
+    the headline result of training-fixpoints.py (BASELINE.md: 50/50
+    fix_other)."""
+    flat = init_flat(WW, jax.random.key(7))
+
+    @jax.jit
+    def epochs(w):
+        def body(x, _):
+            new_x, loss = train_step(WW, x)
+            return new_x, loss
+        return jax.lax.scan(body, w, None, length=1000)
+
+    w, losses = epochs(flat)
+    f = functools.partial(apply_to_weights, WW, w)
+    assert bool(is_fixpoint(f, w, epsilon=1e-4))
+    assert float(losses[-1]) < float(losses[0])
+    # non-trivial: not the zero fixpoint
+    assert float(jnp.abs(w).max()) > 1e-4
+
+
+def test_learn_from_moves_toward_other():
+    rng = np.random.default_rng(3)
+    a = jnp.asarray((rng.normal(size=14) * 0.5).astype(np.float32))
+    b = jnp.asarray((rng.normal(size=14) * 0.5).astype(np.float32))
+    x, y = compute_samples(WW, b)
+    before = float(jnp.mean((predict(WW, a, x) - y.reshape(-1, 1)) ** 2))
+    new_a, _ = learn_from(WW, a, b)
+    after = float(jnp.mean((predict(WW, new_a, x) - y.reshape(-1, 1)) ** 2))
+    assert after < before
+
+
+@pytest.mark.parametrize("topo", [WW, AGG, FFT, RNN])
+def test_train_step_all_variants_finite(topo):
+    flat = init_flat(topo, jax.random.key(11)) * 0.3
+    new_flat, loss = train_step(topo, flat)
+    assert new_flat.shape == flat.shape
+    assert np.isfinite(float(loss))
+    assert np.all(np.isfinite(np.asarray(new_flat)))
+
+
+def test_shuffled_epoch_is_permutation_of_updates():
+    rng = np.random.default_rng(5)
+    flat = jnp.asarray((rng.normal(size=14) * 0.5).astype(np.float32))
+    w1, _ = train_step(WW, flat, key=jax.random.key(0))
+    w2, _ = train_step(WW, flat, key=jax.random.key(1))
+    w3, _ = train_step(WW, flat)
+    # different orders give (slightly) different results but same magnitude
+    assert not np.allclose(np.asarray(w1), np.asarray(w2))
+    assert np.linalg.norm(np.asarray(w1) - np.asarray(w3)) < 0.1
