@@ -1,0 +1,20 @@
+from .mesh import soup_mesh, shard_population, replicate, initialize_distributed
+from .sharded_soup import (
+    make_sharded_state,
+    sharded_evolve,
+    sharded_evolve_step,
+    sharded_count,
+)
+from .ring_rnn import ring_rnn_apply
+
+__all__ = [
+    "soup_mesh",
+    "shard_population",
+    "replicate",
+    "initialize_distributed",
+    "make_sharded_state",
+    "sharded_evolve_step",
+    "sharded_evolve",
+    "sharded_count",
+    "ring_rnn_apply",
+]

@@ -1,0 +1,67 @@
+"""Device-mesh helpers.
+
+The reference is single-process with no parallelism of any kind (SURVEY
+§2.5); this module is where the TPU build gets its scale-out instead:
+a 1-D ``soup`` mesh over which the particle axis is sharded.  Collectives
+ride ICI within a slice; multi-host/multi-slice (DCN) setups initialize via
+``jax.distributed`` first.
+"""
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SOUP_AXIS = "soup"
+
+
+def soup_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the particle ('soup') axis.
+
+    Uses all visible devices by default — on a pod slice these are the local
+    chips plus, after ``initialize_distributed()``, every other host's chips.
+    Requesting more devices than exist fails fast (a mis-scheduled job must
+    not silently run with halved shards).
+    """
+    if devices is None:
+        available = jax.devices()
+        if n_devices is not None:
+            if not 0 < n_devices <= len(available):
+                raise ValueError(
+                    f"requested {n_devices} devices but {len(available)} are available")
+            available = available[:n_devices]
+        devices = available
+    return Mesh(np.asarray(devices), (SOUP_AXIS,))
+
+
+def shard_population(mesh: Mesh, pop: jax.Array) -> jax.Array:
+    """Place a (N, ...) population with the leading axis sharded over the mesh."""
+    return jax.device_put(pop, NamedSharding(mesh, P(SOUP_AXIS)))
+
+
+def replicate(mesh: Mesh, x) -> jax.Array:
+    """Place a value fully replicated over the mesh (e.g. the shared
+    ``self_flat`` argument of ``ring_rnn_apply``)."""
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> bool:
+    """Multi-host bring-up (DCN): wraps ``jax.distributed.initialize``.
+
+    No-op (returns False) when neither explicit arguments nor cluster env
+    vars (``JAX_COORDINATOR_ADDRESS`` / TPU pod metadata) are present, so
+    single-host runs and tests never pay for it.
+    """
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ \
+            and os.environ.get("TPU_WORKER_HOSTNAMES") is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True

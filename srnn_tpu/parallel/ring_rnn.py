@@ -1,0 +1,86 @@
+"""Sequence/context parallelism for the recurrent transform.
+
+The recurrent variant consumes the target's flat weights as ONE sequence of
+length T = P (reference ``network.py:544-564``); at mega-particle sizes that
+sequence no longer fits one device's sweet spot, and the recurrence is the
+only transform that cannot be sliced embarrassingly (SURVEY §5
+"long-context" row).  This module shards the TIME axis across the mesh and
+passes the hidden state around a ``ppermute`` ring — the RNN analog of ring
+attention's block hand-off:
+
+  device 0: scans its chunk, hands h to device 1, which scans its chunk, ...
+
+The wavefront runs D stages per layer; each stage every device executes its
+local ``lax.scan`` (compute is masked-redundant — only the device whose turn
+it is keeps the result, the standard simple pipeline).  Wall-clock per layer
+stays O(T) like the serial scan — the win is MEMORY (each device holds T/D
+of the sequence) plus layer-level pipelining across the stack.  For the
+default linear activation the recurrence is affine and could use a
+distributed associative scan instead (O(T/D) time); kept as a documented
+fast-path candidate.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.activations import resolve_activation
+from ..ops.flatten import unflatten
+from ..ops.linalg import matmul
+from ..topology import Topology
+from .mesh import SOUP_AXIS
+
+
+def _local_forward(topo: Topology, n_dev: int, self_flat, seq_loc):
+    """Per-device body: seq_loc (T/D, 1) chunk of the global sequence."""
+    act = resolve_activation(topo.activation)
+    mats = unflatten(topo, self_flat)
+    d = jax.lax.axis_index(SOUP_AXIS)
+    ring = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    x = seq_loc
+    for layer, (_, units) in enumerate(topo.rnn_layer_dims):
+        kernel, recurrent = mats[2 * layer], mats[2 * layer + 1]
+
+        def step(h, xt, kernel=kernel, recurrent=recurrent, act=act):
+            h_new = act(matmul(topo, xt, kernel) + matmul(topo, h, recurrent))
+            return h_new, h_new
+
+        h_in = jnp.zeros((units,), dtype=x.dtype)
+        ys = jnp.zeros((x.shape[0], units), dtype=x.dtype)
+        for stage in range(n_dev):
+            h_last, ys_stage = jax.lax.scan(step, h_in, x)
+            mine = d == stage
+            ys = jnp.where(mine, ys_stage, ys)
+            # ring hand-off: the active device's final h reaches stage+1
+            h_recv = jax.lax.ppermute(jnp.where(mine, h_last, h_in), SOUP_AXIS, ring)
+            h_in = jnp.where(d == stage + 1, h_recv, h_in)
+        x = ys
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "mesh"))
+def ring_rnn_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
+                   target_flat: jax.Array) -> jax.Array:
+    """Sequence-parallel equivalent of ``recurrent.apply``.
+
+    ``self_flat`` is replicated (the net's own parameters); ``target_flat``
+    (T,) is sharded over the mesh on the time axis.  Returns the new target,
+    sharded the same way; numerically identical to the single-device scan.
+    """
+    assert topo.variant == "recurrent"
+    n_dev = mesh.devices.size
+
+    def body(self_flat, tgt_loc):
+        return _local_forward(topo, n_dev, self_flat, tgt_loc[:, None])[:, 0]
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(SOUP_AXIS)),
+        out_specs=P(SOUP_AXIS),
+        check_vma=False,
+    )
+    return fn(self_flat, target_flat)

@@ -1,0 +1,207 @@
+"""Soup evolution sharded over a device mesh via ``shard_map``.
+
+Scale-out design (SURVEY §2.5 / §7.6), built not ported — the reference has
+no distributed backend at all:
+
+  * The particle axis is sharded: each device owns ``N / D`` rows of the
+    ``(N, P)`` weight matrix and does ALL heavy work (self-applications,
+    SGD epochs) only for its shard.
+  * The soup PRNG key is **replicated**; every device derives the same
+    global gate/target draws with cheap O(N) scalar ops, so no RNG
+    communication is needed and the sharded soup is bit-deterministic.
+  * Counterpart weights (attackers seen by local victims, imitation targets
+    of local learners) come from ONE ``all_gather`` of the weight matrix per
+    generation.  Particles are tiny (P ~ 14 floats), so even a 1M-particle
+    soup gathers ~56 MB — well within HBM and ICI budget; this is by far
+    the simplest correct exchange and it rides ICI as a single fused
+    collective.  (A ppermute ring exchange would only pay off for particles
+    orders of magnitude larger.)
+  * Respawned particles draw fresh uids from per-device blocks computed
+    with an ``all_gather`` of death counts — monotone unique uids without a
+    host round-trip.
+
+Semantics match ``soup._evolve_parallel`` with two sharding-induced
+differences: (a) imitation targets read start-of-generation weights (the
+all_gather snapshot) rather than post-attack ones — visible only when a
+particle learns from a victim attacked in the same generation; (b) respawn
+draws fold the device index into the key, so fresh particles differ from
+the unsharded stream (same distribution).  Attack/train phases are
+bit-identical under matched keys, which tests assert.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nets import apply_to_weights
+from ..ops.predicates import count_classes, is_diverged, is_zero
+from ..soup import (
+    SoupConfig,
+    SoupEvents,
+    SoupState,
+    _event_record,
+    _learn_epochs,
+    _respawn,
+    _train_epochs,
+)
+from ..engine import classify_batch
+from .mesh import SOUP_AXIS
+
+
+def _state_specs():
+    return SoupState(
+        weights=P(SOUP_AXIS),
+        uids=P(SOUP_AXIS),
+        next_uid=P(),
+        time=P(),
+        key=P(),
+    )
+
+
+def _event_specs():
+    return SoupEvents(action=P(SOUP_AXIS), counterpart=P(SOUP_AXIS), loss=P(SOUP_AXIS))
+
+
+def _local_evolve(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
+    """Per-device body. ``state.weights``/``uids`` hold the LOCAL shard;
+    scalars and the key are replicated."""
+    n = config.size
+    w_loc = state.weights
+    n_loc = w_loc.shape[0]
+    d = jax.lax.axis_index(SOUP_AXIS)
+    start = d * n_loc
+    topo = config.topo
+
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+
+    # one collective: everyone sees the start-of-generation population
+    all_w = jax.lax.all_gather(w_loc, SOUP_AXIS, tiled=True)  # (N, P)
+
+    # --- attack ---------------------------------------------------------
+    if config.attacking_rate > 0:
+        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+        att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
+        has_attacker = att_loc >= 0
+        attacker_w = all_w[jnp.clip(att_loc, 0)]
+        attacked = jax.vmap(lambda s, t: apply_to_weights(topo, s, t))(attacker_w, w_loc)
+        w_loc = jnp.where(has_attacker[:, None], attacked, w_loc)
+        attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
+        attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
+    else:
+        attack_gate_loc = jnp.zeros(n_loc, bool)
+        attack_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+
+    # --- learn_from -----------------------------------------------------
+    # imitation targets come from the start-of-generation gather; the
+    # single-device path uses post-attack weights, an intra-generation
+    # staleness difference only for the rare learn-from-an-attacked-victim
+    if config.learn_from_rate > 0:
+        learn_gate = jax.random.uniform(k_lg, (n,)) < config.learn_from_rate
+        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+        learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start, n_loc)
+        learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
+        if config.learn_from_severity > 0:
+            learned, _ = jax.vmap(lambda wi, ow: _learn_epochs(config, wi, ow))(
+                w_loc, all_w[learn_tgt_loc])
+            w_loc = jnp.where(learn_gate_loc[:, None], learned, w_loc)
+    else:
+        learn_gate_loc = jnp.zeros(n_loc, bool)
+        learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+
+    # --- train ----------------------------------------------------------
+    if config.train > 0:
+        w_loc, train_loss = jax.vmap(lambda wi: _train_epochs(config, wi))(w_loc)
+    else:
+        train_loss = jnp.zeros(n_loc, w_loc.dtype)
+
+    # --- respawn with per-device uid blocks -----------------------------
+    # pre-count deaths to carve a uid block for this device, then reuse the
+    # single-device respawn with that block base — one semantic source
+    dead_now = jnp.zeros(n_loc, bool)
+    if config.remove_divergent:
+        dead_now = dead_now | is_diverged(w_loc)
+    if config.remove_zero:
+        dead_now = dead_now | is_zero(w_loc, config.epsilon)
+    local_deaths = dead_now.sum(dtype=jnp.int32)
+    deaths_by_dev = jax.lax.all_gather(local_deaths, SOUP_AXIS)  # (D,)
+    my_uid_base = state.next_uid + jnp.sum(
+        jnp.where(jnp.arange(deaths_by_dev.shape[0]) < d, deaths_by_dev, 0))
+    new_w, new_uids, _, death_action, death_cp = _respawn(
+        config, w_loc, state.uids, my_uid_base, jax.random.fold_in(k_re, d))
+    next_uid = state.next_uid + deaths_by_dev.sum()
+
+    # --- event record (last action wins, shared tail) -------------------
+    # uid of a global index: gather from the uid table
+    all_uids = jax.lax.all_gather(state.uids, SOUP_AXIS, tiled=True)
+    action, counterpart = _event_record(
+        n_loc, attack_gate_loc, all_uids[attack_tgt_loc],
+        learn_gate_loc, all_uids[learn_tgt_loc],
+        config.train > 0, death_action, death_cp)
+
+    new_state = SoupState(new_w, new_uids, next_uid, state.time + 1, key)
+    return new_state, SoupEvents(action, counterpart, train_loss)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mesh"))
+def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
+    """One generation with the particle axis sharded over ``mesh``."""
+    fn = shard_map(
+        functools.partial(_local_evolve, config),
+        mesh=mesh,
+        in_specs=(_state_specs(),),
+        out_specs=(_state_specs(), _event_specs()),
+        check_vma=False,
+    )
+    return fn(state)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mesh", "generations"))
+def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations: int = 1):
+    """Scan ``generations`` sharded steps (collectives stay inside the scan —
+    one compiled program for the whole evolution)."""
+
+    def body(fn_state, _):
+        new_state, _ev = sharded_evolve_step(config, mesh, fn_state)
+        return new_state, None
+
+    final, _ = jax.lax.scan(body, state, None, length=generations)
+    return final
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mesh"))
+def sharded_count(config: SoupConfig, mesh: Mesh, state: SoupState) -> jnp.ndarray:
+    """(5,) global class histogram: local classify + psum."""
+
+    def local_count(w_loc):
+        return count_classes(classify_batch(config.topo, w_loc, config.epsilon))
+
+    fn = shard_map(
+        lambda w: jax.lax.psum(local_count(w), SOUP_AXIS),
+        mesh=mesh,
+        in_specs=(P(SOUP_AXIS),),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(state.weights)
+
+
+def make_sharded_state(config: SoupConfig, mesh: Mesh, key: jax.Array) -> SoupState:
+    """Seed a population already placed with the soup sharding."""
+    from ..soup import seed
+
+    n_dev = mesh.devices.size
+    if config.size % n_dev:
+        raise ValueError(
+            f"soup size {config.size} must be divisible by the mesh's "
+            f"{n_dev} devices (each device owns an equal shard)")
+    state = seed(config, key)
+    specs = _state_specs()
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), state, specs)

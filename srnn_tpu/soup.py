@@ -126,25 +126,49 @@ def _train_epochs(config: SoupConfig, w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.
     return new_w, losses[-1] if config.train > 0 else jnp.zeros((), w.dtype)
 
 
-def _respawn(config: SoupConfig, w, uids, next_uid, key):
+def _respawn(config: SoupConfig, w, uids, uid_base, key):
     """Replace dead particles in place with fresh nets and fresh uids
     (``soup.py:77-86``). Divergent check precedes zero check; both act on the
-    particle's end-of-step weights."""
+    particle's end-of-step weights.
+
+    ``uid_base`` is the first uid available to THIS block of particles —
+    the global counter on one device, a per-device block base under
+    sharding.  Returns the local death count so the caller can advance the
+    global counter.
+    """
     action = jnp.full(w.shape[0], ACT_NONE, jnp.int32)
     dead_div = is_diverged(w) if config.remove_divergent else jnp.zeros(w.shape[0], bool)
     dead_zero = (is_zero(w, config.epsilon) & ~dead_div) if config.remove_zero else jnp.zeros(w.shape[0], bool)
     dead = dead_div | dead_zero
     fresh = init_population(config.topo, key, w.shape[0])
     new_w = jnp.where(dead[:, None], fresh, w)
-    # fresh uids: rank among the dead, offset by the running counter
+    # fresh uids: rank among the dead, offset by the block base
     rank = jnp.cumsum(dead) - 1
-    new_uids = jnp.where(dead, next_uid + rank.astype(jnp.int32), uids)
-    next_uid = next_uid + dead.sum(dtype=jnp.int32)
+    new_uids = jnp.where(dead, uid_base + rank.astype(jnp.int32), uids)
+    deaths = dead.sum(dtype=jnp.int32)
     action = jnp.where(dead_div, ACT_DIV_DEAD, action)
     action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
     # counterpart of a death event is the replacement's uid (soup.py:81,86)
     counterpart = jnp.where(dead, new_uids, -1)
-    return new_w, new_uids, next_uid, action, counterpart
+    return new_w, new_uids, deaths, action, counterpart
+
+
+def _event_record(n, attack_gate, attack_cp, learn_gate, learn_cp, train_on,
+                  death_action, death_cp):
+    """Last-action-wins event tail shared by the local and sharded paths
+    (reference description-dict overwrite quirk, ``soup.py:55-87``)."""
+    action = jnp.full(n, ACT_NONE, jnp.int32)
+    counterpart = jnp.full(n, -1, jnp.int32)
+    action = jnp.where(attack_gate, ACT_ATTACK, action)
+    counterpart = jnp.where(attack_gate, attack_cp, counterpart)
+    action = jnp.where(learn_gate, ACT_LEARN, action)
+    counterpart = jnp.where(learn_gate, learn_cp, counterpart)
+    if train_on:
+        action = jnp.full(n, ACT_TRAIN, jnp.int32)
+        counterpart = jnp.full(n, -1, jnp.int32)
+    action = jnp.where(death_action != ACT_NONE, death_action, action)
+    counterpart = jnp.where(death_action != ACT_NONE, death_cp, counterpart)
+    return action, counterpart
 
 
 def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
@@ -194,22 +218,15 @@ def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, S
         train_loss = jnp.zeros(n, w.dtype)
 
     # --- respawn (soup.py:77-86) ---------------------------------------
-    w, uids, next_uid, death_action, death_cp = _respawn(
+    w, uids, deaths, death_action, death_cp = _respawn(
         config, w, state.uids, state.next_uid, k_re)
+    next_uid = state.next_uid + deaths
 
-    # --- event record: last action wins (soup.py:55-87 quirk) ----------
-    action = jnp.full(n, ACT_NONE, jnp.int32)
-    counterpart = jnp.full(n, -1, jnp.int32)
+    # --- event record: last action wins (soup.py:55-87 quirk);
     # the reference logs 'attacking' on the ATTACKER; victims log nothing
-    action = jnp.where(attack_gate, ACT_ATTACK, action)
-    counterpart = jnp.where(attack_gate, state.uids[attack_tgt], counterpart)
-    action = jnp.where(learn_gate, ACT_LEARN, action)
-    counterpart = jnp.where(learn_gate, state.uids[learn_tgt], counterpart)
-    if config.train > 0:
-        action = jnp.full(n, ACT_TRAIN, jnp.int32)
-        counterpart = jnp.full(n, -1, jnp.int32)
-    action = jnp.where(death_action != ACT_NONE, death_action, action)
-    counterpart = jnp.where(death_action != ACT_NONE, death_cp, counterpart)
+    action, counterpart = _event_record(
+        n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
+        config.train > 0, death_action, death_cp)
 
     new_state = SoupState(w, uids, next_uid, state.time + 1, key)
     return new_state, SoupEvents(action, counterpart, train_loss)

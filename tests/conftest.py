@@ -1,16 +1,22 @@
 """Test env: force an 8-device virtual CPU platform so sharding/mesh logic is
-exercised without TPU hardware (SURVEY §4 implication (c)).  Must run before
-jax initializes its backends, hence top of conftest."""
+exercised without TPU hardware (SURVEY §4 implication (c)).
+
+pytest's plugin machinery imports jax before this file runs, so the
+JAX_PLATFORMS env var is already snapshotted — we must go through
+jax.config.update instead.  XLA_FLAGS is still read at backend-init time,
+which hasn't happened yet, so the env route works for the device count."""
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env presets a TPU platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

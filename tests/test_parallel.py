@@ -1,0 +1,119 @@
+"""Distributed tests on the 8-device virtual CPU mesh (conftest forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8; SURVEY §4 implication (c))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology, init_population
+from srnn_tpu.nets import apply_to_weights
+from srnn_tpu.parallel import (
+    ring_rnn_apply,
+    shard_population,
+    sharded_count,
+    sharded_evolve,
+    sharded_evolve_step,
+    soup_mesh,
+)
+from srnn_tpu.parallel import make_sharded_state
+from srnn_tpu.soup import SoupConfig, count, evolve_step, seed
+from tests.test_apply import WW
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return soup_mesh()
+
+
+def test_sharded_attack_train_bitwise_matches_unsharded(mesh):
+    """Attack + train phases are bit-identical to the single-device parallel
+    soup under matched keys (no respawn, no learn_from)."""
+    cfg = SoupConfig(topo=WW, size=16, attacking_rate=0.4, learn_from_rate=0.0,
+                     train=2)
+    s0 = seed(cfg, jax.random.key(0))
+    ref, _ = evolve_step(cfg, s0)
+    sh_state = make_sharded_state(cfg, mesh, jax.random.key(0))
+    got, _ = sharded_evolve_step(cfg, mesh, sh_state)
+    np.testing.assert_array_equal(np.asarray(ref.weights), np.asarray(got.weights))
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(got.uids))
+    assert int(ref.time) == int(got.time)
+
+
+def test_sharded_events_match_unsharded(mesh):
+    cfg = SoupConfig(topo=WW, size=16, attacking_rate=0.5, learn_from_rate=0.3,
+                     learn_from_severity=1, train=0)
+    s0 = seed(cfg, jax.random.key(1))
+    _, ev_ref = evolve_step(cfg, s0)
+    _, ev_got = sharded_evolve_step(cfg, mesh, make_sharded_state(cfg, mesh, jax.random.key(1)))
+    np.testing.assert_array_equal(np.asarray(ev_ref.action), np.asarray(ev_got.action))
+    np.testing.assert_array_equal(np.asarray(ev_ref.counterpart), np.asarray(ev_got.counterpart))
+
+
+def test_sharded_soup_full_run_with_respawn(mesh):
+    """Full sharded soup with respawn: distributionally equivalent outcome
+    (same class histogram shape, no NaN leakage, global uid monotonicity)."""
+    cfg = SoupConfig(topo=WW, size=24, attacking_rate=0.3, learn_from_rate=-1,
+                     train=5, remove_divergent=True, remove_zero=True)
+    state = make_sharded_state(cfg, mesh, jax.random.key(2))
+    final = sharded_evolve(cfg, mesh, state, generations=10)
+    counts = sharded_count(cfg, mesh, final)
+    assert int(counts.sum()) == 24
+    assert int(final.time) == 10
+    uids = np.asarray(final.uids)
+    assert len(set(uids.tolist())) == 24  # all uids unique after respawns
+    assert int(final.next_uid) >= 24
+
+
+def test_sharded_count_matches_local_count(mesh):
+    cfg = SoupConfig(topo=WW, size=32, attacking_rate=0.0, learn_from_rate=0.0)
+    s = seed(cfg, jax.random.key(3))
+    local = count(cfg, s)
+    sh = sharded_count(cfg, mesh, make_sharded_state(cfg, mesh, jax.random.key(3)))
+    np.testing.assert_array_equal(np.asarray(local), np.asarray(sh))
+
+
+def test_sharded_population_placement(mesh):
+    pop = init_population(WW, jax.random.key(4), 16)
+    sharded = shard_population(mesh, pop)
+    assert sharded.sharding.spec == jax.sharding.PartitionSpec("soup")
+    np.testing.assert_array_equal(np.asarray(sharded), np.asarray(pop))
+
+
+def test_ring_rnn_matches_single_device(mesh):
+    """Sequence-parallel RNN apply == serial scan, for a sequence length
+    divisible by the mesh (T=1024 over 8 devices)."""
+    topo = Topology("recurrent", width=4, depth=2)
+    rng = np.random.default_rng(0)
+    self_flat = jnp.asarray((rng.normal(size=topo.num_weights) * 0.3).astype(np.float32))
+    t = 1024
+    target = jnp.asarray(rng.normal(size=t).astype(np.float32))
+
+    # serial reference on padded-to-T sequence via the variant's forward
+    from srnn_tpu.nets.recurrent import forward
+    expected = forward(topo, self_flat, target[:, None])[:, 0]
+
+    got = ring_rnn_apply(topo, mesh, self_flat, shard_population(mesh, target))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-6)
+
+
+def test_ring_rnn_tanh(mesh):
+    topo = Topology("recurrent", width=2, depth=2, activation="tanh")
+    rng = np.random.default_rng(1)
+    self_flat = jnp.asarray((rng.normal(size=topo.num_weights) * 0.3).astype(np.float32))
+    target = jnp.asarray(rng.normal(size=64).astype(np.float32))
+    from srnn_tpu.nets.recurrent import forward
+    expected = forward(topo, self_flat, target[:, None])[:, 0]
+    got = ring_rnn_apply(topo, mesh, self_flat, shard_population(mesh, target))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-6)
+
+
+def test_data_parallel_fixpoint_run_over_mesh(mesh):
+    """run_fixpoint is embarrassingly parallel: jit with a sharded population
+    compiles to per-device work without code changes (pjit auto-sharding)."""
+    from srnn_tpu.engine import run_fixpoint
+
+    pop = shard_population(mesh, init_population(WW, jax.random.key(5), 64))
+    res = run_fixpoint(WW, pop, step_limit=20)
+    assert int(res.counts.sum()) == 64
