@@ -1,0 +1,70 @@
+"""Benchmark: self-applications/sec on the current accelerator.
+
+Workload: the reference's hot operation — weightwise self-application
+(reference ``network.py:265-279``: one keras ``predict`` per scalar weight
+there) — at the BASELINE.json mega-soup scale of 1M particles, using the
+fused population-major Pallas kernel (``srnn_tpu/ops/pallas_ww.py``): the
+particle axis rides the 128-wide TPU lanes and chained steps stay in VMEM.
+
+North star (BASELINE.json): >= 10M self-applications/sec on a v4-32, i.e.
+312,500/sec/chip.  ``vs_baseline`` is the per-chip multiple of that.
+
+Timing notes: on the tunneled 'axon' platform ``block_until_ready`` does
+not actually synchronize, so the measurement forces a scalar readback; per-
+call RPC latency is amortized by running many chained steps per dispatch.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import time
+
+import jax
+
+from srnn_tpu import Topology, init_population
+from srnn_tpu.ops.pallas_ww import ww_apply_population
+
+N = 1_000_000
+STEPS_PER_CALL = 2000
+CALLS = 3
+BASELINE_PER_CHIP = 10_000_000 / 32  # BASELINE.json north star, v4-32
+
+
+def main():
+    topo = Topology("weightwise", width=2, depth=2)  # science-default f32 precision
+    # damped init keeps the iteration numerically tame for the whole run;
+    # throughput is magnitude-independent
+    wT = (init_population(topo, jax.random.key(0), N) * 0.05).T
+
+    use_pallas = jax.default_backend() == "tpu"  # Mosaic kernel is TPU-only
+
+    @jax.jit
+    def run(wT):
+        if use_pallas:
+            out = ww_apply_population(topo, wT, steps=STEPS_PER_CALL)
+        else:
+            from srnn_tpu.ops.pallas_ww import ww_apply_population_jnp
+
+            def step(w, _):
+                return ww_apply_population_jnp(topo, w), None
+            out = jax.lax.scan(step, wT, None, length=STEPS_PER_CALL)[0]
+        return out, out.sum()
+
+    _ = float(run(wT)[1])  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(CALLS):
+        _ = float(run(wT)[1])  # scalar readback forces completion
+    dt = time.perf_counter() - t0
+
+    apps_per_sec = N * STEPS_PER_CALL * CALLS / dt
+    per_chip = apps_per_sec / jax.device_count()
+    print(json.dumps({
+        "metric": "self-applications/sec/chip",
+        "value": round(per_chip),
+        "unit": "applications/s",
+        "vs_baseline": round(per_chip / BASELINE_PER_CHIP, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

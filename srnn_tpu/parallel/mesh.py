@@ -54,9 +54,13 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
 
     No-op (returns False) when neither explicit arguments nor cluster env
     vars (``JAX_COORDINATOR_ADDRESS`` / TPU pod metadata) are present, so
-    single-host runs and tests never pay for it.
+    single-host runs and tests never pay for it.  Any explicit argument
+    forces initialization (jax can auto-detect the rest on managed
+    clusters).
     """
-    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ \
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
+    if not explicit and "JAX_COORDINATOR_ADDRESS" not in os.environ \
             and os.environ.get("TPU_WORKER_HOSTNAMES") is None:
         return False
     jax.distributed.initialize(

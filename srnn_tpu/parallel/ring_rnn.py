@@ -68,11 +68,19 @@ def ring_rnn_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
     """Sequence-parallel equivalent of ``recurrent.apply``.
 
     ``self_flat`` is replicated (the net's own parameters); ``target_flat``
-    (T,) is sharded over the mesh on the time axis.  Returns the new target,
-    sharded the same way; numerically identical to the single-device scan.
+    (T,) is sharded over the mesh on the time axis.  T need not divide the
+    mesh: the tail is zero-padded to a multiple of D and sliced back — safe
+    because the recurrence is causal, so padding after position T cannot
+    affect the kept outputs.  (Real particle sequences have odd T — e.g.
+    P=17 for the width-2 depth-2 net — so padding is the common case.)
+    Numerically identical to the single-device scan.
     """
     assert topo.variant == "recurrent"
     n_dev = mesh.devices.size
+    t = target_flat.shape[0]
+    pad = (-t) % n_dev
+    if pad:
+        target_flat = jnp.pad(target_flat, (0, pad))
 
     def body(self_flat, tgt_loc):
         return _local_forward(topo, n_dev, self_flat, tgt_loc[:, None])[:, 0]
@@ -83,4 +91,5 @@ def ring_rnn_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
         out_specs=P(SOUP_AXIS),
         check_vma=False,
     )
-    return fn(self_flat, target_flat)
+    out = fn(self_flat, target_flat)
+    return out[:t] if pad else out

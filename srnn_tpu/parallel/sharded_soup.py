@@ -47,6 +47,7 @@ from ..soup import (
     _learn_epochs,
     _respawn,
     _train_epochs,
+    seed,
 )
 from ..engine import classify_batch
 from .mesh import SOUP_AXIS
@@ -194,8 +195,6 @@ def sharded_count(config: SoupConfig, mesh: Mesh, state: SoupState) -> jnp.ndarr
 
 def make_sharded_state(config: SoupConfig, mesh: Mesh, key: jax.Array) -> SoupState:
     """Seed a population already placed with the soup sharding."""
-    from ..soup import seed
-
     n_dev = mesh.devices.size
     if config.size % n_dev:
         raise ValueError(

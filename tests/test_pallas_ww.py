@@ -1,0 +1,53 @@
+"""Pallas weightwise population kernel vs the reference vmap path
+(interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology, init_population
+from srnn_tpu.nets import apply_to_weights
+from srnn_tpu.ops.pallas_ww import ww_apply_population, ww_apply_population_jnp
+from tests.test_apply import WW, identity_fixpoint_flat
+
+
+@pytest.mark.parametrize("activation", ["linear", "sigmoid"])
+def test_kernel_matches_vmap(activation):
+    topo = Topology("weightwise", activation=activation)
+    pop = init_population(topo, jax.random.key(0), 64) * 0.3
+    ref = jax.vmap(lambda w: apply_to_weights(topo, w, w))(pop)
+    out = ww_apply_population(topo, pop.T, interpret=True)
+    np.testing.assert_allclose(np.asarray(out.T), np.asarray(ref), rtol=1e-5, atol=1e-7)
+
+
+def test_kernel_multi_step_chains():
+    pop = init_population(WW, jax.random.key(1), 16) * 0.05
+    ref = pop
+    for _ in range(4):
+        ref = jax.vmap(lambda w: apply_to_weights(WW, w, w))(ref)
+    out = ww_apply_population(WW, pop.T, steps=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(out.T), np.asarray(ref), rtol=1e-5, atol=1e-7)
+
+
+def test_kernel_identity_fixpoint_exact():
+    ident = jnp.asarray(identity_fixpoint_flat())
+    wT = jnp.tile(ident[:, None], (1, 8))
+    out = ww_apply_population(WW, wT, steps=10, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(wT))
+
+
+def test_kernel_pads_ragged_population():
+    # N not a multiple of the lane block
+    pop = init_population(WW, jax.random.key(2), 37) * 0.3
+    ref = jax.vmap(lambda w: apply_to_weights(WW, w, w))(pop)
+    out = ww_apply_population(WW, pop.T, interpret=True)
+    assert out.shape == (14, 37)
+    np.testing.assert_allclose(np.asarray(out.T), np.asarray(ref), rtol=1e-5, atol=1e-7)
+
+
+def test_jnp_fallback_matches_vmap():
+    pop = init_population(WW, jax.random.key(3), 50) * 0.3
+    ref = jax.vmap(lambda w: apply_to_weights(WW, w, w))(pop)
+    out = ww_apply_population_jnp(WW, pop.T)
+    np.testing.assert_allclose(np.asarray(out.T), np.asarray(ref), rtol=1e-5, atol=1e-7)

@@ -117,3 +117,18 @@ def test_data_parallel_fixpoint_run_over_mesh(mesh):
     pop = shard_population(mesh, init_population(WW, jax.random.key(5), 64))
     res = run_fixpoint(WW, pop, step_limit=20)
     assert int(res.counts.sum()) == 64
+
+
+def test_ring_rnn_real_particle_odd_length(mesh):
+    """The motivating workload: a real particle's weight sequence (P=17,
+    odd, not divisible by 8 devices) — causal zero-padding must make this
+    exact."""
+    topo = Topology("recurrent", width=2, depth=2)
+    rng = np.random.default_rng(2)
+    self_flat = jnp.asarray((rng.normal(size=topo.num_weights) * 0.3).astype(np.float32))
+    target = jnp.asarray(rng.normal(size=topo.num_weights).astype(np.float32))
+    from srnn_tpu.nets.recurrent import forward
+    expected = forward(topo, self_flat, target[:, None])[:, 0]
+    got = ring_rnn_apply(topo, mesh, self_flat, target)
+    assert got.shape == (17,)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-6)
