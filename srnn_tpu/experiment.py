@@ -1,0 +1,291 @@
+"""Experiment runtime: run directories, logging, persistence, resume.
+
+Reference layer L1 (``experiment.py:8-59``): a context manager that creates
+``experiments/exp-{name}-{id}-{iteration}/``, collects log messages in RAM
+(flushed to ``log.txt`` on exit), and dill-dumps arbitrary keyword objects.
+The reference has **no mid-run resume** — ``next_iteration`` exists
+(``experiment.py:18,33``) but every run restarts from scratch.
+
+TPU-native redesign:
+
+  * Artifacts are **safe, inspectable formats** instead of dill pickles:
+    arrays/pytrees of arrays -> ``.npz`` (flattened path keys), plain
+    JSON-able python -> ``.json``.  ``load_artifact`` round-trips both.
+  * Logging is dual: human ``log.txt`` lines (reference parity — the
+    committed ``results/*/log.txt`` files are the baseline artifacts,
+    SURVEY §6) plus structured ``events.jsonl`` records for tooling.
+  * **True checkpoint/resume** via orbax: the whole ``SoupState`` pytree
+    (weights, uids, PRNG key, generation counter) round-trips, so a soup can
+    continue exactly where it stopped — the capability gap called out in
+    SURVEY §5 (checkpoint/resume row).
+  * Counters are jnp (5,) histograms; ``format_counters`` renders them as
+    the reference's dict repr so log lines stay diffable against the
+    committed baselines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from .ops.predicates import CLASS_NAMES
+from .soup import SoupState
+
+_SEP = "/"  # path separator for flattened pytree keys inside npz files
+_VALUE_KEY = "__value__"  # reserved npz key for a bare (non-pytree) array
+
+
+# ---------------------------------------------------------------------------
+# artifact persistence (npz / json instead of dill)
+# ---------------------------------------------------------------------------
+
+
+def _is_arraylike(x) -> bool:
+    return isinstance(x, (np.ndarray, jax.Array))
+
+
+def save_artifact(path: str, value: Any) -> str:
+    """Persist one artifact; returns the full filename written.
+
+    Pytrees whose leaves are all arrays (or scalars) go to ``{path}.npz``
+    with flattened key paths; everything JSON-serializable goes to
+    ``{path}.json``.  The reference dill-dumps arbitrary objects
+    (``experiment.py:56-59``); restricting to data formats keeps artifacts
+    loadable without the producing code and safe to share.
+    """
+    # typed PRNG keys can't cross into numpy; store their raw key data.
+    # (Exact resume should go through save_checkpoint, which keeps the impl.)
+    value = jax.tree.map(
+        lambda v: jax.random.key_data(v)
+        if isinstance(v, jax.Array) and jax.dtypes.issubdtype(v.dtype, jax.dtypes.prng_key)
+        else v,
+        value)
+    leaves, treedef = jax.tree.flatten_with_path(value)
+    numeric = lambda v: _is_arraylike(v) or isinstance(v, (int, float, complex, np.number, np.bool_))
+    if leaves and all(numeric(v) for _, v in leaves):
+        flat = {}
+        for keypath, leaf in leaves:
+            key = _SEP.join(_key_str(k) for k in keypath) or _VALUE_KEY
+            if key in flat:
+                raise ValueError(
+                    f"flattened key collision at {key!r} (a dict key containing "
+                    f"{_SEP!r} collides with nesting); rename the offending key")
+            flat[key] = np.asarray(leaf)
+        fname = path + ".npz"
+        np.savez_compressed(fname, **flat)
+        return fname
+    fname = path + ".json"
+    with open(fname, "w") as f:
+        json.dump(_jsonify(value), f, indent=1, default=str)
+    return fname
+
+
+def _key_str(k) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _jsonify(v):
+    if _is_arraylike(v):
+        return np.asarray(v).tolist()
+    if isinstance(v, dict):
+        return {str(k): _jsonify(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonify(x) for x in v]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def load_artifact(path: str) -> Any:
+    """Load an artifact written by :func:`save_artifact`.
+
+    ``.npz`` artifacts come back as a flat ``{path_key: np.ndarray}`` dict
+    (or a bare array when it was saved as a single value); ``.json`` as
+    parsed JSON.  Accepts the basename or the full filename.
+    """
+    if os.path.exists(path + ".npz"):
+        path = path + ".npz"
+    elif os.path.exists(path + ".json"):
+        path = path + ".json"
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            out = {k: z[k] for k in z.files}
+        if set(out) == {_VALUE_KEY}:
+            return out[_VALUE_KEY]
+        return out
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# counters
+# ---------------------------------------------------------------------------
+
+
+def counters_dict(counts) -> Dict[str, int]:
+    """(5,) histogram -> the reference's counter dict
+    (``experiment.py:67``: keys divergent/fix_zero/fix_other/fix_sec/other)."""
+    arr = np.asarray(counts)
+    return {name: int(arr[i]) for i, name in enumerate(CLASS_NAMES)}
+
+
+def format_counters(counts) -> str:
+    """Render a histogram exactly like the reference's logged dict repr, so
+    log lines stay textually comparable to ``results/*/log.txt``."""
+    return str(counters_dict(counts))
+
+
+# ---------------------------------------------------------------------------
+# the Experiment run-directory context
+# ---------------------------------------------------------------------------
+
+
+class Experiment:
+    """Run-directory + log manager (reference ``Experiment``,
+    ``experiment.py:8-59``).
+
+    >>> with Experiment('applying_fixpoint', root='experiments') as exp:
+    ...     exp.log('counters: ...')
+    ...     exp.save(all_counters=counts)        # -> all_counters.npz
+
+    On exit, ``log.txt`` (one line per ``log()`` call) and ``meta.json``
+    are written.  ``next_iteration`` increments per ``with`` entry, giving
+    ``-0``, ``-1``, ... suffixed sibling dirs like the reference.
+    """
+
+    def __init__(self, name: Optional[str] = None, ident: Optional[str] = None,
+                 root: str = "experiments", seed: Optional[int] = None):
+        self.experiment_name = name or "unnamed_experiment"
+        self.experiment_id = f"{ident or ''}_{time.time()}"
+        self.root = root
+        self.next_iteration = 0
+        self.seed = seed
+        self.log_messages: list = []
+        self.dir: Optional[str] = None
+        self._t0: Optional[float] = None
+
+    # -- context ---------------------------------------------------------
+
+    def __enter__(self) -> "Experiment":
+        self.dir = os.path.join(
+            self.root,
+            f"exp-{self.experiment_name}-{self.experiment_id}-{self.next_iteration}")
+        os.makedirs(self.dir)
+        self.log_messages = []
+        self._t0 = time.time()
+        self._events = open(os.path.join(self.dir, "events.jsonl"), "w")
+        print(f"** created {self.dir} **")
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        self.save_log()
+        meta = {
+            "name": self.experiment_name,
+            "id": self.experiment_id,
+            "iteration": self.next_iteration,
+            "seed": self.seed,
+            "wall_seconds": time.time() - self._t0,
+            "error": repr(exc_value) if exc_value is not None else None,
+        }
+        with open(os.path.join(self.dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+        self._events.close()
+        self.next_iteration += 1
+        return False
+
+    # -- logging ---------------------------------------------------------
+
+    def log(self, message, **event_fields):
+        """Print + record a log line (``experiment.py:35-37``); any keyword
+        fields additionally emit a structured jsonl event."""
+        self.log_messages.append(message)
+        print(message)
+        if event_fields:
+            self.event(message=str(message), **event_fields)
+
+    def event(self, **fields):
+        """Append one structured record to ``events.jsonl``."""
+        fields.setdefault("t", time.time() - self._t0)
+        self._events.write(json.dumps(_jsonify(fields), default=str) + "\n")
+        self._events.flush()
+
+    def save_log(self, log_name: str = "log"):
+        with open(os.path.join(self.dir, f"{log_name}.txt"), "w") as f:
+            for message in self.log_messages:
+                print(str(message), file=f)
+
+    # -- artifacts -------------------------------------------------------
+
+    def save(self, **kwargs) -> Dict[str, str]:
+        """Persist each keyword artifact into the run dir
+        (``experiment.py:56-59``); returns {name: filename}."""
+        out = {}
+        for name, value in kwargs.items():
+            out[name] = save_artifact(os.path.join(self.dir, name), value)
+        return out
+
+    def load(self, name: str) -> Any:
+        return load_artifact(os.path.join(self.dir, name))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume (orbax) — capability the reference lacks (SURVEY §5)
+# ---------------------------------------------------------------------------
+
+
+def _soup_state_to_pytree(state: SoupState) -> Dict[str, Any]:
+    """Typed PRNG keys don't serialize; split into raw key data + impl tag."""
+    return {
+        "weights": state.weights,
+        "uids": state.uids,
+        "next_uid": state.next_uid,
+        "time": state.time,
+        "key_data": jax.random.key_data(state.key),
+        "key_impl": str(jax.random.key_impl(state.key)),
+    }
+
+
+def _soup_state_from_pytree(tree: Dict[str, Any]) -> SoupState:
+    import jax.numpy as jnp
+
+    key = jax.random.wrap_key_data(
+        jnp.asarray(tree["key_data"]), impl=str(tree["key_impl"]))
+    return SoupState(
+        weights=jnp.asarray(tree["weights"]),
+        uids=jnp.asarray(tree["uids"]),
+        next_uid=jnp.asarray(tree["next_uid"]),
+        time=jnp.asarray(tree["time"]),
+        key=key,
+    )
+
+
+def save_checkpoint(path: str, state: SoupState) -> str:
+    """Write a resumable checkpoint of a soup (weights + uids + PRNG key +
+    generation counter) at ``path`` (a directory, created fresh)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, _soup_state_to_pytree(state), force=True)
+    return path
+
+
+def restore_checkpoint(path: str) -> SoupState:
+    """Load a :func:`save_checkpoint` checkpoint back into a live
+    ``SoupState``; evolution continues bit-exactly (same PRNG stream)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(path)
+    return _soup_state_from_pytree(tree)

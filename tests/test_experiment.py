@@ -1,0 +1,119 @@
+"""Experiment runtime: run dirs, logging, artifacts, checkpoint/resume."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology
+from srnn_tpu.experiment import (
+    Experiment,
+    counters_dict,
+    format_counters,
+    load_artifact,
+    restore_checkpoint,
+    save_artifact,
+    save_checkpoint,
+)
+from srnn_tpu.soup import SoupConfig, evolve, seed
+
+
+def test_run_dir_and_log(tmp_path):
+    exp = Experiment("demo", ident="t", root=str(tmp_path))
+    with exp as e:
+        e.log("hello")
+        e.log("counters: {'divergent': 1}")
+        run_dir = e.dir
+    assert os.path.isdir(run_dir)
+    assert run_dir.endswith("-0")
+    lines = open(os.path.join(run_dir, "log.txt")).read().splitlines()
+    assert lines == ["hello", "counters: {'divergent': 1}"]
+    meta = json.load(open(os.path.join(run_dir, "meta.json")))
+    assert meta["name"] == "demo" and meta["error"] is None
+    # second entry gets the next iteration suffix (experiment.py:33)
+    with exp as e:
+        second = e.dir
+    assert second.endswith("-1") and second != run_dir
+
+
+def test_structured_events(tmp_path):
+    with Experiment("ev", root=str(tmp_path)) as e:
+        e.log("step done", step=3, counts=np.array([1, 2]))
+        e.event(kind="checkpoint", gen=7)
+        run_dir = e.dir
+    recs = [json.loads(l) for l in open(os.path.join(run_dir, "events.jsonl"))]
+    assert recs[0]["step"] == 3 and recs[0]["counts"] == [1, 2]
+    assert recs[1]["kind"] == "checkpoint" and "t" in recs[1]
+
+
+def test_artifact_roundtrip_array_and_pytree(tmp_path):
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    f = save_artifact(str(tmp_path / "a"), arr)
+    assert f.endswith(".npz")
+    back = load_artifact(str(tmp_path / "a"))
+    np.testing.assert_array_equal(back, arr)
+
+    tree = {"xs": np.arange(3), "nested": {"ys": jnp.ones(2)}}
+    save_artifact(str(tmp_path / "tree"), tree)
+    back = load_artifact(str(tmp_path / "tree"))
+    np.testing.assert_array_equal(back["xs"], np.arange(3))
+    np.testing.assert_array_equal(back["nested/ys"], np.ones(2))
+
+
+def test_artifact_json_fallback(tmp_path):
+    value = {"names": ["ww", "agg"], "rate": 0.1}
+    f = save_artifact(str(tmp_path / "names"), value)
+    assert f.endswith(".json")
+    assert load_artifact(str(tmp_path / "names")) == value
+
+
+def test_artifact_prng_key_and_collisions(tmp_path):
+    # typed PRNG keys are stored as raw key data, not a crash
+    state_like = {"w": jnp.ones(3), "key": jax.random.key(0)}
+    save_artifact(str(tmp_path / "st"), state_like)
+    back = load_artifact(str(tmp_path / "st"))
+    np.testing.assert_array_equal(
+        back["key"], np.asarray(jax.random.key_data(jax.random.key(0))))
+    # separator collisions are an error, not silent data loss
+    with pytest.raises(ValueError, match="collision"):
+        save_artifact(str(tmp_path / "c"), {"a": {"b": np.zeros(1)}, "a/b": np.ones(1)})
+    # a dict whose only key is 'value' survives as a dict
+    save_artifact(str(tmp_path / "v"), {"value": np.arange(3)})
+    assert set(load_artifact(str(tmp_path / "v"))) == {"value"}
+
+
+def test_experiment_save_load(tmp_path):
+    with Experiment("s", root=str(tmp_path)) as e:
+        e.save(all_counters=jnp.array([1, 2, 3, 4, 5]), all_names={"n": ["x"]})
+        np.testing.assert_array_equal(e.load("all_counters"), [1, 2, 3, 4, 5])
+
+
+def test_format_counters_matches_reference_repr():
+    counts = jnp.array([23, 27, 0, 0, 0])
+    assert format_counters(counts) == (
+        "{'divergent': 23, 'fix_zero': 27, 'fix_other': 0, 'fix_sec': 0, 'other': 0}")
+    assert counters_dict(counts)["divergent"] == 23
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    """A soup restored from a checkpoint must continue exactly as the
+    original would have (weights, uids AND PRNG stream)."""
+    cfg = SoupConfig(topo=Topology("weightwise"), size=8,
+                     attacking_rate=0.3, learn_from_rate=0.0, train=0,
+                     remove_divergent=True, remove_zero=True)
+    state = seed(cfg, jax.random.key(7))
+    mid = evolve(cfg, state, generations=3)
+
+    path = save_checkpoint(str(tmp_path / "ckpt"), mid)
+    restored = restore_checkpoint(path)
+    np.testing.assert_array_equal(np.asarray(restored.weights), np.asarray(mid.weights))
+    assert int(restored.time) == 3
+
+    cont_a = evolve(cfg, mid, generations=2)
+    cont_b = evolve(cfg, restored, generations=2)
+    np.testing.assert_array_equal(np.asarray(cont_a.weights), np.asarray(cont_b.weights))
+    np.testing.assert_array_equal(np.asarray(cont_a.uids), np.asarray(cont_b.uids))
+    assert int(cont_b.time) == 5
