@@ -126,6 +126,41 @@ def run_mixed_fixpoint(
     return FixpointRunResult(w, steps, classes, count_classes(classes), trajectory)
 
 
+class TrainingRunResult(NamedTuple):
+    weights: jnp.ndarray      # (N, P) final weights
+    losses: jnp.ndarray       # (E, N) per-epoch training loss
+    classes: jnp.ndarray      # (N,) 5-way class ids
+    counts: jnp.ndarray       # (5,) class histogram
+    trajectory: Optional[jnp.ndarray]  # (E+1, N, P) weight history or None
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "epochs", "train_mode", "record"))
+def run_training(
+    topo: Topology,
+    pop: jnp.ndarray,
+    epochs: int = 1000,
+    epsilon: float = DEFAULT_EPSILON,
+    lr: float = DEFAULT_LR,
+    train_mode: str = "sequential",
+    record: bool = False,
+) -> TrainingRunResult:
+    """Pure self-training, vectorized over trials
+    (``training-fixpoints.py:52-56``: N trials x ``epochs`` train calls, no
+    self-attacks, then classify).  Each epoch recomputes the samples from
+    the current weights — the reference's moving-target regression toward
+    being a fixpoint (``network.py:613-618``)."""
+
+    def epoch(w, _):
+        new_w, loss = jax.vmap(lambda wi: train_step(topo, wi, lr, train_mode))(w)
+        out = (loss, new_w if record else None)
+        return new_w, out
+
+    w, (losses, traj) = jax.lax.scan(epoch, pop, None, length=epochs)
+    classes = classify_batch(topo, w, epsilon)
+    trajectory = jnp.concatenate([pop[None], traj], axis=0) if record else None
+    return TrainingRunResult(w, losses, classes, count_classes(classes), trajectory)
+
+
 class VariationResult(NamedTuple):
     time_to_vergence: jnp.ndarray   # (N,) steps until zero/divergence (or max)
     time_as_fixpoint: jnp.ndarray   # (N,) steps still classified as the initial fixpoint

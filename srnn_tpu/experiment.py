@@ -66,8 +66,9 @@ def save_artifact(path: str, value: Any) -> str:
         else v,
         value)
     leaves, treedef = jax.tree.flatten_with_path(value)
-    numeric = lambda v: _is_arraylike(v) or isinstance(v, (int, float, complex, np.number, np.bool_))
-    if leaves and all(numeric(v) for _, v in leaves):
+    # npz only when every leaf is an actual array: plain-python structures
+    # (sweep dicts of lists, name lists) keep their shape better as JSON
+    if leaves and all(_is_arraylike(v) for _, v in leaves):
         flat = {}
         for keypath, leaf in leaves:
             key = _SEP.join(_key_str(k) for k in keypath) or _VALUE_KEY

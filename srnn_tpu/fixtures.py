@@ -1,0 +1,48 @@
+"""Known-fixpoint fixtures and fault injection.
+
+The reference's canonical regression fixture is the analytically-known
+identity fixpoint of the weightwise net
+(``setups/known-fixpoint-variation.py:20-25``, reused by ``test.py:95-99``):
+with kernels ``[[1,0],[0,0],...]`` the net computes f([w, ids]) = w, so
+self-application reproduces every weight exactly.  ``vary`` is the
+reference's fault-injection operator (``known-fixpoint-variation.py:37-46``):
+perturb each weight by ±U(0,1)·e with a fair sign coin.
+
+Generalized here beyond the hardcoded 2×2 case: the identity chain routes
+input feature 0 (the weight value) through unit 0 of every hidden layer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .topology import Topology
+
+
+def identity_fixpoint_flat(topo: Topology) -> jnp.ndarray:
+    """The exact identity fixpoint of a weightwise net as a flat vector.
+
+    Layer 0 kernel (4, w): route input 0 (the weight value) to unit 0;
+    hidden kernels (w, w): identity on unit 0; final kernel (w, 1): read
+    unit 0.  For width=2, depth=2 this reproduces the reference's fixture
+    matrices bit-for-bit (``known-fixpoint-variation.py:20-25``).
+    """
+    if topo.variant != "weightwise":
+        raise ValueError("the known identity fixpoint exists for the "
+                         "weightwise variant only (reference note at "
+                         "known-fixpoint-variation.py:29)")
+    parts = []
+    for a, b in topo.layer_shapes:
+        k = np.zeros((a, b), np.float32)
+        k[0, 0] = 1.0
+        parts.append(k.reshape(-1))
+    return jnp.asarray(np.concatenate(parts))
+
+
+def vary(key: jax.Array, flat: jnp.ndarray, e: float = 1.0) -> jnp.ndarray:
+    """Perturb every weight by ±U(0,1)·e, sign chosen by a fair coin
+    (``known-fixpoint-variation.py:37-46``).  Functional: the PRNG key
+    replaces the reference's global ``prng()`` stream."""
+    k_sign, k_mag = jax.random.split(key)
+    sign = jnp.where(jax.random.uniform(k_sign, flat.shape) < 0.5, 1.0, -1.0)
+    return flat + sign * jax.random.uniform(k_mag, flat.shape) * e

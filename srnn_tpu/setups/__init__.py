@@ -1,0 +1,22 @@
+"""Paper-experiment entry points (TPU-native equivalents of the reference's
+``code/setups/*.py`` scripts, SURVEY §2.2).
+
+Run one with ``python -m srnn_tpu.setups <name> [flags]``; every script
+supports ``--smoke`` for a seconds-scale sanity run and writes a reference-
+style run directory (log.txt + npz/json artifacts) under ``--root``.
+"""
+
+from . import (  # noqa: F401  (import for registration side effect)
+    applying_fixpoints,
+    fixpoint_density,
+    known_fixpoint_variation,
+    learn_from_soup,
+    mixed_self_fixpoints,
+    mixed_soup,
+    network_trajectorys,
+    soup_trajectorys,
+    training_fixpoints,
+)
+from .common import REGISTRY
+
+__all__ = ["REGISTRY"]

@@ -1,0 +1,16 @@
+import sys
+
+from . import REGISTRY
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help") or argv[0] not in REGISTRY:
+        names = "\n  ".join(sorted(REGISTRY))
+        print(f"usage: python -m srnn_tpu.setups <name> [flags]\n\nnames:\n  {names}")
+        return 2 if argv and argv[0] not in ("-h", "--help") else 0
+    return REGISTRY[argv[0]](argv[1:]) and 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

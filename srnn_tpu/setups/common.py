@@ -1,0 +1,83 @@
+"""Shared plumbing for the paper-experiment entry points.
+
+Each module in this package is the TPU-native equivalent of one reference
+``code/setups/*.py`` script (SURVEY §2.2): same experiment, same knobs, same
+artifact names — but trials run as one vectorized batch instead of a Python
+loop with ``keras.backend.clear_session()`` hygiene between iterations.
+
+Every script exposes ``build_parser()``, ``run(args)`` and ``main(argv)``,
+and registers itself so ``python -m srnn_tpu.setups <name>`` dispatches.
+``--smoke`` shrinks every knob to seconds-scale for CI.
+"""
+
+import argparse
+from typing import Callable, Dict, Tuple
+
+import jax
+import numpy as np
+
+from ..engine import classify_batch
+from ..experiment import Experiment, format_counters
+from ..soup import SoupConfig, SoupState, evolve, seed
+from ..topology import Topology
+
+REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(main_fn):
+        REGISTRY[name] = main_fn
+        return main_fn
+    return deco
+
+
+# the three standard archs every sweep iterates, in the reference's order
+# and with its display names (e.g. mixed-self-fixpoints.py:63-66)
+STANDARD_VARIANTS: Tuple[Tuple[str, Topology], ...] = (
+    ("WeightwiseNeuralNetwork activation='linear' use_bias=False",
+     Topology("weightwise", width=2, depth=2)),
+    ("AggregatingNeuralNetwork activation='linear' use_bias=False",
+     Topology("aggregating", width=2, depth=2, aggregates=4)),
+    ("RecurrentNeuralNetwork activation='linear' use_bias=False",
+     Topology("recurrent", width=2, depth=2)),
+)
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--root", default="experiments",
+                   help="parent directory for run dirs")
+    p.add_argument("--seed", type=int, default=0, help="PRNG seed")
+    p.add_argument("--epsilon", type=float, default=1e-4,
+                   help="fixpoint epsilon (every reference experiment uses 1e-4)")
+    p.add_argument("--smoke", action="store_true",
+                   help="shrink all knobs to a seconds-scale sanity run")
+    return p
+
+
+def evolve_trials(cfg: SoupConfig, key: jax.Array, trials: int,
+                  generations: int) -> SoupState:
+    """Seed and evolve ``trials`` independent soups as one batched program
+    (the reference loops soups one at a time, e.g. ``mixed-soup.py:79-92``)."""
+    keys = jax.random.split(key, trials)
+    states = jax.vmap(lambda k: seed(cfg, k))(keys)
+    return jax.vmap(lambda s: evolve(cfg, s, generations=generations))(states)
+
+
+def count_soup_trials(cfg: SoupConfig, states: SoupState) -> np.ndarray:
+    """(5,) histogram over ALL particles of all trial soups — the setups'
+    per-particle ``count(counters, soup)`` accumulation (``mixed-soup.py:27-52``)."""
+    classes = jax.vmap(lambda w: classify_batch(cfg.topo, w, cfg.epsilon))(states.weights)
+    return np.bincount(np.asarray(classes).reshape(-1), minlength=5)
+
+
+def log_sweep(exp: Experiment, name: str, data: dict):
+    """Reference logging shape: name line, data dict line, blank line
+    (``mixed-self-fixpoints.py:98-101``)."""
+    exp.log(name)
+    exp.log(data)
+    exp.log("\n")
+
+
+def log_counters(exp: Experiment, name: str, counts) -> None:
+    exp.log(f"{name}: {format_counters(counts)}", counts=np.asarray(counts), name=name)

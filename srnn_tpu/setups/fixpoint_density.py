@@ -1,0 +1,57 @@
+"""Natural density of fixpoints among random initializations.
+
+Reference: ``setups/fixpoint-density.py`` — 100,000 random inits per arch
+(WW and Agg; the script notes "FFT doesn't work though", ``:34-35``),
+classified immediately with no dynamics (``:54``).  Statistics are a direct
+function of the init law, which matches keras defaults (``srnn_tpu.init``).
+
+On TPU the 100k trials classify as a handful of batched forwards instead of
+100k ``model.predict`` calls.
+"""
+
+import jax
+
+from ..engine import fixpoint_density
+from ..experiment import Experiment
+from ..init import init_population
+from .common import STANDARD_VARIANTS, base_parser, log_counters, register
+
+
+def build_parser():
+    p = base_parser(__doc__)
+    p.add_argument("--trials", type=int, default=100_000)
+    p.add_argument("--batch", type=int, default=25_000,
+                   help="classification batch (bounds device memory)")
+    return p
+
+
+def run(args):
+    if args.smoke:
+        args.trials, args.batch = 64, 32
+    key = jax.random.key(args.seed)
+    variants = STANDARD_VARIANTS[:2]  # WW + Agg, like the reference (:42-43)
+    with Experiment("fixpoint_density", root=args.root, seed=args.seed) as exp:
+        all_counters, all_names = [], []
+        for i, (name, topo) in enumerate(variants):
+            total = jax.numpy.zeros(5, jax.numpy.int32)
+            done = 0
+            while done < args.trials:
+                n = min(args.batch, args.trials - done)
+                pop = init_population(
+                    topo, jax.random.fold_in(jax.random.fold_in(key, i), done), n)
+                total = total + fixpoint_density(topo, pop, args.epsilon)
+                done += n
+            log_counters(exp, name, total)
+            all_counters.append(total)
+            all_names.append(name)
+        exp.save(all_counters=jax.numpy.stack(all_counters), all_names=all_names)
+        return exp.dir
+
+
+@register("fixpoint_density")
+def main(argv=None):
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Every paper-experiment entry point runs end-to-end at smoke scale and
+writes the reference-style artifacts."""
+
+import os
+
+import numpy as np
+import pytest
+
+from srnn_tpu.experiment import load_artifact
+from srnn_tpu.fixtures import identity_fixpoint_flat, vary
+from srnn_tpu.setups import REGISTRY
+from srnn_tpu.topology import Topology
+
+ALL = sorted(REGISTRY)
+
+
+def test_registry_covers_all_nine_reference_scripts():
+    assert ALL == [
+        "applying_fixpoints", "fixpoint_density", "known_fixpoint_variation",
+        "learn_from_soup", "mixed_self_fixpoints", "mixed_soup",
+        "network_trajectorys", "soup_trajectorys", "training_fixpoints",
+    ]
+
+
+@pytest.mark.parametrize("name", ALL)
+def test_setup_smoke(name, tmp_path):
+    run_dir = REGISTRY[name](["--smoke", "--root", str(tmp_path), "--seed", "1"])
+    assert os.path.isdir(run_dir)
+    assert os.path.exists(os.path.join(run_dir, "log.txt"))
+    assert os.path.exists(os.path.join(run_dir, "meta.json"))
+
+
+def test_applying_fixpoints_artifacts(tmp_path):
+    run_dir = REGISTRY["applying_fixpoints"](
+        ["--smoke", "--root", str(tmp_path), "--record"])
+    counters = load_artifact(os.path.join(run_dir, "all_counters"))
+    assert counters.shape == (3, 5)
+    assert counters.sum() == 3 * 4  # 3 archs x 4 smoke trials
+    names = load_artifact(os.path.join(run_dir, "all_names"))
+    assert "Weightwise" in names[0]
+    traj = load_artifact(os.path.join(run_dir, "trajectorys"))
+    assert traj["weightwise"].shape == (11, 4, 14)  # steps+1, trials, P
+
+
+def test_mixed_soup_sweep_shape(tmp_path):
+    run_dir = REGISTRY["mixed_soup"](["--smoke", "--root", str(tmp_path)])
+    data = load_artifact(os.path.join(run_dir, "all_data"))
+    assert len(data) == 2  # WW + Agg
+    assert data[0]["xs"] == [0, 3]
+    # rates are avg particles per 10-particle soup, bounded by soup size
+    assert all(0.0 <= y <= 6.0 for y in data[0]["ys"] + data[0]["zs"])
+
+
+def test_soup_trajectorys_artifact(tmp_path):
+    run_dir = REGISTRY["soup_trajectorys"](["--smoke", "--root", str(tmp_path)])
+    soup = load_artifact(os.path.join(run_dir, "soup"))
+    g, n, p = soup["weights"].shape
+    assert (g, n, p) == (5, 6, 14)
+    assert soup["action"].shape == (5, 6)
+    # train=2 > 0 means surviving particles log train_self (code 4) unless dead
+    assert set(np.unique(soup["action"])) <= {4, 5, 6}
+
+
+def test_known_fixpoint_variation_monotonic(tmp_path):
+    """Smaller perturbations must survive (weakly) longer as fixpoints —
+    the qualitative shape of the reference baseline (BASELINE.md)."""
+    run_dir = REGISTRY["known_fixpoint_variation"](
+        ["--root", str(tmp_path), "--depth", "4", "--trials", "16",
+         "--max-steps", "30"])
+    data = load_artifact(os.path.join(run_dir, "data"))
+    zs = data["zs"].reshape(4, 16).mean(axis=1)  # per-scale avg time-as-fixpoint
+    assert zs[0] <= zs[-1]
+    ys = data["ys"].reshape(4, 16).mean(axis=1)
+    assert ys[0] <= ys[-1]
+
+
+def test_vary_bounds_and_identity_fixture():
+    import jax
+
+    topo = Topology("weightwise", width=2, depth=2)
+    flat = identity_fixpoint_flat(topo)
+    # bit-for-bit the reference fixture (known-fixpoint-variation.py:20-25)
+    expected = np.concatenate([
+        np.array([[1, 0], [0, 0], [0, 0], [0, 0]], np.float32).reshape(-1),
+        np.array([[1, 0], [0, 0]], np.float32).reshape(-1),
+        np.array([[1], [0]], np.float32).reshape(-1)])
+    np.testing.assert_array_equal(np.asarray(flat), expected)
+    perturbed = vary(jax.random.key(0), flat, e=0.5)
+    delta = np.abs(np.asarray(perturbed) - expected)
+    assert (delta <= 0.5).all() and (delta > 0).all()
