@@ -1,0 +1,316 @@
+"""Analysis & visualization of run-dir artifacts (reference layer L5).
+
+Reference tools (``visualization.py``, ``line_plots.py``, ``bar_plot.py``,
+``box_plots.py``) dill-load experiment artifacts and emit offline plotly
+HTML.  This module renders the same views from the npz/json artifacts the
+TPU runtime writes, using matplotlib (plotly is not in the image):
+
+  * :func:`plot_latent_trajectories_3d` — per-particle weight trajectories
+    embedded by PCA(2) fit on ALL trajectories stacked
+    (``visualization.py:109-115``), drawn as 3-D lines with x/y = PCA
+    components, z = time, red start / black end markers
+    (``visualization.py:119-154``).
+  * :func:`plot_latent_trajectories` — 2-D t-SNE scatter of trajectory
+    points (``visualization.py:43-93``).
+  * :func:`line_plot` — fixpoint-rate-vs-sweep curves from
+    ``all_data``/``all_names`` (``line_plots.py:27-81``).
+  * :func:`plot_bars` — stacked class-distribution bars from
+    ``all_counters`` (``bar_plot.py:28-59``).
+  * :func:`plot_box` — time-to-vergence / time-as-fixpoint boxes per
+    perturbation scale (``box_plots.py:28-94``).
+  * :func:`search_and_apply` — recursive walker that renders every known
+    artifact that doesn't have an output image yet
+    (``visualization.py:255-275``), CLI ``python -m srnn_tpu.viz -i <dir>``.
+
+Soup trajectories are split at uid changes, so each respawned particle gets
+its own line — the equivalent of the reference's per-uid
+``historical_particles`` registry (``soup.py:37-43``).
+"""
+
+import argparse
+import os
+from typing import Dict, List, Optional, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+from .experiment import load_artifact  # noqa: E402
+from .ops.predicates import CLASS_NAMES  # noqa: E402
+
+CLASS_COLORS = ("#d62728", "#1f77b4", "#2ca02c", "#9467bd", "#7f7f7f")
+
+
+# ---------------------------------------------------------------------------
+# trajectory extraction
+# ---------------------------------------------------------------------------
+
+
+def particle_trajectories(artifact: Dict[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
+    """Artifact -> list of {'trajectory': (T, P), 'time': (T,), 'uid': int}.
+
+    Accepts both artifact shapes the setups write:
+      * experiment trajectories: ``{"weights": (T, N, P)}`` — one particle
+        per trial column, uid = column index;
+      * soup histories: ``{"weights": (G, N, P), "uids": (G, N)}`` — slots
+        are split wherever the uid changes (respawn), mirroring
+        ``build_from_soup_or_exp`` (``visualization.py:27-40``).
+    """
+    w = np.asarray(artifact["weights"])
+    if w.ndim != 3:
+        raise ValueError(f"expected (T, N, P) weights, got {w.shape}")
+    t_len, n, _ = w.shape
+    uids = np.asarray(artifact["uids"]) if "uids" in artifact else \
+        np.broadcast_to(np.arange(n, dtype=np.int64), (t_len, n))
+    out = []
+    for col in range(n):
+        col_uids = uids[:, col]
+        # contiguous segments of constant uid = one particle lifetime
+        breaks = np.flatnonzero(np.diff(col_uids) != 0) + 1
+        for seg in np.split(np.arange(t_len), breaks):
+            traj = w[seg, col]
+            finite = np.isfinite(traj).all(axis=-1)
+            traj = traj[finite]
+            if len(traj) < 1:
+                continue
+            out.append({
+                "trajectory": traj,
+                "time": seg[finite].astype(np.int64),
+                "uid": int(col_uids[seg[0]]),
+            })
+    return out
+
+
+def pca2_fit(stacked: np.ndarray):
+    """PCA to 2 components via SVD (replaces the reference's
+    ``sklearn.manifold.t_sne.PCA`` import from a private pre-0.22 path,
+    ``visualization.py:17``). Returns (mean, (P, 2) components)."""
+    mean = stacked.mean(axis=0)
+    centered = stacked - mean
+    _, _, vt = np.linalg.svd(centered, full_matrices=False)
+    return mean, vt[:2].T
+
+
+# ---------------------------------------------------------------------------
+# plots
+# ---------------------------------------------------------------------------
+
+
+def plot_latent_trajectories_3d(artifact, out_path: str, title: str = "") -> str:
+    """3-D PCA trajectory plot (``plot_latent_trajectories_3D``,
+    ``visualization.py:109-154``): PCA fit on all trajectories stacked,
+    per-particle lines, red start / black end markers."""
+    trajs = particle_trajectories(artifact)
+    if not trajs:
+        raise ValueError("no finite trajectories to plot")
+    mean, comps = pca2_fit(np.vstack([t["trajectory"] for t in trajs]))
+    fig = plt.figure(figsize=(9, 8))
+    ax = fig.add_subplot(projection="3d")
+    cmap = plt.get_cmap("tab20")
+    for i, t in enumerate(trajs):
+        xy = (t["trajectory"] - mean) @ comps
+        z = t["time"]
+        ax.plot(xy[:, 0], xy[:, 1], z, lw=1.0, color=cmap(i % 20), alpha=0.8)
+        ax.scatter(*xy[0], z[0], color="red", s=14)      # start marker
+        ax.scatter(*xy[-1], z[-1], color="black", s=14)  # end marker
+    ax.set_xlabel("PCA 1")
+    ax.set_ylabel("PCA 2")
+    ax.set_zlabel("time")
+    ax.set_title(title or "weight-space trajectories (PCA)")
+    fig.savefig(out_path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
+def plot_latent_trajectories(artifact, out_path: str, title: str = "",
+                             perplexity: float = 12.0) -> str:
+    """2-D t-SNE scatter of all trajectory points, colored per particle
+    (``plot_latent_trajectories``, ``visualization.py:43-93``)."""
+    from sklearn.manifold import TSNE
+
+    trajs = particle_trajectories(artifact)
+    stacked = np.vstack([t["trajectory"] for t in trajs])
+    perplexity = min(perplexity, max(2.0, (len(stacked) - 1) / 3))
+    emb = TSNE(n_components=2, perplexity=perplexity,
+               init="pca", random_state=0).fit_transform(stacked)
+    fig, ax = plt.subplots(figsize=(8, 7))
+    cmap = plt.get_cmap("tab20")
+    pos = 0
+    for i, t in enumerate(trajs):
+        n = len(t["trajectory"])
+        seg = emb[pos:pos + n]
+        ax.plot(seg[:, 0], seg[:, 1], lw=0.8, color=cmap(i % 20), alpha=0.7)
+        pos += n
+    ax.set_title(title or "weight-space trajectories (t-SNE)")
+    fig.savefig(out_path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
+def line_plot(all_data: Sequence[dict], all_names: Sequence[str],
+              out_path: str, xlabel: str = "trains per self-attack",
+              ylabel: str = "fixpoint rate") -> str:
+    """Sweep curves (``line_plots.line_plot``, ``line_plots.py:27-81``).
+    Each entry contributes its 'ys' (and 'zs' dashed, when present)."""
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for i, (data, name) in enumerate(zip(all_data, all_names)):
+        color = plt.get_cmap("tab10")(i % 10)
+        ax.plot(data["xs"], data["ys"], "-o", color=color, label=str(name))
+        if "zs" in data:
+            ax.plot(data["xs"], data["zs"], "--s", color=color,
+                    label=f"{name} (non-zero)")
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.legend(fontsize=7)
+    ax.grid(alpha=0.3)
+    fig.savefig(out_path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
+def plot_bars(all_counters: np.ndarray, all_names: Sequence[str],
+              out_path: str) -> str:
+    """Stacked class-distribution bars (``bar_plot.plot_bars``,
+    ``bar_plot.py:28-59``): one bar per experiment, stacked by the 5
+    classes."""
+    counters = np.atleast_2d(np.asarray(all_counters))
+    fig, ax = plt.subplots(figsize=(1.8 + 1.1 * len(counters), 5))
+    x = np.arange(len(counters))
+    bottom = np.zeros(len(counters), dtype=float)
+    for cls in range(counters.shape[1]):
+        vals = counters[:, cls].astype(float)
+        ax.bar(x, vals, bottom=bottom, color=CLASS_COLORS[cls],
+               label=CLASS_NAMES[cls])
+        bottom += vals
+    ax.set_xticks(x)
+    ax.set_xticklabels([str(n)[:28] for n in all_names], rotation=20,
+                       ha="right", fontsize=7)
+    ax.set_ylabel("count")
+    ax.legend(fontsize=7)
+    fig.savefig(out_path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
+def plot_box(data: Dict[str, np.ndarray], out_path: str,
+             trials: Optional[int] = None) -> str:
+    """Perturbation-robustness boxes (``box_plots.plot_box``,
+    ``box_plots.py:28-94``): per scale level, boxplots of time-to-vergence
+    and time-as-fixpoint."""
+    xs, ys, zs = (np.asarray(data[k]) for k in ("xs", "ys", "zs"))
+    scales = sorted(set(xs.tolist()), reverse=True)
+    by_scale_y = [ys[xs == s] for s in scales]
+    by_scale_z = [zs[xs == s] for s in scales]
+    fig, axes = plt.subplots(1, 2, figsize=(12, 5), sharey=True)
+    for ax, series, name in zip(axes, (by_scale_y, by_scale_z),
+                                ("time to vergence", "time as fixpoint")):
+        ax.boxplot(series, tick_labels=[f"{s:.0e}" for s in scales])
+        ax.set_xlabel("perturbation scale")
+        ax.set_title(name)
+        ax.tick_params(axis="x", rotation=45)
+    axes[0].set_ylabel("steps")
+    fig.savefig(out_path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# run-dir walker
+# ---------------------------------------------------------------------------
+
+#: artifact basename -> renderer(run_dir, artifact_path) -> [outputs]
+def _render_trajectories(run_dir: str, path: str) -> List[str]:
+    art = load_artifact(path)
+    outs = []
+    if "weights" in art:  # soup-style single artifact
+        outs.append(plot_latent_trajectories_3d(
+            art, os.path.join(run_dir, "trajectories_3d.png")))
+    else:  # per-variant dict of (T, N, P) histories
+        for variant in sorted({k.split("/")[0] for k in art}):
+            sub = {"weights": art[f"{variant}/__value__"]} \
+                if f"{variant}/__value__" in art else {"weights": art[variant]}
+            outs.append(plot_latent_trajectories_3d(
+                sub, os.path.join(run_dir, f"trajectories_3d_{variant}.png"),
+                title=variant))
+    return outs
+
+
+def _render_soup(run_dir: str, path: str) -> List[str]:
+    return [plot_latent_trajectories_3d(
+        load_artifact(path), os.path.join(run_dir, "soup_trajectories_3d.png"))]
+
+
+def _render_sweep(run_dir: str, path: str) -> List[str]:
+    data = load_artifact(path)
+    names_path = os.path.join(run_dir, "all_names")
+    names = load_artifact(names_path) if os.path.exists(names_path + ".json") \
+        else [f"series {i}" for i in range(len(data))]
+    return [line_plot(data, names, os.path.join(run_dir, "sweep.png"))]
+
+
+def _render_counters(run_dir: str, path: str) -> List[str]:
+    counters = load_artifact(path)
+    names_path = os.path.join(run_dir, "all_names")
+    names = load_artifact(names_path) if os.path.exists(names_path + ".json") \
+        else [f"exp {i}" for i in range(np.atleast_2d(counters).shape[0])]
+    return [plot_bars(counters, names, os.path.join(run_dir, "counters.png"))]
+
+
+def _render_variation(run_dir: str, path: str) -> List[str]:
+    return [plot_box(load_artifact(path), os.path.join(run_dir, "variation_box.png"))]
+
+
+RENDERERS = {
+    "trajectorys": _render_trajectories,
+    "soup": _render_soup,
+    "all_data": _render_sweep,
+    "all_counters": _render_counters,
+    "data": _render_variation,
+}
+
+
+def search_and_apply(directory: str, redo: bool = False) -> List[str]:
+    """Walk ``directory`` recursively; for every known artifact whose run
+    dir has no rendered .png yet (unless ``redo``), render all applicable
+    views (``search_and_apply``, ``visualization.py:255-275``)."""
+    outputs = []
+    for root, _dirs, files in os.walk(directory):
+        basenames = {f.rsplit(".", 1)[0] for f in files
+                     if f.endswith((".npz", ".json"))}
+        for base, renderer in RENDERERS.items():
+            if base not in basenames:
+                continue
+            done_marker = any(f.endswith(".png") and f.startswith(_marker(base))
+                              for f in files)
+            if done_marker and not redo:
+                continue
+            try:
+                outputs += renderer(root, os.path.join(root, base))
+            except Exception as e:  # keep walking like the reference CLI
+                print(f"viz: skipping {base} in {root}: {e!r}")
+    return outputs
+
+
+def _marker(base: str) -> str:
+    return {"trajectorys": "trajectories_3d", "soup": "soup_trajectories_3d",
+            "all_data": "sweep", "all_counters": "counters",
+            "data": "variation_box"}[base]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="render plots for run-dir artifacts")
+    p.add_argument("-i", "--in-dir", dest="in_dir", default="experiments",
+                   help="directory tree to scan (visualization.py:20-24)")
+    p.add_argument("--redo", action="store_true", help="re-render existing plots")
+    args = p.parse_args(argv)
+    outs = search_and_apply(args.in_dir, redo=args.redo)
+    for o in outs:
+        print(o)
+    return 0
+
+
+if __name__ == "__main__":
+    main()

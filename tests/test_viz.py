@@ -1,0 +1,84 @@
+"""Visualization: every renderer produces an image; the walker finds and
+renders artifacts exactly once."""
+
+import os
+
+import numpy as np
+import pytest
+
+from srnn_tpu import viz
+from srnn_tpu.setups import REGISTRY
+
+
+@pytest.fixture(scope="module")
+def traj_artifact():
+    rng = np.random.default_rng(0)
+    t, n, p = 12, 5, 14
+    w = rng.normal(size=(t, n, p)).astype(np.float32).cumsum(axis=0)
+    return {"weights": w}
+
+
+def test_particle_trajectories_trial_columns(traj_artifact):
+    trajs = viz.particle_trajectories(traj_artifact)
+    assert len(trajs) == 5
+    assert trajs[0]["trajectory"].shape == (12, 14)
+    assert trajs[3]["uid"] == 3
+
+
+def test_particle_trajectories_split_on_respawn():
+    w = np.zeros((6, 2, 3), np.float32)
+    uids = np.array([[0, 1]] * 3 + [[5, 1]] * 3)  # particle 0 respawns at t=3
+    trajs = viz.particle_trajectories({"weights": w, "uids": uids})
+    assert len(trajs) == 3
+    assert sorted(t["uid"] for t in trajs) == [0, 1, 5]
+    lifetimes = sorted(len(t["trajectory"]) for t in trajs)
+    assert lifetimes == [3, 3, 6]
+
+
+def test_particle_trajectories_drops_nonfinite():
+    w = np.ones((4, 1, 3), np.float32)
+    w[2] = np.nan
+    trajs = viz.particle_trajectories({"weights": w})
+    assert len(trajs) == 1 and len(trajs[0]["trajectory"]) == 3
+
+
+def test_3d_and_tsne_plots(traj_artifact, tmp_path):
+    out = viz.plot_latent_trajectories_3d(traj_artifact, str(tmp_path / "t3.png"))
+    assert os.path.getsize(out) > 5000
+    out = viz.plot_latent_trajectories(traj_artifact, str(tmp_path / "t2.png"))
+    assert os.path.getsize(out) > 5000
+
+
+def test_line_bar_box(tmp_path):
+    data = [{"xs": [0, 10, 20], "ys": [0.1, 0.5, 0.9], "zs": [0, 0.2, 0.4]}]
+    out = viz.line_plot(data, ["ww"], str(tmp_path / "line.png"))
+    assert os.path.getsize(out) > 5000
+    out = viz.plot_bars(np.array([[3, 4, 2, 0, 1], [1, 1, 1, 1, 6]]),
+                        ["a", "b"], str(tmp_path / "bars.png"))
+    assert os.path.getsize(out) > 5000
+    xs = np.repeat([1.0, 0.1], 8)
+    box = {"xs": xs, "ys": np.arange(16), "zs": np.arange(16)[::-1]}
+    out = viz.plot_box(box, str(tmp_path / "box.png"))
+    assert os.path.getsize(out) > 5000
+
+
+def test_search_and_apply_end_to_end(tmp_path):
+    """Run two smoke setups, then the walker renders their artifacts and is
+    idempotent on the second pass (visualization.py:255-275 semantics)."""
+    REGISTRY["soup_trajectorys"](["--smoke", "--root", str(tmp_path)])
+    REGISTRY["mixed_soup"](["--smoke", "--root", str(tmp_path)])
+    outs = viz.search_and_apply(str(tmp_path))
+    produced = {os.path.basename(o) for o in outs}
+    assert "soup_trajectories_3d.png" in produced
+    assert "sweep.png" in produced
+    assert "counters.png" in produced  # soup_trajectorys saves all_counters
+    again = viz.search_and_apply(str(tmp_path))
+    assert again == []
+
+
+def test_cli(tmp_path, capsys):
+    REGISTRY["known_fixpoint_variation"](
+        ["--root", str(tmp_path), "--depth", "2", "--trials", "4",
+         "--max-steps", "5"])
+    assert viz.main(["-i", str(tmp_path)]) == 0
+    assert "variation_box.png" in capsys.readouterr().out
