@@ -1,0 +1,279 @@
+// trajstore: appendable binary store for soup trajectories + event logs.
+//
+// Role: the host-side IO runtime for srnn_tpu trajectory capture.  The
+// reference keeps every per-step weight snapshot of every particle in RAM
+// inside ParticleDecorator.save_state (reference network.py:193-198) and
+// dill-dumps at exit — impossible at 1M particles x 1000 generations
+// (SURVEY §5, §7 hard parts).  This store streams frames to disk from a
+// background writer thread so device compute overlaps host IO, with a
+// CRC32 per frame for integrity and truncation recovery on read.
+//
+// File layout (little-endian):
+//   header: magic "SRNNTRJ1" | u32 version | u32 reserved
+//           | u64 n_particles | u64 n_weights
+//   frame:  u64 generation
+//           | f32 weights[N*P] | i32 uids[N] | i32 action[N]
+//           | i32 counterpart[N] | f32 loss[N] | u32 crc32(payload)
+//
+// C API (ctypes-friendly): ts_create / ts_append / ts_flush / ts_close on
+// the write side; ts_open_read / ts_frame_count / ts_read_frames /
+// ts_close_read on the read side.  All functions return 0 on success or a
+// negative TS_E* code.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'S', 'R', 'N', 'N', 'T', 'R', 'J', '1'};
+constexpr uint32_t kVersion = 1;
+
+enum TsError : int {
+  TS_OK = 0,
+  TS_EIO = -1,
+  TS_EFORMAT = -2,
+  TS_ECLOSED = -3,
+  TS_ERANGE = -4,
+};
+
+// CRC32 (IEEE 802.3), small table variant — no zlib dependency.
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t crc = 0) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < len; i++) crc = table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+struct Header {
+  char magic[8];
+  uint32_t version;
+  uint32_t reserved;
+  uint64_t n_particles;
+  uint64_t n_weights;
+};
+static_assert(sizeof(Header) == 32, "header layout");
+
+size_t payload_bytes(uint64_t n, uint64_t p) {
+  return sizeof(uint64_t) + n * p * sizeof(float) + 3 * n * sizeof(int32_t) +
+         n * sizeof(float);
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  uint64_t n = 0, p = 0;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_drain;
+  std::deque<std::vector<uint8_t>> queue;
+  bool closing = false;
+  int error = TS_OK;
+  size_t max_queue = 8;  // frames in flight before append blocks
+
+  void run() {
+    for (;;) {
+      std::vector<uint8_t> frame;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_push.wait(lk, [&] { return !queue.empty() || closing; });
+        if (queue.empty()) {
+          if (closing) return;
+          continue;
+        }
+        frame = std::move(queue.front());
+        queue.pop_front();
+      }
+      if (fwrite(frame.data(), 1, frame.size(), f) != frame.size()) {
+        std::lock_guard<std::mutex> lk(mu);
+        error = TS_EIO;
+      }
+      cv_drain.notify_all();
+    }
+  }
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  uint64_t n = 0, p = 0;
+  long data_start = 0;
+  uint64_t frames = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- write side -----------------------------------------------------------
+
+void* ts_create(const char* path, uint64_t n_particles, uint64_t n_weights) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  Header h{};
+  memcpy(h.magic, kMagic, 8);
+  h.version = kVersion;
+  h.n_particles = n_particles;
+  h.n_weights = n_weights;
+  if (fwrite(&h, sizeof h, 1, f) != 1) {
+    fclose(f);
+    return nullptr;
+  }
+  Writer* w = new Writer;
+  w->f = f;
+  w->n = n_particles;
+  w->p = n_weights;
+  w->worker = std::thread([w] { w->run(); });
+  return w;
+}
+
+int ts_append(void* handle, uint64_t generation, const float* weights,
+              const int32_t* uids, const int32_t* action,
+              const int32_t* counterpart, const float* loss) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w || !w->f) return TS_ECLOSED;
+  const uint64_t n = w->n, p = w->p;
+  std::vector<uint8_t> frame(payload_bytes(n, p) + sizeof(uint32_t));
+  uint8_t* dst = frame.data();
+  auto put = [&dst](const void* src, size_t len) {
+    memcpy(dst, src, len);
+    dst += len;
+  };
+  put(&generation, sizeof generation);
+  put(weights, n * p * sizeof(float));
+  put(uids, n * sizeof(int32_t));
+  put(action, n * sizeof(int32_t));
+  put(counterpart, n * sizeof(int32_t));
+  put(loss, n * sizeof(float));
+  uint32_t crc = crc32(frame.data(), payload_bytes(n, p));
+  put(&crc, sizeof crc);
+  {
+    std::unique_lock<std::mutex> lk(w->mu);
+    w->cv_drain.wait(lk, [&] { return w->queue.size() < w->max_queue || w->error; });
+    if (w->error) return w->error;
+    w->queue.push_back(std::move(frame));
+  }
+  w->cv_push.notify_one();
+  return TS_OK;
+}
+
+int ts_flush(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w || !w->f) return TS_ECLOSED;
+  {
+    std::unique_lock<std::mutex> lk(w->mu);
+    w->cv_drain.wait(lk, [&] { return w->queue.empty() || w->error; });
+    if (w->error) return w->error;
+  }
+  return fflush(w->f) == 0 ? TS_OK : TS_EIO;
+}
+
+int ts_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w) return TS_ECLOSED;
+  {
+    std::lock_guard<std::mutex> lk(w->mu);
+    w->closing = true;
+  }
+  w->cv_push.notify_all();
+  if (w->worker.joinable()) w->worker.join();
+  int rc = w->error;
+  if (w->f) {
+    if (fflush(w->f) != 0) rc = rc ? rc : TS_EIO;
+    if (fclose(w->f) != 0) rc = rc ? rc : TS_EIO;
+  }
+  delete w;
+  return rc;
+}
+
+// ---- read side ------------------------------------------------------------
+
+void* ts_open_read(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Header h{};
+  if (fread(&h, sizeof h, 1, f) != 1 || memcmp(h.magic, kMagic, 8) != 0 ||
+      h.version != kVersion) {
+    fclose(f);
+    return nullptr;
+  }
+  Reader* r = new Reader;
+  r->f = f;
+  r->n = h.n_particles;
+  r->p = h.n_weights;
+  r->data_start = static_cast<long>(sizeof h);
+  fseek(f, 0, SEEK_END);
+  long end = ftell(f);
+  size_t frame_bytes = payload_bytes(r->n, r->p) + sizeof(uint32_t);
+  // a torn trailing frame (crash mid-write) is excluded by integer division
+  r->frames = static_cast<uint64_t>(end - r->data_start) / frame_bytes;
+  return r;
+}
+
+int ts_meta(void* handle, uint64_t* n_particles, uint64_t* n_weights,
+            uint64_t* n_frames) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r) return TS_ECLOSED;
+  *n_particles = r->n;
+  *n_weights = r->p;
+  *n_frames = r->frames;
+  return TS_OK;
+}
+
+// Reads frames [start, start+count) into caller-allocated arrays shaped
+// (count, ...). Any frame failing its CRC check aborts with TS_EFORMAT.
+int ts_read_frames(void* handle, uint64_t start, uint64_t count,
+                   uint64_t* generations, float* weights, int32_t* uids,
+                   int32_t* action, int32_t* counterpart, float* loss) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || !r->f) return TS_ECLOSED;
+  if (start + count > r->frames) return TS_ERANGE;
+  const uint64_t n = r->n, p = r->p;
+  const size_t body = payload_bytes(n, p);
+  const size_t frame_bytes = body + sizeof(uint32_t);
+  std::vector<uint8_t> buf(frame_bytes);
+  if (fseek(r->f, r->data_start + static_cast<long>(start * frame_bytes),
+            SEEK_SET) != 0)
+    return TS_EIO;
+  for (uint64_t i = 0; i < count; i++) {
+    if (fread(buf.data(), 1, frame_bytes, r->f) != frame_bytes) return TS_EIO;
+    uint32_t stored;
+    memcpy(&stored, buf.data() + body, sizeof stored);
+    if (crc32(buf.data(), body) != stored) return TS_EFORMAT;
+    const uint8_t* src = buf.data();
+    auto get = [&src](void* dst, size_t len) {
+      memcpy(dst, src, len);
+      src += len;
+    };
+    get(generations + i, sizeof(uint64_t));
+    get(weights + i * n * p, n * p * sizeof(float));
+    get(uids + i * n, n * sizeof(int32_t));
+    get(action + i * n, n * sizeof(int32_t));
+    get(counterpart + i * n, n * sizeof(int32_t));
+    get(loss + i * n, n * sizeof(float));
+  }
+  return TS_OK;
+}
+
+int ts_close_read(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r) return TS_ECLOSED;
+  if (r->f) fclose(r->f);
+  delete r;
+  return TS_OK;
+}
+
+}  // extern "C"

@@ -26,6 +26,12 @@ def build_parser():
                    choices=("sequential", "full_batch"))
     p.add_argument("--checkpoint", action="store_true",
                    help="also write a resumable orbax checkpoint of the final state")
+    p.add_argument("--store", action="store_true",
+                   help="stream frames to a native trajstore (soup.traj) "
+                        "instead of materializing the full history on device "
+                        "— the mega-soup path")
+    p.add_argument("--capture-every", type=int, default=1,
+                   help="store every k-th generation (trajectory stride)")
     return p
 
 
@@ -40,6 +46,19 @@ def run(args):
         epsilon=args.epsilon, train_mode=args.train_mode)
     with Experiment("soup", root=args.root, seed=args.seed) as exp:
         state = seed(cfg, jax.random.key(args.seed))
+        if args.store:
+            from ..utils import TrajStore, evolve_captured
+
+            with TrajStore(f"{exp.dir}/soup.traj", cfg.size,
+                           topo.num_weights) as store:
+                final = evolve_captured(cfg, state, args.generations, store,
+                                        every=args.capture_every)
+            counts = count(cfg, final)
+            exp.log(format_counters(counts), counts=np.asarray(counts))
+            exp.save(action_names=list(ACTION_NAMES), all_counters=counts)
+            if args.checkpoint:
+                save_checkpoint(f"{exp.dir}/checkpoint", final)
+            return exp.dir
         final, (events, weights_hist, uids_hist) = evolve(
             cfg, state, generations=args.generations, record=True)
         counts = count(cfg, final)

@@ -1,0 +1,4 @@
+from .trajstore import TrajStore, read_store, read_store_artifact
+from .capture import evolve_captured
+
+__all__ = ["TrajStore", "read_store", "read_store_artifact", "evolve_captured"]

@@ -1,0 +1,238 @@
+"""Trajectory/event store: ctypes bindings for the native writer.
+
+The native library (``native/trajstore.cpp``) streams soup frames
+(weights, uids, action codes, counterparts, losses per generation) to disk
+from a background C++ thread, so host IO overlaps the next chunk of device
+compute.  This replaces the reference's keep-everything-in-RAM
+``ParticleDecorator.save_state`` history (``network.py:193-198``) with a
+bounded-memory stream — the only workable shape at 1M particles
+(SURVEY §5 / §7 hard parts).
+
+The library is compiled on first use (``make -C native``, g++ baked into
+the image).  If no toolchain is available a pure-Python writer produces the
+identical file format (same header, same CRC32 per frame), so readers never
+care which side wrote a file.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+_MAGIC = b"SRNNTRJ1"
+_VERSION = 1
+_HEADER = struct.Struct("<8sII QQ")  # magic, version, reserved, N, P
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load libtrajstore.so; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    so = os.path.join(_NATIVE_DIR, "libtrajstore.so")
+    try:
+        if not os.path.exists(so):
+            subprocess.run(["make", "-C", _NATIVE_DIR],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    lib.ts_create.restype = ctypes.c_void_p
+    lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.ts_append.restype = ctypes.c_int
+    lib.ts_append.argtypes = [ctypes.c_void_p, ctypes.c_uint64] + \
+        [ctypes.c_void_p] * 5
+    lib.ts_flush.restype = ctypes.c_int
+    lib.ts_flush.argtypes = [ctypes.c_void_p]
+    lib.ts_close.restype = ctypes.c_int
+    lib.ts_close.argtypes = [ctypes.c_void_p]
+    lib.ts_open_read.restype = ctypes.c_void_p
+    lib.ts_open_read.argtypes = [ctypes.c_char_p]
+    lib.ts_meta.restype = ctypes.c_int
+    lib.ts_meta.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 3
+    lib.ts_read_frames.restype = ctypes.c_int
+    lib.ts_read_frames.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_uint64] + [ctypes.c_void_p] * 6
+    lib.ts_close_read.restype = ctypes.c_int
+    lib.ts_close_read.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+class TrajStore:
+    """Appendable frame store for one soup run.
+
+    >>> with TrajStore(path, n_particles=N, n_weights=P) as store:
+    ...     store.append(gen, weights, uids, action, counterpart, loss)
+
+    Uses the native background-thread writer when available, else a
+    format-identical pure-Python writer (``native=False`` forces that).
+    """
+
+    def __init__(self, path: str, n_particles: int, n_weights: int,
+                 native: Optional[bool] = None):
+        self.path = path
+        self.n = int(n_particles)
+        self.p = int(n_weights)
+        lib = _load_native() if native in (None, True) else None
+        if native is True and lib is None:
+            raise RuntimeError("native trajstore requested but unavailable")
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.ts_create(path.encode(), self.n, self.p)
+            if not self._h:
+                raise OSError(f"ts_create failed for {path}")
+            self._f = None
+        else:
+            self._h = None
+            self._f = open(path, "wb")
+            self._f.write(_HEADER.pack(_MAGIC, _VERSION, 0, self.n, self.p))
+        self.frames_written = 0
+
+    def append(self, generation: int, weights, uids, action, counterpart, loss):
+        w = np.ascontiguousarray(np.asarray(weights, np.float32)
+                                 .reshape(self.n, self.p))
+        u = np.ascontiguousarray(np.asarray(uids, np.int32).reshape(self.n))
+        a = np.ascontiguousarray(np.asarray(action, np.int32).reshape(self.n))
+        c = np.ascontiguousarray(np.asarray(counterpart, np.int32).reshape(self.n))
+        l = np.ascontiguousarray(np.asarray(loss, np.float32).reshape(self.n))
+        if self._h is not None:
+            rc = self._lib.ts_append(
+                self._h, int(generation),
+                w.ctypes.data_as(ctypes.c_void_p), u.ctypes.data_as(ctypes.c_void_p),
+                a.ctypes.data_as(ctypes.c_void_p), c.ctypes.data_as(ctypes.c_void_p),
+                l.ctypes.data_as(ctypes.c_void_p))
+            if rc != 0:
+                raise OSError(f"ts_append failed with {rc}")
+        else:
+            payload = (struct.pack("<Q", int(generation)) + w.tobytes() +
+                       u.tobytes() + a.tobytes() + c.tobytes() + l.tobytes())
+            self._f.write(payload + struct.pack("<I", zlib.crc32(payload)))
+        self.frames_written += 1
+
+    def flush(self):
+        if self._h is not None:
+            rc = self._lib.ts_flush(self._h)
+            if rc != 0:
+                raise OSError(f"ts_flush failed with {rc}")
+        else:
+            self._f.flush()
+
+    def close(self):
+        if self._h is not None:
+            rc = self._lib.ts_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise OSError(f"ts_close failed with {rc}")
+        elif self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_store(path: str, start: int = 0, count: Optional[int] = None
+               ) -> Dict[str, np.ndarray]:
+    """Read frames [start, start+count) -> dict of arrays:
+    generations (G,), weights (G, N, P), uids/action/counterpart (G, N),
+    loss (G, N).  CRC failures raise; a torn trailing frame from a crashed
+    writer is silently excluded (truncation recovery)."""
+    lib = _load_native()
+    if lib is not None:
+        h = lib.ts_open_read(path.encode())
+        if not h:
+            raise OSError(f"cannot open {path}")
+        try:
+            n, p, frames = (ctypes.c_uint64(), ctypes.c_uint64(), ctypes.c_uint64())
+            lib.ts_meta(h, ctypes.byref(n), ctypes.byref(p), ctypes.byref(frames))
+            n, p, frames = n.value, p.value, frames.value
+            count = frames - start if count is None else count
+            out = {
+                "generations": np.empty(count, np.uint64),
+                "weights": np.empty((count, n, p), np.float32),
+                "uids": np.empty((count, n), np.int32),
+                "action": np.empty((count, n), np.int32),
+                "counterpart": np.empty((count, n), np.int32),
+                "loss": np.empty((count, n), np.float32),
+            }
+            rc = lib.ts_read_frames(
+                h, start, count,
+                *(out[k].ctypes.data_as(ctypes.c_void_p) for k in
+                  ("generations", "weights", "uids", "action", "counterpart", "loss")))
+            if rc != 0:
+                raise OSError(f"ts_read_frames failed with {rc}"
+                              + (" (CRC mismatch)" if rc == -2 else ""))
+            return out
+        finally:
+            lib.ts_close_read(h)
+    return _read_store_py(path, start, count)
+
+
+def _read_store_py(path: str, start: int, count: Optional[int]
+                   ) -> Dict[str, np.ndarray]:
+    with open(path, "rb") as f:
+        head = f.read(_HEADER.size)
+        magic, version, _res, n, p = _HEADER.unpack(head)
+        if magic != _MAGIC or version != _VERSION:
+            raise OSError(f"{path}: not a trajstore file")
+        body = 8 + n * p * 4 + 3 * n * 4 + n * 4
+        frame_bytes = body + 4
+        f.seek(0, os.SEEK_END)
+        total = (f.tell() - _HEADER.size) // frame_bytes
+        count = total - start if count is None else count
+        if start + count > total:
+            raise OSError(f"{path}: range [{start}, {start + count}) > {total}")
+        out = {
+            "generations": np.empty(count, np.uint64),
+            "weights": np.empty((count, n, p), np.float32),
+            "uids": np.empty((count, n), np.int32),
+            "action": np.empty((count, n), np.int32),
+            "counterpart": np.empty((count, n), np.int32),
+            "loss": np.empty((count, n), np.float32),
+        }
+        f.seek(_HEADER.size + start * frame_bytes)
+        for i in range(count):
+            raw = f.read(frame_bytes)
+            payload, crc = raw[:body], struct.unpack("<I", raw[body:])[0]
+            if zlib.crc32(payload) != crc:
+                raise OSError(f"{path}: CRC mismatch in frame {start + i}")
+            off = 0
+            out["generations"][i] = struct.unpack_from("<Q", payload, off)[0]
+            off += 8
+            out["weights"][i] = np.frombuffer(
+                payload, np.float32, n * p, off).reshape(n, p)
+            off += n * p * 4
+            for key in ("uids", "action", "counterpart"):
+                out[key][i] = np.frombuffer(payload, np.int32, n, off)
+                off += n * 4
+            out["loss"][i] = np.frombuffer(payload, np.float32, n, off)
+    return out
+
+
+def read_store_artifact(path: str) -> Dict[str, np.ndarray]:
+    """Read a whole store in the soup-artifact shape ``srnn_tpu.viz``
+    consumes (weights/uids/action/counterpart/loss keys)."""
+    out = read_store(path)
+    out.pop("generations")
+    return out

@@ -278,6 +278,17 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
     views (``search_and_apply``, ``visualization.py:255-275``)."""
     outputs = []
     for root, _dirs, files in os.walk(directory):
+        for f in files:  # native trajectory stores render like soup artifacts
+            if f.endswith(".traj"):
+                out = os.path.join(root, f[:-5] + "_trajectories_3d.png")
+                if os.path.exists(out) and not redo:
+                    continue
+                from .utils import read_store_artifact
+                try:
+                    outputs.append(plot_latent_trajectories_3d(
+                        read_store_artifact(os.path.join(root, f)), out))
+                except Exception as e:
+                    print(f"viz: skipping {f} in {root}: {e!r}")
         basenames = {f.rsplit(".", 1)[0] for f in files
                      if f.endswith((".npz", ".json"))}
         for base, renderer in RENDERERS.items():
