@@ -1,0 +1,71 @@
+"""NaN/Inf provenance and checked execution (SURVEY §5, race/sanitizer row).
+
+Divergence (NaN/Inf weights) is a *measured outcome* in this science, so it
+must never be silently masked — but when it is unexpected, these tools
+locate it:
+
+  * :func:`checked_apply_to_weights` — checkify-wrapped self-application
+    that raises with a readable message if the output goes non-finite
+    (the debug-mode analog of the reference's ``are_weights_diverged``
+    post-hoc predicate, ``network.py:43-52``).
+  * :func:`divergence_onset` — scan a soup forward and report, per
+    particle, the first generation its weights went non-finite (-1 if
+    never).  One jitted program, no host round-trips per step.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+from ..nets import apply_to_weights
+from ..ops.predicates import is_diverged
+from ..soup import SoupConfig, SoupState, evolve_step
+from ..topology import Topology
+
+
+def checked_apply_to_weights(topo: Topology, self_flat, target_flat):
+    """Self-application that *errors* (checkify) on non-finite output.
+
+    Returns the new weights; raises ``checkify.JaxRuntimeError`` with the
+    offending variant/shape context if any output weight is NaN/Inf while
+    all inputs were finite.
+    """
+
+    def inner(s, t):
+        out = apply_to_weights(topo, s, t)
+        inputs_ok = ~is_diverged(s) & ~is_diverged(t)
+        checkify.check(
+            ~(inputs_ok & is_diverged(out)),
+            f"apply_to_weights({topo.variant}) produced non-finite output "
+            "from finite inputs (|self|={ns}, |target|={nt})",
+            ns=jnp.abs(s).max(), nt=jnp.abs(t).max(),
+        )
+        return out
+
+    err, out = checkify.checkify(inner)(self_flat, target_flat)
+    err.throw()
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("config", "generations"))
+def divergence_onset(config: SoupConfig, state: SoupState,
+                     generations: int) -> Tuple[jnp.ndarray, SoupState]:
+    """(N,) first generation (1-based) each SLOT went non-finite, -1 if
+    never within ``generations``.  Runs with respawn disabled so the onset
+    is observable (a respawning soup replaces divergent particles in the
+    same step, reference ``soup.py:77-86``)."""
+    probe_cfg = config._replace(remove_divergent=False, remove_zero=False)
+
+    def step(carry, _):
+        st, onset = carry
+        new_st, _ev = evolve_step(probe_cfg, st)
+        now_div = is_diverged(new_st.weights)
+        onset = jnp.where((onset < 0) & now_div, new_st.time.astype(jnp.int32), onset)
+        return (new_st, onset), None
+
+    onset0 = jnp.where(is_diverged(state.weights), 0, -1).astype(jnp.int32)
+    (final, onset), _ = jax.lax.scan(step, (state, onset0), None, length=generations)
+    return onset, final

@@ -1,0 +1,66 @@
+"""Tracing / profiling harness (SURVEY §5, tracing row).
+
+The reference's only observability is ``tqdm`` progress bars
+(``network.py:641``, ``experiment.py:101``).  Here:
+
+  * :func:`timed` — wall-clock statistics for a jitted callable with
+    compile/warmup excluded.  Synchronization is by scalar readback, not
+    ``block_until_ready`` — on the tunneled axon platform the latter does
+    not actually wait (see ``bench.py`` timing notes).
+  * :func:`trace` — context manager around ``jax.profiler`` emitting a
+    TensorBoard-loadable trace directory.
+  * :func:`phase` — alias of ``jax.named_scope``: annotate apply / train /
+    evolve phases so they are findable in profiles.
+"""
+
+import contextlib
+import statistics
+import time
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+phase = jax.named_scope
+
+
+def _sync(value) -> None:
+    """Force completion of ``value``'s computation via a scalar readback."""
+    leaves = jax.tree.leaves(value)
+    if leaves:
+        float(jnp.asarray(leaves[0]).ravel()[0])
+
+
+def timed(fn: Callable, *args, iters: int = 10, warmup: int = 2,
+          **kwargs) -> Dict[str, Any]:
+    """Time ``fn(*args, **kwargs)`` over ``iters`` runs after ``warmup``
+    (compile) runs.  Returns mean/median/min/max seconds + per-run list."""
+    for _ in range(max(warmup, 1)):
+        _sync(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    return {
+        "mean_s": statistics.fmean(times),
+        "median_s": statistics.median(times),
+        "min_s": min(times),
+        "max_s": max(times),
+        "iters": iters,
+        "times_s": times,
+    }
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device/host profile into ``log_dir`` (TensorBoard format).
+
+    >>> with trace('/tmp/profile'):
+    ...     state = evolve(cfg, state, generations=10)
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
