@@ -1,0 +1,64 @@
+"""Gradient-free fixpoint search: the stochastic hill climber.
+
+Prior-art parity for the EP prototype (``related/EP/src/NeuralNetwork.py``,
+``fitByStochasticHillClimberV3``): repeatedly propose
+noise-perturbed weight candidates around the incumbent, score each by how
+close the net is to being its own fixpoint, and keep the best.  The EP
+feature reductions {fft, rfft, mean, meanShuffled} map onto the main
+framework's FFT / aggregating variants (SURVEY scope note), so the climber
+here scores in the variant's own sample space via ``compute_samples``.
+
+TPU-native twist: the reference evaluates its ``numberOtRandomShots``
+serially through keras ``predict``; here all shots of a round evaluate as
+ONE vmapped batch, and rounds are a ``lax.scan``.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .nets import compute_samples
+from .topology import Topology
+from .train import predict
+
+
+def fixpoint_loss(topo: Topology, flat: jnp.ndarray) -> jnp.ndarray:
+    """MSE between the net's prediction on its own samples and the targets —
+    0 iff the net is exactly its own fixpoint in sample space (the EP
+    climber's objective, predictions vs feature-reduced weights)."""
+    x, y = compute_samples(topo, flat)
+    pred = predict(topo, flat, x)
+    return jnp.mean((pred - y.reshape(pred.shape)) ** 2)
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "shots", "rounds"))
+def hillclimb(
+    topo: Topology,
+    flat: jnp.ndarray,
+    key: jax.Array,
+    shots: int = 20,
+    rounds: int = 100,
+    std: float = 0.01,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stochastic hill climbing toward a self-application fixpoint.
+
+    Per round: draw ``shots`` gaussian perturbations (σ=``std``) of the
+    incumbent (EP's ``standardDeviation``/``numberOtRandomShots`` knobs),
+    score incumbent + shots in one vmapped batch, keep the argmin.  Returns
+    (best_flat, (rounds,) best-loss trace).  Monotone non-increasing by
+    construction.
+    """
+
+    def round_(carry, k):
+        w, loss = carry
+        noise = jax.random.normal(k, (shots,) + w.shape, w.dtype) * std
+        cands = jnp.concatenate([w[None], w[None] + noise], axis=0)
+        losses = jax.vmap(lambda c: fixpoint_loss(topo, c))(cands)
+        best = jnp.argmin(losses)
+        return (cands[best], losses[best]), losses[best]
+
+    init = (flat, fixpoint_loss(topo, flat))
+    (best, _), trace = jax.lax.scan(round_, init, jax.random.split(key, rounds))
+    return best, trace

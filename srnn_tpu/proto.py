@@ -1,0 +1,173 @@
+"""Second-generation prototype networks ("methods.py" family).
+
+Reference: ``code/methods.py`` — a later, experiment-unused redesign where
+"fit" is **repeated self-application with a delta loss and no gradients**:
+per epoch, predict the flat weights through the net, write the outputs back
+positionally, and record loss = MSE(f(w_t), w_t) *before* the update
+(``RecurrentNetwork.fit``, ``methods.py:106-129``;
+``FeedForwardNetwork.fit``, ``methods.py:141-174``).
+
+Semantics kept bit-faithful:
+
+  * the feed-forward positional feature is ``index / cells`` — divided by
+    the cell count, NOT normalized by the parameter count
+    (``methods.py:154``; quirk noted in SURVEY §2 methods row);
+  * the topology builder's parameter-count formula over-counts the
+    feed-forward head (it assumes a ``features×cells`` output layer while
+    the model ends in Dense(1), ``methods.py:36,50``) — the reference
+    comments out the consistency assert for FF (``methods.py:139``).
+    :meth:`ProtoTopology.builder_parameter_count` reproduces that formula;
+    :meth:`ProtoTopology.num_weights` is the true count.
+
+TPU-native form: one fused forward per epoch (the reference re-enters
+``model.predict`` per epoch from Python), epochs as ``lax.scan``.
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops.linalg import matmul
+from .topology import Topology
+
+
+@dataclass(frozen=True)
+class ProtoTopology:
+    """Mirror of the ``Network`` builder (``methods.py:17-54``):
+    ``features`` inputs, ``cells`` wide, ``layers`` deep, Dense or
+    SimpleRNN body, no biases, linear activations."""
+
+    features: int = 2
+    cells: int = 2
+    layers: int = 2
+    recurrent: bool = False
+    precision: str = "highest"
+
+    @property
+    def layer_shapes(self) -> Tuple[Tuple[int, int], ...]:
+        f, c, l = self.features, self.cells, self.layers
+        if self.recurrent:
+            shapes = [(f, c), (c, c)]                    # RNN 1: input + recurrent
+            shapes += [(c, c), (c, c)] * (l - 1)         # further RNN layers
+            shapes += [(c, f)]                           # Dense(features) head
+            return tuple(shapes)
+        return ((f, c),) + ((c, c),) * (l - 1) + ((c, 1),)
+
+    @property
+    def num_weights(self) -> int:
+        return int(sum(a * b for a, b in self.layer_shapes))
+
+    @property
+    def builder_parameter_count(self) -> int:
+        """The reference's printed/announced count (``methods.py:27-37``) —
+        equals :attr:`num_weights` for recurrent nets (asserted there), but
+        over-counts feed-forward heads (assert commented out)."""
+        f, c, l = self.features, self.cells, self.layers
+        if self.recurrent:
+            p1 = f * c + c * c
+            pn = (c * c + c * c) * (l - 1)
+        else:
+            p1 = f * c
+            pn = (c * c) * (l - 1)
+        return p1 + pn + f * c
+
+    @property
+    def seq_len(self) -> int:
+        """RNN input sequence length (``methods.py:40``: parameters //
+        features, on the true count for recurrent nets)."""
+        assert self.recurrent
+        return self.num_weights // self.features
+
+    def offsets(self):
+        offs = [0]
+        for a, b in self.layer_shapes:
+            offs.append(offs[-1] + a * b)
+        return offs
+
+    def _as_linalg_topo(self) -> Topology:
+        """Precision carrier for ops.linalg.matmul."""
+        return Topology("weightwise", precision=self.precision)
+
+
+def _kernels(pt: ProtoTopology, flat: jnp.ndarray):
+    offs = pt.offsets()
+    return [flat[offs[i]:offs[i + 1]].reshape(shape)
+            for i, shape in enumerate(pt.layer_shapes)]
+
+
+def forward_ff(pt: ProtoTopology, flat: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """(B, features) -> (B, 1): linear Dense chain (``methods.py:43-50``)."""
+    topo = pt._as_linalg_topo()
+    h = x
+    for k in _kernels(pt, flat):
+        h = matmul(topo, h, k)
+    return h
+
+
+def forward_rnn(pt: ProtoTopology, flat: jnp.ndarray, seq: jnp.ndarray) -> jnp.ndarray:
+    """(T, features) -> (T, features): linear SimpleRNN stack +
+    Dense(features) head over the sequence (``methods.py:43-50``)."""
+    topo = pt._as_linalg_topo()
+    ks = _kernels(pt, flat)
+    h = seq
+    for layer in range(pt.layers):
+        wx, wh = ks[2 * layer], ks[2 * layer + 1]
+
+        def cell(hprev, xt, wx=wx, wh=wh):
+            ht = matmul(topo, xt[None, :], wx)[0] + matmul(topo, hprev[None, :], wh)[0]
+            return ht, ht
+
+        _, h = jax.lax.scan(cell, jnp.zeros(wh.shape[0], flat.dtype), h)
+    return matmul(topo, h, ks[-1])
+
+
+def apply_self(pt: ProtoTopology, flat: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One prototype self-application: (new_flat, loss) with
+    loss = MSE(new, old) computed before the update lands
+    (``methods.py:116-126`` / ``:152-171``)."""
+    if pt.recurrent:
+        seq = flat.reshape(pt.seq_len, pt.features)
+        y = forward_rnn(pt, flat, seq).reshape(-1)
+    else:
+        p = pt.num_weights
+        # positional feature = index / cells, the reference's un-normalized
+        # divisor quirk (methods.py:154)
+        idx = jnp.arange(p, dtype=flat.dtype) / pt.cells
+        cols = [flat, idx] + [jnp.zeros_like(flat)] * (pt.features - 2)
+        x = jnp.stack(cols, axis=1)
+        y = forward_ff(pt, flat, x)[:, 0]
+    loss = jnp.mean((y - flat) ** 2)
+    return y, loss
+
+
+@functools.partial(jax.jit, static_argnames=("pt", "epochs"))
+def fit(pt: ProtoTopology, flat: jnp.ndarray, epochs: int = 500
+        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The prototype "training" loop: ``epochs`` self-applications,
+    returning (final_flat, (epochs,) losses) — no gradients anywhere
+    (``methods.py:110-129``)."""
+
+    def step(w, _):
+        new, loss = apply_self(pt, w)
+        return new, loss
+
+    final, losses = jax.lax.scan(step, flat, None, length=epochs)
+    return final, losses
+
+
+def init_proto(pt: ProtoTopology, key: jax.Array, dtype=jnp.float32) -> jnp.ndarray:
+    """Glorot-uniform kernels / orthogonal recurrent kernels, matching the
+    keras defaults the prototype inherits (``methods.py:43-50``)."""
+    from .init import _glorot_uniform, _orthogonal
+
+    parts = []
+    keys = jax.random.split(key, len(pt.layer_shapes))
+    for i, (shape, k) in enumerate(zip(pt.layer_shapes, keys)):
+        recurrent_kernel = pt.recurrent and i < 2 * pt.layers and i % 2 == 1
+        init = _orthogonal if recurrent_kernel else _glorot_uniform
+        parts.append(init(k, shape, dtype).reshape(-1))
+    return jnp.concatenate(parts)
