@@ -25,6 +25,24 @@ from .ops import (
     is_fixpoint,
     is_zero,
 )
+from .engine import (
+    classify_batch,
+    fixpoint_density,
+    run_fixpoint,
+    run_known_fixpoint_variation,
+    run_mixed_fixpoint,
+    run_training,
+)
+from .train import fit_epoch, learn_from, train_step
+from .soup import SoupConfig, SoupState, count, evolve, evolve_step, seed
+from .experiment import (
+    Experiment,
+    load_artifact,
+    restore_checkpoint,
+    save_artifact,
+    save_checkpoint,
+)
+from .fixtures import identity_fixpoint_flat, vary
 
 __version__ = "0.1.0"
 
@@ -41,4 +59,26 @@ __all__ = [
     "is_diverged",
     "is_fixpoint",
     "is_zero",
+    "classify_batch",
+    "fixpoint_density",
+    "run_fixpoint",
+    "run_known_fixpoint_variation",
+    "run_mixed_fixpoint",
+    "run_training",
+    "fit_epoch",
+    "learn_from",
+    "train_step",
+    "SoupConfig",
+    "SoupState",
+    "count",
+    "evolve",
+    "evolve_step",
+    "seed",
+    "Experiment",
+    "load_artifact",
+    "restore_checkpoint",
+    "save_artifact",
+    "save_checkpoint",
+    "identity_fixpoint_flat",
+    "vary",
 ]
