@@ -1,0 +1,222 @@
+"""Heterogeneous soups: mixed-architecture populations with cross-type
+attacks.
+
+The reference's mixed-soup experiment (``mixed-soup.py:66-68``) runs
+*separate homogeneous* soups per architecture — its object design (victim's
+keras layout must match the attacker's expectations) cannot mix types in one
+population.  The functional transforms here can (``srnn_tpu.nets.cross``),
+so this module implements what SURVEY §2.5 maps to expert-parallel grouping:
+one soup whose particles belong to typed subpopulations, where any particle
+can attack any other — a weightwise net rewriting an aggregating net's
+weights and vice versa.
+
+Semantics per generation mirror ``soup._evolve_parallel`` phase-for-phase
+(attack -> learn_from -> train -> respawn, last-action-wins events), with
+one typed-population choice: ``learn_from`` counterparts are drawn from the
+learner's OWN type — imitation needs the teacher's sample space to match
+the learner's input contract, which only same-type pairs guarantee.
+"""
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .init import init_population
+from .nets.cross import cross_apply
+from .ops.predicates import DEFAULT_EPSILON, count_classes
+from .engine import classify_batch
+from .soup import (
+    ACT_ATTACK,
+    ACT_LEARN,
+    ACT_NONE,
+    SoupConfig,
+    _event_record,
+    _learn_epochs,
+    _respawn,
+    _train_epochs,
+)
+from .topology import Topology
+from .train import DEFAULT_LR
+
+
+class MultiSoupConfig(NamedTuple):
+    topos: Tuple[Topology, ...]
+    sizes: Tuple[int, ...]
+    attacking_rate: float = 0.1
+    learn_from_rate: float = 0.1
+    train: int = 0
+    learn_from_severity: int = 1
+    remove_divergent: bool = False
+    remove_zero: bool = False
+    epsilon: float = DEFAULT_EPSILON
+    lr: float = DEFAULT_LR
+    train_mode: str = "sequential"
+
+    @property
+    def total(self) -> int:
+        return sum(self.sizes)
+
+    @property
+    def offsets(self) -> Tuple[int, ...]:
+        offs = [0]
+        for s in self.sizes:
+            offs.append(offs[-1] + s)
+        return tuple(offs)
+
+    def type_config(self, t: int) -> SoupConfig:
+        """Per-type view reusing the homogeneous soup helpers."""
+        return SoupConfig(
+            topo=self.topos[t], size=self.sizes[t],
+            attacking_rate=self.attacking_rate,
+            learn_from_rate=self.learn_from_rate, train=self.train,
+            learn_from_severity=self.learn_from_severity,
+            remove_divergent=self.remove_divergent,
+            remove_zero=self.remove_zero, epsilon=self.epsilon,
+            lr=self.lr, train_mode=self.train_mode)
+
+
+class MultiSoupState(NamedTuple):
+    weights: Tuple[jnp.ndarray, ...]  # per type (N_t, P_t)
+    uids: Tuple[jnp.ndarray, ...]     # per type (N_t,)
+    next_uid: jnp.ndarray
+    time: jnp.ndarray
+    key: jax.Array
+
+
+class MultiSoupEvents(NamedTuple):
+    action: Tuple[jnp.ndarray, ...]
+    counterpart: Tuple[jnp.ndarray, ...]
+    loss: Tuple[jnp.ndarray, ...]
+
+
+def seed_multi(config: MultiSoupConfig, key: jax.Array) -> MultiSoupState:
+    keys = jax.random.split(key, len(config.topos) + 1)
+    weights, uids = [], []
+    offs = config.offsets
+    for t, topo in enumerate(config.topos):
+        weights.append(init_population(topo, keys[t], config.sizes[t]))
+        uids.append(jnp.arange(offs[t], offs[t + 1], dtype=jnp.int32))
+    return MultiSoupState(
+        weights=tuple(weights), uids=tuple(uids),
+        next_uid=jnp.int32(config.total), time=jnp.int32(0), key=keys[-1])
+
+
+def _attack_phase(config: MultiSoupConfig, weights, k_gate, k_tgt):
+    """Global attacker/victim draw, then one vmapped cross-apply per
+    (attacker-type, victim-type) pair with masking — T^2 fused transforms
+    instead of data-dependent control flow."""
+    n = config.total
+    offs = config.offsets
+    gate = jax.random.uniform(k_gate, (n,)) < config.attacking_rate
+    tgt = jax.random.randint(k_tgt, (n,), 0, n)
+    # last-attacker-wins per victim (same resolution as soup._evolve_parallel)
+    att_idx = jax.ops.segment_max(
+        jnp.where(gate, jnp.arange(n), -1), tgt, num_segments=n)
+
+    new_weights = []
+    for b, victim_topo in enumerate(config.topos):
+        w_b = weights[b]
+        att_b = jax.lax.dynamic_slice_in_dim(att_idx, offs[b], config.sizes[b])
+        out = w_b
+        for a, attacker_topo in enumerate(config.topos):
+            mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
+            rows = weights[a][jnp.clip(att_b - offs[a], 0, config.sizes[a] - 1)]
+            attacked = jax.vmap(
+                lambda s, v: cross_apply(attacker_topo, s, victim_topo, v)
+            )(rows, w_b)
+            out = jnp.where(mask[:, None], attacked, out)
+        new_weights.append(out)
+    return tuple(new_weights), gate, tgt
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
+                      ) -> Tuple[MultiSoupState, MultiSoupEvents]:
+    """One mixed-soup generation (phase order of ``soup.py:51-87``)."""
+    n = config.total
+    offs = config.offsets
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    weights = state.weights
+
+    # --- attack (cross-type) -------------------------------------------
+    if config.attacking_rate > 0:
+        weights, attack_gate, attack_tgt = _attack_phase(
+            config, weights, k_ag, k_at)
+    else:
+        attack_gate = jnp.zeros(n, bool)
+        attack_tgt = jnp.zeros(n, jnp.int32)
+
+    # global uid lookup for counterpart logging
+    all_uids = jnp.concatenate(state.uids)
+
+    new_weights, new_uids, actions, counterparts, losses = [], [], [], [], []
+    total_deaths = jnp.int32(0)
+    re_keys = jax.random.split(k_re, len(config.topos))
+    for t, topo in enumerate(config.topos):
+        tc = config.type_config(t)
+        w_t = weights[t]
+        n_t = config.sizes[t]
+        sl = lambda arr: jax.lax.dynamic_slice_in_dim(arr, offs[t], n_t)
+
+        # --- learn_from (same-type teachers) ---------------------------
+        if config.learn_from_rate > 0:
+            learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
+            learn_tgt = jax.random.randint(
+                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+            if config.learn_from_severity > 0:
+                learned, _ = jax.vmap(
+                    lambda wi, ow: _learn_epochs(tc, wi, ow))(w_t, w_t[learn_tgt])
+                w_t = jnp.where(learn_gate[:, None], learned, w_t)
+            learn_cp = state.uids[t][learn_tgt]
+        else:
+            learn_gate = jnp.zeros(n_t, bool)
+            learn_cp = jnp.zeros(n_t, jnp.int32)
+
+        # --- train ------------------------------------------------------
+        if config.train > 0:
+            w_t, loss_t = jax.vmap(lambda wi: _train_epochs(tc, wi))(w_t)
+        else:
+            loss_t = jnp.zeros(n_t, w_t.dtype)
+
+        # --- respawn with per-type uid blocks ---------------------------
+        w_t, uids_t, deaths, death_action, death_cp = _respawn(
+            tc, w_t, state.uids[t], state.next_uid + total_deaths, re_keys[t])
+        total_deaths = total_deaths + deaths
+
+        action, counterpart = _event_record(
+            n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
+            learn_gate, learn_cp, config.train > 0, death_action, death_cp)
+
+        new_weights.append(w_t)
+        new_uids.append(uids_t)
+        actions.append(action)
+        counterparts.append(counterpart)
+        losses.append(loss_t)
+
+    new_state = MultiSoupState(
+        weights=tuple(new_weights), uids=tuple(new_uids),
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+    return new_state, MultiSoupEvents(tuple(actions), tuple(counterparts),
+                                      tuple(losses))
+
+
+@functools.partial(jax.jit, static_argnames=("config", "generations"))
+def evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
+                 generations: int = 1) -> MultiSoupState:
+    def body(s, _):
+        new_s, _ev = evolve_multi_step(config, s)
+        return new_s, None
+
+    final, _ = jax.lax.scan(body, state, None, length=generations)
+    return final
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def count_multi(config: MultiSoupConfig, state: MultiSoupState) -> jnp.ndarray:
+    """(T, 5) per-type class histograms (types keep their own science)."""
+    rows = [count_classes(classify_batch(config.topos[t], state.weights[t],
+                                         config.epsilon))
+            for t in range(len(config.topos))]
+    return jnp.stack(rows)

@@ -1,0 +1,89 @@
+"""Cross-architecture self-application: any attacker variant vs any victim.
+
+The reference's ``attack(other)`` (``network.py:116-118``) is only ever
+exercised between same-class nets (soups are homogeneous; ``mixed-soup.py``
+runs separate soups per class).  But the operator itself is well-defined for
+ANY victim: the weightwise transform rewrites *per scalar weight of the
+victim* from the victim's own coordinates, the aggregating transform chunks
+*whatever weight count the victim has* into the attacker's k collections,
+the FFT transform inverse-expands to the victim's length, and the recurrent
+transform consumes the victim's weights as a sequence of arbitrary length.
+
+This module generalizes each transform to (attacker topology, victim
+topology) pairs, enabling heterogeneous soups (``srnn_tpu.multisoup``) —
+the EP-style mixed-population capability SURVEY §2.5 maps to expert-
+parallel grouping.
+
+``cross_apply(t, a, t, v)`` with equal topologies is exactly
+``apply_to_weights(t, a, v)``; tests assert that.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.linalg import matmul
+from ..topology import Topology, segments_for
+from . import fft as fft_mod
+from . import recurrent as rnn_mod
+from . import weightwise as ww_mod
+
+
+def _cross_aggregate(attacker: Topology, victim_flat: jnp.ndarray) -> jnp.ndarray:
+    """Chunk the victim's weights into the ATTACKER's k collections
+    (reference ``collect_weights`` rule applied to the victim's count)."""
+    p = victim_flat.shape[0]
+    seg, counts = segments_for(p, attacker.aggregates)
+    onehot = jnp.asarray(np.eye(attacker.aggregates, dtype=np.float32)[seg],
+                         dtype=victim_flat.dtype)
+    if attacker.aggregator == "average":
+        return matmul(attacker, victim_flat, onehot) / jnp.asarray(
+            counts, dtype=victim_flat.dtype)
+    if attacker.aggregator in ("max", "max_buggy"):
+        # cross-shape max: the real max; the falsy-max quirk is only
+        # reproduced for same-topology application (aggregating.apply)
+        return jax.ops.segment_max(victim_flat, jnp.asarray(seg),
+                                   num_segments=attacker.aggregates,
+                                   indices_are_sorted=True)
+    raise ValueError(f"unknown aggregator {attacker.aggregator!r}")
+
+
+def _cross_deaggregate(attacker: Topology, aggs: jnp.ndarray, p: int,
+                       key=None) -> jnp.ndarray:
+    seg, _ = segments_for(p, attacker.aggregates)
+    flat = aggs[jnp.asarray(seg)]
+    if attacker.shuffler == "random":
+        if key is None:
+            raise ValueError("shuffler='random' requires a PRNG key")
+        flat = jax.random.permutation(key, flat)
+    return flat
+
+
+def cross_apply(attacker: Topology, attacker_flat: jnp.ndarray,
+                victim: Topology, victim_flat: jnp.ndarray,
+                key=None) -> jnp.ndarray:
+    """Apply the attacker's transform to the victim's weights; returns the
+    victim's new flat vector (same length as ``victim_flat``)."""
+    if attacker.variant == "weightwise":
+        # victim's coordinate table, attacker's MLP
+        pts = ww_mod.points(victim, victim_flat)
+        return ww_mod.forward(attacker, attacker_flat, pts)[:, 0]
+    if attacker.variant == "aggregating":
+        aggs = _cross_aggregate(attacker, victim_flat)
+        new_aggs = ww_mod.forward(attacker, attacker_flat, aggs[None, :])[0]
+        return _cross_deaggregate(attacker, new_aggs, victim_flat.shape[0], key)
+    if attacker.variant == "fft":
+        src = victim_flat if attacker.fft_use_target else attacker_flat
+        coeffs = jnp.fft.fft(src, n=attacker.aggregates).real.astype(
+            victim_flat.dtype)
+        new_coeffs = fft_mod.forward(attacker, attacker_flat, coeffs[None, :])[0]
+        out = jnp.fft.ifft(new_coeffs, n=victim_flat.shape[0]).real.astype(
+            victim_flat.dtype)
+        if attacker.shuffler == "random":
+            if key is None:
+                raise ValueError("shuffler='random' requires a PRNG key")
+            out = jax.random.permutation(key, out)
+        return out
+    if attacker.variant == "recurrent":
+        return rnn_mod.forward(attacker, attacker_flat, victim_flat[:, None])[:, 0]
+    raise ValueError(f"unknown variant {attacker.variant!r}")

@@ -183,20 +183,25 @@ def normalized_weight_coords(topo: Topology) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def aggregation_segments(topo: Topology) -> Tuple[np.ndarray, np.ndarray]:
-    """Segment ids + counts for the aggregating variant's collection rule.
+def segments_for(p: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Segment ids + counts chunking ``p`` weights into ``k`` collections.
 
     Reference ``collect_weights`` (``network.py:388-403``): weights are
-    chunked into groups of ``P // k`` in flat order; the trailing ``P % k``
-    leftovers are appended to the *last* collection.
+    chunked into groups of ``p // k`` in flat order; the trailing ``p % k``
+    leftovers are appended to the *last* collection.  Keyed by (p, k) so
+    cross-architecture application (an aggregating attacker chunking a
+    *victim's* weight count) shares the same rule.
 
-    Returns (segment_ids (P,) int32, counts (k,) int32).
+    Returns (segment_ids (p,) int32, counts (k,) int32).
     """
-    k = topo.aggregates
-    p = topo.num_weights
     size = p // k
     if size == 0:
         raise ValueError(f"aggregates={k} exceeds weight count {p}")
     seg = np.minimum(np.arange(p) // size, k - 1).astype(np.int32)
     counts = np.bincount(seg, minlength=k).astype(np.int32)
     return seg, counts
+
+
+def aggregation_segments(topo: Topology) -> Tuple[np.ndarray, np.ndarray]:
+    """Segments of a topology's own weights under its own ``aggregates``."""
+    return segments_for(topo.num_weights, topo.aggregates)
