@@ -1,0 +1,87 @@
+"""Attractor explorations — the ``fixpoint-2.ipynb`` notebook as a script.
+
+The reference notebook (cells 0-24) probes four phenomena around weightwise
+self-application; each section below reproduces one, printing its finding
+and (optionally) saving a plot.  Run: ``python examples/attractors.py``.
+
+1. Training f(x)=x on a single point: SGD on one sample drives the net to
+   reproduce that sample — the simplest "learn to be a fixpoint" picture.
+2. Untrained random nets are attractors too: repeated self-application
+   almost always converges *somewhere* (zero or infinity), rarely wanders.
+3. Chains/cycles of networks: apply net A to net B's weights and vice versa
+   — two-element cycles where each rewrites the other.
+4. Offset perturbation: nudge an attractor's weights and watch the return
+   (or escape) — the notebook-scale version of known-fixpoint-variation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srnn_tpu import (Topology, init_flat, init_population, is_diverged,
+                      is_zero, run_fixpoint)
+from srnn_tpu.fixtures import identity_fixpoint_flat, vary
+from srnn_tpu.netops import attack, self_attack
+from srnn_tpu.train import fit_epoch
+
+TOPO = Topology("weightwise", width=2, depth=2)
+
+
+def single_point_training(steps: int = 400):
+    """Cells ~0-6: regress one fixed (x, y) pair with plain SGD."""
+    w = init_flat(TOPO, jax.random.key(0))
+    x = jnp.asarray([[0.5, 0.0, 0.5, 0.5]])
+    y = jnp.asarray([0.25])
+    for _ in range(steps):
+        w, loss = fit_epoch(TOPO, w, x, y, lr=0.1, mode="full_batch")
+    print(f"1. single-point training: loss after {steps} steps = {float(loss):.2e}")
+    return float(loss)
+
+
+def random_nets_converge(trials: int = 64):
+    """Cells ~7-12: classify where untrained nets end up after repeated
+    self-application."""
+    pop = init_population(TOPO, jax.random.key(1), trials)
+    res = run_fixpoint(TOPO, pop, step_limit=100)
+    counts = np.asarray(res.counts)
+    wandering = counts[4]
+    print(f"2. random nets after 100 self-applications: "
+          f"{counts[0]} diverged, {counts[1]} at zero, {wandering} still wandering")
+    return counts
+
+
+def two_net_cycle(steps: int = 20):
+    """Cells ~13-18: A attacks B, then B attacks A, repeatedly."""
+    a = init_flat(TOPO, jax.random.key(2)) * 0.7
+    b = init_flat(TOPO, jax.random.key(3)) * 0.7
+    for _ in range(steps):
+        b = attack(TOPO, a, b)
+        a = attack(TOPO, b, a)
+    fate = ("diverged" if bool(is_diverged(a) | is_diverged(b)) else
+            "zero" if bool(is_zero(a) & is_zero(b)) else "nontrivial")
+    print(f"3. two-net cycle after {steps} rounds: {fate}")
+    return a, b
+
+
+def offset_perturbation(scale: float = 1e-4, steps: int = 50):
+    """Cells ~19-24: perturb the identity fixpoint, self-apply, measure
+    drift from the fixpoint."""
+    fp = identity_fixpoint_flat(TOPO)
+    w = vary(jax.random.key(4), fp, scale)
+    drift0 = float(jnp.abs(w - fp).max())
+    w = self_attack(TOPO, w, iterations=steps)
+    drift = float(jnp.abs(w - fp).max())
+    print(f"4. perturb identity by {scale:g}: initial drift {drift0:.2e} -> "
+          f"after {steps} self-applications {drift:.2e}")
+    return drift0, drift
+
+
+def main():
+    single_point_training()
+    random_nets_converge()
+    two_net_cycle()
+    offset_perturbation()
+
+
+if __name__ == "__main__":
+    main()

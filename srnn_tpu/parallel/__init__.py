@@ -6,8 +6,11 @@ from .sharded_soup import (
     sharded_count,
 )
 from .ring_rnn import ring_rnn_apply
+from .multihost import DCN_AXIS, multislice_soup_mesh
 
 __all__ = [
+    "DCN_AXIS",
+    "multislice_soup_mesh",
     "soup_mesh",
     "shard_population",
     "replicate",

@@ -1,0 +1,34 @@
+"""Multi-slice mesh construction (DCN tier of SURVEY §2.5).
+
+Single-slice soups scale over ICI via ``sharded_soup`` + ``soup_mesh``;
+process bring-up is ``mesh.initialize_distributed``.  Beyond one slice
+(multi-pod), the mesh needs an outer axis spanning slices over DCN with the
+inner axis staying on ICI.  The collectives in ``sharded_soup`` are
+axis-name-agnostic, so the same ``shard_map`` body runs unchanged on these
+meshes — the all-gather of a mega-soup's weight matrix is the only
+DCN-crossing traffic, one fused collective per generation.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import SOUP_AXIS
+
+DCN_AXIS = "slices"
+
+
+def multislice_soup_mesh(num_slices: int,
+                         devices: Optional[Sequence] = None) -> Mesh:
+    """(slices, particles) mesh: outer axis crosses DCN, inner axis rides
+    ICI.  Shard soups with ``P((DCN_AXIS, SOUP_AXIS))`` on the particle
+    dimension so each slice owns a contiguous block and intra-slice
+    exchange stays on ICI."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if devs.size % num_slices:
+        raise ValueError(
+            f"{devs.size} devices do not split into {num_slices} slices")
+    grid = devs.reshape(num_slices, devs.size // num_slices)
+    return Mesh(grid, (DCN_AXIS, SOUP_AXIS))

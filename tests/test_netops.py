@@ -1,0 +1,80 @@
+"""Reference API-surface operators, multihost mesh construction, and the
+attractor example script."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology, apply_to_weights, init_flat
+from srnn_tpu.fixtures import identity_fixpoint_flat
+from srnn_tpu.netops import (absorb, are_weights_within, attack, fuck, meet,
+                             self_attack, weights_to_string)
+
+TOPO = Topology("weightwise", width=2, depth=2)
+
+
+def test_attack_fuck_meet_are_applications():
+    a = init_flat(TOPO, jax.random.key(0)) * 0.5
+    b = init_flat(TOPO, jax.random.key(1)) * 0.5
+    expected = np.asarray(apply_to_weights(TOPO, a, b))
+    np.testing.assert_array_equal(np.asarray(attack(TOPO, a, b)), expected)
+    np.testing.assert_array_equal(np.asarray(fuck(TOPO, a, b)), expected)
+    np.testing.assert_array_equal(np.asarray(absorb(TOPO, a, b)), expected)
+    np.testing.assert_array_equal(np.asarray(meet(TOPO, a, b)), expected)
+
+
+def test_self_attack_iterates_on_updated_weights():
+    w = init_flat(TOPO, jax.random.key(2)) * 0.5
+    once = apply_to_weights(TOPO, w, w)
+    twice = apply_to_weights(TOPO, once, once)  # net updates between rounds
+    np.testing.assert_allclose(
+        np.asarray(self_attack(TOPO, w, iterations=2)), np.asarray(twice),
+        rtol=1e-6)
+
+
+def test_identity_is_self_attack_fixed():
+    fp = identity_fixpoint_flat(TOPO)
+    np.testing.assert_allclose(
+        np.asarray(self_attack(TOPO, fp, iterations=5)), np.asarray(fp),
+        atol=1e-6)
+
+
+def test_are_weights_within():
+    assert bool(are_weights_within(jnp.asarray([0.1, -0.2]), -0.2, 0.1))
+    assert not bool(are_weights_within(jnp.asarray([0.1, -0.21]), -0.2, 0.1))
+
+
+def test_weights_to_string_layout():
+    s = weights_to_string(TOPO, identity_fixpoint_flat(TOPO))
+    blocks = s.split("\n\n")
+    assert len(blocks) == 3                      # three kernels
+    assert blocks[0].count("\n") == 3            # (4, 2) kernel: 4 rows
+    assert "1.0000000" in blocks[0]
+
+
+def test_multislice_mesh_axes():
+    from srnn_tpu.parallel import DCN_AXIS, multislice_soup_mesh
+
+    mesh = multislice_soup_mesh(2)
+    assert mesh.axis_names == (DCN_AXIS, "soup")
+    assert mesh.devices.shape == (2, len(jax.devices()) // 2)
+    with pytest.raises(ValueError, match="split"):
+        multislice_soup_mesh(3)
+
+
+def test_attractor_examples_run():
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples"))
+    import attractors
+
+    assert attractors.single_point_training(steps=200) < 1e-3
+    counts = attractors.random_nets_converge(trials=16)
+    assert counts.sum() == 16
+    a, b = attractors.two_net_cycle(steps=5)
+    assert a.shape == (14,)
+    drift0, drift = attractors.offset_perturbation(scale=1e-6, steps=10)
+    assert drift0 > 0
