@@ -1,0 +1,63 @@
+"""Mega-soup generation throughput (BASELINE.json north-star workload:
+1M-particle soup over many generations).
+
+Measures full soup generations/sec — attack draws + collision resolution +
+vmapped self-application + respawn — at increasing population sizes on the
+current accelerator, and reports particle-updates/sec.  Distinct from
+``bench.py`` (raw self-application throughput for the driver); this is the
+end-to-end dynamics number.
+
+Run: ``python benchmarks/soup_throughput.py [--sizes 10000 100000 1000000]``
+Prints one JSON line per size.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+
+from srnn_tpu import Topology
+from srnn_tpu.soup import SoupConfig, evolve, seed
+
+
+def bench_size(n: int, generations: int = 50, repeats: int = 3) -> dict:
+    cfg = SoupConfig(
+        topo=Topology("weightwise", width=2, depth=2),
+        size=n, attacking_rate=0.1, learn_from_rate=-1.0, train=0,
+        remove_divergent=True, remove_zero=True)
+    state = seed(cfg, jax.random.key(0))
+
+    def run(s):
+        return evolve(cfg, s, generations=generations)
+
+    out = run(state)
+    float(out.weights.sum())  # compile + settle (scalar readback sync)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = run(state)
+        float(out.weights.sum())
+    dt = (time.perf_counter() - t0) / repeats
+    gens_per_sec = generations / dt
+    return {
+        "metric": "soup-generations/sec",
+        "particles": n,
+        "generations": generations,
+        "value": round(gens_per_sec, 2),
+        "particle_updates_per_sec": round(gens_per_sec * n),
+        "unit": "generations/s",
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", type=int, nargs="*",
+                   default=[10_000, 100_000, 1_000_000])
+    p.add_argument("--generations", type=int, default=50)
+    args = p.parse_args()
+    for n in args.sizes:
+        print(json.dumps(bench_size(n, args.generations)))
+
+
+if __name__ == "__main__":
+    main()
