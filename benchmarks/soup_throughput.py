@@ -1,13 +1,24 @@
 """Mega-soup generation throughput (BASELINE.json north-star workload:
 1M-particle soup over many generations).
 
-Measures full soup generations/sec — attack draws + collision resolution +
-vmapped self-application + respawn — at increasing population sizes on the
-current accelerator, and reports particle-updates/sec.  Distinct from
-``bench.py`` (raw self-application throughput for the driver); this is the
-end-to-end dynamics number.
+Measures full soup generations/sec at increasing population sizes on the
+current accelerator.  Distinct from ``bench.py`` (raw self-application
+throughput for the driver); this is the end-to-end dynamics number.
 
-Run: ``python benchmarks/soup_throughput.py [--sizes 10000 100000 1000000]``
+Three presets, so numbers are comparable to what they claim to measure:
+
+  * ``apply``  — attack + respawn only (train/learn_from off): upper bound,
+    the pure self-application dynamics.
+  * ``full``   — attack 0.1 + learn_from 0.1 (severity 1) + 10 self-training
+    epochs per particle per generation (batch-1 SGD parity mode): the
+    dynamics the paper's soup experiments actually run
+    (``mixed-soup.py:80-84``, ``soup_trajectorys.py:22-27``).
+  * ``mixed``  — the BASELINE.json mega-soup config: heterogeneous
+    weightwise/aggregating/recurrent subpopulations with cross-type attacks
+    (``srnn_tpu.multisoup``), full dynamics.
+
+Run: ``python benchmarks/soup_throughput.py [--preset apply|full|mixed]
+[--sizes 10000 100000 1000000] [--generations 50]``
 Prints one JSON line per size.
 """
 
@@ -18,45 +29,76 @@ import time
 import jax
 
 from srnn_tpu import Topology
+from srnn_tpu.multisoup import MultiSoupConfig, evolve_multi, seed_multi
 from srnn_tpu.soup import SoupConfig, evolve, seed
 
+PRESETS = ("apply", "full", "mixed")
 
-def bench_size(n: int, generations: int = 50, repeats: int = 3) -> dict:
-    cfg = SoupConfig(
-        topo=Topology("weightwise", width=2, depth=2),
-        size=n, attacking_rate=0.1, learn_from_rate=-1.0, train=0,
-        remove_divergent=True, remove_zero=True)
-    state = seed(cfg, jax.random.key(0))
 
-    def run(s):
-        return evolve(cfg, s, generations=generations)
+def _dynamics(preset: str) -> dict:
+    if preset == "apply":
+        return dict(attacking_rate=0.1, learn_from_rate=-1.0, train=0)
+    return dict(attacking_rate=0.1, learn_from_rate=0.1,
+                learn_from_severity=1, train=10)
 
-    out = run(state)
-    float(out.weights.sum())  # compile + settle (scalar readback sync)
+
+def bench_size(preset: str, n: int, generations: int = 50,
+               repeats: int = 3) -> dict:
+    dyn = _dynamics(preset)
+    if preset == "mixed":
+        third = n // 3
+        cfg = MultiSoupConfig(
+            topos=(Topology("weightwise", width=2, depth=2),
+                   Topology("aggregating", width=2, depth=2),
+                   Topology("recurrent", width=2, depth=2)),
+            sizes=(n - 2 * third, third, third),
+            remove_divergent=True, remove_zero=True, **dyn)
+        state = seed_multi(cfg, jax.random.key(0))
+
+        def run(s):
+            return evolve_multi(cfg, s, generations=generations)
+
+        def sync(out):
+            return float(out.weights[0].sum())
+    else:
+        cfg = SoupConfig(
+            topo=Topology("weightwise", width=2, depth=2), size=n,
+            remove_divergent=True, remove_zero=True, **dyn)
+        state = seed(cfg, jax.random.key(0))
+
+        def run(s):
+            return evolve(cfg, s, generations=generations)
+
+        def sync(out):
+            return float(out.weights.sum())
+
+    sync(run(state))  # compile + settle (scalar readback sync)
     t0 = time.perf_counter()
     for _ in range(repeats):
-        out = run(state)
-        float(out.weights.sum())
+        sync(run(state))
     dt = (time.perf_counter() - t0) / repeats
     gens_per_sec = generations / dt
     return {
-        "metric": "soup-generations/sec",
+        "metric": f"soup-generations/sec[{preset}]",
         "particles": n,
         "generations": generations,
         "value": round(gens_per_sec, 2),
-        "particle_updates_per_sec": round(gens_per_sec * n),
+        "particle_generations_per_sec": round(gens_per_sec * n),
         "unit": "generations/s",
     }
 
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--preset", choices=PRESETS, default="apply")
     p.add_argument("--sizes", type=int, nargs="*",
                    default=[10_000, 100_000, 1_000_000])
     p.add_argument("--generations", type=int, default=50)
+    p.add_argument("--repeats", type=int, default=3)
     args = p.parse_args()
     for n in args.sizes:
-        print(json.dumps(bench_size(n, args.generations)))
+        print(json.dumps(bench_size(args.preset, n, args.generations,
+                                    args.repeats)))
 
 
 if __name__ == "__main__":

@@ -38,6 +38,23 @@ def init_flat(topo: Topology, key: jax.Array, dtype=jnp.float32) -> jnp.ndarray:
     return jnp.concatenate(parts)
 
 
+# Chunk size for mega-population init.  The orthogonal initializer lowers to
+# a batched QR custom call whose scoped-VMEM footprint grows with batch size
+# and overflows around ~300k tiny matrices on v5e; a lax.map over fixed-size
+# chunks keeps each QR batch small with no measurable init-time cost.
+_INIT_CHUNK = 65536
+
+
 def init_population(topo: Topology, key: jax.Array, n: int, dtype=jnp.float32) -> jnp.ndarray:
-    """Sample ``n`` particles -> (n, P). vmap of :func:`init_flat`."""
-    return jax.vmap(lambda k: init_flat(topo, k, dtype))(jax.random.split(key, n))
+    """Sample ``n`` particles -> (n, P). vmap of :func:`init_flat`,
+    chunked via ``lax.map`` at mega-population sizes."""
+    keys = jax.random.split(key, n)
+    sample = jax.vmap(lambda k: init_flat(topo, k, dtype))
+    if n <= _INIT_CHUNK:
+        return sample(keys)
+    split = n - n % _INIT_CHUNK
+    head = keys[:split].reshape(-1, _INIT_CHUNK, *keys.shape[1:])
+    out = jax.lax.map(sample, head).reshape(split, topo.num_weights)
+    if split < n:
+        out = jnp.concatenate([out, sample(keys[split:])])
+    return out

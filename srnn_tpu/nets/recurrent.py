@@ -26,6 +26,8 @@ from ..topology import Topology
 
 def forward(topo: Topology, self_flat: jnp.ndarray, seq: jnp.ndarray) -> jnp.ndarray:
     """Run the stacked RNN over seq (T, 1) -> (T, 1)."""
+    if topo.rnn_scan == "associative":
+        return _forward_associative(topo, self_flat, seq)
     act = resolve_activation(topo.activation)
     mats = unflatten(topo, self_flat)
     x = seq
@@ -38,6 +40,40 @@ def forward(topo: Topology, self_flat: jnp.ndarray, seq: jnp.ndarray) -> jnp.nda
 
         h0 = jnp.zeros((units,), dtype=seq.dtype)
         _, x = jax.lax.scan(step, h0, x)
+    return x
+
+
+def _forward_associative(topo: Topology, self_flat: jnp.ndarray,
+                         seq: jnp.ndarray) -> jnp.ndarray:
+    """Linear-activation fast path (``Topology.rnn_scan='associative'``).
+
+    With the identity activation the keras SimpleRNN step
+    ``h_t = x_t @ K + h_{t-1} @ R`` is an affine map of the hidden state, so
+    each layer solves as an ``associative_scan`` over composed affine maps
+    ``(A, b): h -> h @ A + b`` in O(log T) depth instead of a length-T
+    serial chain — the TPU-native answer to the reference's only inherently
+    sequential transform (``network.py:544-564``).  Same math as the serial
+    scan up to float reassociation (composition products ``R^k`` are formed
+    in a different order).
+    """
+    assert topo.activation == "linear", "associative scan requires affine recurrence"
+    mats = unflatten(topo, self_flat)
+    x = seq
+    for layer, (_, units) in enumerate(topo.rnn_layer_dims):
+        kernel, recurrent = mats[2 * layer], mats[2 * layer + 1]
+        t = x.shape[0]
+        b = matmul(topo, x, kernel)                          # (T, units)
+        a = jnp.broadcast_to(recurrent, (t, units, units))   # (T, units, units)
+
+        def combine(lhs, rhs):
+            a1, b1 = lhs
+            a2, b2 = rhs
+            # (h@A1 + b1)@A2 + b2 = h@(A1@A2) + (b1@A2 + b2)
+            return (matmul(topo, a1, a2),
+                    matmul(topo, b1[:, None, :], a2)[:, 0, :] + b2)
+
+        # h0 = 0 (keras default), so h_t is just the accumulated offset
+        _, x = jax.lax.associative_scan(combine, (a, b))
     return x
 
 

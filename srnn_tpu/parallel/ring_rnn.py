@@ -15,9 +15,10 @@ local ``lax.scan`` (compute is masked-redundant — only the device whose turn
 it is keeps the result, the standard simple pipeline).  Wall-clock per layer
 stays O(T) like the serial scan — the win is MEMORY (each device holds T/D
 of the sequence) plus layer-level pipelining across the stack.  For the
-default linear activation the recurrence is affine and could use a
-distributed associative scan instead (O(T/D) time); kept as a documented
-fast-path candidate.
+default linear activation the recurrence is affine; the single-device
+O(log T)-depth fast path is ``Topology(rnn_scan='associative')``
+(``nets/recurrent.py``), and a distributed associative scan (O(T/D) time)
+remains the documented next step for multi-device long sequences.
 """
 
 import functools

@@ -63,6 +63,13 @@ class Topology:
     # is ~3e-3 at unit scale — larger than epsilon).  'default' opts into
     # fast bf16 passes for throughput-only workloads.
     precision: str = "highest"
+    # recurrent-variant option: 'sequential' (default) is the serial
+    # lax.scan matching keras step order; 'associative' exploits that the
+    # linear-activation recurrence is affine and solves each layer with an
+    # associative scan in O(log T) depth — the TPU-native fast path for
+    # giant-particle sequences (requires activation='linear'; floating-point
+    # reassociation means bitwise differences from the serial scan).
+    rnn_scan: str = "sequential"
 
     def __post_init__(self):
         if self.variant not in VARIANTS:
@@ -77,6 +84,13 @@ class Topology:
             raise ValueError(f"unknown aggregator {self.aggregator!r}")
         if self.shuffler not in ("not", "random"):
             raise ValueError(f"unknown shuffler {self.shuffler!r}")
+        if self.rnn_scan not in ("sequential", "associative"):
+            raise ValueError(f"unknown rnn_scan {self.rnn_scan!r}")
+        if (self.variant == "recurrent" and self.rnn_scan == "associative"
+                and self.activation != "linear"):
+            raise ValueError(
+                "rnn_scan='associative' requires activation='linear' "
+                "(the recurrence must be affine)")
 
     # ---- shape metadata -------------------------------------------------
 

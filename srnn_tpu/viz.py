@@ -22,6 +22,10 @@ TPU runtime writes, using matplotlib (plotly is not in the image):
     artifact that doesn't have an output image yet
     (``visualization.py:255-275``), CLI ``python -m srnn_tpu.viz -i <dir>``.
 
+Trajectory views are emitted twice per artifact: a static PNG and an
+interactive, dependency-free HTML (``viz_html.py``) — the stand-in for the
+reference's offline plotly HTML output.
+
 Soup trajectories are split at uid changes, so each respawned particle gets
 its own line — the equivalent of the reference's per-uid
 ``historical_particles`` registry (``soup.py:37-43``).
@@ -99,14 +103,22 @@ def pca2_fit(stacked: np.ndarray):
 # ---------------------------------------------------------------------------
 
 
-def plot_latent_trajectories_3d(artifact, out_path: str, title: str = "") -> str:
-    """3-D PCA trajectory plot (``plot_latent_trajectories_3D``,
-    ``visualization.py:109-154``): PCA fit on all trajectories stacked,
-    per-particle lines, red start / black end markers."""
+def extract_pca(artifact):
+    """Shared per-artifact preprocessing for the 3-D trajectory views:
+    -> (trajs, mean, (P, 2) components).  Compute once, render many."""
     trajs = particle_trajectories(artifact)
     if not trajs:
         raise ValueError("no finite trajectories to plot")
     mean, comps = pca2_fit(np.vstack([t["trajectory"] for t in trajs]))
+    return trajs, mean, comps
+
+
+def plot_latent_trajectories_3d(artifact, out_path: str, title: str = "",
+                                extracted=None) -> str:
+    """3-D PCA trajectory plot (``plot_latent_trajectories_3D``,
+    ``visualization.py:109-154``): PCA fit on all trajectories stacked,
+    per-particle lines, red start / black end markers."""
+    trajs, mean, comps = extracted if extracted is not None else extract_pca(artifact)
     fig = plt.figure(figsize=(9, 8))
     ax = fig.add_subplot(projection="3d")
     cmap = plt.get_cmap("tab20")
@@ -222,25 +234,39 @@ def plot_box(data: Dict[str, np.ndarray], out_path: str,
 # ---------------------------------------------------------------------------
 
 #: artifact basename -> renderer(run_dir, artifact_path) -> [outputs]
+def _render_traj_views(artifact, run_dir: str, stem: str, title: str = "") -> List[str]:
+    """Static PNG + interactive HTML (the reference emits offline plotly
+    HTML per artifact, ``visualization.py:119-179``).  Trajectory extraction
+    and the PCA fit run once, shared by both renderers."""
+    from .viz_html import write_html_trajectories_3d
+
+    extracted = extract_pca(artifact)
+    return [
+        plot_latent_trajectories_3d(
+            artifact, os.path.join(run_dir, stem + ".png"), title=title,
+            extracted=extracted),
+        write_html_trajectories_3d(
+            artifact, os.path.join(run_dir, stem + ".html"), title=title,
+            extracted=extracted),
+    ]
+
+
 def _render_trajectories(run_dir: str, path: str) -> List[str]:
     art = load_artifact(path)
     outs = []
     if "weights" in art:  # soup-style single artifact
-        outs.append(plot_latent_trajectories_3d(
-            art, os.path.join(run_dir, "trajectories_3d.png")))
+        outs += _render_traj_views(art, run_dir, "trajectories_3d")
     else:  # per-variant dict of (T, N, P) histories
         for variant in sorted({k.split("/")[0] for k in art}):
             sub = {"weights": art[f"{variant}/__value__"]} \
                 if f"{variant}/__value__" in art else {"weights": art[variant]}
-            outs.append(plot_latent_trajectories_3d(
-                sub, os.path.join(run_dir, f"trajectories_3d_{variant}.png"),
-                title=variant))
+            outs += _render_traj_views(
+                sub, run_dir, f"trajectories_3d_{variant}", title=variant)
     return outs
 
 
 def _render_soup(run_dir: str, path: str) -> List[str]:
-    return [plot_latent_trajectories_3d(
-        load_artifact(path), os.path.join(run_dir, "soup_trajectories_3d.png"))]
+    return _render_traj_views(load_artifact(path), run_dir, "soup_trajectories_3d")
 
 
 def _render_sweep(run_dir: str, path: str) -> List[str]:
@@ -280,13 +306,15 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
     for root, _dirs, files in os.walk(directory):
         for f in files:  # native trajectory stores render like soup artifacts
             if f.endswith(".traj"):
-                out = os.path.join(root, f[:-5] + "_trajectories_3d.png")
-                if os.path.exists(out) and not redo:
+                stem = f[:-5] + "_trajectories_3d"
+                done = all(os.path.exists(os.path.join(root, stem + ext))
+                           for ext in (".png", ".html"))
+                if done and not redo:
                     continue
                 from .utils import read_store_artifact
                 try:
-                    outputs.append(plot_latent_trajectories_3d(
-                        read_store_artifact(os.path.join(root, f)), out))
+                    outputs += _render_traj_views(
+                        read_store_artifact(os.path.join(root, f)), root, stem)
                 except Exception as e:
                     print(f"viz: skipping {f} in {root}: {e!r}")
         basenames = {f.rsplit(".", 1)[0] for f in files
@@ -296,6 +324,15 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
                 continue
             done_marker = any(f.endswith(".png") and f.startswith(_marker(base))
                               for f in files)
+            if base in ("trajectorys", "soup"):
+                # trajectory renderers also emit the interactive HTML twin;
+                # any PNG without its own .html sibling (pre-HTML run dirs,
+                # partial multi-variant failure) must be revisited so the
+                # walker backfills the missing HTML
+                pngs = [f for f in files
+                        if f.endswith(".png") and f.startswith(_marker(base))]
+                done_marker = bool(pngs) and all(
+                    f[:-4] + ".html" in files for f in pngs)
             if done_marker and not redo:
                 continue
             try:

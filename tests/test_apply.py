@@ -203,6 +203,44 @@ def test_rnn_apply_tanh():
     np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("width,depth", [(2, 2), (3, 1), (4, 3)])
+def test_rnn_associative_scan_matches_sequential(width, depth):
+    """rnn_scan='associative' (affine associative_scan, O(log T) depth) is
+    the same map as the serial lax.scan for the linear activation."""
+    topo = Topology("recurrent", width=width, depth=depth)
+    fast = topo.with_(rnn_scan="associative")
+    rng = np.random.default_rng(10)
+    p = topo.num_weights
+    self_flat = jnp.asarray(rng.normal(size=p).astype(np.float32) * 0.3)
+    target = jnp.asarray(rng.normal(size=p).astype(np.float32))
+    seq = apply_to_weights(topo, self_flat, target)
+    assoc = apply_to_weights(fast, self_flat, target)
+    np.testing.assert_allclose(np.asarray(assoc), np.asarray(seq),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_associative_requires_linear():
+    with pytest.raises(ValueError, match="associative"):
+        Topology("recurrent", activation="tanh", rnn_scan="associative")
+
+
+def test_init_population_chunked_matches_direct():
+    """The lax.map chunking at mega-population sizes (QR VMEM workaround)
+    produces the same particles as the direct vmap."""
+    import srnn_tpu.init as init_mod
+
+    topo = Topology("recurrent", width=2, depth=2)
+    key = jax.random.key(7)
+    direct = init_mod.init_population(topo, key, 10)
+    old = init_mod._INIT_CHUNK
+    init_mod._INIT_CHUNK = 4  # force chunked path: 2 chunks + tail of 2
+    try:
+        chunked = init_mod.init_population(topo, key, 10)
+    finally:
+        init_mod._INIT_CHUNK = old
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(chunked))
+
+
 # ------------------------------------------------------------------- generic
 
 @pytest.mark.parametrize("topo", [WW, AGG, FFT, RNN])

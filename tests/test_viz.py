@@ -62,6 +62,20 @@ def test_line_bar_box(tmp_path):
     assert os.path.getsize(out) > 5000
 
 
+def test_html_trajectories(traj_artifact, tmp_path):
+    """The interactive HTML view is self-contained: embedded data, inline
+    renderer, no external resources (parity with the reference's offline
+    plotly HTML, visualization.py:119-179)."""
+    from srnn_tpu.viz_html import write_html_trajectories_3d
+
+    out = write_html_trajectories_3d(traj_artifact, str(tmp_path / "t3.html"))
+    html = open(out).read()
+    assert html.startswith("<!DOCTYPE html>")
+    assert '"xyz":' in html and "canvas" in html
+    assert "http://" not in html and "https://" not in html  # offline
+    assert html.count('"color"') == 5  # one series per particle
+
+
 def test_search_and_apply_end_to_end(tmp_path):
     """Run two smoke setups, then the walker renders their artifacts and is
     idempotent on the second pass (visualization.py:255-275 semantics)."""
@@ -70,10 +84,17 @@ def test_search_and_apply_end_to_end(tmp_path):
     outs = viz.search_and_apply(str(tmp_path))
     produced = {os.path.basename(o) for o in outs}
     assert "soup_trajectories_3d.png" in produced
+    assert "soup_trajectories_3d.html" in produced  # interactive twin
     assert "sweep.png" in produced
     assert "counters.png" in produced  # soup_trajectorys saves all_counters
     again = viz.search_and_apply(str(tmp_path))
     assert again == []
+    # a run dir with the PNG but no HTML twin (pre-HTML render, partial
+    # failure) is revisited and backfilled, not skipped
+    html = next(p for p in outs if p.endswith("soup_trajectories_3d.html"))
+    os.remove(html)
+    backfilled = viz.search_and_apply(str(tmp_path))
+    assert html in backfilled and os.path.exists(html)
 
 
 def test_cli(tmp_path, capsys):
