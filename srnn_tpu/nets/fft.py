@@ -27,7 +27,19 @@ from ..topology import Topology
 
 def coefficients(topo: Topology, flat: jnp.ndarray) -> jnp.ndarray:
     """Real parts of the first k DFT coefficients (``aggregate_fft``,
-    ``network.py:444-448`` + the keras complex->float32 cast)."""
+    ``network.py:444-448`` + the keras complex->float32 cast).
+
+    ``fft_mode='rfft'`` uses the real-input transform instead — the EP
+    prototype's alternative reduction (``related/EP/src/FeatureReduction.py``);
+    the first k rfft bins (zero-padded if the spectrum is shorter than k).
+    """
+    if topo.fft_mode == "rfft":
+        spec = jnp.fft.rfft(flat).real.astype(flat.dtype)
+        k = topo.aggregates
+        n = spec.shape[-1]
+        if n >= k:
+            return spec[..., :k]
+        return jnp.pad(spec, [(0, 0)] * (spec.ndim - 1) + [(0, k - n)])
     return jnp.fft.fft(flat, n=topo.aggregates).real.astype(flat.dtype)
 
 
@@ -44,7 +56,10 @@ def apply(topo: Topology, self_flat: jnp.ndarray, target_flat: jnp.ndarray,
     src = target_flat if topo.fft_use_target else self_flat
     coeffs = coefficients(topo, src)
     new_coeffs = forward(topo, self_flat, coeffs[None, :])[0]
-    new_flat = jnp.fft.ifft(new_coeffs, n=topo.num_weights).real.astype(target_flat.dtype)
+    if topo.fft_mode == "rfft":
+        new_flat = jnp.fft.irfft(new_coeffs, n=topo.num_weights).astype(target_flat.dtype)
+    else:
+        new_flat = jnp.fft.ifft(new_coeffs, n=topo.num_weights).real.astype(target_flat.dtype)
     if topo.shuffler == "random":
         if key is None:
             raise ValueError("shuffler='random' requires a PRNG key")

@@ -58,6 +58,12 @@ class Topology:
     # ``attack(other)`` writes self-derived values. False keeps that
     # behavior; True fixes the quirk and transforms the target instead.
     fft_use_target: bool = False
+    # fft-variant transform: 'fft' (reference ``aggregate_fft``,
+    # ``network.py:444-448``) or 'rfft' — the real-input transform the
+    # related/EP prototype's FeatureReduction offered alongside fft
+    # (``related/EP/src/FeatureReduction.py:9-16``); coefficients are the
+    # first k real-FFT bins, inverse via irfft.
+    fft_mode: str = "fft"
     # matmul precision: 'highest' keeps f32 accumulation on the MXU so that
     # |delta| < 1e-4 fixpoint thresholds are meaningful on TPU (bf16 rounding
     # is ~3e-3 at unit scale — larger than epsilon).  'default' opts into
@@ -84,6 +90,8 @@ class Topology:
             raise ValueError(f"unknown aggregator {self.aggregator!r}")
         if self.shuffler not in ("not", "random"):
             raise ValueError(f"unknown shuffler {self.shuffler!r}")
+        if self.fft_mode not in ("fft", "rfft"):
+            raise ValueError(f"unknown fft_mode {self.fft_mode!r}")
         if self.rnn_scan not in ("sequential", "associative"):
             raise ValueError(f"unknown rnn_scan {self.rnn_scan!r}")
         if (self.variant == "recurrent" and self.rnn_scan == "associative"

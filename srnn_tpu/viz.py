@@ -229,11 +229,61 @@ def plot_box(data: Dict[str, np.ndarray], out_path: str,
     return out_path
 
 
+def plot_histogram(bars_dict_list: Sequence[dict], out_path: str,
+                   title: str = "") -> str:
+    """Generic categorical count histogram (``visualization.plot_histogram``,
+    ``visualization.py:183-206``): one series per dict, counted over its
+    'name' categories."""
+    fig, ax = plt.subplots(figsize=(6, 4.5))
+    cmap = plt.get_cmap("RdYlBu")
+    names = []
+    for d in bars_dict_list:
+        xs = d.get("name", "unnamed")
+        names += list(np.atleast_1d(xs))
+    cats = sorted(set(names))
+    for i, d in enumerate(bars_dict_list):
+        xs = np.atleast_1d(d.get("name", "unnamed"))
+        counts = [int(np.sum(xs == c)) for c in cats]
+        offset = (i - (len(bars_dict_list) - 1) / 2) * 0.8 / max(len(bars_dict_list), 1)
+        ax.bar(np.arange(len(cats)) + offset,
+               counts, width=0.8 / max(len(bars_dict_list), 1),
+               color=cmap(i / max(len(bars_dict_list) - 1, 1)))
+    ax.set_xticks(np.arange(len(cats)))
+    ax.set_xticklabels(cats, rotation=20, ha="right", fontsize=8)
+    ax.set_ylabel("count")
+    if title:
+        ax.set_title(title)
+    fig.savefig(out_path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
+def line_plot_with_bands(line_dict_list: Sequence[dict], out_path: str,
+                         title: str = "") -> str:
+    """Generic mean curves with shaded upper/lower bands
+    (``visualization.line_plot``, ``visualization.py:209-252``): each dict
+    carries 'x', 'main_y', 'upper_y', 'lower_y', and optionally 'name'."""
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for i, d in enumerate(line_dict_list):
+        color = plt.get_cmap("RdYlGn")(i / max(len(line_dict_list) - 1, 1))
+        x = np.asarray(d["x"])
+        ax.fill_between(x, np.asarray(d["lower_y"]), np.asarray(d["upper_y"]),
+                        color=color, alpha=0.4, lw=0)
+        ax.plot(x, np.asarray(d["main_y"]), color=color,
+                label=str(d.get("name", f"series {i}")))
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    if title:
+        ax.set_title(title)
+    fig.savefig(out_path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
+
+
 # ---------------------------------------------------------------------------
 # run-dir walker
 # ---------------------------------------------------------------------------
 
-#: artifact basename -> renderer(run_dir, artifact_path) -> [outputs]
 def _render_traj_views(artifact, run_dir: str, stem: str, title: str = "") -> List[str]:
     """Static PNG + interactive HTML (the reference emits offline plotly
     HTML per artifact, ``visualization.py:119-179``).  Trajectory extraction
@@ -289,6 +339,7 @@ def _render_variation(run_dir: str, path: str) -> List[str]:
     return [plot_box(load_artifact(path), os.path.join(run_dir, "variation_box.png"))]
 
 
+#: artifact basename -> renderer(run_dir, artifact_path) -> [outputs]
 RENDERERS = {
     "trajectorys": _render_trajectories,
     "soup": _render_soup,

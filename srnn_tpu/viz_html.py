@@ -14,20 +14,21 @@ so it opens anywhere — the same property the reference got from
 ``include_plotlyjs=True`` offline plots.
 """
 
+import html as _html
 import json
 import os
 from typing import Dict, List
 
+import matplotlib.colors
+import matplotlib.pyplot
 import numpy as np
 
 from .viz import extract_pca
 
-# tab20-equivalent hex palette (matches the PNG renderer's color cycle)
-_PALETTE = (
-    "#1f77b4", "#aec7e8", "#ff7f0e", "#ffbb78", "#2ca02c", "#98df8a",
-    "#d62728", "#ff9896", "#9467bd", "#c5b0d5", "#8c564b", "#c49c94",
-    "#e377c2", "#f7b6d2", "#7f7f7f", "#c7c7c7", "#bcbd22", "#dbdb8d",
-    "#17becf", "#9edae5",
+# same tab20 cycle as the PNG renderer, derived so the two can't drift
+_PALETTE = tuple(
+    matplotlib.colors.to_hex(matplotlib.pyplot.get_cmap("tab20")(i))
+    for i in range(20)
 )
 
 _TEMPLATE = """<!DOCTYPE html>
@@ -128,7 +129,7 @@ def write_html_trajectories_3d(artifact: Dict[str, np.ndarray], out_path: str,
         data.append({"xyz": xyz.tolist(), "color": _PALETTE[i % len(_PALETTE)]})
 
     html = _TEMPLATE % {
-        "title": title or os.path.basename(out_path),
+        "title": _html.escape(title or os.path.basename(out_path)),
         "n_traj": len(data),
         "data": json.dumps(data, separators=(",", ":")),
     }

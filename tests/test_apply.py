@@ -162,6 +162,23 @@ def test_fft_quirk_ignores_target_by_default():
         np.asarray(apply_to_weights(fixed, self_flat, t2)))
 
 
+def test_fft_rfft_mode_matches_numpy_reference():
+    """fft_mode='rfft' — the EP prototype's real-input reduction
+    (related/EP/src/FeatureReduction.py): first k rfft bins in, irfft out."""
+    topo = FFT.with_(fft_mode="rfft")
+    rng = np.random.default_rng(17)
+    p = topo.num_weights
+    self_flat = rng.normal(size=p).astype(np.float32)
+    target = rng.normal(size=p).astype(np.float32)
+    coeffs = np.fft.rfft(self_flat).real.astype(np.float32)[:4]
+    mats = [np.asarray(m) for m in unflatten(topo, jnp.asarray(self_flat))]
+    new_coeffs = np_mlp(mats, coeffs[None, :])[0]
+    expected = np.fft.irfft(new_coeffs, n=p)
+    got = apply_to_weights(topo, jnp.asarray(self_flat), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-6)
+    assert np.asarray(got).dtype == np.float32
+
+
 # ----------------------------------------------------------------- recurrent
 
 RNN = Topology("recurrent", width=2, depth=2)

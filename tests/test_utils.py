@@ -76,3 +76,26 @@ def test_soup_determinism_same_key():
     np.testing.assert_array_equal(np.asarray(a.weights), np.asarray(b.weights))
     np.testing.assert_array_equal(np.asarray(a.uids), np.asarray(b.uids))
     assert not np.array_equal(np.asarray(a.weights), np.asarray(c.weights))
+
+
+def test_printing_object_reference_surface(capsys):
+    """PrintingObject mirrors util.py:1-39: silent default, fluent setters,
+    SilenceSignal restores the previous value."""
+    from srnn_tpu.utils import PrintingObject
+
+    class Thing(PrintingObject):
+        pass
+
+    t = Thing()
+    assert t.is_silent() and t.get_silence()
+    t._print("hidden")
+    assert capsys.readouterr().out == ""
+    assert t.unset_silence() is t and not t.silent
+    t._print("shown")
+    assert capsys.readouterr().out == "shown\n"
+    with t.silence():
+        assert t.silent
+        t._print("muted")
+    assert not t.silent  # restored
+    assert capsys.readouterr().out == ""
+    assert t.with_silence().is_silent()

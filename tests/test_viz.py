@@ -103,3 +103,19 @@ def test_cli(tmp_path, capsys):
          "--max-steps", "5"])
     assert viz.main(["-i", str(tmp_path)]) == 0
     assert "variation_box.png" in capsys.readouterr().out
+
+
+def test_plot_histogram_and_bands(tmp_path):
+    """Generic plotters (visualization.py:183-252 parity)."""
+    out = viz.plot_histogram(
+        [{"name": np.array(["a", "b", "a"])}, {"name": np.array(["b", "b"])}],
+        str(tmp_path / "hist.png"), title="hist")
+    assert os.path.exists(out)
+    x = np.arange(5)
+    out = viz.line_plot_with_bands(
+        [{"x": x, "main_y": x * 1.0, "upper_y": x + 1.0, "lower_y": x - 1.0,
+          "name": "s0"},
+         {"x": x, "main_y": x * 0.5, "upper_y": x * 0.5 + 0.2,
+          "lower_y": x * 0.5 - 0.2}],
+        str(tmp_path / "bands.png"))
+    assert os.path.exists(out)
