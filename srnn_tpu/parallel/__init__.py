@@ -6,6 +6,13 @@ from .sharded_soup import (
     sharded_count,
 )
 from .ring_rnn import ring_rnn_apply
+from .sharded_apply import (
+    rnn_associative_apply,
+    sharded_aggregating_apply,
+    sharded_apply_to_weights,
+    sharded_fft_apply,
+    sharded_weightwise_apply,
+)
 from .multihost import DCN_AXIS, multislice_soup_mesh
 
 __all__ = [
@@ -20,4 +27,9 @@ __all__ = [
     "sharded_evolve",
     "sharded_count",
     "ring_rnn_apply",
+    "rnn_associative_apply",
+    "sharded_apply_to_weights",
+    "sharded_weightwise_apply",
+    "sharded_aggregating_apply",
+    "sharded_fft_apply",
 ]

@@ -1,0 +1,293 @@
+"""Sequence parallelism for giant particles: every transform sharded on the
+weight axis.
+
+The reference caps out at 2x2 nets (14-17 weights); nothing in it can grow a
+particle past one host's memory (SURVEY §5 "long-context" row).  Here the
+TARGET weight vector — the "sequence" every transform consumes — is sharded
+over the mesh and each variant uses the cheapest collective that preserves
+its exact semantics:
+
+  * weightwise  — embarrassingly parallel over weight points: each device
+    rewrites its local chunk with the replicated tiny MLP; NO collective.
+  * aggregating — local partial segment sums + one ``psum`` of (k,) sums;
+    the k-vector MLP runs replicated; deaggregation is local replication.
+    (reference ``collect_weights`` chunk rule, ``network.py:388-403``.)
+  * fft         — the truncated DFT/inverse pair becomes small cos-basis
+    matmuls: a ``psum`` assembles the k input bins, each device synthesizes
+    its local slice of the inverse transform.  Matches
+    ``np.fft.fft(flat, n=k)`` / ``ifft(coeffs, n=P).real`` bit-for-bit in
+    real arithmetic (reference ``network.py:444-453``), both fft and rfft
+    modes.
+  * recurrent   — a DISTRIBUTED associative scan (the "documented next
+    step" of ``ring_rnn``): with the affine (linear-activation) recurrence,
+    each device scans its chunk in O(log T/D) depth, all-gathers one
+    (units x units, units) chunk summary, prefix-composes the D summaries
+    locally, and finishes its outputs — O(T/D log) time instead of the
+    ring pipeline's O(T) wavefront.
+
+All functions are numerically equivalent to their single-device
+counterparts in ``srnn_tpu.nets`` (same math, possibly reassociated) and
+zero-pad the weight axis to a mesh multiple (safe: padded positions never
+influence kept outputs — weightwise/agg/fft index positions explicitly, and
+the recurrence is causal with padding at the tail).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.linalg import matmul
+from ..ops.mlp import mlp_forward
+from ..ops.flatten import unflatten
+from ..topology import Topology, normalized_weight_coords, segments_for
+from .mesh import SOUP_AXIS
+from .ring_rnn import ring_rnn_apply
+
+
+def _pad_to(x: jnp.ndarray, mult: int) -> jnp.ndarray:
+    pad = (-x.shape[0]) % mult
+    return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)) if pad else x
+
+
+# ---------------------------------------------------------------------------
+# weightwise: pure map over weight points
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "mesh"))
+def sharded_weightwise_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
+                             target_flat: jax.Array) -> jax.Array:
+    """Weightwise transform with the (P,) target sharded over the mesh.
+
+    Each device holds its chunk of the precomputed positional-encoding table
+    (``network.py:239-255``) and runs the replicated MLP on its points only —
+    the pure-map decomposition SURVEY §5 calls out.  No collectives.
+    """
+    assert topo.variant == "weightwise"
+    n_dev = mesh.devices.size
+    t = target_flat.shape[0]
+    coords = jnp.asarray(normalized_weight_coords(topo), target_flat.dtype)
+    tgt = _pad_to(target_flat, n_dev)
+    crd = _pad_to(coords, n_dev)
+
+    def body(self_flat, tgt_loc, crd_loc):
+        pts = jnp.concatenate([tgt_loc[:, None], crd_loc], axis=1)
+        return mlp_forward(topo, self_flat, pts)[:, 0]
+
+    out = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(SOUP_AXIS), P(SOUP_AXIS)),
+        out_specs=P(SOUP_AXIS), check_vma=False,
+    )(self_flat, tgt, crd)
+    return out[:t]
+
+
+# ---------------------------------------------------------------------------
+# aggregating: psum of per-segment partial sums
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "mesh"))
+def sharded_aggregating_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
+                              target_flat: jax.Array) -> jax.Array:
+    """Aggregating transform with the (P,) target sharded over the mesh.
+
+    Collect (reference chunks-of-``P//k``-with-leftovers-to-last rule,
+    ``network.py:388-403``) becomes: local one-hot partial sums ->
+    ``psum`` of a (k,) vector -> divide by the constant counts.  Only the
+    'average' aggregator is defined under sharding (the reference default);
+    max aggregators and the random shuffler need global order and raise.
+    """
+    assert topo.variant == "aggregating"
+    if topo.aggregator != "average" or topo.shuffler != "not":
+        raise NotImplementedError(
+            "sharded aggregating supports aggregator='average', shuffler='not'")
+    n_dev = mesh.devices.size
+    p = target_flat.shape[0]
+    k = topo.aggregates
+    seg, counts = segments_for(p, k)
+    # padded tail gets segment id k (an extra bin discarded after psum)
+    seg_pad = _pad_to(jnp.asarray(seg, jnp.int32), n_dev)
+    pad = seg_pad.shape[0] - p
+    if pad:
+        seg_pad = seg_pad.at[p:].set(k)
+    tgt = _pad_to(target_flat, n_dev)
+    counts = jnp.asarray(counts, target_flat.dtype)
+
+    def body(self_flat, tgt_loc, seg_loc):
+        onehot = jax.nn.one_hot(seg_loc, k + 1, dtype=tgt_loc.dtype)[:, :k]
+        partial = matmul(topo, tgt_loc, onehot)            # (k,) local sums
+        aggs = jax.lax.psum(partial, SOUP_AXIS) / counts
+        new_aggs = mlp_forward(topo, self_flat, aggs[None, :])[0]
+        return matmul(topo, onehot, new_aggs)              # local deaggregate
+
+    out = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(SOUP_AXIS), P(SOUP_AXIS)),
+        out_specs=P(SOUP_AXIS), check_vma=False,
+    )(self_flat, tgt, seg_pad)
+    return out[:p]
+
+
+# ---------------------------------------------------------------------------
+# fft: distributed truncated DFT as cos-basis matmuls
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "mesh"))
+def sharded_fft_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
+                      target_flat: jax.Array) -> jax.Array:
+    """FFT transform with the (P,) source/target sharded over the mesh.
+
+    The reference keeps only REAL parts on both sides of the net
+    (``network.py:444-453``, keras float32 casts), so the whole round trip
+    is real arithmetic:
+
+      * 'fft' mode: ``fft(flat, n=k)`` crops to the first k weights —
+        local masked partial sums against a (k, k) cos basis, one psum.
+      * 'rfft' mode: the first k bins of the FULL-length real FFT — same
+        partial-sum shape with the (k, P) basis rows evaluated locally.
+      * inverse: ``ifft(c, n=P).real`` / ``irfft(c, n=P)`` synthesize from
+        k real coefficients — each device emits its local slice via a
+        (T_loc, k) cos matrix.  (irfft doubles the non-DC bins.)
+
+    The psum moves k floats; everything else is local.  The self weights
+    stay replicated; ``fft_use_target`` picks which vector feeds the DFT
+    (quirk §2.4.2).  The random shuffler needs a global permutation and
+    raises.
+    """
+    assert topo.variant == "fft"
+    if topo.shuffler != "not":
+        raise NotImplementedError("sharded fft supports shuffler='not'")
+    n_dev = mesh.devices.size
+    p = target_flat.shape[0]
+    k = topo.aggregates
+    src = target_flat if topo.fft_use_target else self_flat
+    assert src.shape[0] == p, "sharded fft: source and target must share length"
+    tgt = _pad_to(src, n_dev)
+    padded = tgt.shape[0]
+    t_loc = padded // n_dev
+    dtype = target_flat.dtype
+
+    def body(tgt_loc):
+        d = jax.lax.axis_index(SOUP_AXIS)
+        gidx = d * t_loc + jnp.arange(t_loc)               # global positions
+        j = jnp.arange(k, dtype=dtype)                     # bin indices
+        if topo.fft_mode == "rfft":
+            # Re rfft(flat)[j] = sum_t flat_t cos(2 pi j t / P) over ALL t
+            ang = 2.0 * jnp.pi * j[None, :] * gidx[:, None].astype(dtype) / p
+            keep = (gidx < p)[:, None].astype(dtype)
+        else:
+            # fft(flat, n=k): crop to first k samples, length-k DFT
+            ang = 2.0 * jnp.pi * j[None, :] * gidx[:, None].astype(dtype) / k
+            keep = (gidx < k)[:, None].astype(dtype)
+        partial = (tgt_loc[:, None] * jnp.cos(ang) * keep).sum(axis=0)
+        coeffs = jax.lax.psum(partial, SOUP_AXIS)          # (k,) real bins
+        new_c = mlp_forward(topo, self_flat, coeffs[None, :])[0]
+        # local slice of the inverse transform
+        ang_i = 2.0 * jnp.pi * j[None, :] * gidx[:, None].astype(dtype) / p
+        basis = jnp.cos(ang_i)
+        if topo.fft_mode == "rfft":
+            # irfft doubles every bin except DC (and Nyquist, absent: k-1 < P/2)
+            scale = jnp.where(j > 0, 2.0, 1.0).astype(dtype)
+            return basis @ (new_c * scale) / p
+        return basis @ new_c / p
+
+    out = shard_map(
+        lambda t_: body(t_), mesh=mesh,
+        in_specs=(P(SOUP_AXIS),), out_specs=P(SOUP_AXIS), check_vma=False,
+    )(tgt)
+    return out[:p].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# recurrent: distributed associative scan
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "mesh"))
+def rnn_associative_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
+                          target_flat: jax.Array) -> jax.Array:
+    """Sequence-parallel recurrent transform via a distributed associative
+    scan (upgrades ``ring_rnn_apply``'s O(T) wavefront to O(T/D log) time).
+
+    Requires the affine recurrence (``activation='linear'``, the reference
+    default every experiment ran with — quirk §2.4.11).  Per layer:
+
+      1. local ``associative_scan`` of the composed affine maps
+         ``(A, b): h -> h @ A + b`` over this device's chunk;
+      2. ``all_gather`` of the (units x units, units) chunk summary — the
+         only communication, D tiny tensors per layer;
+      3. every device prefix-composes the summaries below its rank into its
+         incoming hidden state (h0 = 0, keras default) and finishes
+         ``y_t = h_in @ Acum_t + bcum_t`` locally.
+    """
+    assert topo.variant == "recurrent"
+    assert topo.activation == "linear", (
+        "distributed associative scan requires activation='linear'; "
+        "use ring_rnn_apply for nonlinear recurrences")
+    n_dev = mesh.devices.size
+    t = target_flat.shape[0]
+    tgt = _pad_to(target_flat, n_dev)
+    mats = unflatten(topo, self_flat)
+
+    def body(self_flat, tgt_loc):
+        del self_flat  # mats closed over (replicated constants)
+        d = jax.lax.axis_index(SOUP_AXIS)
+        x = tgt_loc[:, None]
+        for layer, (_, units) in enumerate(topo.rnn_layer_dims):
+            kernel, recurrent = mats[2 * layer], mats[2 * layer + 1]
+            t_loc = x.shape[0]
+            b = matmul(topo, x, kernel)                        # (T_loc, u)
+            a = jnp.broadcast_to(recurrent, (t_loc, units, units))
+
+            def combine(lhs, rhs):
+                a1, b1 = lhs
+                a2, b2 = rhs
+                return (matmul(topo, a1, a2),
+                        matmul(topo, b1[:, None, :], a2)[:, 0, :] + b2)
+
+            a_cum, b_cum = jax.lax.associative_scan(combine, (a, b))
+            # chunk summary -> every device; prefix-compose ranks below mine
+            a_all = jax.lax.all_gather(a_cum[-1], SOUP_AXIS)   # (D, u, u)
+            b_all = jax.lax.all_gather(b_cum[-1], SOUP_AXIS)   # (D, u)
+            h_in = jnp.zeros((units,), x.dtype)
+            for r in range(n_dev - 1):                         # h0 = 0
+                nxt = matmul(topo, h_in, a_all[r]) + b_all[r]
+                h_in = jnp.where(d > r, nxt, h_in)
+            x = matmul(topo, h_in[None, :], a_cum)[:, 0, :] + b_cum
+        return x[:, 0]
+
+    out = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(SOUP_AXIS)), out_specs=P(SOUP_AXIS), check_vma=False,
+    )(self_flat, tgt)
+    return out[:t]
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+
+
+def sharded_apply_to_weights(topo: Topology, mesh: Mesh, self_flat: jax.Array,
+                             target_flat: jax.Array) -> jax.Array:
+    """Variant dispatch for weight-axis-sharded self-application — the
+    giant-particle twin of ``nets.apply_to_weights``.  The recurrent variant
+    routes on ``topo.rnn_scan``: 'associative' (linear) takes the
+    distributed scan, 'sequential' the ``ppermute`` ring."""
+    if topo.variant == "weightwise":
+        return sharded_weightwise_apply(topo, mesh, self_flat, target_flat)
+    if topo.variant == "aggregating":
+        return sharded_aggregating_apply(topo, mesh, self_flat, target_flat)
+    if topo.variant == "fft":
+        return sharded_fft_apply(topo, mesh, self_flat, target_flat)
+    if topo.variant == "recurrent":
+        if topo.rnn_scan == "associative":
+            return rnn_associative_apply(topo, mesh, self_flat, target_flat)
+        return ring_rnn_apply(topo, mesh, self_flat, target_flat)
+    raise ValueError(f"unknown variant {topo.variant!r}")

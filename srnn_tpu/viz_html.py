@@ -19,15 +19,17 @@ import json
 import os
 from typing import Dict, List
 
+import matplotlib
 import matplotlib.colors
-import matplotlib.pyplot
 import numpy as np
 
 from .viz import extract_pca
 
 # same tab20 cycle as the PNG renderer, derived so the two can't drift
+# (colormap registry access only — no pyplot state machine / backend side
+# effects in this otherwise matplotlib-free module)
 _PALETTE = tuple(
-    matplotlib.colors.to_hex(matplotlib.pyplot.get_cmap("tab20")(i))
+    matplotlib.colors.to_hex(matplotlib.colormaps["tab20"](i))
     for i in range(20)
 )
 

@@ -132,3 +132,43 @@ def test_ring_rnn_real_particle_odd_length(mesh):
     got = ring_rnn_apply(topo, mesh, self_flat, target)
     assert got.shape == (17,)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- weight-axis sharding (SP)
+
+
+@pytest.mark.parametrize("topo", [
+    Topology("weightwise", width=4, depth=3),
+    Topology("aggregating", width=5, depth=2, aggregates=4),
+    Topology("fft", width=5, depth=2, aggregates=4),
+    Topology("fft", width=5, depth=2, aggregates=4, fft_mode="rfft"),
+    Topology("recurrent", width=3, depth=2, rnn_scan="associative"),
+    Topology("recurrent", width=3, depth=2),  # dispatches to the ring
+])
+def test_sharded_apply_matches_single_device(mesh, topo):
+    """Every weight-axis-sharded transform equals its single-device twin
+    (P is odd for every one of these, so tail padding is exercised)."""
+    from srnn_tpu.parallel.sharded_apply import sharded_apply_to_weights
+
+    rng = np.random.default_rng(23)
+    p = topo.num_weights
+    assert p % mesh.devices.size != 0  # padding path active
+    self_flat = jnp.asarray(rng.normal(size=p).astype(np.float32) * 0.3)
+    target = jnp.asarray(rng.normal(size=p).astype(np.float32))
+    want = np.asarray(apply_to_weights(topo, self_flat, target))
+    got = np.asarray(sharded_apply_to_weights(topo, mesh, self_flat, target))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_sharded_apply_unsupported_options_raise(mesh):
+    from srnn_tpu.parallel.sharded_apply import (
+        sharded_aggregating_apply, sharded_fft_apply)
+
+    p = Topology("aggregating", width=2, depth=2).num_weights
+    w = jnp.zeros(p)
+    with pytest.raises(NotImplementedError):
+        sharded_aggregating_apply(
+            Topology("aggregating", aggregator="max"), mesh, w, w)
+    with pytest.raises(NotImplementedError):
+        sharded_fft_apply(
+            Topology("fft", shuffler="random"), mesh, w, w)
