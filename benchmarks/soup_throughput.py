@@ -35,16 +35,17 @@ from srnn_tpu.soup import SoupConfig, evolve, seed
 PRESETS = ("apply", "full", "mixed")
 
 
-def _dynamics(preset: str) -> dict:
+def _dynamics(preset: str, train_mode: str = "sequential") -> dict:
     if preset == "apply":
         return dict(attacking_rate=0.1, learn_from_rate=-1.0, train=0)
     return dict(attacking_rate=0.1, learn_from_rate=0.1,
-                learn_from_severity=1, train=10)
+                learn_from_severity=1, train=10, train_mode=train_mode)
 
 
 def bench_size(preset: str, n: int, generations: int = 50,
-               repeats: int = 3) -> dict:
-    dyn = _dynamics(preset)
+               repeats: int = 3, layout: str = "rowmajor",
+               train_mode: str = "sequential") -> dict:
+    dyn = _dynamics(preset, train_mode)
     if preset == "mixed":
         third = n // 3
         cfg = MultiSoupConfig(
@@ -63,7 +64,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
     else:
         cfg = SoupConfig(
             topo=Topology("weightwise", width=2, depth=2), size=n,
-            remove_divergent=True, remove_zero=True, **dyn)
+            remove_divergent=True, remove_zero=True, layout=layout, **dyn)
         state = seed(cfg, jax.random.key(0))
 
         def run(s):
@@ -80,6 +81,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
     gens_per_sec = generations / dt
     return {
         "metric": f"soup-generations/sec[{preset}]",
+        "layout": layout,
         "particles": n,
         "generations": generations,
         "value": round(gens_per_sec, 2),
@@ -95,10 +97,27 @@ def main():
                    default=[10_000, 100_000, 1_000_000])
     p.add_argument("--generations", type=int, default=50)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--layout", choices=("rowmajor", "popmajor"),
+                   default="rowmajor",
+                   help="popmajor: (P, N) lane-major weightwise generation "
+                        "(apply/full presets only; see srnn_tpu/ops/popmajor.py)")
+    p.add_argument("--train-mode", choices=("sequential", "full_batch"),
+                   default="sequential",
+                   help="train/learn_from SGD mode for the 'full'/'mixed' presets")
     args = p.parse_args()
+    if args.layout == "popmajor" and args.preset == "mixed":
+        p.error("--layout popmajor applies to the single-type weightwise presets")
+    if (args.layout == "popmajor" and args.preset == "full"
+            and args.train_mode == "sequential"):
+        # the scan(epochs) x scan(samples) x grad nest compiles unboundedly
+        # long on remote TPU compile services at mega-soup N (see
+        # srnn_tpu/ops/popmajor.py "Known limitation")
+        p.error("--layout popmajor --preset full requires --train-mode "
+                "full_batch (sequential-mode compile pathology at mega-N)")
     for n in args.sizes:
         print(json.dumps(bench_size(args.preset, n, args.generations,
-                                    args.repeats)))
+                                    args.repeats, args.layout,
+                                    args.train_mode)))
 
 
 if __name__ == "__main__":

@@ -26,14 +26,16 @@ DEFAULT_EPSILON = 1e-4  # every reference experiment overrides the 1e-14
                         # constructor default to 1e-4 (e.g. training-fixpoints.py:38)
 
 
-def is_diverged(flat: jnp.ndarray) -> jnp.ndarray:
-    """True if any weight is NaN or +-Inf. Reduces over the last axis."""
-    return jnp.any(~jnp.isfinite(flat), axis=-1)
+def is_diverged(flat: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """True if any weight is NaN or +-Inf. Reduces over ``axis`` (the weight
+    axis: last for (N, P) row-major, 0 for (P, N) population-major)."""
+    return jnp.any(~jnp.isfinite(flat), axis=axis)
 
 
-def is_zero(flat: jnp.ndarray, epsilon: float = DEFAULT_EPSILON) -> jnp.ndarray:
+def is_zero(flat: jnp.ndarray, epsilon: float = DEFAULT_EPSILON,
+            axis: int = -1) -> jnp.ndarray:
     """True if all weights lie in the closed interval [-eps, eps]."""
-    return jnp.all((flat >= -epsilon) & (flat <= epsilon), axis=-1)
+    return jnp.all((flat >= -epsilon) & (flat <= epsilon), axis=axis)
 
 
 def is_fixpoint(

@@ -153,6 +153,10 @@ def _local_evolve(config: SoupConfig, state: SoupState) -> Tuple[SoupState, Soup
 @functools.partial(jax.jit, static_argnames=("config", "mesh"))
 def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
     """One generation with the particle axis sharded over ``mesh``."""
+    if config.layout != "rowmajor":
+        raise NotImplementedError(
+            f"sharded soup supports layout='rowmajor' (got {config.layout!r}); "
+            "the population-major layout is single-device for now")
     fn = shard_map(
         functools.partial(_local_evolve, config),
         mesh=mesh,

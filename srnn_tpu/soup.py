@@ -67,6 +67,12 @@ class SoupConfig(NamedTuple):
     lr: float = DEFAULT_LR
     train_mode: str = "sequential"
     mode: str = "parallel"          # 'parallel' | 'sequential'
+    # 'rowmajor' keeps (N, P) arrays and vmaps per particle; 'popmajor'
+    # (weightwise + parallel mode only) transposes the generation to (P, N)
+    # so the particle axis rides the TPU lanes and the train/learn gradient
+    # steps stay elementwise — ~4-16x faster phases at N=1M (see
+    # ops/popmajor.py).  Same math up to float reassociation.
+    layout: str = "rowmajor"        # 'rowmajor' | 'popmajor'
 
 
 class SoupState(NamedTuple):
@@ -232,6 +238,88 @@ def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, S
     return new_state, SoupEvents(action, counterpart, train_loss)
 
 
+def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
+                             wT: jnp.ndarray) -> Tuple[SoupState, SoupEvents, jnp.ndarray]:
+    """Population-major twin of ``_evolve_parallel`` for weightwise soups.
+
+    ``wT`` is the (P, N) transposed population (``state.weights`` is
+    ignored and carried only for uid/time/key metadata); returns the new
+    transposed weights alongside the state so ``evolve`` can keep the
+    carry transposed across generations (one transpose per run, not per
+    step).  Phase order and event semantics identical to the row-major
+    path; arithmetic differs only by reassociation.
+    """
+    from .ops.popmajor import (ww_forward_popmajor, ww_learn_epochs_popmajor,
+                               ww_train_epochs_popmajor)
+
+    n = config.size
+    topo = config.topo
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+
+    # --- attack (soup.py:56-61); same last-attacker-wins resolution -----
+    if config.attacking_rate > 0:
+        attack_gate = (jax.random.uniform(k_ag, (n,)) < config.attacking_rate)
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+        has_attacker = att_idx >= 0
+        attacked = ww_forward_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT)
+        wT = jnp.where(has_attacker[None, :], attacked, wT)
+    else:
+        attack_gate = jnp.zeros(n, bool)
+        attack_tgt = jnp.zeros(n, jnp.int32)
+
+    # --- learn_from (soup.py:62-68) -------------------------------------
+    if config.learn_from_rate > 0:
+        learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
+        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+        if config.learn_from_severity > 0:
+            learned, _ = ww_learn_epochs_popmajor(
+                topo, wT, wT[:, learn_tgt], config.learn_from_severity,
+                config.lr, config.train_mode)
+            wT = jnp.where(learn_gate[None, :], learned, wT)
+    else:
+        learn_gate = jnp.zeros(n, bool)
+        learn_tgt = jnp.zeros(n, jnp.int32)
+
+    # --- train (soup.py:69-76) ------------------------------------------
+    if config.train > 0:
+        wT, train_loss = ww_train_epochs_popmajor(
+            topo, wT, config.train, config.lr, config.train_mode)
+    else:
+        train_loss = jnp.zeros(n, wT.dtype)
+
+    # --- respawn (soup.py:77-86); per-lane masks ------------------------
+    action = jnp.full(n, ACT_NONE, jnp.int32)
+    dead_div = is_diverged(wT, axis=0) if config.remove_divergent \
+        else jnp.zeros(n, bool)
+    dead_zero = (is_zero(wT, config.epsilon, axis=0) & ~dead_div) \
+        if config.remove_zero else jnp.zeros(n, bool)
+    dead = dead_div | dead_zero
+    fresh = init_population(topo, k_re, n).T
+    wT = jnp.where(dead[None, :], fresh, wT)
+    rank = jnp.cumsum(dead) - 1
+    uids = jnp.where(dead, state.next_uid + rank.astype(jnp.int32), state.uids)
+    deaths = dead.sum(dtype=jnp.int32)
+    action = jnp.where(dead_div, ACT_DIV_DEAD, action)
+    action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
+    death_cp = jnp.where(dead, uids, -1)
+
+    act, cp = _event_record(
+        n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
+        config.train > 0, action, death_cp)
+    new_state = SoupState(state.weights, uids, state.next_uid + deaths,
+                          state.time + 1, key)
+    return new_state, SoupEvents(act, cp, train_loss), wT
+
+
+def _check_popmajor(config: SoupConfig) -> None:
+    if config.topo.variant != "weightwise" or config.mode != "parallel":
+        raise ValueError(
+            "layout='popmajor' supports the weightwise variant in parallel "
+            f"mode (got variant={config.topo.variant!r}, mode={config.mode!r})")
+
+
 def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
     """Particle-by-particle in-place mutation (reference semantics,
     ``soup.py:51-87``): particle i's action sees all mutations made by
@@ -300,6 +388,13 @@ def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState,
 @functools.partial(jax.jit, static_argnames=("config",))
 def evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
     """One generation (``Soup.evolve`` body, ``soup.py:51-87``)."""
+    if config.layout == "popmajor":
+        _check_popmajor(config)
+        new_state, events, wT = _evolve_parallel_popmajor(config, state,
+                                                          state.weights.T)
+        return new_state._replace(weights=wT.T), events
+    if config.layout != "rowmajor":
+        raise ValueError(f"unknown soup layout {config.layout!r}")
     if config.mode == "sequential":
         return _evolve_sequential(config, state)
     if config.mode != "parallel":
@@ -321,6 +416,25 @@ def evolve(
     (the vectorized stand-in for ``ParticleDecorator.save_state`` histories,
     ``network.py:193-198``).
     """
+
+    if config.layout == "popmajor":
+        # keep the carry transposed across the whole run: one transpose at
+        # entry/exit instead of two per generation
+        _check_popmajor(config)
+
+        def step_t(carry, _):
+            s, wT = carry
+            new_s, ev, new_wT = _evolve_parallel_popmajor(config, s, wT)
+            out = (ev, new_wT.T, new_s.uids) if record else None
+            return (new_s, new_wT), out
+
+        # the transposed wT is the live weights carry; null the row-major
+        # field so the scan doesn't drag a dead (N, P) buffer along
+        light = state._replace(weights=jnp.zeros((0,), state.weights.dtype))
+        (final, wT), recs = jax.lax.scan(
+            step_t, (light, state.weights.T), None, length=generations)
+        final = final._replace(weights=wT.T)
+        return (final, recs) if record else final
 
     def step(s, _):
         new_s, ev = evolve_step(config, s)

@@ -170,3 +170,65 @@ def test_modes_distributionally_similar(mode):
     counts = count(cfg, final)
     # most particles should have left 'other' by now
     assert int(counts[CLS_OTHER]) < 8
+
+
+# ------------------------------------------------- population-major layout
+
+
+@pytest.mark.parametrize("dyn", [
+    dict(attacking_rate=0.5, learn_from_rate=-1.0, train=0),
+    dict(attacking_rate=0.5, learn_from_rate=0.5, learn_from_severity=2, train=0),
+    dict(attacking_rate=0.3, learn_from_rate=0.3, train=3,
+         remove_divergent=True, remove_zero=True),
+    dict(attacking_rate=0.3, learn_from_rate=0.3, train=3,
+         train_mode="full_batch"),
+])
+def test_popmajor_matches_rowmajor(dyn):
+    """layout='popmajor' draws the same PRNG stream as the row-major path, so
+    gates/targets/respawns coincide and weights agree up to reassociation."""
+    cfg_row = mkconfig(size=24, **dyn)
+    cfg_pop = mkconfig(size=24, layout="popmajor", **dyn)
+    st = seed(cfg_row, jax.random.key(5))
+    row_s, row_ev = evolve_step(cfg_row, st)
+    pop_s, pop_ev = evolve_step(cfg_pop, st)
+    np.testing.assert_array_equal(np.asarray(row_ev.action), np.asarray(pop_ev.action))
+    np.testing.assert_array_equal(np.asarray(row_ev.counterpart),
+                                  np.asarray(pop_ev.counterpart))
+    np.testing.assert_array_equal(np.asarray(row_s.uids), np.asarray(pop_s.uids))
+    np.testing.assert_allclose(np.asarray(row_s.weights), np.asarray(pop_s.weights),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(row_ev.loss), np.asarray(pop_ev.loss),
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_popmajor_evolve_many_generations_matches():
+    cfg_row = mkconfig(size=16, attacking_rate=0.2, train=2,
+                       remove_divergent=True, remove_zero=True)
+    cfg_pop = cfg_row._replace(layout="popmajor")
+    st = seed(cfg_row, jax.random.key(7))
+    row = evolve(cfg_row, st, generations=10)
+    pop = evolve(cfg_pop, st, generations=10)
+    assert int(pop.time) == 10
+    np.testing.assert_array_equal(np.asarray(row.uids), np.asarray(pop.uids))
+    np.testing.assert_allclose(np.asarray(row.weights), np.asarray(pop.weights),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_popmajor_record_and_count():
+    cfg = mkconfig(size=12, attacking_rate=0.3, train=1, layout="popmajor",
+                   remove_divergent=True, remove_zero=True)
+    st = seed(cfg, jax.random.key(1))
+    final, (ev, w_hist, uid_hist) = evolve(cfg, st, generations=5, record=True)
+    assert w_hist.shape == (5, 12, WW.num_weights)
+    assert uid_hist.shape == (5, 12)
+    assert int(count(cfg, final).sum()) == 12
+
+
+def test_popmajor_rejects_unsupported_configs():
+    with pytest.raises(ValueError):
+        evolve_step(mkconfig(layout="popmajor", mode="sequential"),
+                    seed(mkconfig(), jax.random.key(0)))
+    rnn_cfg = SoupConfig(topo=Topology("recurrent"), size=4, layout="popmajor")
+    with pytest.raises(ValueError):
+        evolve_step(rnn_cfg, seed(SoupConfig(topo=Topology("recurrent"), size=4),
+                                  jax.random.key(0)))

@@ -114,3 +114,51 @@ def test_shuffled_epoch_is_permutation_of_updates():
     # different orders give (slightly) different results but same magnitude
     assert not np.allclose(np.asarray(w1), np.asarray(w2))
     assert np.linalg.norm(np.asarray(w1) - np.asarray(w3)) < 0.1
+
+
+# ------------------------------------------------- population-major trainer
+
+
+@pytest.mark.parametrize("mode", ["sequential", "full_batch"])
+def test_popmajor_fit_epoch_matches_rowmajor(mode):
+    """ops.popmajor epoch == vmapped train.fit_epoch on the transposed pop."""
+    from srnn_tpu.ops.popmajor import ww_fit_epoch_popmajor
+    from srnn_tpu.nets import compute_samples
+
+    topo = Topology("weightwise", width=2, depth=2)
+    rng = np.random.default_rng(29)
+    pop = jnp.asarray(rng.normal(size=(32, topo.num_weights)).astype(np.float32) * 0.5)
+
+    def row_one(w):
+        x, y = compute_samples(topo, w)
+        return fit_epoch(topo, w, x, y, mode=mode)
+
+    want_w, want_l = jax.vmap(row_one)(pop)
+    got_wT, got_l = ww_fit_epoch_popmajor(topo, pop.T, pop.T, pop.T, mode=mode)
+    np.testing.assert_allclose(np.asarray(got_wT.T), np.asarray(want_w),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
+                               rtol=2e-3, atol=1e-6)
+
+
+def test_popmajor_train_epochs_recompute_samples():
+    """Repeated train() recomputes samples from current weights each epoch —
+    popmajor must match the row-major multi-epoch trajectory, not a frozen-
+    sample one."""
+    from srnn_tpu.ops.popmajor import ww_train_epochs_popmajor
+
+    topo = Topology("weightwise", width=2, depth=2)
+    rng = np.random.default_rng(31)
+    pop = jnp.asarray(rng.normal(size=(8, topo.num_weights)).astype(np.float32) * 0.5)
+
+    def row_epochs(w):
+        for _ in range(4):
+            w, loss = train_step(topo, w)
+        return w, loss
+
+    want_w, want_l = jax.vmap(row_epochs)(pop)
+    got_wT, got_l = ww_train_epochs_popmajor(topo, pop.T, epochs=4)
+    np.testing.assert_allclose(np.asarray(got_wT.T), np.asarray(want_w),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
+                               rtol=2e-3, atol=1e-6)
