@@ -175,6 +175,37 @@ class Experiment:
         self.dir: Optional[str] = None
         self._t0: Optional[float] = None
 
+    @classmethod
+    def attach(cls, run_dir: str) -> "Experiment":
+        """Re-attach to an existing run directory (resume support — no
+        reference equivalent; its runs always restart, SURVEY §5).
+
+        Returns an entered Experiment whose ``log``/``event``/``save`` append
+        to the existing ``log.txt``/``events.jsonl``/artifacts.  Exit it (or
+        use it as a context manager) to flush the log as usual.
+        """
+        run_dir = os.path.normpath(run_dir)
+        if not os.path.isdir(run_dir):
+            raise FileNotFoundError(run_dir)
+        base = os.path.basename(run_dir)
+        self = cls(name=base, root=os.path.dirname(run_dir) or ".")
+        meta_path = os.path.join(run_dir, "meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self.experiment_name = meta.get("name", base)
+            self.experiment_id = meta.get("id", self.experiment_id)
+            self.next_iteration = meta.get("iteration", 0)
+            self.seed = meta.get("seed")
+        self.dir = run_dir
+        self._t0 = time.time()
+        log_path = os.path.join(run_dir, "log.txt")
+        if os.path.exists(log_path):
+            with open(log_path) as f:
+                self.log_messages = [line.rstrip("\n") for line in f]
+        self._events = open(os.path.join(run_dir, "events.jsonl"), "a")
+        return self
+
     # -- context ---------------------------------------------------------
 
     def __enter__(self) -> "Experiment":

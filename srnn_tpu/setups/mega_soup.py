@@ -1,0 +1,159 @@
+"""The north-star mega-soup: BASELINE.json's 1M-particle / 1000-generation
+workload as a resumable production run.
+
+No reference equivalent — the reference cannot exceed a few hundred
+particles (one keras model per particle, ``soup.py:37-49``).  This entry
+point is the showcase composition of the runtime: the weightwise soup at
+mega scale (``layout='popmajor'`` by default — particle axis on the TPU
+lanes), periodic orbax checkpoints with bit-exact ``--resume``, per-chunk
+class-count logging, and optional strided trajectory capture to the native
+``.traj`` store.
+
+    python -m srnn_tpu.setups mega_soup --size 1000000 --generations 1000
+    python -m srnn_tpu.setups mega_soup --resume experiments/exp-mega-soup-…-0
+
+Interrupted runs continue from the last checkpoint on the SAME PRNG stream,
+so an interrupted-and-resumed run reproduces an uninterrupted one exactly.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+from ..experiment import (Experiment, counters_dict, format_counters,
+                          restore_checkpoint, save_checkpoint)
+from ..soup import SoupConfig, count, evolve, seed
+from ..topology import Topology
+from .common import base_parser, register
+
+
+def build_parser():
+    p = base_parser(__doc__)
+    p.add_argument("--size", type=int, default=1_000_000)
+    p.add_argument("--generations", type=int, default=1000)
+    p.add_argument("--attacking-rate", type=float, default=0.1)
+    p.add_argument("--learn-from-rate", type=float, default=-1.0)
+    p.add_argument("--train", type=int, default=0)
+    p.add_argument("--train-mode", default="sequential",
+                   choices=("sequential", "full_batch"))
+    p.add_argument("--layout", default="popmajor",
+                   choices=("rowmajor", "popmajor"))
+    p.add_argument("--checkpoint-every", type=int, default=100,
+                   help="generations per checkpoint/log chunk")
+    p.add_argument("--resume", default=None, metavar="RUN_DIR",
+                   help="continue a previous run from its latest checkpoint")
+    return p
+
+
+def _latest_checkpoint(run_dir: str):
+    # only finalized checkpoints: a kill during save leaves orbax tmp dirs
+    # (ckpt-genNNN.orbax-checkpoint-tmp-*) that must not be picked up
+    ckpts = sorted(
+        (p for p in glob.glob(os.path.join(run_dir, "ckpt-gen*"))
+         if p.rsplit("gen", 1)[1].isdigit()),
+        key=lambda p: int(p.rsplit("gen", 1)[1]))
+    if not ckpts:
+        raise FileNotFoundError(f"no finalized ckpt-gen* checkpoints under {run_dir}")
+    return ckpts[-1]
+
+
+_CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
+                  "train_mode", "layout", "epsilon")
+
+
+def _save_config(run_dir: str, args) -> None:
+    with open(os.path.join(run_dir, "config.json"), "w") as f:
+        json.dump({k: getattr(args, k) for k in _CONFIG_FIELDS}, f, indent=1)
+
+
+def _load_config(run_dir: str, args) -> None:
+    """Resume must continue the ORIGINAL run's dynamics (size, rates, train
+    schedule, layout), not whatever the resuming invocation's CLI defaults
+    happen to be.  The horizon (``--generations``) and checkpoint cadence
+    stay CLI-controlled — extending a finished run is legitimate."""
+    path = os.path.join(run_dir, "config.json")
+    with open(path) as f:
+        saved = json.load(f)
+    for k in _CONFIG_FIELDS:
+        setattr(args, k, saved[k])
+
+
+def run(args):
+    if args.smoke:
+        # shrink only the knobs left at their defaults, so e.g.
+        # `--smoke --generations 4` still means 4 generations
+        args.size = 64 if args.size == 1_000_000 else args.size
+        args.generations = 6 if args.generations == 1000 else args.generations
+        args.checkpoint_every = 2 if args.checkpoint_every == 100 \
+            else args.checkpoint_every
+    if args.layout == "popmajor" and args.train > 0 \
+            and args.train_mode == "sequential" and args.size >= 100_000:
+        raise SystemExit(
+            "popmajor + sequential training at mega-N is a known remote-"
+            "compile pathology (ops/popmajor.py); use --train-mode "
+            "full_batch or --layout rowmajor")
+
+    if args.resume:
+        _load_config(args.resume, args)  # original dynamics win over CLI
+        cfg = _make_config(args)
+        exp = Experiment.attach(args.resume)
+        ckpt = _latest_checkpoint(exp.dir)
+        state = restore_checkpoint(ckpt)
+        exp.log(f"resumed from {os.path.basename(ckpt)} "
+                f"at generation {int(state.time)}")
+    else:
+        cfg = _make_config(args)
+        exp = Experiment("mega-soup", root=args.root, seed=args.seed).__enter__()
+        _save_config(exp.dir, args)
+        state = seed(cfg, jax.random.key(args.seed))
+        exp.log(f"mega-soup N={cfg.size} layout={cfg.layout} "
+                f"attack={cfg.attacking_rate} train={cfg.train}/{cfg.train_mode}")
+
+    import time as _time
+    try:
+        counts = np.asarray(count(cfg, state))
+        while int(state.time) < args.generations:
+            chunk = min(args.checkpoint_every, args.generations - int(state.time))
+            t0 = _time.perf_counter()
+            state = evolve(cfg, state, generations=chunk)
+            counts = np.asarray(count(cfg, state))
+            dt = _time.perf_counter() - t0
+            gen = int(state.time)
+            exp.log(f"gen {gen}/{args.generations}  {chunk / dt:.2f} gens/s  "
+                    f"{format_counters(counts)}",
+                    generation=gen, gens_per_sec=round(chunk / dt, 3))
+            save_checkpoint(os.path.join(exp.dir, f"ckpt-gen{gen:08d}"), state)
+        exp.log(f"done: {counters_dict(counts)}")
+    finally:
+        # exp is already entered (fresh or attached); close exactly once,
+        # passing real exception info so meta.json records crashes
+        exp.__exit__(*sys.exc_info())
+    return exp.dir
+
+
+def _make_config(args) -> SoupConfig:
+    return SoupConfig(
+        topo=Topology("weightwise", width=2, depth=2),
+        size=args.size,
+        attacking_rate=args.attacking_rate,
+        learn_from_rate=args.learn_from_rate,
+        train=args.train,
+        train_mode=args.train_mode,
+        remove_divergent=True,
+        remove_zero=True,
+        epsilon=args.epsilon,
+        layout=args.layout,
+    )
+
+
+@register("mega_soup")
+def main(argv=None):
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()
