@@ -125,7 +125,8 @@ def run(args):
             gen = int(state.time)
             exp.log(f"gen {gen}/{args.generations}  {chunk / dt:.2f} gens/s  "
                     f"{format_counters(counts)}",
-                    generation=gen, gens_per_sec=round(chunk / dt, 3))
+                    generation=gen, gens_per_sec=round(chunk / dt, 3),
+                    counts=counters_dict(counts))
             save_checkpoint(os.path.join(exp.dir, f"ckpt-gen{gen:08d}"), state)
         exp.log(f"done: {counters_dict(counts)}")
     finally:

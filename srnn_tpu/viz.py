@@ -339,13 +339,55 @@ def _render_variation(run_dir: str, path: str) -> List[str]:
     return [plot_box(load_artifact(path), os.path.join(run_dir, "variation_box.png"))]
 
 
-#: artifact basename -> renderer(run_dir, artifact_path) -> [outputs]
+def _render_mega_curve(run_dir: str, path: str) -> List[str]:
+    """Class-count trajectory of a mega-soup run, from the structured event
+    log (``config.json`` marks a mega_soup run dir; events carry per-chunk
+    ``generation`` + ``counts``)."""
+    import json as _json
+
+    events_path = os.path.join(run_dir, "events.jsonl")
+    if not os.path.exists(events_path):
+        return []
+    gens, series = [], {name: [] for name in CLASS_NAMES}
+    with open(events_path) as f:
+        for line in f:
+            try:
+                ev = _json.loads(line)
+            except ValueError:
+                continue
+            if "generation" not in ev or "counts" not in ev:
+                continue
+            gens.append(ev["generation"])
+            for name in CLASS_NAMES:
+                series[name].append(ev["counts"].get(name, 0))
+    # always write the marker PNG — even with no counts yet — so the walk
+    # stays idempotent; staleness vs the growing events.jsonl is handled by
+    # the mtime rule in search_and_apply
+    fig, ax = plt.subplots(figsize=(9, 5))
+    for i, name in enumerate(CLASS_NAMES):
+        ax.plot(gens, series[name], color=CLASS_COLORS[i], label=name)
+    ax.set_xlabel("generation")
+    ax.set_ylabel("particles")
+    if gens:
+        ax.legend(fontsize=8)
+    else:
+        ax.set_title("no generation counts logged yet")
+    ax.grid(alpha=0.3)
+    out = os.path.join(run_dir, "mega_curve.png")
+    fig.savefig(out, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return [out]
+
+
+#: artifact basename -> (renderer(run_dir, artifact_path) -> [outputs],
+#:                        output-file marker prefix)
 RENDERERS = {
-    "trajectorys": _render_trajectories,
-    "soup": _render_soup,
-    "all_data": _render_sweep,
-    "all_counters": _render_counters,
-    "data": _render_variation,
+    "trajectorys": (_render_trajectories, "trajectories_3d"),
+    "soup": (_render_soup, "soup_trajectories_3d"),
+    "all_data": (_render_sweep, "sweep"),
+    "all_counters": (_render_counters, "counters"),
+    "data": (_render_variation, "variation_box"),
+    "config": (_render_mega_curve, "mega_curve"),
 }
 
 
@@ -370,10 +412,10 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
                     print(f"viz: skipping {f} in {root}: {e!r}")
         basenames = {f.rsplit(".", 1)[0] for f in files
                      if f.endswith((".npz", ".json"))}
-        for base, renderer in RENDERERS.items():
+        for base, (renderer, marker) in RENDERERS.items():
             if base not in basenames:
                 continue
-            done_marker = any(f.endswith(".png") and f.startswith(_marker(base))
+            done_marker = any(f.endswith(".png") and f.startswith(marker)
                               for f in files)
             if base in ("trajectorys", "soup"):
                 # trajectory renderers also emit the interactive HTML twin;
@@ -381,9 +423,16 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
                 # partial multi-variant failure) must be revisited so the
                 # walker backfills the missing HTML
                 pngs = [f for f in files
-                        if f.endswith(".png") and f.startswith(_marker(base))]
+                        if f.endswith(".png") and f.startswith(marker)]
                 done_marker = bool(pngs) and all(
                     f[:-4] + ".html" in files for f in pngs)
+            if base == "config" and done_marker:
+                # events.jsonl is append-only (resumed runs grow it): the
+                # curve is only done if at least as new as the event log
+                png = os.path.join(root, marker + ".png")
+                ev = os.path.join(root, "events.jsonl")
+                done_marker = not os.path.exists(ev) or \
+                    os.path.getmtime(png) >= os.path.getmtime(ev)
             if done_marker and not redo:
                 continue
             try:
@@ -391,12 +440,6 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
             except Exception as e:  # keep walking like the reference CLI
                 print(f"viz: skipping {base} in {root}: {e!r}")
     return outputs
-
-
-def _marker(base: str) -> str:
-    return {"trajectorys": "trajectories_3d", "soup": "soup_trajectories_3d",
-            "all_data": "sweep", "all_counters": "counters",
-            "data": "variation_box"}[base]
 
 
 def main(argv=None):

@@ -119,3 +119,12 @@ def test_plot_histogram_and_bands(tmp_path):
           "lower_y": x * 0.5 - 0.2}],
         str(tmp_path / "bands.png"))
     assert os.path.exists(out)
+
+
+def test_mega_curve_rendered_by_walker(tmp_path):
+    """The walker renders a class-count-vs-generation curve for mega_soup
+    run dirs (marked by config.json; counts live in events.jsonl)."""
+    d = REGISTRY["mega_soup"](["--smoke", "--root", str(tmp_path)])
+    outs = viz.search_and_apply(str(tmp_path))
+    assert os.path.join(d, "mega_curve.png") in outs
+    assert viz.search_and_apply(str(tmp_path)) == []  # idempotent
