@@ -44,6 +44,10 @@ def build_parser():
                    choices=("rowmajor", "popmajor"))
     p.add_argument("--checkpoint-every", type=int, default=100,
                    help="generations per checkpoint/log chunk")
+    p.add_argument("--capture-every", type=int, default=0, metavar="K",
+                   help="stream every K-th generation's full soup frame to "
+                        "the native .traj store (0 = off); must divide "
+                        "--checkpoint-every")
     p.add_argument("--resume", default=None, metavar="RUN_DIR",
                    help="continue a previous run from its latest checkpoint")
     return p
@@ -113,13 +117,28 @@ def run(args):
         exp.log(f"mega-soup N={cfg.size} layout={cfg.layout} "
                 f"attack={cfg.attacking_rate} train={cfg.train}/{cfg.train_mode}")
 
+    store = None
+    if args.capture_every:
+        if args.checkpoint_every % args.capture_every:
+            raise SystemExit("--capture-every must divide --checkpoint-every")
+        from ..utils import TrajStore
+        store = TrajStore(os.path.join(exp.dir, "soup.traj"),
+                          n_particles=cfg.size,
+                          n_weights=cfg.topo.num_weights)
+        exp.log(f"capturing every {args.capture_every} generations to soup.traj")
+
     import time as _time
     try:
         counts = np.asarray(count(cfg, state))
         while int(state.time) < args.generations:
             chunk = min(args.checkpoint_every, args.generations - int(state.time))
             t0 = _time.perf_counter()
-            state = evolve(cfg, state, generations=chunk)
+            if store is not None:
+                from ..utils import evolve_captured
+                state = evolve_captured(cfg, state, chunk, store,
+                                        every=args.capture_every)
+            else:
+                state = evolve(cfg, state, generations=chunk)
             counts = np.asarray(count(cfg, state))
             dt = _time.perf_counter() - t0
             gen = int(state.time)
