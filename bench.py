@@ -7,63 +7,123 @@ fused population-major Pallas kernel (``srnn_tpu/ops/pallas_ww.py``): the
 particle axis rides the 128-wide TPU lanes and chained steps stay in VMEM.
 
 North star (BASELINE.json): >= 10M self-applications/sec on a v4-32, i.e.
-312,500/sec/chip.  ``vs_baseline`` is the per-chip multiple of that.
+312,500/sec/chip (convention: per-chip = total / 32 mesh devices, per
+BASELINE.json's v4-32 device count).  ``vs_baseline`` is the per-chip
+multiple of that.
 
-Timing notes: on the tunneled 'axon' platform ``block_until_ready`` does
-not actually synchronize, so the measurement forces a scalar readback; per-
-call RPC latency is amortized by running many chained steps per dispatch.
+Robustness (round-3 hardening): the tunneled 'axon' platform flakes at
+backend *init* (the round-1 failure), so the backend is probed with retries
++ registry clears (``srnn_tpu.utils.backend.ensure_backend``), the workload
+ramps (tiny compile-check first, then the full 1M-particle run), and every
+failure path still prints one well-formed JSON line carrying the best
+measurement obtained so far plus an ``error`` field — never a bare stack
+trace.
+
+Timing notes: on 'axon' ``block_until_ready`` does not actually
+synchronize, so the measurement forces a scalar readback; per-call RPC
+latency is amortized by running many chained steps per dispatch.
 
 Prints exactly one JSON line.
 """
 
 import json
 import time
-
-import jax
-
-from srnn_tpu import Topology, init_population
-from srnn_tpu.ops.pallas_ww import ww_apply_population
+import traceback
 
 N = 1_000_000
 STEPS_PER_CALL = 2000
 CALLS = 3
+RAMP_N = 8192
+RAMP_STEPS = 50
 BASELINE_PER_CHIP = 10_000_000 / 32  # BASELINE.json north star, v4-32
 
 
-def main():
-    topo = Topology("weightwise", width=2, depth=2)  # science-default f32 precision
+def _measure(topo, n, steps, calls):
+    """Ramped measurement unit: returns applications/sec for (n, steps)."""
+    import jax
+
+    from srnn_tpu import init_population
+    from srnn_tpu.ops.pallas_ww import ww_apply_population
+
     # damped init keeps the iteration numerically tame for the whole run;
     # throughput is magnitude-independent
-    wT = (init_population(topo, jax.random.key(0), N) * 0.05).T
+    wT = (init_population(topo, jax.random.key(0), n) * 0.05).T
 
     use_pallas = jax.default_backend() == "tpu"  # Mosaic kernel is TPU-only
 
     @jax.jit
     def run(wT):
         if use_pallas:
-            out = ww_apply_population(topo, wT, steps=STEPS_PER_CALL)
+            out = ww_apply_population(topo, wT, steps=steps)
         else:
             from srnn_tpu.ops.pallas_ww import ww_apply_population_jnp
 
             def step(w, _):
                 return ww_apply_population_jnp(topo, w), None
-            out = jax.lax.scan(step, wT, None, length=STEPS_PER_CALL)[0]
+            out = jax.lax.scan(step, wT, None, length=steps)[0]
         return out, out.sum()
 
     _ = float(run(wT)[1])  # compile + warm
     t0 = time.perf_counter()
-    for _ in range(CALLS):
+    for _ in range(calls):
         _ = float(run(wT)[1])  # scalar readback forces completion
     dt = time.perf_counter() - t0
+    return n * steps * calls / dt
 
-    apps_per_sec = N * STEPS_PER_CALL * CALLS / dt
-    per_chip = apps_per_sec / jax.device_count()
-    print(json.dumps({
+
+WATCHDOG_S = 1500.0  # hard bound on the whole bench (init wedges included)
+
+
+def main():
+    result = {
         "metric": "self-applications/sec/chip",
-        "value": round(per_chip),
+        "value": 0,
         "unit": "applications/s",
-        "vs_baseline": round(per_chip / BASELINE_PER_CHIP, 2),
-    }))
+        "vs_baseline": 0.0,
+    }
+
+    def emit():
+        result["vs_baseline"] = round(result["value"] / BASELINE_PER_CHIP, 2)
+        print(json.dumps(result), flush=True)
+
+    from srnn_tpu.utils.backend import ensure_backend, watchdog
+
+    # the tunnel's OTHER failure mode is a hang (init/compile wedges instead
+    # of raising) — retries can't catch that, so the whole bench runs under
+    # a watchdog that still emits the fail-soft JSON line before exiting
+    cancel = watchdog(
+        WATCHDOG_S,
+        on_fire=lambda: (result.setdefault(
+            "error", f"watchdog: wedged > {WATCHDOG_S:.0f}s"), emit()))
+    try:
+        platform, fell_back = ensure_backend(retries=5, sleep_s=15.0,
+                                             fallback_cpu=True)
+        import jax
+
+        from srnn_tpu import Topology
+
+        topo = Topology("weightwise", width=2, depth=2)  # science-default f32
+
+        # ramp stage: tiny shapes — proves compile + execute end-to-end and
+        # leaves a nonzero fail-soft number if the full run dies
+        apps = _measure(topo, RAMP_N, RAMP_STEPS, 1)
+        result["value"] = round(apps / jax.device_count())
+        result["ramp_only"] = True
+
+        if fell_back:
+            # degraded run: the full 1M x 2000-step workload would take
+            # hours on host CPU; report a reduced honest measurement
+            result["backend"] = "cpu-fallback"
+            apps = _measure(topo, 100_000, 20, 1)
+        else:
+            apps = _measure(topo, N, STEPS_PER_CALL, CALLS)
+        result["value"] = round(apps / jax.device_count())
+        del result["ramp_only"]
+    except Exception as e:  # fail-soft: always emit the JSON line
+        result["error"] = f"{type(e).__name__}: {e}"
+        traceback.print_exc()
+    cancel()
+    emit()
 
 
 if __name__ == "__main__":

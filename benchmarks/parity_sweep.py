@@ -1,0 +1,98 @@
+"""Seed-sweep error bars for the two statistically soft parity rows
+(RESULTS.md): the reference committed ONE run of each experiment, so
+single-seed comparisons conflate attractor identity with seed noise.  This
+sweep reruns each config over many seeds and reports per-class mean +- sd,
+so RESULTS.md can state parity (or honest deviation) with distributions.
+
+Rows swept:
+  * soup_trajectorys  — Soup(20, WW, train=30, attack 0.1), 100 generations
+    (reference ``setups/soup_trajectorys.py:22-27``; committed artifact
+    ``results/Soup/log.txt:1`` = 13 fix_other / 7 other).
+  * training_fixpoints RNN arm — 50 trials x 1000 batch-1 epochs
+    (reference ``setups/training-fixpoints.py:36-38``; committed
+    ``results/exp-training_fixpoint-*/log.txt`` RNN row = 38 divergent /
+    12 other).
+
+Run: ``python benchmarks/parity_sweep.py [--seeds 10] [--rows soup rnn]``
+Prints one JSON line per row.
+"""
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srnn_tpu import Topology
+from srnn_tpu.engine import run_training
+from srnn_tpu.init import init_population
+from srnn_tpu.ops.predicates import CLASS_NAMES
+from srnn_tpu.soup import SoupConfig, count, evolve, seed
+
+
+def sweep_soup_trajectorys(n_seeds: int) -> dict:
+    cfg = SoupConfig(
+        topo=Topology("weightwise", width=2, depth=2), size=20,
+        attacking_rate=0.1, learn_from_rate=-1.0, train=30,
+        remove_divergent=True, remove_zero=True)
+    states = [seed(cfg, jax.random.key(s)) for s in range(n_seeds)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    finals = jax.vmap(lambda s: evolve(cfg, s, generations=100))(stacked)
+    rows = np.stack([
+        np.asarray(count(cfg, jax.tree.map(lambda x: x[i], finals)))
+        for i in range(n_seeds)])
+    return _report("soup_trajectorys[N=20,train=30,100gen]", rows,
+                   reference={"fix_other": 13, "other": 7})
+
+
+def sweep_training_rnn(n_seeds: int) -> dict:
+    topo = Topology("recurrent", width=2, depth=2)
+    rows = []
+    for s in range(n_seeds):
+        pop = init_population(topo, jax.random.key(1000 + s), 50)
+        res = run_training(topo, pop, epochs=1000, train_mode="sequential")
+        rows.append(np.asarray(res.counts))
+    return _report("training_fixpoints[RNN,50x1000]", np.stack(rows),
+                   reference={"divergent": 38, "other": 12})
+
+
+def _report(name: str, rows: np.ndarray, reference: dict) -> dict:
+    mean, sd = rows.mean(0), rows.std(0, ddof=1)
+    out = {
+        "row": name,
+        "seeds": rows.shape[0],
+        "mean": {c: round(float(m), 2) for c, m in zip(CLASS_NAMES, mean)},
+        "sd": {c: round(float(v), 2) for c, v in zip(CLASS_NAMES, sd)},
+        "reference": reference,
+    }
+    # z-score of the reference's single committed run under the sweep
+    # distribution: |ref - mean| / sd per nonzero class
+    z = {}
+    for c, ref_v in reference.items():
+        i = CLASS_NAMES.index(c)
+        z[c] = round(abs(ref_v - float(mean[i])) / max(float(sd[i]), 1e-9), 2)
+    out["ref_z"] = z
+    return out
+
+
+def main():
+    from srnn_tpu.utils.backend import ensure_backend, watchdog
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", type=int, default=10)
+    p.add_argument("--rows", nargs="*", default=["soup", "rnn"],
+                   choices=["soup", "rnn"])
+    args = p.parse_args()
+    watchdog(2400.0, on_fire=lambda: print(json.dumps(
+        {"row": "parity_sweep", "error": "watchdog: wedged > 2400s"}),
+        flush=True))
+    ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=True)
+    if "soup" in args.rows:
+        print(json.dumps(sweep_soup_trajectorys(args.seeds)))
+    if "rnn" in args.rows:
+        print(json.dumps(sweep_training_rnn(args.seeds)))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,7 @@ def _dynamics(preset: str, train_mode: str = "sequential") -> dict:
 
 def bench_size(preset: str, n: int, generations: int = 50,
                repeats: int = 3, layout: str = "rowmajor",
-               train_mode: str = "sequential") -> dict:
+               train_mode: str = "sequential", sharded: bool = False) -> dict:
     dyn = _dynamics(preset, train_mode)
     if preset == "mixed":
         third = n // 3
@@ -54,10 +54,21 @@ def bench_size(preset: str, n: int, generations: int = 50,
                    Topology("recurrent", width=2, depth=2)),
             sizes=(n - 2 * third, third, third),
             remove_divergent=True, remove_zero=True, **dyn)
-        state = seed_multi(cfg, jax.random.key(0))
+        if sharded:
+            from srnn_tpu.parallel import (make_sharded_multi_state,
+                                           sharded_evolve_multi, soup_mesh)
 
-        def run(s):
-            return evolve_multi(cfg, s, generations=generations)
+            mesh = soup_mesh()
+            state = make_sharded_multi_state(cfg, mesh, jax.random.key(0))
+
+            def run(s):
+                return sharded_evolve_multi(cfg, mesh, s,
+                                            generations=generations)
+        else:
+            state = seed_multi(cfg, jax.random.key(0))
+
+            def run(s):
+                return evolve_multi(cfg, s, generations=generations)
 
         def sync(out):
             return float(out.weights[0].sum())
@@ -65,10 +76,20 @@ def bench_size(preset: str, n: int, generations: int = 50,
         cfg = SoupConfig(
             topo=Topology("weightwise", width=2, depth=2), size=n,
             remove_divergent=True, remove_zero=True, layout=layout, **dyn)
-        state = seed(cfg, jax.random.key(0))
+        if sharded:
+            from srnn_tpu.parallel import (make_sharded_state, sharded_evolve,
+                                           soup_mesh)
 
-        def run(s):
-            return evolve(cfg, s, generations=generations)
+            mesh = soup_mesh()
+            state = make_sharded_state(cfg, mesh, jax.random.key(0))
+
+            def run(s):
+                return sharded_evolve(cfg, mesh, s, generations=generations)
+        else:
+            state = seed(cfg, jax.random.key(0))
+
+            def run(s):
+                return evolve(cfg, s, generations=generations)
 
         def sync(out):
             return float(out.weights.sum())
@@ -82,6 +103,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
     return {
         "metric": f"soup-generations/sec[{preset}]",
         "layout": layout,
+        "sharded_devices": jax.device_count() if sharded else 0,
         "particles": n,
         "generations": generations,
         "value": round(gens_per_sec, 2),
@@ -91,6 +113,8 @@ def bench_size(preset: str, n: int, generations: int = 50,
 
 
 def main():
+    from srnn_tpu.utils.backend import ensure_backend
+
     p = argparse.ArgumentParser()
     p.add_argument("--preset", choices=PRESETS, default="apply")
     p.add_argument("--sizes", type=int, nargs="*",
@@ -104,20 +128,27 @@ def main():
     p.add_argument("--train-mode", choices=("sequential", "full_batch"),
                    default="sequential",
                    help="train/learn_from SGD mode for the 'full'/'mixed' presets")
+    p.add_argument("--sharded", action="store_true",
+                   help="run the soup sharded over ALL visible devices "
+                        "(all presets incl. the heterogeneous 'mixed'; "
+                        "shard_map data parallel)")
     args = p.parse_args()
     if args.layout == "popmajor" and args.preset == "mixed":
         p.error("--layout popmajor applies to the single-type weightwise presets")
-    if (args.layout == "popmajor" and args.preset == "full"
-            and args.train_mode == "sequential"):
-        # the scan(epochs) x scan(samples) x grad nest compiles unboundedly
-        # long on remote TPU compile services at mega-soup N (see
-        # srnn_tpu/ops/popmajor.py "Known limitation")
-        p.error("--layout popmajor --preset full requires --train-mode "
-                "full_batch (sequential-mode compile pathology at mega-N)")
+    # the tunneled TPU backend flakes at init (sometimes raising, sometimes
+    # wedging): probe with retries AND bound the whole run with a watchdog
+    # that still emits a JSON line (no CPU fallback — perf must be honest)
+    from srnn_tpu.utils.backend import watchdog
+
+    watchdog(2400.0, on_fire=lambda: print(json.dumps(
+        {"metric": f"soup-generations/sec[{args.preset}]", "value": 0,
+         "unit": "generations/s", "error": "watchdog: wedged > 2400s"}),
+        flush=True))
+    ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=False)
     for n in args.sizes:
         print(json.dumps(bench_size(args.preset, n, args.generations,
                                     args.repeats, args.layout,
-                                    args.train_mode)))
+                                    args.train_mode, args.sharded)))
 
 
 if __name__ == "__main__":

@@ -15,10 +15,16 @@
 //           | f32 weights[N*P] | i32 uids[N] | i32 action[N]
 //           | i32 counterpart[N] | f32 loss[N] | u32 crc32(payload)
 //
-// C API (ctypes-friendly): ts_create / ts_append / ts_flush / ts_close on
-// the write side; ts_open_read / ts_frame_count / ts_read_frames /
-// ts_close_read on the read side.  All functions return 0 on success or a
-// negative TS_E* code.
+// C API (ctypes-friendly): ts_create / ts_open_append / ts_append /
+// ts_flush / ts_close on the write side; ts_open_read / ts_meta /
+// ts_read_frames / ts_close_read on the read side.  All functions return 0
+// on success or a negative TS_E* code.
+//
+// Resume semantics: ts_create truncates (a NEW run); ts_open_append
+// validates the existing header (magic/version/N/P must match), drops a
+// torn trailing frame from a crashed writer (ftruncate to the last
+// complete frame), and appends after it — a resumed soup run never loses
+// previously captured frames.
 
 #include <cstdint>
 #include <cstdio>
@@ -29,6 +35,9 @@
 #include <string>
 #include <thread>
 #include <vector>
+
+#include <sys/stat.h>
+#include <unistd.h>
 
 namespace {
 
@@ -132,6 +141,53 @@ void* ts_create(const char* path, uint64_t n_particles, uint64_t n_weights) {
     fclose(f);
     return nullptr;
   }
+  Writer* w = new Writer;
+  w->f = f;
+  w->n = n_particles;
+  w->p = n_weights;
+  w->worker = std::thread([w] { w->run(); });
+  return w;
+}
+
+// Open an existing store for appending (or create it if absent).  The
+// header must match (n_particles, n_weights) exactly; a torn trailing
+// frame is truncated away.  ``existing_frames`` (nullable) receives the
+// number of complete frames already on disk.
+void* ts_open_append(const char* path, uint64_t n_particles,
+                     uint64_t n_weights, uint64_t* existing_frames) {
+  if (existing_frames) *existing_frames = 0;
+  struct stat st;
+  if (stat(path, &st) != 0) return ts_create(path, n_particles, n_weights);
+  FILE* f = fopen(path, "r+b");
+  if (!f) return nullptr;
+  Header h{};
+  if (fread(&h, sizeof h, 1, f) != 1 || memcmp(h.magic, kMagic, 8) != 0 ||
+      h.version != kVersion || h.n_particles != n_particles ||
+      h.n_weights != n_weights) {
+    fclose(f);
+    return nullptr;
+  }
+  size_t frame_bytes = payload_bytes(n_particles, n_weights) + sizeof(uint32_t);
+  if (fseek(f, 0, SEEK_END) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  long end = ftell(f);
+  uint64_t frames =
+      static_cast<uint64_t>(end - sizeof(Header)) / frame_bytes;
+  long valid_end = static_cast<long>(sizeof(Header) + frames * frame_bytes);
+  if (valid_end != end) {
+    // crashed mid-frame: drop the torn tail so appends start clean
+    if (ftruncate(fileno(f), valid_end) != 0) {
+      fclose(f);
+      return nullptr;
+    }
+  }
+  if (fseek(f, valid_end, SEEK_SET) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  if (existing_frames) *existing_frames = frames;
   Writer* w = new Writer;
   w->f = f;
   w->n = n_particles;

@@ -174,6 +174,7 @@ class Experiment:
         self.log_messages: list = []
         self.dir: Optional[str] = None
         self._t0: Optional[float] = None
+        self._prior_wall = 0.0  # accumulated runtime of earlier attach()ed runs
 
     @classmethod
     def attach(cls, run_dir: str) -> "Experiment":
@@ -197,6 +198,9 @@ class Experiment:
             self.experiment_id = meta.get("id", self.experiment_id)
             self.next_iteration = meta.get("iteration", 0)
             self.seed = meta.get("seed")
+            # carry runtime forward so a resumed run's meta.json reports the
+            # CUMULATIVE wall time across all sessions, not just the last one
+            self._prior_wall = float(meta.get("wall_seconds") or 0.0)
         self.dir = run_dir
         self._t0 = time.time()
         log_path = os.path.join(run_dir, "log.txt")
@@ -226,7 +230,7 @@ class Experiment:
             "id": self.experiment_id,
             "iteration": self.next_iteration,
             "seed": self.seed,
-            "wall_seconds": time.time() - self._t0,
+            "wall_seconds": self._prior_wall + (time.time() - self._t0),
             "error": repr(exc_value) if exc_value is not None else None,
         }
         with open(os.path.join(self.dir, "meta.json"), "w") as f:

@@ -15,11 +15,14 @@ This module is the jnp twin of the Pallas kernel in ``pallas_ww.py``
 (which fuses chained self-applications in VMEM); here the win is pure
 layout, so it works on any backend and — crucially — under ``jax.grad``.
 
-Known limitation: ``mode='sequential'`` nests scan(epochs) x scan(samples)
-x grad; remote TPU compile services have been observed to take unboundedly
-long on that nest at N=1M.  Prefer popmajor for apply-dominated soups or
-with ``train_mode='full_batch'`` at mega-N; the row-major sequential path
-(``train.fit_epoch``) remains the batch-1 parity default.
+Compile-pathology note: the multi-epoch batch-1 drivers used to nest
+scan(epochs) x scan(samples) x grad, and remote TPU compile services took
+unboundedly long on that nest at N=1M once the soup's generations scan
+wrapped it (three scan levels).  ``_ww_seq_sgd_flat`` flattens epochs and
+samples into ONE scan (epoch-start sample snapshot carried, refreshed when
+the flattened index wraps), so the full soup is scan(generations) x
+scan(epochs*samples) x grad — the same two-level shape as full_batch mode,
+with bounded compile at mega-N (measured: see RESULTS.md).
 
 Only the weightwise variant needs this: aggregating/fft reduce to k-vector
 ops and the recurrent scan is time- not layout-bound (SURVEY §3.1).
@@ -129,6 +132,61 @@ def ww_fit_epoch_popmajor(
     return wT, losses.mean(axis=0)
 
 
+def _ww_seq_sgd_flat(
+    topo: Topology,
+    wT: jnp.ndarray,
+    epochs: int,
+    lr: float,
+    fixed_xyT: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``epochs`` passes of batch-1 SGD over the P samples as ONE flattened
+    scan of length ``epochs * P`` — the compile-bounded replacement for the
+    old scan(epochs) x scan(samples) nest.
+
+    ``fixed_xyT is None`` is self-training: the sample set (x = y = weights)
+    is re-snapshotted from the CURRENT weights whenever the flattened sample
+    index wraps to 0, reproducing "samples recomputed before every epoch"
+    (``network.py:613-618``).  Otherwise ``fixed_xyT`` (P, N) is a fixed
+    imitation target (``learn_from``, ``network.py:620-626``).
+
+    Per-step math is identical to ``ww_fit_epoch_popmajor('sequential')`` —
+    same update order, same pre-update keras-history loss — and everything
+    is elementwise over the lane axis, so the sharded soup can call this on
+    a lane shard bitwise-identically.  Returns (new_wT, last epoch's mean
+    pre-update loss (N,)).
+    """
+    p, n = wT.shape
+    coords = jnp.asarray(normalized_weight_coords(topo))
+    refresh = fixed_xyT is None
+    snap0 = wT if refresh else jax.lax.stop_gradient(fixed_xyT)
+    zeros = jnp.zeros(n, wT.dtype)
+    s_seq = jnp.tile(jnp.arange(p), max(epochs, 0))
+
+    def step(carry, s_idx):
+        w, snap, accum, last = carry
+        if refresh:
+            snap = jnp.where(s_idx == 0, w, snap)
+        x_s = jax.lax.stop_gradient(snap[s_idx])
+        coord_s = coords[s_idx]
+
+        def sample_loss(wi):
+            pred = _forward_one_sample(topo, wi, x_s, coord_s)
+            per_particle = (pred - x_s) ** 2
+            return per_particle.sum(), per_particle
+
+        grads, per_particle = jax.grad(sample_loss, has_aux=True)(w)
+        w = w - lr * grads
+        accum = accum + per_particle
+        done = s_idx == p - 1
+        last = jnp.where(done, accum / p, last)
+        accum = jnp.where(done, jnp.zeros_like(accum), accum)
+        return (w, snap, accum, last), None
+
+    (new_wT, _, _, last), _ = jax.lax.scan(
+        step, (wT, snap0, zeros, zeros), s_seq)
+    return new_wT, last
+
+
 def ww_train_epochs_popmajor(
     topo: Topology,
     wT: jnp.ndarray,
@@ -139,13 +197,17 @@ def ww_train_epochs_popmajor(
     """``epochs`` self-training calls (samples recomputed from the current
     weights before every epoch, matching repeated ``train()``,
     ``network.py:613-618``).  Returns (new_wT, last epoch loss (N,))."""
+    if epochs <= 0:
+        return wT, jnp.zeros(wT.shape[1], wT.dtype)
+    if mode == "sequential":
+        return _ww_seq_sgd_flat(topo, wT, epochs, lr)
+
     def body(w, _):
         new_w, loss = ww_fit_epoch_popmajor(topo, w, w, w, lr, mode)
         return new_w, loss
 
-    new_wT, losses = jax.lax.scan(body, wT, None, length=max(epochs, 0))
-    last = losses[-1] if epochs > 0 else jnp.zeros(wT.shape[1], wT.dtype)
-    return new_wT, last
+    new_wT, losses = jax.lax.scan(body, wT, None, length=epochs)
+    return new_wT, losses[-1]
 
 
 def ww_learn_epochs_popmajor(
@@ -159,10 +221,14 @@ def ww_learn_epochs_popmajor(
     """``severity`` imitation epochs toward the counterparts' samples
     (x = y = other's weights, fixed across the call — ``network.py:620-626``).
     ``otherT`` (P, N) is each particle's counterpart column."""
+    if severity <= 0:
+        return wT, jnp.zeros(wT.shape[1], wT.dtype)
+    if mode == "sequential":
+        return _ww_seq_sgd_flat(topo, wT, severity, lr, otherT)
+
     def body(w, _):
         new_w, loss = ww_fit_epoch_popmajor(topo, w, otherT, otherT, lr, mode)
         return new_w, loss
 
-    new_wT, losses = jax.lax.scan(body, wT, None, length=max(severity, 0))
-    last = losses[-1] if severity > 0 else jnp.zeros(wT.shape[1], wT.dtype)
-    return new_wT, last
+    new_wT, losses = jax.lax.scan(body, wT, None, length=severity)
+    return new_wT, losses[-1]

@@ -5,6 +5,12 @@ from .sharded_soup import (
     sharded_evolve_step,
     sharded_count,
 )
+from .sharded_multisoup import (
+    make_sharded_multi_state,
+    sharded_evolve_multi,
+    sharded_evolve_multi_step,
+    sharded_count_multi,
+)
 from .ring_rnn import ring_rnn_apply
 from .sharded_apply import (
     rnn_associative_apply,
@@ -26,6 +32,10 @@ __all__ = [
     "sharded_evolve_step",
     "sharded_evolve",
     "sharded_count",
+    "make_sharded_multi_state",
+    "sharded_evolve_multi_step",
+    "sharded_evolve_multi",
+    "sharded_count_multi",
     "ring_rnn_apply",
     "rnn_associative_apply",
     "sharded_apply_to_weights",

@@ -1,0 +1,276 @@
+"""Heterogeneous (mixed-architecture) soup sharded over a device mesh.
+
+The EP-flavored scale-out of ``srnn_tpu.multisoup`` (SURVEY §2.5 expert-
+parallel row, generalizing the reference's separate homogeneous soups,
+``mixed-soup.py:66-68``): every TYPE's particle axis is sharded over the
+same 1-D soup mesh — device d owns rows [d*N_t/D, (d+1)*N_t/D) of every
+type t.  Cross-type attacks need every victim to be able to read any
+attacker's weights, so each generation starts with one small ``all_gather``
+per type (particles are tiny — a 1M-particle 3-type soup gathers ~60 MB
+total), after which the T^2 masked cross-apply runs on local victim rows
+only.
+
+The sharded step is **semantically identical** to ``evolve_multi_step``
+under matched keys (tests assert):
+
+  * all gate/target draws come from the replicated soup key — identical
+    streams on every device, local slices taken per shard;
+  * same-type imitation teachers are re-gathered POST-attack, matching the
+    single-device phase ordering;
+  * respawn uids use the GLOBAL per-type dead-rank (all_gather of the
+    death mask + cumsum) with the single-device type-major block order,
+    and fresh replacements replicate the single-device per-type draw
+    (``init_population(topo, re_keys[t], N_t)``) and slice the local rows.
+
+All integer state (uids, next_uid, event actions/counterparts) is EXACT.
+Weights match to reduction-reassociation tolerance, not bitwise: the
+aggregating/fft/recurrent transforms contain row-internal reductions whose
+XLA tiling legitimately differs between the unsharded (N_t-row) and
+sharded (N_t/D-row) batch shapes.  (The homogeneous weightwise popmajor
+path IS bitwise — every op there is elementwise over lanes.)
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..init import init_population
+from ..multisoup import (
+    MultiSoupConfig,
+    MultiSoupEvents,
+    MultiSoupState,
+    count_multi,
+    seed_multi,
+)
+from ..nets.cross import cross_apply
+from ..ops.predicates import count_classes, is_diverged, is_zero
+from ..engine import classify_batch
+from ..soup import (
+    ACT_DIV_DEAD,
+    ACT_NONE,
+    ACT_ZERO_DEAD,
+    _event_record,
+    _learn_epochs,
+    _train_epochs,
+)
+from .mesh import SOUP_AXIS
+
+
+def _mstate_specs(config: MultiSoupConfig) -> MultiSoupState:
+    t = len(config.topos)
+    return MultiSoupState(
+        weights=tuple(P(SOUP_AXIS) for _ in range(t)),
+        uids=tuple(P(SOUP_AXIS) for _ in range(t)),
+        next_uid=P(),
+        time=P(),
+        key=P(),
+    )
+
+
+def _mevent_specs(config: MultiSoupConfig) -> MultiSoupEvents:
+    t = len(config.topos)
+    return MultiSoupEvents(
+        action=tuple(P(SOUP_AXIS) for _ in range(t)),
+        counterpart=tuple(P(SOUP_AXIS) for _ in range(t)),
+        loss=tuple(P(SOUP_AXIS) for _ in range(t)),
+    )
+
+
+def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
+                        ) -> Tuple[MultiSoupState, MultiSoupEvents]:
+    """Per-device body: ``state.weights[t]``/``uids[t]`` hold the LOCAL
+    (N_t/D, P_t) shards; scalars and the key are replicated."""
+    n = config.total
+    offs = config.offsets
+    d = jax.lax.axis_index(SOUP_AXIS)
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    w_loc = list(state.weights)
+    n_locs = [w.shape[0] for w in w_loc]
+
+    # start-of-generation gathers: attacker weight tables + uid tables
+    all_w = tuple(jax.lax.all_gather(w, SOUP_AXIS, tiled=True) for w in w_loc)
+    all_uids_t = tuple(jax.lax.all_gather(u, SOUP_AXIS, tiled=True)
+                       for u in state.uids)
+    all_uids = jnp.concatenate(all_uids_t)
+
+    # --- attack draws (global, replicated) ------------------------------
+    if config.attacking_rate > 0:
+        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt,
+            num_segments=n)
+    else:
+        attack_gate = jnp.zeros(n, bool)
+        attack_tgt = jnp.zeros(n, jnp.int32)
+        att_idx = None
+
+    new_weights, new_uids, actions, counterparts, losses = [], [], [], [], []
+    total_deaths = jnp.int32(0)
+    re_keys = jax.random.split(k_re, len(config.topos))
+    for t, topo in enumerate(config.topos):
+        tc = config.type_config(t)
+        n_t = config.sizes[t]
+        n_loc = n_locs[t]
+        start = offs[t] + d * n_loc  # this shard's GLOBAL index range
+        w_t = w_loc[t]
+
+        def sl(arr, start=start, n_loc=n_loc):
+            return jax.lax.dynamic_slice_in_dim(arr, start, n_loc)
+
+        # --- attack on local victims (T^2 masked cross-apply) -----------
+        if config.attacking_rate > 0:
+            att_b = sl(att_idx)
+            out = w_t
+            for a, attacker_topo in enumerate(config.topos):
+                mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
+                rows = all_w[a][jnp.clip(att_b - offs[a], 0,
+                                         config.sizes[a] - 1)]
+                attacked = jax.vmap(
+                    lambda s, v: cross_apply(attacker_topo, s, topo, v)
+                )(rows, w_t)
+                out = jnp.where(mask[:, None], attacked, out)
+            w_t = out
+
+        # --- learn_from (same-type teachers, POST-attack re-gather) -----
+        if config.learn_from_rate > 0:
+            learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
+                < config.learn_from_rate
+            learn_tgt_full = jax.random.randint(
+                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+            learn_tgt = jax.lax.dynamic_slice_in_dim(
+                learn_tgt_full, d * n_loc, n_loc)
+            if config.learn_from_severity > 0:
+                post_attack = jax.lax.all_gather(w_t, SOUP_AXIS, tiled=True)
+                learned, _ = jax.vmap(
+                    lambda wi, ow: _learn_epochs(tc, wi, ow)
+                )(w_t, post_attack[learn_tgt])
+                w_t = jnp.where(learn_gate[:, None], learned, w_t)
+            learn_cp = all_uids_t[t][learn_tgt]
+        else:
+            learn_gate = jnp.zeros(n_loc, bool)
+            learn_cp = jnp.zeros(n_loc, jnp.int32)
+
+        # --- train ------------------------------------------------------
+        if config.train > 0:
+            w_t, loss_t = jax.vmap(lambda wi: _train_epochs(tc, wi))(w_t)
+        else:
+            loss_t = jnp.zeros(n_loc, w_t.dtype)
+
+        # --- respawn: global per-type dead-rank, replicated fresh draws -
+        dead_div = is_diverged(w_t) if tc.remove_divergent \
+            else jnp.zeros(n_loc, bool)
+        dead_zero = (is_zero(w_t, tc.epsilon) & ~dead_div) \
+            if tc.remove_zero else jnp.zeros(n_loc, bool)
+        dead = dead_div | dead_zero
+        all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
+        rank = jnp.cumsum(all_dead) - 1
+        rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
+        fresh = init_population(topo, re_keys[t], n_t)
+        fresh_loc = jax.lax.dynamic_slice_in_dim(fresh, d * n_loc, n_loc,
+                                                 axis=0)
+        w_t = jnp.where(dead[:, None], fresh_loc, w_t)
+        uid_base = state.next_uid + total_deaths
+        uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
+                           state.uids[t])
+        total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
+        death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+        death_cp = jnp.where(dead, uids_t, -1)
+
+        action, counterpart = _event_record(
+            n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
+            learn_gate, learn_cp, config.train > 0, death_action, death_cp)
+
+        new_weights.append(w_t)
+        new_uids.append(uids_t)
+        actions.append(action)
+        counterparts.append(counterpart)
+        losses.append(loss_t)
+
+    new_state = MultiSoupState(
+        weights=tuple(new_weights), uids=tuple(new_uids),
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+    return new_state, MultiSoupEvents(tuple(actions), tuple(counterparts),
+                                      tuple(losses))
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mesh"))
+def sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
+                              state: MultiSoupState):
+    """One mixed-soup generation with every type's particle axis sharded."""
+    fn = shard_map(
+        functools.partial(_local_evolve_multi, config),
+        mesh=mesh,
+        in_specs=(_mstate_specs(config),),
+        out_specs=(_mstate_specs(config), _mevent_specs(config)),
+        check_vma=False,
+    )
+    return fn(state)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mesh", "generations"))
+def sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
+                         state: MultiSoupState, generations: int = 1
+                         ) -> MultiSoupState:
+    """Scan ``generations`` sharded mixed-soup steps inside ONE shard_map
+    (collectives stay inside the scan)."""
+
+    def local_run(st: MultiSoupState) -> MultiSoupState:
+        def body(s, _):
+            new_s, _ev = _local_evolve_multi(config, s)
+            return new_s, None
+
+        final, _ = jax.lax.scan(body, st, None, length=generations)
+        return final
+
+    fn = shard_map(
+        local_run,
+        mesh=mesh,
+        in_specs=(_mstate_specs(config),),
+        out_specs=_mstate_specs(config),
+        check_vma=False,
+    )
+    return fn(state)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mesh"))
+def sharded_count_multi(config: MultiSoupConfig, mesh: Mesh,
+                        state: MultiSoupState) -> jnp.ndarray:
+    """(T, 5) per-type global class histograms: local classify + psum."""
+
+    def local_count(*w_locs):
+        rows = [count_classes(classify_batch(config.topos[t], w_locs[t],
+                                             config.epsilon))
+                for t in range(len(config.topos))]
+        return jax.lax.psum(jnp.stack(rows), SOUP_AXIS)
+
+    fn = shard_map(
+        local_count,
+        mesh=mesh,
+        in_specs=tuple(P(SOUP_AXIS) for _ in config.topos),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(*state.weights)
+
+
+def make_sharded_multi_state(config: MultiSoupConfig, mesh: Mesh,
+                             key: jax.Array) -> MultiSoupState:
+    """Seed a mixed population already placed with the per-type sharding."""
+    n_dev = mesh.devices.size
+    for t, n_t in enumerate(config.sizes):
+        if n_t % n_dev:
+            raise ValueError(
+                f"type-{t} population {n_t} must be divisible by the mesh's "
+                f"{n_dev} devices (each device owns an equal shard per type)")
+    state = seed_multi(config, key)
+    specs = _mstate_specs(config)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state, specs)

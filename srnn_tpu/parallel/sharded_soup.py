@@ -20,13 +20,20 @@ no distributed backend at all:
     with an ``all_gather`` of death counts — monotone unique uids without a
     host round-trip.
 
-Semantics match ``soup._evolve_parallel`` with two sharding-induced
-differences: (a) imitation targets read start-of-generation weights (the
-all_gather snapshot) rather than post-attack ones — visible only when a
-particle learns from a victim attacked in the same generation; (b) respawn
-draws fold the device index into the key, so fresh particles differ from
-the unsharded stream (same distribution).  Attack/train phases are
-bit-identical under matched keys, which tests assert.
+Row-major semantics match ``soup._evolve_parallel`` with two
+sharding-induced differences: (a) imitation targets read
+start-of-generation weights (the all_gather snapshot) rather than
+post-attack ones — visible only when a particle learns from a victim
+attacked in the same generation; (b) respawn draws fold the device index
+into the key, so fresh particles differ from the unsharded stream (same
+distribution).  Attack/train phases are bit-identical under matched keys,
+which tests assert.
+
+The population-major layout (``layout='popmajor'``, the fast (P, N)
+lane-major path for mega-soups) is ALSO sharded here — each device owns a
+(P, N/D) lane shard — and its sharded step is **fully bitwise** vs the
+single-device popmajor step, respawn and imitation included (see
+``_local_evolve_popmajor``).
 """
 
 import functools
@@ -37,12 +44,17 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..init import init_population
 from ..nets import apply_to_weights
 from ..ops.predicates import count_classes, is_diverged, is_zero
 from ..soup import (
+    ACT_DIV_DEAD,
+    ACT_NONE,
+    ACT_ZERO_DEAD,
     SoupConfig,
     SoupEvents,
     SoupState,
+    _check_popmajor,
     _event_record,
     _learn_epochs,
     _respawn,
@@ -150,15 +162,131 @@ def _local_evolve(config: SoupConfig, state: SoupState) -> Tuple[SoupState, Soup
     return new_state, SoupEvents(action, counterpart, train_loss)
 
 
+def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
+                           wT_loc: jnp.ndarray):
+    """Per-device popmajor generation body: ``wT_loc`` is the LOCAL (P, N/D)
+    lane-major shard; ``state.weights`` is ignored (uids are the local shard,
+    scalars/key replicated).
+
+    Unlike the row-major sharded path, this one is **fully bitwise** vs the
+    single-device popmajor step (``soup._evolve_parallel_popmajor``):
+
+      * gates/targets come from the replicated key (same draws);
+      * imitation targets are re-gathered AFTER the attack phase, so a
+        particle learning from a just-attacked victim sees the same
+        post-attack weights the single-device path uses;
+      * respawn draws the SAME global fresh population
+        (``init_population(topo, k_re, N)``) on every device and slices its
+        shard, and fresh uids use the GLOBAL dead-rank (all_gather of the
+        death mask + cumsum) — identical uids, identical weights.
+
+    All heavy per-lane math is elementwise over the lane axis, so slicing
+    lanes across devices cannot reassociate anything; tests assert exact
+    equality over multi-generation full-dynamics runs.
+    """
+    from ..ops.popmajor import (ww_forward_popmajor, ww_learn_epochs_popmajor,
+                                ww_train_epochs_popmajor)
+
+    n = config.size
+    n_loc = wT_loc.shape[1]
+    d = jax.lax.axis_index(SOUP_AXIS)
+    start = d * n_loc
+    topo = config.topo
+
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+
+    # --- attack (soup.py:56-61); last-attacker-wins, same as single-device
+    if config.attacking_rate > 0:
+        all_wT = jax.lax.all_gather(wT_loc, SOUP_AXIS, axis=1, tiled=True)
+        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+        att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
+        has_attacker = att_loc >= 0
+        attacked = ww_forward_popmajor(topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc)
+        wT_loc = jnp.where(has_attacker[None, :], attacked, wT_loc)
+        attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
+        attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
+    else:
+        attack_gate_loc = jnp.zeros(n_loc, bool)
+        attack_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+
+    # --- learn_from (soup.py:62-68): POST-attack re-gather for exact parity
+    if config.learn_from_rate > 0:
+        learn_gate = jax.random.uniform(k_lg, (n,)) < config.learn_from_rate
+        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+        learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start, n_loc)
+        learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
+        if config.learn_from_severity > 0:
+            post_attack = jax.lax.all_gather(wT_loc, SOUP_AXIS, axis=1, tiled=True)
+            learned, _ = ww_learn_epochs_popmajor(
+                topo, wT_loc, post_attack[:, learn_tgt_loc],
+                config.learn_from_severity, config.lr, config.train_mode)
+            wT_loc = jnp.where(learn_gate_loc[None, :], learned, wT_loc)
+    else:
+        learn_gate_loc = jnp.zeros(n_loc, bool)
+        learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+
+    # --- train (soup.py:69-76) ------------------------------------------
+    if config.train > 0:
+        wT_loc, train_loss = ww_train_epochs_popmajor(
+            topo, wT_loc, config.train, config.lr, config.train_mode)
+    else:
+        train_loss = jnp.zeros(n_loc, wT_loc.dtype)
+
+    # --- respawn (soup.py:77-86): global-rank uids + replicated fresh draws
+    dead_div = is_diverged(wT_loc, axis=0) if config.remove_divergent \
+        else jnp.zeros(n_loc, bool)
+    dead_zero = (is_zero(wT_loc, config.epsilon, axis=0) & ~dead_div) \
+        if config.remove_zero else jnp.zeros(n_loc, bool)
+    dead = dead_div | dead_zero
+    all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (N,) device order
+    rank = jnp.cumsum(all_dead) - 1
+    rank_loc = jax.lax.dynamic_slice_in_dim(rank, start, n_loc)
+    # every device draws the same global fresh population and keeps its rows:
+    # bitwise-identical replacements to the single-device k_re stream
+    fresh = init_population(topo, k_re, n)
+    freshT_loc = jax.lax.dynamic_slice_in_dim(fresh, start, n_loc, axis=0).T
+    wT_loc = jnp.where(dead[None, :], freshT_loc, wT_loc)
+    uids = jnp.where(dead, state.next_uid + rank_loc.astype(jnp.int32),
+                     state.uids)
+    next_uid = state.next_uid + all_dead.sum(dtype=jnp.int32)
+    death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+    death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+    death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+    death_cp = jnp.where(dead, uids, -1)
+
+    # --- event record (last action wins) --------------------------------
+    all_uids = jax.lax.all_gather(state.uids, SOUP_AXIS, tiled=True)
+    action, counterpart = _event_record(
+        n_loc, attack_gate_loc, all_uids[attack_tgt_loc],
+        learn_gate_loc, all_uids[learn_tgt_loc],
+        config.train > 0, death_action, death_cp)
+
+    new_state = SoupState(state.weights, uids, next_uid, state.time + 1, key)
+    return new_state, SoupEvents(action, counterpart, train_loss), wT_loc
+
+
+def _local_popmajor_step(config: SoupConfig, state: SoupState):
+    """Single-step wrapper: transpose the local (N/D, P) shard in and out."""
+    new_state, events, wT = _local_evolve_popmajor(config, state,
+                                                   state.weights.T)
+    return new_state._replace(weights=wT.T), events
+
+
 @functools.partial(jax.jit, static_argnames=("config", "mesh"))
 def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
     """One generation with the particle axis sharded over ``mesh``."""
-    if config.layout != "rowmajor":
-        raise NotImplementedError(
-            f"sharded soup supports layout='rowmajor' (got {config.layout!r}); "
-            "the population-major layout is single-device for now")
+    if config.layout == "popmajor":
+        _check_popmajor(config)
+        body = functools.partial(_local_popmajor_step, config)
+    elif config.layout == "rowmajor":
+        body = functools.partial(_local_evolve, config)
+    else:
+        raise ValueError(f"unknown soup layout {config.layout!r}")
     fn = shard_map(
-        functools.partial(_local_evolve, config),
+        body,
         mesh=mesh,
         in_specs=(_state_specs(),),
         out_specs=(_state_specs(), _event_specs()),
@@ -170,7 +298,35 @@ def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
 @functools.partial(jax.jit, static_argnames=("config", "mesh", "generations"))
 def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations: int = 1):
     """Scan ``generations`` sharded steps (collectives stay inside the scan —
-    one compiled program for the whole evolution)."""
+    one compiled program for the whole evolution).
+
+    In the popmajor layout the whole scan runs inside ONE ``shard_map`` with
+    the local shard kept transposed (P, N/D) across generations — one
+    transpose at entry/exit instead of two per step, mirroring the
+    single-device ``soup.evolve`` fast path."""
+    if config.layout == "popmajor":
+        _check_popmajor(config)
+
+        def local_run(st: SoupState) -> SoupState:
+            light = st._replace(weights=jnp.zeros((0,), st.weights.dtype))
+
+            def body(carry, _):
+                s, wT = carry
+                new_s, _ev, new_wT = _local_evolve_popmajor(config, s, wT)
+                return (new_s, new_wT), None
+
+            (final, wT), _ = jax.lax.scan(
+                body, (light, st.weights.T), None, length=generations)
+            return final._replace(weights=wT.T)
+
+        fn = shard_map(
+            local_run,
+            mesh=mesh,
+            in_specs=(_state_specs(),),
+            out_specs=_state_specs(),
+            check_vma=False,
+        )
+        return fn(state)
 
     def body(fn_state, _):
         new_state, _ev = sharded_evolve_step(config, mesh, fn_state)

@@ -66,7 +66,7 @@ def _latest_checkpoint(run_dir: str):
 
 
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
-                  "train_mode", "layout", "epsilon")
+                  "train_mode", "layout", "epsilon", "capture_every")
 
 
 def _save_config(run_dir: str, args) -> None:
@@ -76,14 +76,17 @@ def _save_config(run_dir: str, args) -> None:
 
 def _load_config(run_dir: str, args) -> None:
     """Resume must continue the ORIGINAL run's dynamics (size, rates, train
-    schedule, layout), not whatever the resuming invocation's CLI defaults
-    happen to be.  The horizon (``--generations``) and checkpoint cadence
-    stay CLI-controlled — extending a finished run is legitimate."""
+    schedule, layout) AND its capture cadence — a resume that omits
+    ``--capture-every`` must not silently stop capturing.  The horizon
+    (``--generations``) and checkpoint cadence stay CLI-controlled —
+    extending a finished run is legitimate."""
     path = os.path.join(run_dir, "config.json")
     with open(path) as f:
         saved = json.load(f)
     for k in _CONFIG_FIELDS:
-        setattr(args, k, saved[k])
+        # .get: config.json files written before capture_every was persisted
+        # fall back to the CLI value rather than failing the resume
+        setattr(args, k, saved.get(k, getattr(args, k)))
 
 
 def run(args):
@@ -94,23 +97,22 @@ def run(args):
         args.generations = 6 if args.generations == 1000 else args.generations
         args.checkpoint_every = 2 if args.checkpoint_every == 100 \
             else args.checkpoint_every
-    if args.layout == "popmajor" and args.train > 0 \
-            and args.train_mode == "sequential" and args.size >= 100_000:
-        raise SystemExit(
-            "popmajor + sequential training at mega-N is a known remote-"
-            "compile pathology (ops/popmajor.py); use --train-mode "
-            "full_batch or --layout rowmajor")
-
+    # validate everything cheap BEFORE creating/attaching the Experiment, so
+    # a bad invocation can never leave a run dir without meta.json
+    ckpt = None
     if args.resume:
         _load_config(args.resume, args)  # original dynamics win over CLI
-        cfg = _make_config(args)
+        ckpt = _latest_checkpoint(args.resume)
+    if args.capture_every and args.checkpoint_every % args.capture_every:
+        raise SystemExit("--capture-every must divide --checkpoint-every")
+    cfg = _make_config(args)
+
+    if args.resume:
         exp = Experiment.attach(args.resume)
-        ckpt = _latest_checkpoint(exp.dir)
         state = restore_checkpoint(ckpt)
         exp.log(f"resumed from {os.path.basename(ckpt)} "
                 f"at generation {int(state.time)}")
     else:
-        cfg = _make_config(args)
         exp = Experiment("mega-soup", root=args.root, seed=args.seed).__enter__()
         _save_config(exp.dir, args)
         state = seed(cfg, jax.random.key(args.seed))
@@ -118,17 +120,27 @@ def run(args):
                 f"attack={cfg.attacking_rate} train={cfg.train}/{cfg.train_mode}")
 
     store = None
-    if args.capture_every:
-        if args.checkpoint_every % args.capture_every:
-            raise SystemExit("--capture-every must divide --checkpoint-every")
-        from ..utils import TrajStore
-        store = TrajStore(os.path.join(exp.dir, "soup.traj"),
-                          n_particles=cfg.size,
-                          n_weights=cfg.topo.num_weights)
-        exp.log(f"capturing every {args.capture_every} generations to soup.traj")
-
     import time as _time
     try:
+        if args.capture_every:
+            from ..utils import TrajStore, truncate_frames
+            traj_path = os.path.join(exp.dir, "soup.traj")
+            if args.resume:
+                # drop frames captured AFTER the restored checkpoint (a kill
+                # between a capture flush and the next checkpoint finalizing)
+                # so the re-evolved generations aren't appended twice
+                truncate_frames(traj_path, int(state.time) // args.capture_every)
+            # resume APPENDS to the existing store (header-validated, torn
+            # tail dropped) — previously captured frames are never lost
+            store = TrajStore(traj_path,
+                              n_particles=cfg.size,
+                              n_weights=cfg.topo.num_weights,
+                              mode="a" if args.resume else "w")
+            if store.existing_frames:
+                exp.log(f"soup.traj: appending after "
+                        f"{store.existing_frames} existing frames")
+            exp.log(f"capturing every {args.capture_every} generations "
+                    f"to soup.traj")
         counts = np.asarray(count(cfg, state))
         while int(state.time) < args.generations:
             chunk = min(args.checkpoint_every, args.generations - int(state.time))
@@ -149,9 +161,16 @@ def run(args):
             save_checkpoint(os.path.join(exp.dir, f"ckpt-gen{gen:08d}"), state)
         exp.log(f"done: {counters_dict(counts)}")
     finally:
-        # exp is already entered (fresh or attached); close exactly once,
-        # passing real exception info so meta.json records crashes
-        exp.__exit__(*sys.exc_info())
+        # close the capture store first (joins the native writer thread so
+        # every queued frame hits disk even on a crash path), then close the
+        # experiment exactly once with real exception info so meta.json
+        # records crashes.  The nested finally guarantees meta.json is
+        # written even when store.close() itself raises (e.g. disk full).
+        try:
+            if store is not None:
+                store.close()
+        finally:
+            exp.__exit__(*sys.exc_info())
     return exp.dir
 
 

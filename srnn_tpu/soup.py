@@ -42,7 +42,7 @@ from .init import init_population
 from .nets import apply_to_weights, compute_samples
 from .ops.predicates import DEFAULT_EPSILON, count_classes, is_diverged, is_zero
 from .topology import Topology
-from .train import DEFAULT_LR, fit_epoch
+from .train import DEFAULT_LR, fit_epochs_flat
 from .engine import classify_batch
 
 # action codes for the event log (reference action strings, soup.py:60-85;
@@ -106,30 +106,20 @@ def seed(config: SoupConfig, key: jax.Array) -> SoupState:
 
 def _learn_epochs(config: SoupConfig, w: jnp.ndarray, other_w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """``learn_from_severity`` imitation epochs toward other's samples
-    (recomputed from other's fixed weights each epoch, as the reference
-    recomputes per ``learn_from`` call, ``network.py:620-626``)."""
+    (fixed across the call, as the reference recomputes per ``learn_from``
+    call, ``network.py:620-626``).  Flattened epoch*sample scan so the
+    soup's generations scan (and shard_map) stays compile-bounded."""
     x, y = compute_samples(config.topo, other_w)
-
-    def body(wi, _):
-        new_w, loss = fit_epoch(config.topo, wi, x, y, config.lr, config.train_mode)
-        return new_w, loss
-
-    new_w, losses = jax.lax.scan(body, w, None, length=max(config.learn_from_severity, 0))
-    return new_w, losses[-1] if config.learn_from_severity > 0 else jnp.zeros((), w.dtype)
+    return fit_epochs_flat(config.topo, w, config.learn_from_severity,
+                           config.lr, config.train_mode, xy=(x, y))
 
 
 def _train_epochs(config: SoupConfig, w: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """``train`` self-training epochs; samples are recomputed from the
     current weights before every epoch (``soup.py:69-76`` calls ``train()``
     repeatedly, and each call recomputes samples)."""
-
-    def body(wi, _):
-        x, y = compute_samples(config.topo, wi)
-        new_w, loss = fit_epoch(config.topo, wi, x, y, config.lr, config.train_mode)
-        return new_w, loss
-
-    new_w, losses = jax.lax.scan(body, w, None, length=max(config.train, 0))
-    return new_w, losses[-1] if config.train > 0 else jnp.zeros((), w.dtype)
+    return fit_epochs_flat(config.topo, w, config.train, config.lr,
+                           config.train_mode)
 
 
 def _respawn(config: SoupConfig, w, uids, uid_base, key):

@@ -82,6 +82,71 @@ def fit_epoch(
     return flat, losses.mean()
 
 
+def fit_epochs_flat(
+    topo: Topology,
+    flat: jnp.ndarray,
+    epochs: int,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+    xy: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``epochs`` repeated ``train()``/``learn_from()`` calls as ONE
+    compile-bounded program.
+
+    ``xy=None`` is self-training: the sample set is re-snapshotted from the
+    CURRENT weights whenever the flattened sample index wraps to 0 —
+    "samples recomputed before every epoch" (``network.py:613-618``).
+    Otherwise ``xy`` is a fixed imitation sample set (``learn_from``,
+    ``network.py:620-626``).
+
+    Why flat: the naive scan(epochs){scan(samples){grad}} nest, once wrapped
+    in the soup's scan(generations) (and worse, shard_map), compiles
+    unboundedly long on the remote TPU compile service.  Sequential mode
+    here is a SINGLE scan of length ``epochs * n_samples`` with one grad in
+    the body — per-step math identical to ``fit_epoch('sequential')``, same
+    update order, same pre-update keras-history loss.  Returns
+    (new_flat, last epoch's mean pre-update loss).
+    """
+    if epochs <= 0:
+        return flat, jnp.zeros((), flat.dtype)
+    if mode == "full_batch":
+        def body(w, _):
+            x, y = compute_samples(topo, w) if xy is None else xy
+            new_w, loss = fit_epoch(topo, w, x, y, lr, "full_batch")
+            return new_w, loss
+
+        new_flat, losses = jax.lax.scan(body, flat, None, length=epochs)
+        return new_flat, losses[-1]
+    if mode != "sequential":
+        raise ValueError(f"unknown train mode {mode!r}")
+
+    x0, y0 = compute_samples(topo, flat) if xy is None else xy
+    x0 = jax.lax.stop_gradient(x0)
+    y0 = jax.lax.stop_gradient(y0)
+    s = x0.shape[0]
+    idx = jnp.tile(jnp.arange(s), epochs)
+    zero = jnp.zeros((), flat.dtype)
+
+    def step(carry, s_idx):
+        w, sx, sy, accum, last = carry
+        if xy is None:  # refresh the sample snapshot at each epoch top
+            nx, ny = compute_samples(topo, w)
+            sx = jnp.where(s_idx == 0, nx, sx)
+            sy = jnp.where(s_idx == 0, ny, sy)
+        loss, grad = jax.value_and_grad(_mse, argnums=1)(
+            topo, w, sx[s_idx][None], sy[s_idx][None])
+        w = w - lr * grad
+        accum = accum + loss
+        done = s_idx == s - 1
+        last = jnp.where(done, accum / s, last)
+        accum = jnp.where(done, zero, accum)
+        return (w, sx, sy, accum, last), None
+
+    (new_flat, _, _, _, last), _ = jax.lax.scan(
+        step, (flat, x0, y0, zero, zero), idx)
+    return new_flat, last
+
+
 def train_step(
     topo: Topology,
     flat: jnp.ndarray,

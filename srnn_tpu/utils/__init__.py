@@ -1,11 +1,13 @@
-from .trajstore import TrajStore, read_store, read_store_artifact
+from .trajstore import (TrajStore, read_store, read_store_artifact,
+                        truncate_frames)
 from .capture import evolve_captured
 from .profiling import phase, timed, trace
 from .debug import checked_apply_to_weights, divergence_onset
 from .printing import PrintingObject
 
 __all__ = [
-    "TrajStore", "read_store", "read_store_artifact", "evolve_captured",
+    "TrajStore", "read_store", "read_store_artifact", "truncate_frames",
+    "evolve_captured",
     "phase", "timed", "trace",
     "checked_apply_to_weights", "divergence_onset",
     "PrintingObject",

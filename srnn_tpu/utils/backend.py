@@ -1,0 +1,114 @@
+"""Backend probing/retry helpers for driver entry points.
+
+The tunneled 'axon' TPU platform is flaky at *initialization* time: the
+plugin sometimes raises ``RuntimeError: Unable to initialize backend 'axon'``
+even though a retry seconds later succeeds (observed repeatedly; the round-1
+bench failure was exactly this).  jax caches the failed client, so a bare
+retry inside the same process does nothing — the backend registry must be
+cleared between attempts.
+
+``ensure_backend`` turns "tunnel luck" into a bounded retry loop with an
+optional CPU fallback, so ``bench.py`` / benchmarks always produce a useful
+JSON line instead of a stack trace.
+"""
+
+import os
+import time
+from typing import Optional
+
+
+def _clear_backends() -> None:
+    try:
+        from jax.extend import backend as jax_backend
+
+        jax_backend.clear_backends()
+    except Exception:
+        pass
+
+
+def force_cpu(n_devices: Optional[int] = None) -> None:
+    """Pin the process to the host-CPU platform (optionally with ``n_devices``
+    virtual devices) WITHOUT ever touching the default backend — safe to call
+    before any jax API that would initialize the flaky tunnel.
+
+    Side effect by design: invalidates live Arrays/compiled fns
+    (clear_backends).  Call at process start, never mid-computation.
+    """
+    import jax
+
+    if n_devices is not None:
+        try:
+            # jax.config refuses jax_num_cpu_devices after backend init;
+            # set_global skips that pre-init-only validator (private API,
+            # jax 0.9.x) and clear_backends rebuilds the client.
+            from jax._src import xla_bridge
+
+            xla_bridge.num_cpu_devices.set_global(n_devices)
+        except Exception:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n_devices}")
+    jax.config.update("jax_platforms", "cpu")
+    _clear_backends()
+
+
+def ensure_backend(retries: int = 4, sleep_s: float = 10.0,
+                   fallback_cpu: bool = True) -> "tuple[str, bool]":
+    """Probe the default jax backend, retrying init failures with the backend
+    registry cleared between attempts.  Returns ``(platform, fell_back)``
+    where ``platform`` is the live platform name ('tpu', 'axon', 'cpu', ...)
+    and ``fell_back`` is True only when the CPU fallback actually fired — a
+    machine whose default backend IS the CPU returns ('cpu', False).
+
+    With ``fallback_cpu`` the last resort is the host CPU platform (so a
+    caller can still produce an honest, labeled result); otherwise the final
+    error propagates.
+
+    Note: this guards against init *errors*; an init that HANGS must be
+    bounded by the caller (see :func:`watchdog`).
+    """
+    import jax
+
+    last: Optional[BaseException] = None
+    retries = max(retries, 1)
+    for attempt in range(retries):
+        try:
+            return jax.devices()[0].platform, False
+        except RuntimeError as e:
+            last = e
+            _clear_backends()
+            if attempt < retries - 1:  # no pointless sleep after the last try
+                print(f"ensure_backend: attempt {attempt + 1}/{retries} "
+                      f"failed ({e}); retrying in {sleep_s:.0f}s")
+                time.sleep(sleep_s)
+            else:
+                print(f"ensure_backend: attempt {attempt + 1}/{retries} "
+                      f"failed ({e})")
+    if fallback_cpu:
+        print("ensure_backend: default backend unavailable, falling back to CPU")
+        force_cpu()
+        return jax.devices()[0].platform, True
+    raise last  # type: ignore[misc]
+
+
+def watchdog(seconds: float, on_fire=None, exit_code: int = 3):
+    """Bound a whole process phase against backend WEDGES (an init or
+    compile that hangs instead of raising — the tunneled platform's other
+    failure mode).  After ``seconds``, runs ``on_fire()`` (e.g. print a
+    fail-soft JSON line) and hard-exits.  Returns a ``cancel()`` callable.
+    """
+    import threading
+
+    def fire():
+        try:
+            if on_fire is not None:
+                on_fire()
+        finally:
+            print(f"watchdog: fired after {seconds:.0f}s — backend wedge or "
+                  f"compile stall", flush=True)
+            os._exit(exit_code)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t.cancel

@@ -29,6 +29,14 @@ _MAGIC = b"SRNNTRJ1"
 _VERSION = 1
 _HEADER = struct.Struct("<8sII QQ")  # magic, version, reserved, N, P
 
+
+def _frame_bytes(n: int, p: int) -> int:
+    """On-disk frame size: u64 generation + f32 weights[N*P] + 3x i32[N]
+    (uids/action/counterpart) + f32 loss[N] + u32 crc.  Single source of
+    truth for writer, reader, and resume reconciliation (mirror of
+    ``payload_bytes`` in native/trajstore.cpp)."""
+    return 8 + n * p * 4 + 3 * n * 4 + n * 4 + 4
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 
@@ -52,6 +60,10 @@ def _load_native() -> Optional[ctypes.CDLL]:
         return None
     lib.ts_create.restype = ctypes.c_void_p
     lib.ts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.ts_open_append.restype = ctypes.c_void_p
+    lib.ts_open_append.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                   ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_uint64)]
     lib.ts_append.restype = ctypes.c_int
     lib.ts_append.argtypes = [ctypes.c_void_p, ctypes.c_uint64] + \
         [ctypes.c_void_p] * 5
@@ -82,29 +94,84 @@ class TrajStore:
     >>> with TrajStore(path, n_particles=N, n_weights=P) as store:
     ...     store.append(gen, weights, uids, action, counterpart, loss)
 
+    ``mode='w'`` starts a NEW store (truncates any existing file);
+    ``mode='a'`` reopens an existing one for a resumed run — the header is
+    validated against (N, P), a torn trailing frame from a crashed writer
+    is dropped, and ``existing_frames`` reports what was already on disk.
+    Previously captured frames are never lost on resume.
+
     Uses the native background-thread writer when available, else a
     format-identical pure-Python writer (``native=False`` forces that).
     """
 
     def __init__(self, path: str, n_particles: int, n_weights: int,
-                 native: Optional[bool] = None):
+                 native: Optional[bool] = None, mode: str = "w"):
+        if mode not in ("w", "a"):
+            raise ValueError(f"mode must be 'w' or 'a', got {mode!r}")
         self.path = path
         self.n = int(n_particles)
         self.p = int(n_weights)
+        self.existing_frames = 0
         lib = _load_native() if native in (None, True) else None
         if native is True and lib is None:
             raise RuntimeError("native trajstore requested but unavailable")
         self._lib = lib
         if lib is not None:
-            self._h = lib.ts_create(path.encode(), self.n, self.p)
-            if not self._h:
-                raise OSError(f"ts_create failed for {path}")
+            if mode == "a":
+                if os.path.exists(path) and os.path.getsize(path) < _HEADER.size:
+                    os.remove(path)  # torn header: unrecoverable, start fresh
+                existing = ctypes.c_uint64()
+                self._h = lib.ts_open_append(path.encode(), self.n, self.p,
+                                             ctypes.byref(existing))
+                if not self._h:
+                    raise OSError(
+                        f"ts_open_append failed for {path} (header mismatch "
+                        f"or IO error)")
+                self.existing_frames = existing.value
+            else:
+                self._h = lib.ts_create(path.encode(), self.n, self.p)
+                if not self._h:
+                    raise OSError(f"ts_create failed for {path}")
             self._f = None
         else:
             self._h = None
-            self._f = open(path, "wb")
-            self._f.write(_HEADER.pack(_MAGIC, _VERSION, 0, self.n, self.p))
+            if mode == "a" and os.path.exists(path) \
+                    and os.path.getsize(path) >= _HEADER.size:
+                self._f = self._reopen_py(path)
+            else:
+                # absent file — or one whose buffered header never hit disk
+                # (a crash right after creation): nothing recoverable, start
+                # the store fresh rather than failing the resume
+                self._f = open(path, "wb")
+                self._f.write(_HEADER.pack(_MAGIC, _VERSION, 0, self.n, self.p))
         self.frames_written = 0
+
+    def _reopen_py(self, path: str):
+        """Pure-Python append reopen: validate header, truncate a torn tail,
+        seek to the end of the last complete frame."""
+        f = open(path, "r+b")
+        try:
+            head = f.read(_HEADER.size)
+            if len(head) < _HEADER.size:
+                raise OSError(f"{path}: truncated header")
+            magic, version, _res, n, p = _HEADER.unpack(head)
+            if magic != _MAGIC or version != _VERSION:
+                raise OSError(f"{path}: not a trajstore file")
+            if (n, p) != (self.n, self.p):
+                raise OSError(
+                    f"{path}: store is (N={n}, P={p}) but resume expects "
+                    f"(N={self.n}, P={self.p})")
+            frame_bytes = _frame_bytes(n, p)
+            f.seek(0, os.SEEK_END)
+            frames = (f.tell() - _HEADER.size) // frame_bytes
+            valid_end = _HEADER.size + frames * frame_bytes
+            f.truncate(valid_end)
+            f.seek(valid_end)
+            self.existing_frames = int(frames)
+            return f
+        except Exception:
+            f.close()
+            raise
 
     def append(self, generation: int, weights, uids, action, counterpart, loss):
         w = np.ascontiguousarray(np.asarray(weights, np.float32)
@@ -196,8 +263,8 @@ def _read_store_py(path: str, start: int, count: Optional[int]
         magic, version, _res, n, p = _HEADER.unpack(head)
         if magic != _MAGIC or version != _VERSION:
             raise OSError(f"{path}: not a trajstore file")
-        body = 8 + n * p * 4 + 3 * n * 4 + n * 4
-        frame_bytes = body + 4
+        frame_bytes = _frame_bytes(n, p)
+        body = frame_bytes - 4
         f.seek(0, os.SEEK_END)
         total = (f.tell() - _HEADER.size) // frame_bytes
         count = total - start if count is None else count
@@ -228,6 +295,29 @@ def _read_store_py(path: str, start: int, count: Optional[int]
                 off += n * 4
             out["loss"][i] = np.frombuffer(payload, np.float32, n, off)
     return out
+
+
+def truncate_frames(path: str, keep: int) -> int:
+    """Truncate a store to its first ``keep`` frames (no-op if it already
+    has fewer).  Returns the frame count after truncation.
+
+    Resume reconciliation: a run killed AFTER capture flushed frames but
+    BEFORE the next checkpoint finalized would otherwise re-evolve and
+    re-append those generations, duplicating frames.  The resuming caller
+    truncates to the frames consistent with the restored checkpoint first.
+    """
+    if not os.path.exists(path) or os.path.getsize(path) < _HEADER.size:
+        return 0
+    with open(path, "r+b") as f:
+        magic, version, _res, n, p = _HEADER.unpack(f.read(_HEADER.size))
+        if magic != _MAGIC or version != _VERSION:
+            raise OSError(f"{path}: not a trajstore file")
+        fb = _frame_bytes(n, p)
+        f.seek(0, os.SEEK_END)
+        frames = (f.tell() - _HEADER.size) // fb
+        keep = min(int(keep), int(frames))
+        f.truncate(_HEADER.size + keep * fb)
+    return keep
 
 
 def read_store_artifact(path: str) -> Dict[str, np.ndarray]:

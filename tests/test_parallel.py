@@ -66,6 +66,134 @@ def test_sharded_soup_full_run_with_respawn(mesh):
     assert int(final.next_uid) >= 24
 
 
+def test_sharded_popmajor_step_bitwise_matches_unsharded(mesh):
+    """The sharded popmajor step is FULLY bitwise vs single-device popmajor —
+    attack, imitation (post-attack re-gather), train, respawn uids and fresh
+    draws included."""
+    cfg = SoupConfig(topo=WW, size=16, attacking_rate=0.5, learn_from_rate=0.3,
+                     learn_from_severity=1, train=2, remove_divergent=True,
+                     remove_zero=True, layout="popmajor")
+    s0 = seed(cfg, jax.random.key(7))
+    ref, ev_ref = evolve_step(cfg, s0)
+    sh_state = make_sharded_state(cfg, mesh, jax.random.key(7))
+    got, ev_got = sharded_evolve_step(cfg, mesh, sh_state)
+    np.testing.assert_array_equal(np.asarray(ref.weights), np.asarray(got.weights))
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(got.uids))
+    assert int(ref.next_uid) == int(got.next_uid)
+    np.testing.assert_array_equal(np.asarray(ev_ref.action), np.asarray(ev_got.action))
+    np.testing.assert_array_equal(np.asarray(ev_ref.counterpart),
+                                  np.asarray(ev_got.counterpart))
+
+
+def test_sharded_popmajor_multigeneration_bitwise(mesh):
+    """10 full-dynamics generations through the transposed-carry scan path
+    equal the single-device popmajor evolve bit-for-bit."""
+    from srnn_tpu.soup import evolve
+
+    cfg = SoupConfig(topo=WW, size=24, attacking_rate=0.3, learn_from_rate=0.2,
+                     learn_from_severity=1, train=3, remove_divergent=True,
+                     remove_zero=True, layout="popmajor")
+    s0 = seed(cfg, jax.random.key(8))
+    ref = evolve(cfg, s0, generations=10)
+    sh = sharded_evolve(cfg, mesh, make_sharded_state(cfg, mesh, jax.random.key(8)),
+                        generations=10)
+    np.testing.assert_array_equal(np.asarray(ref.weights), np.asarray(sh.weights))
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(sh.uids))
+    assert int(ref.next_uid) == int(sh.next_uid)
+    assert int(sh.time) == 10
+    counts = sharded_count(cfg, mesh, sh)
+    assert int(counts.sum()) == 24
+
+
+def test_sharded_popmajor_rejects_non_weightwise(mesh):
+    from srnn_tpu import Topology
+
+    cfg = SoupConfig(topo=Topology("aggregating", width=2, depth=2),
+                     size=16, layout="popmajor")
+    state = make_sharded_state(cfg._replace(layout="rowmajor"), mesh,
+                               jax.random.key(9))
+    with pytest.raises(ValueError):
+        sharded_evolve_step(cfg, mesh, state)
+
+
+def test_sharded_multisoup_step_matches_unsharded(mesh):
+    """The sharded heterogeneous soup step — cross-type attacks included —
+    matches evolve_multi_step under matched keys: integer state (uids,
+    events, next_uid) EXACTLY; weights to reduction-reassociation tolerance
+    (the agg/fft/rnn transforms' row-internal reductions tile differently
+    at different batch shapes — see sharded_multisoup.py docstring)."""
+    from srnn_tpu import Topology
+    from srnn_tpu.multisoup import MultiSoupConfig, evolve_multi_step, seed_multi
+    from srnn_tpu.parallel import (make_sharded_multi_state,
+                                   sharded_evolve_multi_step)
+
+    cfg = MultiSoupConfig(
+        topos=(Topology("weightwise", width=2, depth=2),
+               Topology("aggregating", width=2, depth=2),
+               Topology("recurrent", width=2, depth=2)),
+        sizes=(16, 8, 8),
+        attacking_rate=0.5, learn_from_rate=0.3, learn_from_severity=1,
+        train=1, remove_divergent=True, remove_zero=True)
+    s0 = seed_multi(cfg, jax.random.key(11))
+    ref, ev_ref = evolve_multi_step(cfg, s0)
+    sh0 = make_sharded_multi_state(cfg, mesh, jax.random.key(11))
+    got, ev_got = sharded_evolve_multi_step(cfg, mesh, sh0)
+    for t in range(3):
+        np.testing.assert_allclose(np.asarray(ref.weights[t]),
+                                   np.asarray(got.weights[t]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ref.uids[t]),
+                                      np.asarray(got.uids[t]))
+        np.testing.assert_array_equal(np.asarray(ev_ref.action[t]),
+                                      np.asarray(ev_got.action[t]))
+        np.testing.assert_array_equal(np.asarray(ev_ref.counterpart[t]),
+                                      np.asarray(ev_got.counterpart[t]))
+    assert int(ref.next_uid) == int(got.next_uid)
+
+
+def test_sharded_multisoup_multigeneration(mesh):
+    """Multi-generation sharded mixed soup: matches unsharded (weights to
+    tolerance, uids exact), global counts conserved, uid monotonicity
+    across cross-type respawns."""
+    from srnn_tpu import Topology
+    from srnn_tpu.multisoup import (MultiSoupConfig, count_multi, evolve_multi,
+                                    seed_multi)
+    from srnn_tpu.parallel import (make_sharded_multi_state,
+                                   sharded_count_multi, sharded_evolve_multi)
+
+    cfg = MultiSoupConfig(
+        topos=(Topology("weightwise", width=2, depth=2),
+               Topology("recurrent", width=2, depth=2)),
+        sizes=(16, 8),
+        attacking_rate=0.4, learn_from_rate=-1.0, train=2,
+        remove_divergent=True, remove_zero=True)
+    ref = evolve_multi(cfg, seed_multi(cfg, jax.random.key(12)), generations=8)
+    sh = sharded_evolve_multi(
+        cfg, mesh, make_sharded_multi_state(cfg, mesh, jax.random.key(12)),
+        generations=8)
+    for t in range(2):
+        np.testing.assert_allclose(np.asarray(ref.weights[t]),
+                                   np.asarray(sh.weights[t]),
+                                   rtol=5e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(ref.uids[t]),
+                                      np.asarray(sh.uids[t]))
+    counts = np.asarray(sharded_count_multi(cfg, mesh, sh))
+    np.testing.assert_array_equal(counts, np.asarray(count_multi(cfg, ref)))
+    assert counts.sum() == 24 and int(sh.time) == 8
+
+
+def test_sharded_multisoup_rejects_indivisible_sizes(mesh):
+    from srnn_tpu import Topology
+    from srnn_tpu.multisoup import MultiSoupConfig
+    from srnn_tpu.parallel import make_sharded_multi_state
+
+    cfg = MultiSoupConfig(
+        topos=(Topology("weightwise"), Topology("aggregating")),
+        sizes=(16, 9))
+    with pytest.raises(ValueError, match="divisible"):
+        make_sharded_multi_state(cfg, mesh, jax.random.key(13))
+
+
 def test_sharded_count_matches_local_count(mesh):
     cfg = SoupConfig(topo=WW, size=32, attacking_rate=0.0, learn_from_rate=0.0)
     s = seed(cfg, jax.random.key(3))

@@ -117,8 +117,63 @@ def test_mega_soup_smoke_and_bit_exact_resume(tmp_path):
     assert "resumed from ckpt-gen00000004" in log and "done:" in log
 
 
-def test_mega_soup_rejects_pathological_config():
+def test_mega_soup_popmajor_sequential_train_runs(tmp_path):
+    """popmajor + batch-1 sequential training used to be a hard-errored
+    compile pathology; the flattened epochs*samples scan
+    (ops/popmajor.py::_ww_seq_sgd_flat) makes it a supported config."""
+    d = REGISTRY["mega_soup"](
+        ["--smoke", "--root", str(tmp_path), "--train", "2",
+         "--train-mode", "sequential", "--layout", "popmajor"])
+    assert "done:" in open(os.path.join(d, "log.txt")).read()
+
+
+def test_mega_soup_capture_survives_resume(tmp_path):
+    """Interrupt a capturing run, resume it (WITHOUT re-passing
+    --capture-every): capture continues per the saved config, the store is
+    appended to not truncated, and every pre- and post-resume frame reads
+    back (the round-2 TrajStore data-loss bug)."""
+    from srnn_tpu.utils import read_store
+
+    d_half = REGISTRY["mega_soup"](
+        ["--smoke", "--root", str(tmp_path), "--generations", "4",
+         "--capture-every", "2"])
+    traj = os.path.join(d_half, "soup.traj")
+    pre = read_store(traj)
+    assert pre["generations"].tolist() == [2, 4]
+    d_resumed = REGISTRY["mega_soup"](["--smoke", "--resume", d_half])
+    assert d_resumed == d_half
+    out = read_store(traj)
+    assert out["generations"].tolist() == [2, 4, 6]
+    np.testing.assert_array_equal(out["weights"][:2], pre["weights"])
+    log = open(os.path.join(d_half, "log.txt")).read()
+    assert "appending after 2 existing frames" in log
+
+
+def test_mega_soup_bad_capture_cadence_leaves_no_run_dir(tmp_path):
+    """Validation happens BEFORE the Experiment is entered: a rejected
+    invocation must not leave a run dir without meta.json."""
     with pytest.raises(SystemExit):
         REGISTRY["mega_soup"](
-            ["--size", "100000", "--train", "10", "--train-mode", "sequential",
-             "--layout", "popmajor", "--generations", "1"])
+            ["--smoke", "--root", str(tmp_path), "--capture-every", "3",
+             "--checkpoint-every", "4"])
+    assert not os.path.exists(tmp_path) or os.listdir(str(tmp_path)) == []
+
+
+def test_experiment_wall_seconds_cumulative(tmp_path):
+    """meta.json wall_seconds accumulates across attach() sessions instead
+    of being overwritten by the last session's runtime."""
+    import json
+    import time as _t
+
+    from srnn_tpu.experiment import Experiment
+
+    with Experiment("wall", root=str(tmp_path)) as exp:
+        _t.sleep(0.05)
+    meta_path = os.path.join(exp.dir, "meta.json")
+    first = json.load(open(meta_path))["wall_seconds"]
+    assert first > 0
+    exp2 = Experiment.attach(exp.dir)
+    _t.sleep(0.05)
+    exp2.__exit__(None, None, None)
+    second = json.load(open(meta_path))["wall_seconds"]
+    assert second >= first + 0.05

@@ -232,3 +232,54 @@ def test_popmajor_rejects_unsupported_configs():
     with pytest.raises(ValueError):
         evolve_step(rnn_cfg, seed(SoupConfig(topo=Topology("recurrent"), size=4),
                                   jax.random.key(0)))
+
+
+# ----------------------------------------- parallel-vs-sequential statistics
+
+
+def _class_counts_over_seeds(cfg, n_seeds, generations):
+    """End-state class histograms for n_seeds independent soups, evolved in
+    one vmapped/jitted program (soups stacked on a leading axis)."""
+    states = [seed(cfg, jax.random.key(s)) for s in range(n_seeds)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    finals = jax.vmap(lambda s: evolve(cfg, s, generations=generations))(stacked)
+    return np.stack([
+        np.asarray(count(cfg, jax.tree.map(lambda x: x[i], finals)))
+        for i in range(n_seeds)
+    ])
+
+
+def test_parallel_vs_sequential_distribution():
+    """Quantifies the documented last-attacker-wins deviation (soup.py
+    header vs reference soup.py:54-61): with respawn OFF, the parallel and
+    sequential modes' end-state class-count distributions are statistically
+    indistinguishable at the paper's rates (measured: largest per-class
+    |dmean| = 0.25/100 particles, all |d|/SE < 1 at 20 seeds); with respawn
+    ON the known TIMING deviation appears (sequential re-kills respawned
+    particles later in the same generation, leaving ~1.35/100 divergent at
+    count time where parallel leaves ~0).  PARITY.md L3 documents the
+    measured numbers."""
+    n_seeds, gens = 20, 100
+    common = dict(size=100, attacking_rate=0.1, learn_from_rate=-1.0, train=0)
+
+    # respawn OFF: isolates the collision/ordering deviation
+    par = _class_counts_over_seeds(
+        mkconfig(**common, mode="parallel"), n_seeds, gens)
+    seq = _class_counts_over_seeds(
+        mkconfig(**common, mode="sequential"), n_seeds, gens)
+    delta = par.mean(0) - seq.mean(0)
+    se = np.sqrt(par.var(0) / n_seeds + seq.var(0) / n_seeds)
+    # indistinguishable: every class within 3 SE (and within 1 particle abs)
+    assert (np.abs(delta) <= np.maximum(3 * se, 1.0)).all(), (delta, se)
+
+    # respawn ON: the timing deviation is real, bounded, and directional
+    par_r = _class_counts_over_seeds(
+        mkconfig(**common, mode="parallel", remove_divergent=True,
+                 remove_zero=True), n_seeds, gens)
+    seq_r = _class_counts_over_seeds(
+        mkconfig(**common, mode="sequential", remove_divergent=True,
+                 remove_zero=True), n_seeds, gens)
+    # parallel counts after end-of-generation respawn: ~no dead particles
+    assert par_r.mean(0)[0] <= 0.2
+    # sequential keeps a small residual divergent mass — present but < 4/100
+    assert 0.0 < seq_r.mean(0)[0] < 4.0

@@ -162,3 +162,38 @@ def test_popmajor_train_epochs_recompute_samples():
                                rtol=2e-4, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
                                rtol=2e-3, atol=1e-6)
+
+
+@pytest.mark.parametrize("variant", ["weightwise", "aggregating", "fft", "recurrent"])
+def test_fit_epochs_flat_matches_repeated_calls(variant):
+    """The flattened epochs*samples scan == the naive loop of train_step /
+    learn_from calls, for every variant (same update order, same last-epoch
+    keras-history loss)."""
+    from srnn_tpu.train import fit_epochs_flat, learn_from
+    from srnn_tpu.nets import compute_samples
+
+    topo = Topology(variant, width=2, depth=2)
+    rng = np.random.default_rng(37)
+    w0 = jnp.asarray(rng.normal(size=topo.num_weights).astype(np.float32) * 0.4)
+    other = jnp.asarray(rng.normal(size=topo.num_weights).astype(np.float32) * 0.4)
+
+    # self-training: 3 repeated train() calls
+    w_ref = w0
+    for _ in range(3):
+        w_ref, loss_ref = train_step(topo, w_ref)
+    w_got, loss_got = fit_epochs_flat(topo, w0, epochs=3)
+    np.testing.assert_allclose(np.asarray(w_got), np.asarray(w_ref),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(float(loss_got), float(loss_ref),
+                               rtol=1e-4, atol=1e-8)
+
+    # imitation: 2 repeated learn_from(other) calls (fixed samples)
+    w_ref = w0
+    for _ in range(2):
+        w_ref, loss_ref = learn_from(topo, w_ref, other)
+    x, y = compute_samples(topo, other)
+    w_got, loss_got = fit_epochs_flat(topo, w0, epochs=2, xy=(x, y))
+    np.testing.assert_allclose(np.asarray(w_got), np.asarray(w_ref),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(float(loss_got), float(loss_ref),
+                               rtol=1e-4, atol=1e-8)

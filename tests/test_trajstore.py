@@ -101,6 +101,111 @@ def test_range_reads(tmp_path):
         read_store(str(path), start=5, count=3)
 
 
+@pytest.mark.parametrize("native", [False, True])
+def test_append_mode_preserves_existing_frames(tmp_path, native):
+    """Resume path: mode='a' appends after existing frames instead of
+    truncating them (the round-2 data-loss bug: open(path, 'wb') wiped all
+    previously captured trajectories on resume)."""
+    if native and not native_available():
+        pytest.skip("native lib unavailable")
+    n, p = 4, 9
+    first, second = _frames(n, p, 3, seed_=4), _frames(n, p, 2, seed_=5)
+    path = tmp_path / "resume.traj"
+    _write(path, first, n, p, native)
+    with TrajStore(str(path), n, p, native=native, mode="a") as s:
+        assert s.existing_frames == 3
+        for fr in second:
+            s.append(fr["generation"] + 3, fr["weights"], fr["uids"],
+                     fr["action"], fr["counterpart"], fr["loss"])
+    out = read_store(str(path))
+    assert out["weights"].shape[0] == 5
+    np.testing.assert_array_equal(out["weights"][0], first[0]["weights"])
+    np.testing.assert_array_equal(out["weights"][3], second[0]["weights"])
+    np.testing.assert_array_equal(out["weights"][4], second[1]["weights"])
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_append_mode_drops_torn_tail(tmp_path, native):
+    """A crash mid-frame leaves a torn tail; reopening for append truncates
+    it and the next append lands on a clean frame boundary."""
+    if native and not native_available():
+        pytest.skip("native lib unavailable")
+    n, p = 3, 5
+    frames = _frames(n, p, 3, seed_=6)
+    path = tmp_path / "torn.traj"
+    _write(path, frames, n, p, native)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 7)
+    extra = _frames(n, p, 1, seed_=7)[0]
+    with TrajStore(str(path), n, p, native=native, mode="a") as s:
+        assert s.existing_frames == 2  # torn 3rd frame dropped
+        s.append(99, extra["weights"], extra["uids"], extra["action"],
+                 extra["counterpart"], extra["loss"])
+    out = read_store(str(path))
+    assert out["generations"].tolist() == [1, 2, 99]
+    np.testing.assert_array_equal(out["weights"][2], extra["weights"])
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_append_mode_rejects_shape_mismatch(tmp_path, native):
+    if native and not native_available():
+        pytest.skip("native lib unavailable")
+    n, p = 4, 9
+    path = tmp_path / "mismatch.traj"
+    _write(path, _frames(n, p, 1, seed_=8), n, p, native)
+    with pytest.raises(OSError):
+        TrajStore(str(path), n + 1, p, native=native, mode="a")
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_append_mode_creates_missing_file(tmp_path, native):
+    """mode='a' on a fresh path behaves like a new store."""
+    if native and not native_available():
+        pytest.skip("native lib unavailable")
+    n, p = 2, 3
+    path = tmp_path / "fresh.traj"
+    fr = _frames(n, p, 1, seed_=9)[0]
+    with TrajStore(str(path), n, p, native=native, mode="a") as s:
+        assert s.existing_frames == 0
+        s.append(1, fr["weights"], fr["uids"], fr["action"],
+                 fr["counterpart"], fr["loss"])
+    assert read_store(str(path))["weights"].shape[0] == 1
+
+
+def test_truncate_frames_reconciles_post_checkpoint_captures(tmp_path):
+    """truncate_frames drops frames past the restored checkpoint so a resume
+    can't append duplicates; no-op when already consistent."""
+    from srnn_tpu.utils import truncate_frames
+
+    n, p = 3, 4
+    frames = _frames(n, p, 5, seed_=10)
+    path = tmp_path / "dup.traj"
+    _write(path, frames, n, p, native=False)
+    assert truncate_frames(str(path), 3) == 3
+    out = read_store(str(path))
+    assert out["generations"].tolist() == [1, 2, 3]
+    assert truncate_frames(str(path), 99) == 3  # no-op beyond current count
+    assert truncate_frames(str(tmp_path / "absent.traj"), 2) == 0
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_append_mode_recreates_torn_header(tmp_path, native):
+    """A crash right after store creation can leave a 0-byte file (buffered
+    header never flushed); mode='a' must recreate rather than fail the
+    whole resume."""
+    if native and not native_available():
+        pytest.skip("native lib unavailable")
+    n, p = 2, 3
+    path = tmp_path / "torn_header.traj"
+    path.write_bytes(b"SRNN")  # shorter than the header
+    fr = _frames(n, p, 1, seed_=11)[0]
+    with TrajStore(str(path), n, p, native=native, mode="a") as s:
+        assert s.existing_frames == 0
+        s.append(1, fr["weights"], fr["uids"], fr["action"],
+                 fr["counterpart"], fr["loss"])
+    assert read_store(str(path))["weights"].shape[0] == 1
+
+
 def test_evolve_captured_stride_and_viz_artifact(tmp_path):
     """Streaming capture: strided frames match an unstrided device run at
     the captured generations, and the artifact renders in viz."""
