@@ -7,28 +7,39 @@ fused population-major Pallas kernel (``srnn_tpu/ops/pallas_ww.py``): the
 particle axis rides the 128-wide TPU lanes and chained steps stay in VMEM.
 
 North star (BASELINE.json): >= 10M self-applications/sec on a v4-32, i.e.
-312,500/sec/chip (convention: per-chip = total / 32 mesh devices, per
-BASELINE.json's v4-32 device count).  ``vs_baseline`` is the per-chip
-multiple of that.
+312,500/sec/chip (convention: per-chip = total / jax.device_count(); the
+JSON records ``device_count`` so the normalization is interpretable on any
+topology).  ``vs_baseline`` is the per-chip multiple of that.
 
-Robustness (round-3 hardening): the tunneled 'axon' platform flakes at
-backend *init* (the round-1 failure), so the backend is probed with retries
-+ registry clears (``srnn_tpu.utils.backend.ensure_backend``), the workload
-ramps (tiny compile-check first, then the full 1M-particle run), and every
-failure path still prints one well-formed JSON line carrying the best
-measurement obtained so far plus an ``error`` field — never a bare stack
-trace.
+Robustness (round-4 rework): the tunneled 'axon' platform has TWO failure
+modes — init that *raises* (round-1) and init/compile that *hangs*
+(round-3, where an in-process watchdog could only emit value=0 because the
+wedge killed every later stage in the same process).  So the bench is now
+subprocess-isolated:
+
+  * the PARENT never imports jax — it cannot wedge.  It spawns each stage
+    (``--stage ramp``, ``--stage full``) as a fresh child process with its
+    own timeout, kills and retries on a hang (the flake is per-process init
+    luck — a fresh process is the only retry that can work), keeps the best
+    measurement so far, and always prints exactly ONE JSON line.
+  * children share a persistent ``JAX_COMPILATION_CACHE_DIR`` so a retry
+    after a wedge does not re-pay the compile that wedged.
+  * the ramp stage (tiny shapes) lands a nonzero fail-soft number before
+    the full 1M-particle run is attempted.
 
 Timing notes: on 'axon' ``block_until_ready`` does not actually
 synchronize, so the measurement forces a scalar readback; per-call RPC
 latency is amortized by running many chained steps per dispatch.
 
-Prints exactly one JSON line.
+Prints exactly one JSON line (on the parent's stdout; child diagnostics go
+to stderr, child results travel on a sentinel-prefixed stdout line).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-import traceback
 
 N = 1_000_000
 STEPS_PER_CALL = 2000
@@ -37,6 +48,26 @@ RAMP_N = 8192
 RAMP_STEPS = 50
 BASELINE_PER_CHIP = 10_000_000 / 32  # BASELINE.json north star, v4-32
 
+# Stage budget (seconds).  The parent clamps every stage to the remaining
+# global deadline so the single JSON line is always emitted before the
+# driver's external timeout.  All overridable for tests.
+DEADLINE_S = float(os.environ.get("SRNN_BENCH_DEADLINE_S", "1400"))
+RAMP_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_RAMP_TIMEOUT_S", "420"))
+FULL_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_FULL_TIMEOUT_S", "650"))
+RAMP_ATTEMPTS = 3
+FULL_ATTEMPTS = 2
+# deadline slice the ramp/full stages may NOT eat into: keeps the cpu-rescue
+# leg runnable even when every accelerator attempt times out at full budget
+# (without it, 3x420 + 2x650 > 1400 and a persistently wedged tunnel starves
+# the rescue — reproducing the r3 value=0 scorecard)
+RESCUE_RESERVE_S = 330.0
+
+_SENTINEL = "@@BENCH_RESULT "
+
+
+# --------------------------------------------------------------------------
+# child side: one stage per process
+# --------------------------------------------------------------------------
 
 def _measure(topo, n, steps, calls):
     """Ramped measurement unit: returns applications/sec for (n, steps)."""
@@ -71,60 +102,183 @@ def _measure(topo, n, steps, calls):
     return n * steps * calls / dt
 
 
-WATCHDOG_S = 1500.0  # hard bound on the whole bench (init wedges included)
+def _child_stage(stage: str) -> None:
+    """Run one stage and print its result on a sentinel stdout line."""
+    if stage in os.environ.get("SRNN_BENCH_TEST_HANG", "").split(","):
+        time.sleep(3600)  # test hook: simulate a wedged backend init
+
+    from srnn_tpu.utils.backend import ensure_backend, force_cpu
+
+    forced_cpu = os.environ.get("SRNN_BENCH_PLATFORM") == "cpu"
+    if forced_cpu:
+        # pin via jax.config BEFORE any device probe: the axon sitecustomize
+        # overrides the JAX_PLATFORMS env var at register() time, so the env
+        # route cannot keep a child off the (possibly wedged) tunnel
+        force_cpu()
+        platform, fell_back = "cpu", False
+    else:
+        platform, fell_back = ensure_backend(retries=3, sleep_s=10.0,
+                                             fallback_cpu=True)
+    import jax
+
+    from srnn_tpu import Topology
+
+    topo = Topology("weightwise", width=2, depth=2)  # science-default f32
+    on_cpu = platform == "cpu"  # fallback OR a genuinely CPU-default host
+    if stage == "ramp":
+        # tiny shapes — proves compile + execute end-to-end and leaves a
+        # nonzero fail-soft number if the full run dies
+        apps = _measure(topo, RAMP_N, RAMP_STEPS, 1)
+    elif on_cpu:
+        # degraded run: the full 1M x 2000-step workload would take hours
+        # on host CPU; report a reduced honest measurement
+        apps = _measure(topo, 100_000, 20, 1)
+    else:
+        apps = _measure(topo, N, STEPS_PER_CALL, CALLS)
+    out = {
+        "apps_per_chip": apps / jax.device_count(),
+        "device_count": jax.device_count(),
+        "backend": platform + ("-fallback" if fell_back else
+                               "-forced" if forced_cpu else ""),
+    }
+    print(_SENTINEL + json.dumps(out), flush=True)
+    sys.stdout.flush()
+    # skip interpreter/backend teardown: a dead tunnel can hang atexit
+    # handlers after the measurement is already delivered
+    os._exit(0)
+
+
+# --------------------------------------------------------------------------
+# parent side: orchestration only (no jax import — cannot wedge)
+# --------------------------------------------------------------------------
+
+def _run_child(stage: str, timeout: float, env: dict):
+    """Spawn one stage as a fresh process.  Returns (result_dict | None,
+    error_str | None).  On timeout the child is killed — a wedged backend
+    dies with its process, which an in-process retry provably cannot do
+    (BENCH_r03)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=None,
+                              timeout=timeout, env=env)
+        out, rc = proc.stdout, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # the child may have PRINTED its measurement and then hung in
+        # backend teardown — salvage the sentinel from the partial stdout
+        # rather than discarding a completed run
+        out, rc = e.stdout, None
+    parsed = _parse_result(out)
+    if parsed is not None:
+        return parsed, None
+    if rc is None:
+        return None, f"timeout>{timeout:.0f}s"
+    return None, f"rc={rc}, no result line"
+
+
+def _parse_result(stdout_bytes):
+    if not stdout_bytes:
+        return None
+    for line in reversed(stdout_bytes.decode(errors="replace").splitlines()):
+        if line.startswith(_SENTINEL):
+            try:
+                return json.loads(line[len(_SENTINEL):])
+            except json.JSONDecodeError:
+                return None
+    return None
 
 
 def main():
+    t_start = time.monotonic()
     result = {
         "metric": "self-applications/sec/chip",
         "value": 0,
         "unit": "applications/s",
         "vs_baseline": 0.0,
     }
+    errors = []
 
-    def emit():
-        result["vs_baseline"] = round(result["value"] / BASELINE_PER_CHIP, 2)
-        print(json.dumps(result), flush=True)
-
-    from srnn_tpu.utils.backend import ensure_backend, watchdog
-
-    # the tunnel's OTHER failure mode is a hang (init/compile wedges instead
-    # of raising) — retries can't catch that, so the whole bench runs under
-    # a watchdog that still emits the fail-soft JSON line before exiting
-    cancel = watchdog(
-        WATCHDOG_S,
-        on_fire=lambda: (result.setdefault(
-            "error", f"watchdog: wedged > {WATCHDOG_S:.0f}s"), emit()))
+    env = dict(os.environ)
+    # persistent compile cache: a retried stage skips the compile that
+    # wedged; also shared ramp -> full within one run
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
     try:
-        platform, fell_back = ensure_backend(retries=5, sleep_s=15.0,
-                                             fallback_cpu=True)
-        import jax
+        os.makedirs(env["JAX_COMPILATION_CACHE_DIR"], exist_ok=True)
+    except OSError:
+        # never let cache-dir trouble break the one-JSON-line contract;
+        # children just run uncached
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
 
-        from srnn_tpu import Topology
+    def remaining():
+        return DEADLINE_S - (time.monotonic() - t_start)
 
-        topo = Topology("weightwise", width=2, depth=2)  # science-default f32
+    def run_stage(stage, attempts, per_timeout, stage_env=None, reserve=0.0):
+        for i in range(attempts):
+            if remaining() - reserve <= 10:
+                errors.append(f"{stage}: deadline exhausted"
+                              + (" (rescue slice reserved)" if reserve else ""))
+                return None
+            t = min(per_timeout, remaining() - reserve)
+            r, err = _run_child(stage, t, stage_env or env)
+            if r is not None:
+                return r
+            errors.append(f"{stage} attempt {i + 1}/{attempts}: {err}")
+            print(f"bench: {errors[-1]}; retrying in a fresh process"
+                  if i + 1 < attempts else f"bench: {errors[-1]}",
+                  file=sys.stderr, flush=True)
+        return None
 
-        # ramp stage: tiny shapes — proves compile + execute end-to-end and
-        # leaves a nonzero fail-soft number if the full run dies
-        apps = _measure(topo, RAMP_N, RAMP_STEPS, 1)
-        result["value"] = round(apps / jax.device_count())
-        result["ramp_only"] = True
-
-        if fell_back:
-            # degraded run: the full 1M x 2000-step workload would take
-            # hours on host CPU; report a reduced honest measurement
-            result["backend"] = "cpu-fallback"
-            apps = _measure(topo, 100_000, 20, 1)
+    def take(measured, stage_tag):
+        result["value"] = round(measured["apps_per_chip"])
+        result["device_count"] = measured["device_count"]
+        result["backend"] = measured["backend"]
+        if stage_tag:
+            result["stage"] = stage_tag
         else:
-            apps = _measure(topo, N, STEPS_PER_CALL, CALLS)
-        result["value"] = round(apps / jax.device_count())
-        del result["ramp_only"]
-    except Exception as e:  # fail-soft: always emit the JSON line
-        result["error"] = f"{type(e).__name__}: {e}"
-        traceback.print_exc()
-    cancel()
-    emit()
+            result.pop("stage", None)
+
+    ramp = run_stage("ramp", RAMP_ATTEMPTS, RAMP_TIMEOUT_S,
+                     reserve=RESCUE_RESERVE_S)
+    if ramp is not None:
+        take(ramp, "ramp-only")
+
+    # once any accelerator measurement exists the rescue leg is moot, so
+    # the full stage may spend the whole remaining deadline
+    full = run_stage("full", FULL_ATTEMPTS, FULL_TIMEOUT_S,
+                     reserve=0.0 if ramp is not None else RESCUE_RESERVE_S)
+    if full is not None:
+        # keep the BEST measurement: a full-stage child whose own backend
+        # init fell back to host CPU (per-process tunnel luck) must not
+        # overwrite a real accelerator ramp number with a degraded one
+        accel_ramp = ramp is not None and not ramp["backend"].endswith(
+            ("-fallback", "-forced"))
+        if full["backend"].endswith("-fallback") and accel_ramp:
+            errors.append("full stage fell back to CPU; keeping the "
+                          "accelerator ramp measurement")
+        else:
+            take(full, None)
+
+    if ramp is None and full is None:
+        # every accelerator attempt wedged or failed — a labeled host-CPU
+        # number is strictly more information than value=0 (the r3 scorecard)
+        cpu_env = dict(env)
+        cpu_env["SRNN_BENCH_PLATFORM"] = "cpu"
+        # the hang hook simulates a wedged TUNNEL; a CPU-pinned rescue child
+        # never dials it, so the simulated wedge does not apply
+        cpu_env.pop("SRNN_BENCH_TEST_HANG", None)
+        rescue = run_stage("full", 1, 300.0, stage_env=cpu_env)
+        if rescue is not None:
+            take(rescue, "cpu-rescue")
+
+    if (full is None or ramp is None) and errors:
+        result["error"] = "; ".join(errors)
+    result["vs_baseline"] = round(result["value"] / BASELINE_PER_CHIP, 2)
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
+        _child_stage(sys.argv[2])
+    else:
+        main()

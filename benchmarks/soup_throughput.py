@@ -136,19 +136,30 @@ def main():
     if args.layout == "popmajor" and args.preset == "mixed":
         p.error("--layout popmajor applies to the single-type weightwise presets")
     # the tunneled TPU backend flakes at init (sometimes raising, sometimes
-    # wedging): probe with retries AND bound the whole run with a watchdog
-    # that still emits a JSON line (no CPU fallback — perf must be honest)
+    # wedging): probe with retries AND bound each phase with a watchdog that
+    # still emits a JSON line (no CPU fallback — perf must be honest).  The
+    # watchdog is re-armed per size so the bound scales with the sweep and a
+    # wedge in one size doesn't discard the rows already printed, and
+    # cancelled after the last size so a long legitimate sweep is never
+    # hard-killed post-measurement.
     from srnn_tpu.utils.backend import watchdog
 
-    watchdog(2400.0, on_fire=lambda: print(json.dumps(
-        {"metric": f"soup-generations/sec[{args.preset}]", "value": 0,
-         "unit": "generations/s", "error": "watchdog: wedged > 2400s"}),
-        flush=True))
+    def arm(phase: str, seconds: float):
+        return watchdog(seconds, on_fire=lambda: print(json.dumps(
+            {"metric": f"soup-generations/sec[{args.preset}]", "value": 0,
+             "unit": "generations/s",
+             "error": f"watchdog: {phase} wedged > {seconds:.0f}s"}),
+            flush=True))
+
+    cancel = arm("backend init", 600.0)
     ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=False)
     for n in args.sizes:
+        cancel()
+        cancel = arm(f"size {n}", 2400.0)
         print(json.dumps(bench_size(args.preset, n, args.generations,
                                     args.repeats, args.layout,
                                     args.train_mode, args.sharded)))
+    cancel()
 
 
 if __name__ == "__main__":

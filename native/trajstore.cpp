@@ -26,6 +26,14 @@
 // complete frame), and appends after it — a resumed soup run never loses
 // previously captured frames.
 
+// Large-file safety: a mega-soup .traj (1M particles ≈ 56 MB/frame) passes
+// 2 GiB within ~40 frames, so all offsets go through fseeko/ftello with
+// off_t forced to 64 bits — long-based fseek/ftell would overflow on any
+// ILP32 build (ADVICE r3).
+#ifndef _FILE_OFFSET_BITS
+#define _FILE_OFFSET_BITS 64
+#endif
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -119,7 +127,7 @@ struct Writer {
 struct Reader {
   FILE* f = nullptr;
   uint64_t n = 0, p = 0;
-  long data_start = 0;
+  off_t data_start = 0;
   uint64_t frames = 0;
 };
 
@@ -168,14 +176,14 @@ void* ts_open_append(const char* path, uint64_t n_particles,
     return nullptr;
   }
   size_t frame_bytes = payload_bytes(n_particles, n_weights) + sizeof(uint32_t);
-  if (fseek(f, 0, SEEK_END) != 0) {
+  if (fseeko(f, 0, SEEK_END) != 0) {
     fclose(f);
     return nullptr;
   }
-  long end = ftell(f);
+  off_t end = ftello(f);
   uint64_t frames =
       static_cast<uint64_t>(end - sizeof(Header)) / frame_bytes;
-  long valid_end = static_cast<long>(sizeof(Header) + frames * frame_bytes);
+  off_t valid_end = static_cast<off_t>(sizeof(Header) + frames * frame_bytes);
   if (valid_end != end) {
     // crashed mid-frame: drop the torn tail so appends start clean
     if (ftruncate(fileno(f), valid_end) != 0) {
@@ -183,7 +191,7 @@ void* ts_open_append(const char* path, uint64_t n_particles,
       return nullptr;
     }
   }
-  if (fseek(f, valid_end, SEEK_SET) != 0) {
+  if (fseeko(f, valid_end, SEEK_SET) != 0) {
     fclose(f);
     return nullptr;
   }
@@ -270,9 +278,9 @@ void* ts_open_read(const char* path) {
   r->f = f;
   r->n = h.n_particles;
   r->p = h.n_weights;
-  r->data_start = static_cast<long>(sizeof h);
-  fseek(f, 0, SEEK_END);
-  long end = ftell(f);
+  r->data_start = static_cast<off_t>(sizeof h);
+  fseeko(f, 0, SEEK_END);
+  off_t end = ftello(f);
   size_t frame_bytes = payload_bytes(r->n, r->p) + sizeof(uint32_t);
   // a torn trailing frame (crash mid-write) is excluded by integer division
   r->frames = static_cast<uint64_t>(end - r->data_start) / frame_bytes;
@@ -301,8 +309,8 @@ int ts_read_frames(void* handle, uint64_t start, uint64_t count,
   const size_t body = payload_bytes(n, p);
   const size_t frame_bytes = body + sizeof(uint32_t);
   std::vector<uint8_t> buf(frame_bytes);
-  if (fseek(r->f, r->data_start + static_cast<long>(start * frame_bytes),
-            SEEK_SET) != 0)
+  if (fseeko(r->f, r->data_start + static_cast<off_t>(start * frame_bytes),
+             SEEK_SET) != 0)
     return TS_EIO;
   for (uint64_t i = 0; i < count; i++) {
     if (fread(buf.data(), 1, frame_bytes, r->f) != frame_bytes) return TS_EIO;

@@ -130,9 +130,16 @@ def fit_epochs_flat(
     def step(carry, s_idx):
         w, sx, sy, accum, last = carry
         if xy is None:  # refresh the sample snapshot at each epoch top
-            nx, ny = compute_samples(topo, w)
-            sx = jnp.where(s_idx == 0, nx, sx)
-            sy = jnp.where(s_idx == 0, ny, sy)
+            # cond, not where: the snapshot forward pass (a full RNN run for
+            # the recurrent variant) must only execute on epoch boundaries,
+            # not on every flattened sample step.  (Under vmap XLA lowers
+            # cond to select-with-both-branches — same cost as before; the
+            # win is the unvmapped single-net path, e.g. run_training.)
+            sx, sy = jax.lax.cond(
+                s_idx == 0,
+                lambda w, sx, sy: compute_samples(topo, w),
+                lambda w, sx, sy: (sx, sy),
+                w, sx, sy)
         loss, grad = jax.value_and_grad(_mse, argnums=1)(
             topo, w, sx[s_idx][None], sy[s_idx][None])
         w = w - lr * grad
