@@ -24,8 +24,11 @@ the flattened index wraps), so the full soup is scan(generations) x
 scan(epochs*samples) x grad — the same two-level shape as full_batch mode,
 with bounded compile at mega-N (measured: see RESULTS.md).
 
-Only the weightwise variant needs this: aggregating/fft reduce to k-vector
-ops and the recurrent scan is time- not layout-bound (SURVEY §3.1).
+The aggregating/fft variants get the same layout in ``popmajor_kvec.py``
+(their reduce/expand pair is a constant matmul / batched FFT over lanes);
+the ``apply_popmajor`` / ``train_epochs_popmajor`` / ``learn_epochs_popmajor``
+dispatchers at the bottom of this module route per variant.  Only the
+recurrent transform stays row-major (time- not layout-bound, SURVEY §3.1).
 """
 
 from typing import Optional, Tuple
@@ -232,3 +235,39 @@ def ww_learn_epochs_popmajor(
 
     new_wT, losses = jax.lax.scan(body, wT, None, length=severity)
     return new_wT, losses[-1]
+
+
+# ---------------------------------------------------------------------------
+# Variant dispatch: one population-major surface for the soup / sharded soup.
+# ---------------------------------------------------------------------------
+
+
+def apply_popmajor(topo: Topology, selfT: jnp.ndarray,
+                   targetT: jnp.ndarray) -> jnp.ndarray:
+    """Population-major self-application / attack for any lane-capable
+    variant: particle n's transform (parameters ``selfT[:, n]``) rewrites
+    ``targetT[:, n]``."""
+    if topo.variant == "weightwise":
+        return ww_forward_popmajor(topo, selfT, targetT)
+    from .popmajor_kvec import kvec_apply_popmajor
+
+    return kvec_apply_popmajor(topo, selfT, targetT)
+
+
+def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
+                          lr: float = DEFAULT_LR, mode: str = "sequential"):
+    if topo.variant == "weightwise":
+        return ww_train_epochs_popmajor(topo, wT, epochs, lr, mode)
+    from .popmajor_kvec import kvec_train_epochs_popmajor
+
+    return kvec_train_epochs_popmajor(topo, wT, epochs, lr, mode)
+
+
+def learn_epochs_popmajor(topo: Topology, wT: jnp.ndarray, otherT: jnp.ndarray,
+                          severity: int, lr: float = DEFAULT_LR,
+                          mode: str = "sequential"):
+    if topo.variant == "weightwise":
+        return ww_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)
+    from .popmajor_kvec import kvec_learn_epochs_popmajor
+
+    return kvec_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)

@@ -1,0 +1,208 @@
+"""Population-major (P, N) lane layout for the k-vector variants
+(aggregating / fft).
+
+Same rationale as the weightwise twin (``ops/popmajor.py``): row-major
+``vmap`` leaves per-particle tensors whose minor dims (k ~ 4, w ~ 2) waste
+the (8, 128) vector tiles, while the transposed layout puts the particle
+axis on the 128-wide lanes and turns every per-particle op into an
+elementwise op over lanes.  What is new here is the reduce/expand pair
+around the tiny MLP:
+
+  * aggregating: collect = one (k, P) constant matmul over the lane matrix
+    (reference ``collect_weights``, ``network.py:388-403``), deaggregate =
+    its (P, k) transpose (``deaggregate_identically``, ``network.py:310-312``)
+    — both MXU-trivial and bitwise-equal to the row-major path's matmuls;
+  * fft: the truncated DFT rides ``jnp.fft`` along axis 0 of the (P, N)
+    matrix — one batched FFT for the whole population instead of N vmapped
+    ones (reference ``aggregate_fft``, ``network.py:444-448``).
+
+Self-training for these variants has exactly ONE sample per epoch (x = y =
+the k-aggregate vector, ``network.py:414-417``/``:518-521``), so the
+reference's batch_size=1 epoch (``network.py:613-617``) IS a single
+full-batch step — sequential and full_batch modes coincide and the
+multi-epoch driver is a plain scan(epochs){grad}, no flattened sample nest
+needed.
+
+``shuffler='random'`` stays row-major-only: a per-particle permutation of
+the P axis is a per-lane gather that defeats the lane layout (fenced in
+``soup._check_popmajor``).
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..topology import Topology, aggregation_segments
+from .activations import resolve_activation
+from .linalg import matmul
+
+DEFAULT_LR = 0.01  # keras SGD default (mirrors train.DEFAULT_LR)
+
+
+@functools.lru_cache(maxsize=None)
+def _segment_onehot(topo: Topology) -> np.ndarray:
+    """(P, k) one-hot membership matrix (same construction as
+    ``nets.aggregating._segment_onehot``; cached per topology)."""
+    seg, _ = aggregation_segments(topo)
+    return np.eye(topo.aggregates, dtype=np.float32)[seg]
+
+
+def _mlp_forward_lanes(topo: Topology, wT: jnp.ndarray,
+                       xk: jnp.ndarray) -> jnp.ndarray:
+    """The variant's tiny MLP with per-lane parameters: ``wT`` (P, N) holds
+    each particle's flat weights, ``xk`` (k, N) each particle's input
+    vector.  Keras kernel order: flat index o + i*b + j = kernel[i, j]
+    (fan_in i, fan_out j), so out_j = act(sum_i x_i * w[o + i*b + j]).
+    Returns (k, N)."""
+    act = resolve_activation(topo.activation)
+    h = [xk[i] for i in range(xk.shape[0])]
+    for (a, b), o in zip(topo.layer_shapes, topo.offsets):
+        nxt = []
+        for j in range(b):
+            acc = h[0] * wT[o + j, :]
+            for i in range(1, a):
+                acc = acc + h[i] * wT[o + i * b + j, :]
+            nxt.append(act(acc))
+        h = nxt
+    return jnp.stack(h)
+
+
+def _segment_bounds(topo: Topology):
+    seg, counts = aggregation_segments(topo)
+    starts = np.searchsorted(seg, np.arange(topo.aggregates))
+    ends = starts + counts
+    return starts, ends
+
+
+def kvec_reduce_popmajor(topo: Topology, targetT: jnp.ndarray) -> jnp.ndarray:
+    """(P, N) weights -> (k, N) aggregates / DFT coefficients, per variant."""
+    if topo.variant == "fft":
+        if topo.fft_mode == "rfft":
+            spec = jnp.fft.rfft(targetT, axis=0).real.astype(targetT.dtype)
+            k, n = topo.aggregates, spec.shape[0]
+            if n >= k:
+                return spec[:k]
+            return jnp.pad(spec, ((0, k - n), (0, 0)))
+        return jnp.fft.fft(targetT, n=topo.aggregates, axis=0).real.astype(
+            targetT.dtype)
+    assert topo.variant == "aggregating"
+    _, counts = aggregation_segments(topo)
+    if topo.aggregator == "average":
+        onehotT = jnp.asarray(_segment_onehot(topo).T, targetT.dtype)
+        return matmul(topo, onehotT, targetT) / jnp.asarray(
+            counts, targetT.dtype)[:, None]
+    starts, ends = _segment_bounds(topo)
+    if topo.aggregator == "max":
+        return jnp.stack([jnp.max(targetT[s:e], axis=0)
+                          for s, e in zip(starts, ends)])
+    if topo.aggregator == "max_buggy":
+        # bit-faithful falsy-max (network.py:303-308), unrolled over the
+        # small segment: identical comparison chain to the row-major scan,
+        # so NaN/zero edge cases resolve the same way
+        rows = []
+        for s, e in zip(starts, ends):
+            acc = targetT[s]
+            for r in range(s + 1, e):
+                w = targetT[r]
+                acc = jnp.where((w > acc) & (w != 0.0), w, acc)
+            rows.append(acc)
+        return jnp.stack(rows)
+    raise ValueError(f"unknown aggregator {topo.aggregator!r}")
+
+
+def kvec_expand_popmajor(topo: Topology, aggs: jnp.ndarray) -> jnp.ndarray:
+    """(k, N) outputs -> (P, N) weights, per variant (replication /
+    inverse FFT)."""
+    if topo.variant == "fft":
+        if topo.fft_mode == "rfft":
+            return jnp.fft.irfft(aggs, n=topo.num_weights, axis=0).astype(
+                aggs.dtype)
+        return jnp.fft.ifft(aggs, n=topo.num_weights, axis=0).real.astype(
+            aggs.dtype)
+    assert topo.variant == "aggregating"
+    # matmul (not a row gather) so 0*NaN propagation matches the row-major
+    # deaggregate (aggregating.deaggregate) bit-for-bit
+    onehot = jnp.asarray(_segment_onehot(topo), aggs.dtype)
+    return matmul(topo, onehot, aggs)
+
+
+def kvec_apply_popmajor(topo: Topology, selfT: jnp.ndarray,
+                        targetT: jnp.ndarray) -> jnp.ndarray:
+    """Population-major self-application / attack: each particle's transform
+    (parameters = column of ``selfT``) rewrites the matching column of
+    ``targetT``.  Mirrors ``aggregating.apply`` / ``fft.apply`` vmapped over
+    the population, arithmetic reassociated onto lanes."""
+    if topo.variant == "fft":
+        src = targetT if topo.fft_use_target else selfT
+    else:
+        src = targetT
+    aggs = kvec_reduce_popmajor(topo, src)
+    new_aggs = _mlp_forward_lanes(topo, selfT, aggs)
+    return kvec_expand_popmajor(topo, new_aggs)
+
+
+def _kvec_epoch_grad(topo: Topology, wT: jnp.ndarray,
+                     xk: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One mse-SGD step on the single sample x = y = ``xk`` (k, N).
+    Returns (grads, per-particle pre-update loss (N,))."""
+    xk = jax.lax.stop_gradient(xk)
+
+    def loss_fn(w):
+        pred = _mlp_forward_lanes(topo, w, xk)
+        per_particle = jnp.mean((pred - xk) ** 2, axis=0)
+        return per_particle.sum(), per_particle
+
+    return jax.grad(loss_fn, has_aux=True)(wT)
+
+
+def kvec_train_epochs_popmajor(
+    topo: Topology,
+    wT: jnp.ndarray,
+    epochs: int,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``epochs`` self-training calls: samples re-reduced from the CURRENT
+    weights before every epoch (repeated ``train()``, ``network.py:613-618``).
+    One sample per epoch, so 'sequential' (batch-1) and 'full_batch' are the
+    same program.  Returns (new_wT, last epoch per-particle loss (N,))."""
+    if mode not in ("sequential", "full_batch"):
+        raise ValueError(f"unknown train mode {mode!r}")
+    if epochs <= 0:
+        return wT, jnp.zeros(wT.shape[1], wT.dtype)
+
+    def body(w, _):
+        grads, per_particle = _kvec_epoch_grad(
+            topo, w, kvec_reduce_popmajor(topo, w))
+        return w - lr * grads, per_particle
+
+    new_wT, losses = jax.lax.scan(body, wT, None, length=epochs)
+    return new_wT, losses[-1]
+
+
+def kvec_learn_epochs_popmajor(
+    topo: Topology,
+    wT: jnp.ndarray,
+    otherT: jnp.ndarray,
+    severity: int,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``severity`` imitation epochs toward the counterparts' sample (x = y =
+    other's aggregate vector, fixed across the call — ``network.py:620-626``).
+    ``otherT`` (P, N) holds each particle's counterpart column."""
+    if mode not in ("sequential", "full_batch"):
+        raise ValueError(f"unknown train mode {mode!r}")
+    if severity <= 0:
+        return wT, jnp.zeros(wT.shape[1], wT.dtype)
+    xk = jax.lax.stop_gradient(kvec_reduce_popmajor(topo, otherT))
+
+    def body(w, _):
+        grads, per_particle = _kvec_epoch_grad(topo, w, xk)
+        return w - lr * grads, per_particle
+
+    new_wT, losses = jax.lax.scan(body, wT, None, length=severity)
+    return new_wT, losses[-1]

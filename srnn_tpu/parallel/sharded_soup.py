@@ -184,8 +184,8 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     lanes across devices cannot reassociate anything; tests assert exact
     equality over multi-generation full-dynamics runs.
     """
-    from ..ops.popmajor import (ww_forward_popmajor, ww_learn_epochs_popmajor,
-                                ww_train_epochs_popmajor)
+    from ..ops.popmajor import (apply_popmajor, learn_epochs_popmajor,
+                                train_epochs_popmajor)
 
     n = config.size
     n_loc = wT_loc.shape[1]
@@ -204,7 +204,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
             jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
         att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
         has_attacker = att_loc >= 0
-        attacked = ww_forward_popmajor(topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc)
+        attacked = apply_popmajor(topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc)
         wT_loc = jnp.where(has_attacker[None, :], attacked, wT_loc)
         attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
         attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
@@ -220,7 +220,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
         if config.learn_from_severity > 0:
             post_attack = jax.lax.all_gather(wT_loc, SOUP_AXIS, axis=1, tiled=True)
-            learned, _ = ww_learn_epochs_popmajor(
+            learned, _ = learn_epochs_popmajor(
                 topo, wT_loc, post_attack[:, learn_tgt_loc],
                 config.learn_from_severity, config.lr, config.train_mode)
             wT_loc = jnp.where(learn_gate_loc[None, :], learned, wT_loc)
@@ -230,7 +230,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
 
     # --- train (soup.py:69-76) ------------------------------------------
     if config.train > 0:
-        wT_loc, train_loss = ww_train_epochs_popmajor(
+        wT_loc, train_loss = train_epochs_popmajor(
             topo, wT_loc, config.train, config.lr, config.train_mode)
     else:
         train_loss = jnp.zeros(n_loc, wT_loc.dtype)

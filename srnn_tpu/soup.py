@@ -230,7 +230,9 @@ def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, S
 
 def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
                              wT: jnp.ndarray) -> Tuple[SoupState, SoupEvents, jnp.ndarray]:
-    """Population-major twin of ``_evolve_parallel`` for weightwise soups.
+    """Population-major twin of ``_evolve_parallel`` (weightwise,
+    aggregating and fft variants — ``ops/popmajor.py`` /
+    ``ops/popmajor_kvec.py``).
 
     ``wT`` is the (P, N) transposed population (``state.weights`` is
     ignored and carried only for uid/time/key metadata); returns the new
@@ -239,8 +241,8 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
     step).  Phase order and event semantics identical to the row-major
     path; arithmetic differs only by reassociation.
     """
-    from .ops.popmajor import (ww_forward_popmajor, ww_learn_epochs_popmajor,
-                               ww_train_epochs_popmajor)
+    from .ops.popmajor import (apply_popmajor, learn_epochs_popmajor,
+                               train_epochs_popmajor)
 
     n = config.size
     topo = config.topo
@@ -253,7 +255,7 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         att_idx = jax.ops.segment_max(
             jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
         has_attacker = att_idx >= 0
-        attacked = ww_forward_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT)
+        attacked = apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT)
         wT = jnp.where(has_attacker[None, :], attacked, wT)
     else:
         attack_gate = jnp.zeros(n, bool)
@@ -264,7 +266,7 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
         learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
         if config.learn_from_severity > 0:
-            learned, _ = ww_learn_epochs_popmajor(
+            learned, _ = learn_epochs_popmajor(
                 topo, wT, wT[:, learn_tgt], config.learn_from_severity,
                 config.lr, config.train_mode)
             wT = jnp.where(learn_gate[None, :], learned, wT)
@@ -274,7 +276,7 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
 
     # --- train (soup.py:69-76) ------------------------------------------
     if config.train > 0:
-        wT, train_loss = ww_train_epochs_popmajor(
+        wT, train_loss = train_epochs_popmajor(
             topo, wT, config.train, config.lr, config.train_mode)
     else:
         train_loss = jnp.zeros(n, wT.dtype)
@@ -304,10 +306,17 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
 
 
 def _check_popmajor(config: SoupConfig) -> None:
-    if config.topo.variant != "weightwise" or config.mode != "parallel":
+    if config.topo.variant == "recurrent" or config.mode != "parallel":
         raise ValueError(
-            "layout='popmajor' supports the weightwise variant in parallel "
-            f"mode (got variant={config.topo.variant!r}, mode={config.mode!r})")
+            "layout='popmajor' supports the weightwise/aggregating/fft "
+            "variants in parallel mode (got "
+            f"variant={config.topo.variant!r}, mode={config.mode!r}); the "
+            "recurrent transform is time-bound, use layout='rowmajor'")
+    if config.topo.shuffler == "random":
+        raise ValueError(
+            "layout='popmajor' requires shuffler='not': a per-particle "
+            "random permutation of the weight axis is a per-lane gather "
+            "that defeats the lane layout — use layout='rowmajor'")
 
 
 def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:

@@ -232,6 +232,46 @@ def test_popmajor_rejects_unsupported_configs():
     with pytest.raises(ValueError):
         evolve_step(rnn_cfg, seed(SoupConfig(topo=Topology("recurrent"), size=4),
                                   jax.random.key(0)))
+    # per-particle random shuffling is a per-lane gather — rowmajor-only
+    shuf_topo = Topology("aggregating", width=2, depth=2, shuffler="random")
+    shuf_cfg = SoupConfig(topo=shuf_topo, size=4, layout="popmajor")
+    with pytest.raises(ValueError):
+        evolve_step(shuf_cfg, seed(SoupConfig(topo=shuf_topo, size=4),
+                                   jax.random.key(0)))
+
+
+@pytest.mark.parametrize("topo", [
+    Topology("aggregating", width=2, depth=2),
+    Topology("aggregating", width=2, depth=2, aggregator="max"),
+    Topology("aggregating", width=2, depth=2, aggregator="max_buggy"),
+    Topology("fft", width=2, depth=2),
+    Topology("fft", width=2, depth=2, fft_mode="rfft"),
+], ids=["agg-avg", "agg-max", "agg-max_buggy", "fft", "fft-rfft"])
+def test_popmajor_kvec_matches_rowmajor(topo):
+    """The k-vector variants ride the lane layout too (ops/popmajor_kvec.py):
+    full dynamics (attack + imitation + train + respawn) over several
+    generations must track the row-major path under the shared PRNG
+    stream."""
+    cfg_row = SoupConfig(topo=topo, size=16, attacking_rate=0.4,
+                         learn_from_rate=0.3, learn_from_severity=2, train=2,
+                         remove_divergent=True, remove_zero=True)
+    cfg_pop = cfg_row._replace(layout="popmajor")
+    st = seed(cfg_row, jax.random.key(9))
+    row_s, row_ev = evolve_step(cfg_row, st)
+    pop_s, pop_ev = evolve_step(cfg_pop, st)
+    np.testing.assert_array_equal(np.asarray(row_ev.action),
+                                  np.asarray(pop_ev.action))
+    np.testing.assert_array_equal(np.asarray(row_s.uids), np.asarray(pop_s.uids))
+    np.testing.assert_allclose(np.asarray(row_s.weights),
+                               np.asarray(pop_s.weights), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(row_ev.loss), np.asarray(pop_ev.loss),
+                               rtol=1e-3, atol=1e-6)
+    # multi-generation scan path agrees too
+    row = evolve(cfg_row, st, generations=8)
+    pop = evolve(cfg_pop, st, generations=8)
+    np.testing.assert_array_equal(np.asarray(row.uids), np.asarray(pop.uids))
+    np.testing.assert_allclose(np.asarray(row.weights), np.asarray(pop.weights),
+                               rtol=1e-3, atol=1e-5)
 
 
 # ----------------------------------------- parallel-vs-sequential statistics
