@@ -188,13 +188,25 @@ def _parse_result(stdout_bytes):
 
 
 def main():
-    t_start = time.monotonic()
     result = {
         "metric": "self-applications/sec/chip",
         "value": 0,
         "unit": "applications/s",
         "vs_baseline": 0.0,
     }
+    try:
+        _orchestrate(result)
+    except Exception as e:  # fail-soft: the one-JSON-line contract holds
+        import traceback
+
+        traceback.print_exc()
+        result.setdefault("error", f"parent: {type(e).__name__}: {e}")
+    result["vs_baseline"] = round(result["value"] / BASELINE_PER_CHIP, 2)
+    print(json.dumps(result), flush=True)
+
+
+def _orchestrate(result):
+    t_start = time.monotonic()
     errors = []
 
     env = dict(os.environ)
@@ -271,10 +283,8 @@ def main():
         if rescue is not None:
             take(rescue, "cpu-rescue")
 
-    if (full is None or ramp is None) and errors:
+    if errors:  # always surface what happened, even when a stage recovered
         result["error"] = "; ".join(errors)
-    result["vs_baseline"] = round(result["value"] / BASELINE_PER_CHIP, 2)
-    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

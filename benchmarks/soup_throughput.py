@@ -53,7 +53,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
                    Topology("aggregating", width=2, depth=2),
                    Topology("recurrent", width=2, depth=2)),
             sizes=(n - 2 * third, third, third),
-            remove_divergent=True, remove_zero=True, **dyn)
+            remove_divergent=True, remove_zero=True, layout=layout, **dyn)
         if sharded:
             from srnn_tpu.parallel import (make_sharded_multi_state,
                                            sharded_evolve_multi, soup_mesh)
@@ -123,8 +123,9 @@ def main():
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--layout", choices=("rowmajor", "popmajor"),
                    default="rowmajor",
-                   help="popmajor: (P, N) lane-major weightwise generation "
-                        "(apply/full presets only; see srnn_tpu/ops/popmajor.py)")
+                   help="popmajor: (P, N) lane-major generation — all "
+                        "presets incl. the heterogeneous 'mixed' "
+                        "(see srnn_tpu/ops/popmajor*.py)")
     p.add_argument("--train-mode", choices=("sequential", "full_batch"),
                    default="sequential",
                    help="train/learn_from SGD mode for the 'full'/'mixed' presets")
@@ -133,8 +134,6 @@ def main():
                         "(all presets incl. the heterogeneous 'mixed'; "
                         "shard_map data parallel)")
     args = p.parse_args()
-    if args.layout == "popmajor" and args.preset == "mixed":
-        p.error("--layout popmajor applies to the single-type weightwise presets")
     # the tunneled TPU backend flakes at init (sometimes raising, sometimes
     # wedging): probe with retries AND bound each phase with a watchdog that
     # still emits a JSON line (no CPU fallback — perf must be honest).  The

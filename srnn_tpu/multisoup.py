@@ -53,6 +53,10 @@ class MultiSoupConfig(NamedTuple):
     epsilon: float = DEFAULT_EPSILON
     lr: float = DEFAULT_LR
     train_mode: str = "sequential"
+    # 'popmajor' runs every per-type population as a (P_t, N_t) lane matrix
+    # (ops/popmajor*.py) — same dynamics, particle axis on the TPU lanes;
+    # requires shuffler='not' on every topo (soup._check_popmajor rationale)
+    layout: str = "rowmajor"
 
     @property
     def total(self) -> int:
@@ -131,10 +135,133 @@ def _attack_phase(config: MultiSoupConfig, weights, k_gate, k_tgt):
     return tuple(new_weights), gate, tgt
 
 
+def _check_popmajor_multi(config: MultiSoupConfig) -> None:
+    for topo in config.topos:
+        if topo.shuffler == "random":
+            raise ValueError(
+                "layout='popmajor' requires shuffler='not' on every topo "
+                "(per-lane permutation — use layout='rowmajor')")
+
+
+def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
+                           wTs: Tuple[jnp.ndarray, ...]):
+    """Population-major twin of ``evolve_multi_step``: every per-type
+    population is a (P_t, N_t) lane matrix, cross-type attacks ride
+    ``cross_apply_popmajor``, and the train/learn phases use the per-variant
+    lane kernels.  Same PRNG draws, same phase order, same event record as
+    the row-major path (parity-tested)."""
+    from .ops.popmajor import learn_epochs_popmajor, train_epochs_popmajor
+    from .ops.popmajor_cross import cross_apply_popmajor
+    from .ops.predicates import is_diverged, is_zero
+    from .soup import ACT_DIV_DEAD, ACT_ZERO_DEAD
+
+    n = config.total
+    offs = config.offsets
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+
+    # --- attack (cross-type, last-attacker-wins) ------------------------
+    if config.attacking_rate > 0:
+        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt,
+            num_segments=n)
+        new_wTs = []
+        for b, vic in enumerate(config.topos):
+            att_b = jax.lax.dynamic_slice_in_dim(att_idx, offs[b],
+                                                 config.sizes[b])
+            out = wTs[b]
+            for a, atk in enumerate(config.topos):
+                mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
+                selfT = wTs[a][:, jnp.clip(att_b - offs[a], 0,
+                                           config.sizes[a] - 1)]
+                attacked = cross_apply_popmajor(atk, selfT, vic, wTs[b])
+                out = jnp.where(mask[None, :], attacked, out)
+            new_wTs.append(out)
+        wTs = tuple(new_wTs)
+    else:
+        attack_gate = jnp.zeros(n, bool)
+        attack_tgt = jnp.zeros(n, jnp.int32)
+
+    all_uids = jnp.concatenate(state.uids)
+
+    out_wTs, new_uids, actions, counterparts, losses = [], [], [], [], []
+    total_deaths = jnp.int32(0)
+    re_keys = jax.random.split(k_re, len(config.topos))
+    for t, topo in enumerate(config.topos):
+        wT_t = wTs[t]
+        n_t = config.sizes[t]
+        sl = lambda arr: jax.lax.dynamic_slice_in_dim(arr, offs[t], n_t)
+
+        # --- learn_from (same-type teachers, post-attack weights) -------
+        if config.learn_from_rate > 0:
+            learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
+            learn_tgt = jax.random.randint(
+                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+            if config.learn_from_severity > 0:
+                learned, _ = learn_epochs_popmajor(
+                    topo, wT_t, wT_t[:, learn_tgt],
+                    config.learn_from_severity, config.lr, config.train_mode)
+                wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
+            learn_cp = state.uids[t][learn_tgt]
+        else:
+            learn_gate = jnp.zeros(n_t, bool)
+            learn_cp = jnp.zeros(n_t, jnp.int32)
+
+        # --- train ------------------------------------------------------
+        if config.train > 0:
+            wT_t, loss_t = train_epochs_popmajor(
+                topo, wT_t, config.train, config.lr, config.train_mode)
+        else:
+            loss_t = jnp.zeros(n_t, wT_t.dtype)
+
+        # --- respawn (same draws/uid blocks as the row-major _respawn) --
+        dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
+            else jnp.zeros(n_t, bool)
+        dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
+            if config.remove_zero else jnp.zeros(n_t, bool)
+        dead = dead_div | dead_zero
+        fresh = init_population(topo, re_keys[t], n_t).T
+        wT_t = jnp.where(dead[None, :], fresh, wT_t)
+        rank = jnp.cumsum(dead) - 1
+        base = state.next_uid + total_deaths
+        uids_t = jnp.where(dead, base + rank.astype(jnp.int32),
+                           state.uids[t])
+        total_deaths = total_deaths + dead.sum(dtype=jnp.int32)
+        death_action = jnp.full(n_t, ACT_NONE, jnp.int32)
+        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+        death_cp = jnp.where(dead, uids_t, -1)
+
+        action, counterpart = _event_record(
+            n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
+            learn_gate, learn_cp, config.train > 0, death_action, death_cp)
+
+        out_wTs.append(wT_t)
+        new_uids.append(uids_t)
+        actions.append(action)
+        counterparts.append(counterpart)
+        losses.append(loss_t)
+
+    new_state = MultiSoupState(
+        weights=state.weights, uids=tuple(new_uids),
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+    events = MultiSoupEvents(tuple(actions), tuple(counterparts),
+                             tuple(losses))
+    return new_state, events, tuple(out_wTs)
+
+
 @functools.partial(jax.jit, static_argnames=("config",))
 def evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
                       ) -> Tuple[MultiSoupState, MultiSoupEvents]:
     """One mixed-soup generation (phase order of ``soup.py:51-87``)."""
+    if config.layout == "popmajor":
+        _check_popmajor_multi(config)
+        new_state, events, wTs = _evolve_multi_popmajor(
+            config, state, tuple(w.T for w in state.weights))
+        return new_state._replace(weights=tuple(wT.T for wT in wTs)), events
+    if config.layout != "rowmajor":
+        raise ValueError(f"unknown multisoup layout {config.layout!r}")
     n = config.total
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
@@ -205,6 +332,23 @@ def evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
 @functools.partial(jax.jit, static_argnames=("config", "generations"))
 def evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
                  generations: int = 1) -> MultiSoupState:
+    if config.layout == "popmajor":
+        # keep every per-type carry transposed across the whole run: one
+        # transpose per type at entry/exit instead of two per generation
+        _check_popmajor_multi(config)
+
+        def body_t(carry, _):
+            s, wTs = carry
+            new_s, _ev, new_wTs = _evolve_multi_popmajor(config, s, wTs)
+            return (new_s, new_wTs), None
+
+        light = state._replace(weights=tuple(
+            jnp.zeros((0,), w.dtype) for w in state.weights))
+        (final, wTs), _ = jax.lax.scan(
+            body_t, (light, tuple(w.T for w in state.weights)), None,
+            length=generations)
+        return final._replace(weights=tuple(wT.T for wT in wTs))
+
     def body(s, _):
         new_s, _ev = evolve_multi_step(config, s)
         return new_s, None

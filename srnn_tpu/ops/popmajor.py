@@ -244,11 +244,17 @@ def ww_learn_epochs_popmajor(
 
 def apply_popmajor(topo: Topology, selfT: jnp.ndarray,
                    targetT: jnp.ndarray) -> jnp.ndarray:
-    """Population-major self-application / attack for any lane-capable
-    variant: particle n's transform (parameters ``selfT[:, n]``) rewrites
-    ``targetT[:, n]``."""
+    """Population-major self-application / attack for any variant: particle
+    n's transform (parameters ``selfT[:, n]``) rewrites ``targetT[:, n]``.
+    The recurrent variant runs the serial time scan (lanes parallelize the
+    population; the associative decomposition only matters for the
+    weight-axis-sharded path, ``parallel/sharded_apply.py``)."""
     if topo.variant == "weightwise":
         return ww_forward_popmajor(topo, selfT, targetT)
+    if topo.variant == "recurrent":
+        from .popmajor_rnn import rnn_forward_popmajor
+
+        return rnn_forward_popmajor(topo, selfT, targetT)
     from .popmajor_kvec import kvec_apply_popmajor
 
     return kvec_apply_popmajor(topo, selfT, targetT)
@@ -258,6 +264,10 @@ def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
                           lr: float = DEFAULT_LR, mode: str = "sequential"):
     if topo.variant == "weightwise":
         return ww_train_epochs_popmajor(topo, wT, epochs, lr, mode)
+    if topo.variant == "recurrent":
+        from .popmajor_rnn import rnn_train_epochs_popmajor
+
+        return rnn_train_epochs_popmajor(topo, wT, epochs, lr, mode)
     from .popmajor_kvec import kvec_train_epochs_popmajor
 
     return kvec_train_epochs_popmajor(topo, wT, epochs, lr, mode)
@@ -268,6 +278,10 @@ def learn_epochs_popmajor(topo: Topology, wT: jnp.ndarray, otherT: jnp.ndarray,
                           mode: str = "sequential"):
     if topo.variant == "weightwise":
         return ww_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)
+    if topo.variant == "recurrent":
+        from .popmajor_rnn import rnn_learn_epochs_popmajor
+
+        return rnn_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)
     from .popmajor_kvec import kvec_learn_epochs_popmajor
 
     return kvec_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)

@@ -1,0 +1,114 @@
+"""Population-major cross-architecture attacks (the lane-layout twin of
+``nets.cross.cross_apply``).
+
+Heterogeneous soups (``srnn_tpu.multisoup``) apply ANY attacker variant's
+transform to ANY victim type's weights.  In the lane layout the victim
+population is a (P_vic, N) matrix and the attacker parameters arrive as a
+(P_att, N) column-gathered matrix (attacker n rewrites victim n), so each
+(attacker-variant, victim-shape) pair lowers to the same per-lane math as
+the homogeneous kernels — only the shape constants (the victim's coordinate
+table, segment chunking of the victim's weight count, the inverse-DFT
+length) come from the victim side, mirroring ``nets/cross.py`` decision for
+decision:
+
+  * weightwise: the VICTIM's normalized duplex coordinates, the attacker's
+    MLP (``cross.py`` weightwise arm);
+  * aggregating: the victim's weight count chunked into the attacker's k
+    collections; cross-shape max is the REAL max (the falsy-max quirk is
+    same-topology-only); deaggregate is the row-gather replication;
+  * fft: always the plain DFT (the cross path ignores ``fft_mode``), source
+    = attacker's own weights unless ``fft_use_target``;
+  * recurrent: the victim's weights as the input sequence, any length.
+
+``shuffler='random'`` stays row-major-only (per-lane permutation — same
+fence as the homogeneous popmajor layout).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..topology import Topology, normalized_weight_coords, segments_for
+from .activations import resolve_activation
+from .linalg import matmul
+from .popmajor_kvec import _mlp_forward_lanes
+from .popmajor_rnn import rnn_forward_popmajor
+
+
+def _check_lane_capable(att: Topology) -> None:
+    if att.shuffler == "random":
+        raise ValueError(
+            "shuffler='random' is a per-lane permutation — use the "
+            "row-major multisoup layout")
+
+
+def _ww_cross(att: Topology, selfT: jnp.ndarray, vic: Topology,
+              targetT: jnp.ndarray) -> jnp.ndarray:
+    """Attacker's weightwise MLP over the victim's duplex points: input
+    features per victim weight p are [w_p, victim-layer, -cell, -weight]
+    (victim's own coordinate table, ``cross.py`` weightwise arm)."""
+    coords = normalized_weight_coords(vic)
+    act = resolve_activation(att.activation)
+    p, n = targetT.shape
+    h = [targetT] + [
+        jnp.broadcast_to(jnp.asarray(coords[:, k][:, None], targetT.dtype),
+                         (p, n))
+        for k in range(3)
+    ]
+    for (a, b), o in zip(att.layer_shapes, att.offsets):
+        nxt = []
+        for j in range(b):
+            acc = h[0] * selfT[o + j, :]
+            for i in range(1, a):
+                acc = acc + h[i] * selfT[o + i * b + j, :]
+            nxt.append(act(acc))
+        h = nxt
+    return h[0]
+
+
+def _agg_cross(att: Topology, selfT: jnp.ndarray,
+               targetT: jnp.ndarray) -> jnp.ndarray:
+    p = targetT.shape[0]
+    seg, counts = segments_for(p, att.aggregates)
+    if att.aggregator == "average":
+        onehotT = jnp.asarray(
+            np.eye(att.aggregates, dtype=np.float32)[seg].T, targetT.dtype)
+        aggs = matmul(att, onehotT, targetT) / jnp.asarray(
+            counts, targetT.dtype)[:, None]
+    elif att.aggregator in ("max", "max_buggy"):
+        # cross-shape max is the real max (nets/cross.py:42-47)
+        starts = np.searchsorted(seg, np.arange(att.aggregates))
+        ends = starts + counts
+        aggs = jnp.stack([jnp.max(targetT[s:e], axis=0)
+                          for s, e in zip(starts, ends)])
+    else:
+        raise ValueError(f"unknown aggregator {att.aggregator!r}")
+    new_aggs = _mlp_forward_lanes(att, selfT, aggs)
+    # replication by row gather (cross_deaggregate, nets/cross.py:51-59)
+    return new_aggs[jnp.asarray(seg)]
+
+
+def _fft_cross(att: Topology, selfT: jnp.ndarray,
+               targetT: jnp.ndarray) -> jnp.ndarray:
+    src = targetT if att.fft_use_target else selfT
+    coeffs = jnp.fft.fft(src, n=att.aggregates, axis=0).real.astype(
+        targetT.dtype)
+    new_coeffs = _mlp_forward_lanes(att, selfT, coeffs)
+    return jnp.fft.ifft(new_coeffs, n=targetT.shape[0], axis=0).real.astype(
+        targetT.dtype)
+
+
+def cross_apply_popmajor(att: Topology, selfT: jnp.ndarray, vic: Topology,
+                         targetT: jnp.ndarray) -> jnp.ndarray:
+    """Lane-layout ``cross_apply``: attacker n (parameters ``selfT[:, n]``,
+    shape (P_att, N)) rewrites victim n (``targetT[:, n]``, shape
+    (P_vic, N)).  Returns the victims' new (P_vic, N) weights."""
+    _check_lane_capable(att)
+    if att.variant == "weightwise":
+        return _ww_cross(att, selfT, vic, targetT)
+    if att.variant == "aggregating":
+        return _agg_cross(att, selfT, targetT)
+    if att.variant == "fft":
+        return _fft_cross(att, selfT, targetT)
+    if att.variant == "recurrent":
+        return rnn_forward_popmajor(att, selfT, targetT)
+    raise ValueError(f"unknown variant {att.variant!r}")

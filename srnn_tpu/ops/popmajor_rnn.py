@@ -1,0 +1,120 @@
+"""Population-major (P, N) lane layout for the recurrent variant.
+
+The SimpleRNN transform is inherently sequential over its length-T weight
+sequence (reference ``network.py:544-564``), but the POPULATION axis is
+embarrassingly parallel — so the lane layout applies exactly as it does for
+the other variants: hidden state lives as a (units, N) lane matrix, each of
+the T scan steps is ~(in+units)*units fused multiply-adds over the 128-wide
+lanes, and per-particle parameters are per-lane scalars (rows of the (P, N)
+transposed population).  The time axis stays a ``lax.scan``; what the
+layout removes is the row-major path's per-particle batched tiny matmuls
+(vmap of (1,w)@(w,w) — ~2% lane utilization).
+
+Self-training for this variant has ONE sample per epoch (x = y = the whole
+weight sequence, ``network.py:566-574``), so — like the k-vector variants —
+the batch_size=1 reference epoch is a single full-batch gradient step and
+the multi-epoch driver is scan(epochs){grad through the time scan}.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..topology import Topology
+from .activations import resolve_activation
+
+DEFAULT_LR = 0.01  # keras SGD default (mirrors train.DEFAULT_LR)
+
+
+def rnn_forward_popmajor(topo: Topology, wT: jnp.ndarray,
+                         xT: jnp.ndarray) -> jnp.ndarray:
+    """Stacked SimpleRNN over lanes: ``wT`` (P, N) per-lane parameters,
+    ``xT`` (T, N) the input sequence's single feature per lane.  Keras law
+    h_t = act(x_t @ K + h_{t-1} @ R) with kernel[i, u] at flat offset
+    ko + i*units + u and recurrent[v, u] at ro + v*units + u
+    (``Topology.layer_shapes`` interleaves kernel/recurrent per layer).
+    Returns the final layer's (T, N) output sequence."""
+    act = resolve_activation(topo.activation)
+    n = xT.shape[1]
+    x = xT[:, None, :]  # (T, in=1, N)
+    for layer, (ind, units) in enumerate(topo.rnn_layer_dims):
+        ko = topo.offsets[2 * layer]
+        ro = topo.offsets[2 * layer + 1]
+
+        def step(h, x_t, ko=ko, ro=ro, ind=ind, units=units):
+            outs = []
+            for u in range(units):
+                acc = x_t[0] * wT[ko + u, :]
+                for i in range(1, ind):
+                    acc = acc + x_t[i] * wT[ko + i * units + u, :]
+                for v in range(units):
+                    acc = acc + h[v] * wT[ro + v * units + u, :]
+                outs.append(act(acc))
+            h_new = jnp.stack(outs)
+            return h_new, h_new
+
+        h0 = jnp.zeros((units, n), xT.dtype)
+        _, x = jax.lax.scan(step, h0, x)
+    return x[:, 0, :]
+
+
+def _rnn_epoch_grad(topo: Topology, wT: jnp.ndarray,
+                    xT: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One mse-SGD step on the single sequence sample x = y = ``xT`` (T, N).
+    Returns (grads, per-particle pre-update loss (N,))."""
+    xT = jax.lax.stop_gradient(xT)
+
+    def loss_fn(w):
+        pred = rnn_forward_popmajor(topo, w, xT)
+        per_particle = jnp.mean((pred - xT) ** 2, axis=0)
+        return per_particle.sum(), per_particle
+
+    return jax.grad(loss_fn, has_aux=True)(wT)
+
+
+def rnn_train_epochs_popmajor(
+    topo: Topology,
+    wT: jnp.ndarray,
+    epochs: int,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``epochs`` self-training calls (the sample sequence is the CURRENT
+    weights, re-snapshotted before every epoch — repeated ``train()``,
+    ``network.py:613-618``)."""
+    if mode not in ("sequential", "full_batch"):
+        raise ValueError(f"unknown train mode {mode!r}")
+    if epochs <= 0:
+        return wT, jnp.zeros(wT.shape[1], wT.dtype)
+
+    def body(w, _):
+        grads, per_particle = _rnn_epoch_grad(topo, w, w)
+        return w - lr * grads, per_particle
+
+    new_wT, losses = jax.lax.scan(body, wT, None, length=epochs)
+    return new_wT, losses[-1]
+
+
+def rnn_learn_epochs_popmajor(
+    topo: Topology,
+    wT: jnp.ndarray,
+    otherT: jnp.ndarray,
+    severity: int,
+    lr: float = DEFAULT_LR,
+    mode: str = "sequential",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``severity`` imitation epochs toward the counterparts' sequence
+    (fixed across the call — ``network.py:620-626``)."""
+    if mode not in ("sequential", "full_batch"):
+        raise ValueError(f"unknown train mode {mode!r}")
+    if severity <= 0:
+        return wT, jnp.zeros(wT.shape[1], wT.dtype)
+    xT = jax.lax.stop_gradient(otherT)
+
+    def body(w, _):
+        grads, per_particle = _rnn_epoch_grad(topo, w, xT)
+        return w - lr * grads, per_particle
+
+    new_wT, losses = jax.lax.scan(body, wT, None, length=severity)
+    return new_wT, losses[-1]

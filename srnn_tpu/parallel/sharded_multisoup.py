@@ -43,6 +43,7 @@ from ..multisoup import (
     MultiSoupConfig,
     MultiSoupEvents,
     MultiSoupState,
+    _check_popmajor_multi,
     count_multi,
     seed_multi,
 )
@@ -200,12 +201,154 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
                                       tuple(losses))
 
 
+def _local_evolve_multi_popmajor(config: MultiSoupConfig,
+                                 state: MultiSoupState,
+                                 wT_locs: Tuple[jnp.ndarray, ...]):
+    """Lane-major per-device body: ``wT_locs[t]`` is the LOCAL (P_t, N_t/D)
+    lane shard of type t (``state.weights`` carries only uid/scalar
+    metadata).  Same collectives and draw structure as
+    ``_local_evolve_multi``; the heavy phases run the per-variant popmajor
+    kernels (``ops/popmajor*.py``), cross-type attacks via
+    ``cross_apply_popmajor``."""
+    from ..ops.popmajor import learn_epochs_popmajor, train_epochs_popmajor
+    from ..ops.popmajor_cross import cross_apply_popmajor
+
+    n = config.total
+    offs = config.offsets
+    d = jax.lax.axis_index(SOUP_AXIS)
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    n_locs = [wT.shape[1] for wT in wT_locs]
+
+    all_wT = tuple(jax.lax.all_gather(wT, SOUP_AXIS, axis=1, tiled=True)
+                   for wT in wT_locs)
+    all_uids_t = tuple(jax.lax.all_gather(u, SOUP_AXIS, tiled=True)
+                       for u in state.uids)
+    all_uids = jnp.concatenate(all_uids_t)
+
+    if config.attacking_rate > 0:
+        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt,
+            num_segments=n)
+    else:
+        attack_gate = jnp.zeros(n, bool)
+        attack_tgt = jnp.zeros(n, jnp.int32)
+        att_idx = None
+
+    new_wTs, new_uids, actions, counterparts, losses = [], [], [], [], []
+    total_deaths = jnp.int32(0)
+    re_keys = jax.random.split(k_re, len(config.topos))
+    for t, topo in enumerate(config.topos):
+        n_t = config.sizes[t]
+        n_loc = n_locs[t]
+        start = offs[t] + d * n_loc
+        wT_t = wT_locs[t]
+
+        def sl(arr, start=start, n_loc=n_loc):
+            return jax.lax.dynamic_slice_in_dim(arr, start, n_loc)
+
+        # --- attack on local victims (T^2 masked lane cross-apply) ------
+        if config.attacking_rate > 0:
+            att_b = sl(att_idx)
+            out = wT_t
+            for a, attacker_topo in enumerate(config.topos):
+                mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
+                selfT = all_wT[a][:, jnp.clip(att_b - offs[a], 0,
+                                              config.sizes[a] - 1)]
+                attacked = cross_apply_popmajor(attacker_topo, selfT, topo,
+                                                wT_t)
+                out = jnp.where(mask[None, :], attacked, out)
+            wT_t = out
+
+        # --- learn_from (same-type teachers, POST-attack re-gather) -----
+        if config.learn_from_rate > 0:
+            learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
+                < config.learn_from_rate
+            learn_tgt_full = jax.random.randint(
+                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+            learn_tgt = jax.lax.dynamic_slice_in_dim(
+                learn_tgt_full, d * n_loc, n_loc)
+            if config.learn_from_severity > 0:
+                post_attack = jax.lax.all_gather(wT_t, SOUP_AXIS, axis=1,
+                                                 tiled=True)
+                learned, _ = learn_epochs_popmajor(
+                    topo, wT_t, post_attack[:, learn_tgt],
+                    config.learn_from_severity, config.lr, config.train_mode)
+                wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
+            learn_cp = all_uids_t[t][learn_tgt]
+        else:
+            learn_gate = jnp.zeros(n_loc, bool)
+            learn_cp = jnp.zeros(n_loc, jnp.int32)
+
+        # --- train ------------------------------------------------------
+        if config.train > 0:
+            wT_t, loss_t = train_epochs_popmajor(
+                topo, wT_t, config.train, config.lr, config.train_mode)
+        else:
+            loss_t = jnp.zeros(n_loc, wT_t.dtype)
+
+        # --- respawn: global per-type dead-rank, replicated fresh draws -
+        dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
+            else jnp.zeros(n_loc, bool)
+        dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
+            if config.remove_zero else jnp.zeros(n_loc, bool)
+        dead = dead_div | dead_zero
+        all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
+        rank = jnp.cumsum(all_dead) - 1
+        rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
+        fresh = init_population(topo, re_keys[t], n_t)
+        freshT_loc = jax.lax.dynamic_slice_in_dim(fresh, d * n_loc, n_loc,
+                                                  axis=0).T
+        wT_t = jnp.where(dead[None, :], freshT_loc, wT_t)
+        uid_base = state.next_uid + total_deaths
+        uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
+                           state.uids[t])
+        total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
+        death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+        death_cp = jnp.where(dead, uids_t, -1)
+
+        action, counterpart = _event_record(
+            n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
+            learn_gate, learn_cp, config.train > 0, death_action, death_cp)
+
+        new_wTs.append(wT_t)
+        new_uids.append(uids_t)
+        actions.append(action)
+        counterparts.append(counterpart)
+        losses.append(loss_t)
+
+    new_state = MultiSoupState(
+        weights=state.weights, uids=tuple(new_uids),
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+    events = MultiSoupEvents(tuple(actions), tuple(counterparts),
+                             tuple(losses))
+    return new_state, events, tuple(new_wTs)
+
+
+def _local_multi_popmajor_step(config: MultiSoupConfig,
+                               state: MultiSoupState):
+    """Single-step wrapper: transpose local (N/D, P) shards in and out."""
+    new_state, events, wTs = _local_evolve_multi_popmajor(
+        config, state, tuple(w.T for w in state.weights))
+    return new_state._replace(weights=tuple(wT.T for wT in wTs)), events
+
+
 @functools.partial(jax.jit, static_argnames=("config", "mesh"))
 def sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
                               state: MultiSoupState):
     """One mixed-soup generation with every type's particle axis sharded."""
+    if config.layout == "popmajor":
+        _check_popmajor_multi(config)
+        body = functools.partial(_local_multi_popmajor_step, config)
+    elif config.layout == "rowmajor":
+        body = functools.partial(_local_evolve_multi, config)
+    else:
+        raise ValueError(f"unknown multisoup layout {config.layout!r}")
     fn = shard_map(
-        functools.partial(_local_evolve_multi, config),
+        body,
         mesh=mesh,
         in_specs=(_mstate_specs(config),),
         out_specs=(_mstate_specs(config), _mevent_specs(config)),
@@ -219,7 +362,35 @@ def sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
                          state: MultiSoupState, generations: int = 1
                          ) -> MultiSoupState:
     """Scan ``generations`` sharded mixed-soup steps inside ONE shard_map
-    (collectives stay inside the scan)."""
+    (collectives stay inside the scan).  The popmajor layout keeps every
+    per-type local shard transposed (P_t, N_t/D) across generations."""
+    if config.layout not in ("rowmajor", "popmajor"):
+        raise ValueError(f"unknown multisoup layout {config.layout!r}")
+    if config.layout == "popmajor":
+        _check_popmajor_multi(config)
+        def local_run_t(st: MultiSoupState) -> MultiSoupState:
+            light = st._replace(weights=tuple(
+                jnp.zeros((0,), w.dtype) for w in st.weights))
+
+            def body(carry, _):
+                s, wTs = carry
+                new_s, _ev, new_wTs = _local_evolve_multi_popmajor(
+                    config, s, wTs)
+                return (new_s, new_wTs), None
+
+            (final, wTs), _ = jax.lax.scan(
+                body, (light, tuple(w.T for w in st.weights)), None,
+                length=generations)
+            return final._replace(weights=tuple(wT.T for wT in wTs))
+
+        fn = shard_map(
+            local_run_t,
+            mesh=mesh,
+            in_specs=(_mstate_specs(config),),
+            out_specs=_mstate_specs(config),
+            check_vma=False,
+        )
+        return fn(state)
 
     def local_run(st: MultiSoupState) -> MultiSoupState:
         def body(s, _):

@@ -230,9 +230,9 @@ def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, S
 
 def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
                              wT: jnp.ndarray) -> Tuple[SoupState, SoupEvents, jnp.ndarray]:
-    """Population-major twin of ``_evolve_parallel`` (weightwise,
-    aggregating and fft variants — ``ops/popmajor.py`` /
-    ``ops/popmajor_kvec.py``).
+    """Population-major twin of ``_evolve_parallel`` (all variants — the
+    per-variant lane kernels live in ``ops/popmajor.py`` /
+    ``ops/popmajor_kvec.py`` / ``ops/popmajor_rnn.py``).
 
     ``wT`` is the (P, N) transposed population (``state.weights`` is
     ignored and carried only for uid/time/key metadata); returns the new
@@ -306,12 +306,11 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
 
 
 def _check_popmajor(config: SoupConfig) -> None:
-    if config.topo.variant == "recurrent" or config.mode != "parallel":
+    if config.mode != "parallel":
         raise ValueError(
-            "layout='popmajor' supports the weightwise/aggregating/fft "
-            "variants in parallel mode (got "
-            f"variant={config.topo.variant!r}, mode={config.mode!r}); the "
-            "recurrent transform is time-bound, use layout='rowmajor'")
+            "layout='popmajor' requires mode='parallel' (got "
+            f"mode={config.mode!r}); the sequential-parity scan mutates one "
+            "particle at a time and cannot ride the lane layout")
     if config.topo.shuffler == "random":
         raise ValueError(
             "layout='popmajor' requires shuffler='not': a per-particle "

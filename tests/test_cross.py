@@ -110,3 +110,49 @@ def test_multisoup_cross_attack_actually_crosses():
     # particles get aggregate-replicated rows instead — check at least the
     # shapes/finiteness and that the step ran the cross path without error
     assert np.isfinite(np.asarray(new_state.weights[1])).all()
+
+
+def test_multisoup_popmajor_matches_rowmajor():
+    """The lane-major mixed soup (layout='popmajor',
+    ops/popmajor_cross.py) must track the row-major path under the shared
+    PRNG stream: full dynamics with all four variants, cross-type attacks
+    included, single step and the multi-generation carry."""
+    cfg_row = MultiSoupConfig(
+        topos=(TOPOS["weightwise"], TOPOS["aggregating"], TOPOS["fft"],
+               TOPOS["recurrent"]),
+        sizes=(6, 5, 4, 5), attacking_rate=0.5, learn_from_rate=0.3,
+        learn_from_severity=2, train=2,
+        remove_divergent=True, remove_zero=True)
+    cfg_pop = cfg_row._replace(layout="popmajor")
+    st = seed_multi(cfg_row, jax.random.key(3))
+    row_s, row_ev = evolve_multi_step(cfg_row, st)
+    pop_s, pop_ev = evolve_multi_step(cfg_pop, st)
+    for t in range(4):
+        np.testing.assert_array_equal(np.asarray(row_ev.action[t]),
+                                      np.asarray(pop_ev.action[t]))
+        np.testing.assert_array_equal(np.asarray(row_s.uids[t]),
+                                      np.asarray(pop_s.uids[t]))
+        np.testing.assert_allclose(np.asarray(row_s.weights[t]),
+                                   np.asarray(pop_s.weights[t]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(row_ev.loss[t]),
+                                   np.asarray(pop_ev.loss[t]),
+                                   rtol=1e-3, atol=1e-6)
+    row = evolve_multi(cfg_row, st, generations=6)
+    pop = evolve_multi(cfg_pop, st, generations=6)
+    assert int(pop.time) == 6
+    for t in range(4):
+        np.testing.assert_array_equal(np.asarray(row.uids[t]),
+                                      np.asarray(pop.uids[t]))
+        np.testing.assert_allclose(np.asarray(row.weights[t]),
+                                   np.asarray(pop.weights[t]),
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_multisoup_popmajor_rejects_random_shuffler():
+    shuf = Topology("aggregating", width=2, depth=2, shuffler="random")
+    cfg = MultiSoupConfig(topos=(TOPOS["weightwise"], shuf), sizes=(2, 2),
+                          layout="popmajor")
+    base = MultiSoupConfig(topos=(TOPOS["weightwise"], shuf), sizes=(2, 2))
+    with pytest.raises(ValueError):
+        evolve_multi_step(cfg, seed_multi(base, jax.random.key(0)))

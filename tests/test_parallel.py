@@ -105,15 +105,31 @@ def test_sharded_popmajor_multigeneration_bitwise(mesh):
     assert int(counts.sum()) == 24
 
 
-def test_sharded_popmajor_rejects_non_weightwise(mesh):
+def test_sharded_popmajor_aggregating_matches_unsharded(mesh):
+    """All variants ride the sharded lane layout now; the aggregating soup's
+    sharded popmajor step must match the single-device popmajor step
+    (fence remains only for shuffler='random')."""
     from srnn_tpu import Topology
+    from srnn_tpu.soup import evolve_step
 
     cfg = SoupConfig(topo=Topology("aggregating", width=2, depth=2),
-                     size=16, layout="popmajor")
-    state = make_sharded_state(cfg._replace(layout="rowmajor"), mesh,
-                               jax.random.key(9))
+                     size=16, attacking_rate=0.5, train=1,
+                     remove_divergent=True, remove_zero=True,
+                     layout="popmajor")
+    s0 = seed(cfg, jax.random.key(9))
+    ref, _ = evolve_step(cfg, s0)
+    state = make_sharded_state(cfg, mesh, jax.random.key(9))
+    got, _ = sharded_evolve_step(cfg, mesh, state)
+    np.testing.assert_allclose(np.asarray(ref.weights), np.asarray(got.weights),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(got.uids))
+
+    shuf_topo = Topology("aggregating", width=2, depth=2, shuffler="random")
+    shuf_cfg = SoupConfig(topo=shuf_topo, size=16, layout="popmajor")
+    shuf_state = make_sharded_state(shuf_cfg._replace(layout="rowmajor"), mesh,
+                                    jax.random.key(9))
     with pytest.raises(ValueError):
-        sharded_evolve_step(cfg, mesh, state)
+        sharded_evolve_step(shuf_cfg, mesh, shuf_state)
 
 
 def test_sharded_multisoup_step_matches_unsharded(mesh):
@@ -300,3 +316,46 @@ def test_sharded_apply_unsupported_options_raise(mesh):
     with pytest.raises(NotImplementedError):
         sharded_fft_apply(
             Topology("fft", shuffler="random"), mesh, w, w)
+
+
+def test_sharded_multisoup_popmajor_matches_unsharded(mesh):
+    """The lane-major sharded mixed soup (per-type (P_t, N_t/D) shards,
+    cross_apply_popmajor attacks) matches the unsharded popmajor path:
+    integer state exactly, weights to reduction tolerance; multi-generation
+    scan included."""
+    from srnn_tpu import Topology
+    from srnn_tpu.multisoup import (MultiSoupConfig, evolve_multi,
+                                    evolve_multi_step, seed_multi)
+    from srnn_tpu.parallel import (make_sharded_multi_state,
+                                   sharded_evolve_multi,
+                                   sharded_evolve_multi_step)
+
+    cfg = MultiSoupConfig(
+        topos=(Topology("weightwise", width=2, depth=2),
+               Topology("aggregating", width=2, depth=2),
+               Topology("recurrent", width=2, depth=2)),
+        sizes=(16, 8, 8),
+        attacking_rate=0.5, learn_from_rate=0.3, learn_from_severity=1,
+        train=1, remove_divergent=True, remove_zero=True, layout="popmajor")
+    s0 = seed_multi(cfg, jax.random.key(21))
+    ref, ev_ref = evolve_multi_step(cfg, s0)
+    sh0 = make_sharded_multi_state(cfg, mesh, jax.random.key(21))
+    got, ev_got = sharded_evolve_multi_step(cfg, mesh, sh0)
+    for t in range(3):
+        np.testing.assert_allclose(np.asarray(ref.weights[t]),
+                                   np.asarray(got.weights[t]),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ref.uids[t]),
+                                      np.asarray(got.uids[t]))
+        np.testing.assert_array_equal(np.asarray(ev_ref.action[t]),
+                                      np.asarray(ev_got.action[t]))
+    assert int(ref.next_uid) == int(got.next_uid)
+
+    ref8 = evolve_multi(cfg, s0, generations=6)
+    sh8 = sharded_evolve_multi(cfg, mesh, sh0, generations=6)
+    for t in range(3):
+        np.testing.assert_allclose(np.asarray(ref8.weights[t]),
+                                   np.asarray(sh8.weights[t]),
+                                   rtol=5e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(ref8.uids[t]),
+                                      np.asarray(sh8.uids[t]))

@@ -228,10 +228,6 @@ def test_popmajor_rejects_unsupported_configs():
     with pytest.raises(ValueError):
         evolve_step(mkconfig(layout="popmajor", mode="sequential"),
                     seed(mkconfig(), jax.random.key(0)))
-    rnn_cfg = SoupConfig(topo=Topology("recurrent"), size=4, layout="popmajor")
-    with pytest.raises(ValueError):
-        evolve_step(rnn_cfg, seed(SoupConfig(topo=Topology("recurrent"), size=4),
-                                  jax.random.key(0)))
     # per-particle random shuffling is a per-lane gather — rowmajor-only
     shuf_topo = Topology("aggregating", width=2, depth=2, shuffler="random")
     shuf_cfg = SoupConfig(topo=shuf_topo, size=4, layout="popmajor")
@@ -246,12 +242,13 @@ def test_popmajor_rejects_unsupported_configs():
     Topology("aggregating", width=2, depth=2, aggregator="max_buggy"),
     Topology("fft", width=2, depth=2),
     Topology("fft", width=2, depth=2, fft_mode="rfft"),
-], ids=["agg-avg", "agg-max", "agg-max_buggy", "fft", "fft-rfft"])
-def test_popmajor_kvec_matches_rowmajor(topo):
-    """The k-vector variants ride the lane layout too (ops/popmajor_kvec.py):
-    full dynamics (attack + imitation + train + respawn) over several
-    generations must track the row-major path under the shared PRNG
-    stream."""
+    Topology("recurrent", width=2, depth=2),
+], ids=["agg-avg", "agg-max", "agg-max_buggy", "fft", "fft-rfft", "rnn"])
+def test_popmajor_variants_match_rowmajor(topo):
+    """The k-vector and recurrent variants ride the lane layout too
+    (ops/popmajor_kvec.py, ops/popmajor_rnn.py): full dynamics (attack +
+    imitation + train + respawn) over several generations must track the
+    row-major path under the shared PRNG stream."""
     cfg_row = SoupConfig(topo=topo, size=16, attacking_rate=0.4,
                          learn_from_rate=0.3, learn_from_severity=2, train=2,
                          remove_divergent=True, remove_zero=True)
