@@ -1,6 +1,7 @@
 from .mesh import soup_mesh, shard_population, replicate, initialize_distributed
 from .sharded_soup import (
     make_sharded_state,
+    place_sharded_state,
     sharded_evolve,
     sharded_evolve_step,
     sharded_count,
@@ -29,6 +30,7 @@ __all__ = [
     "replicate",
     "initialize_distributed",
     "make_sharded_state",
+    "place_sharded_state",
     "sharded_evolve_step",
     "sharded_evolve",
     "sharded_count",

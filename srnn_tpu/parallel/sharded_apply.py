@@ -9,9 +9,11 @@ its exact semantics:
 
   * weightwise  — embarrassingly parallel over weight points: each device
     rewrites its local chunk with the replicated tiny MLP; NO collective.
-  * aggregating — local partial segment sums + one ``psum`` of (k,) sums;
-    the k-vector MLP runs replicated; deaggregation is local replication.
-    (reference ``collect_weights`` chunk rule, ``network.py:388-403``.)
+  * aggregating — local partial segment sums + one ``psum`` of (k,) sums
+    ('average'; the max aggregators use per-segment partial maxima + one
+    ``pmax``); the k-vector MLP runs replicated; deaggregation is local
+    replication.  (reference ``collect_weights`` chunk rule,
+    ``network.py:388-403``.)
   * fft         — the truncated DFT/inverse pair becomes small cos-basis
     matmuls: a ``psum`` assembles the k input bins, each device synthesizes
     its local slice of the inverse transform.  Matches
@@ -97,39 +99,66 @@ def sharded_aggregating_apply(topo: Topology, mesh: Mesh, self_flat: jax.Array,
     """Aggregating transform with the (P,) target sharded over the mesh.
 
     Collect (reference chunks-of-``P//k``-with-leftovers-to-last rule,
-    ``network.py:388-403``) becomes: local one-hot partial sums ->
-    ``psum`` of a (k,) vector -> divide by the constant counts.  Only the
-    'average' aggregator is defined under sharding (the reference default);
-    max aggregators and the random shuffler need global order and raise.
+    ``network.py:388-403``) becomes, per aggregator:
+
+      * 'average'  — local one-hot partial sums -> ``psum`` of a (k,)
+        vector -> divide by the constant counts;
+      * 'max'      — local per-segment partial maxima -> ``pmax``;
+      * 'max_buggy' — the falsy-max quirk (``network.py:303-308``) in its
+        order-free closed form: a candidate wins only if nonzero OR it is
+        the segment's first element, so the result is the masked max of
+        {first} ∪ {nonzero rest}.  Identical to the sequential comparison
+        chain for finite inputs; a NaN later in a segment propagates here
+        where the chain would ignore it (divergent particles are
+        respawned upstream, so the difference is unobservable in soups).
+
+    The random shuffler needs a global permutation and raises.
     """
     assert topo.variant == "aggregating"
-    if topo.aggregator != "average" or topo.shuffler != "not":
-        raise NotImplementedError(
-            "sharded aggregating supports aggregator='average', shuffler='not'")
+    if topo.shuffler != "not":
+        raise NotImplementedError("sharded aggregating supports shuffler='not'")
     n_dev = mesh.devices.size
     p = target_flat.shape[0]
     k = topo.aggregates
     seg, counts = segments_for(p, k)
-    # padded tail gets segment id k (an extra bin discarded after psum)
+    # padded tail gets segment id k (an extra bin discarded after the
+    # collective)
     seg_pad = _pad_to(jnp.asarray(seg, jnp.int32), n_dev)
     pad = seg_pad.shape[0] - p
     if pad:
         seg_pad = seg_pad.at[p:].set(k)
     tgt = _pad_to(target_flat, n_dev)
     counts = jnp.asarray(counts, target_flat.dtype)
+    if topo.aggregator == "max_buggy":
+        # constant mask: each segment's FIRST position is always a candidate
+        starts = np.searchsorted(seg, np.arange(k))
+        first_np = np.zeros(seg_pad.shape[0], bool)
+        first_np[starts] = True
+        first_pad = jnp.asarray(first_np)
+    else:
+        first_pad = jnp.zeros(seg_pad.shape[0], bool)
 
-    def body(self_flat, tgt_loc, seg_loc):
+    def body(self_flat, tgt_loc, seg_loc, first_loc):
         onehot = jax.nn.one_hot(seg_loc, k + 1, dtype=tgt_loc.dtype)[:, :k]
-        partial = matmul(topo, tgt_loc, onehot)            # (k,) local sums
-        aggs = jax.lax.psum(partial, SOUP_AXIS) / counts
+        if topo.aggregator == "average":
+            partial = matmul(topo, tgt_loc, onehot)        # (k,) local sums
+            aggs = jax.lax.psum(partial, SOUP_AXIS) / counts
+        else:
+            if topo.aggregator == "max_buggy":
+                cand = first_loc | (tgt_loc != 0.0)
+                vals = jnp.where(cand, tgt_loc, -jnp.inf)
+            else:  # real max (quirk deliberately fixed, aggregating.py:41-45)
+                vals = tgt_loc
+            partial = jax.ops.segment_max(vals, seg_loc, num_segments=k + 1)[:k]
+            aggs = jax.lax.pmax(partial, SOUP_AXIS)
         new_aggs = mlp_forward(topo, self_flat, aggs[None, :])[0]
         return matmul(topo, onehot, new_aggs)              # local deaggregate
 
     out = shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(SOUP_AXIS), P(SOUP_AXIS)),
+        in_specs=(P(), P(SOUP_AXIS), P(SOUP_AXIS), P(SOUP_AXIS)),
         out_specs=P(SOUP_AXIS), check_vma=False,
-    )(self_flat, tgt, seg_pad)
+    )(self_flat, tgt, seg_pad, first_pad)
     return out[:p]
 
 

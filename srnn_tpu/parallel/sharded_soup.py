@@ -65,34 +65,48 @@ from ..engine import classify_batch
 from .mesh import SOUP_AXIS
 
 
-def _state_specs():
+def _soup_axes(mesh: Mesh):
+    """The mesh axis name(s) the particle dimension is sharded over.
+
+    A 1-D ``soup_mesh`` uses the single ICI axis; a
+    ``multihost.multislice_soup_mesh`` adds the outer DCN axis, and the
+    particle dimension shards over BOTH (``P((DCN_AXIS, SOUP_AXIS))``) so
+    each slice owns a contiguous block.  Every collective in the local
+    bodies takes this name (or tuple of names) — the bodies are genuinely
+    axis-agnostic, which is what makes the same code the DCN tier."""
+    return tuple(mesh.axis_names) if len(mesh.axis_names) > 1 else SOUP_AXIS
+
+
+def _state_specs(axes=SOUP_AXIS):
     return SoupState(
-        weights=P(SOUP_AXIS),
-        uids=P(SOUP_AXIS),
+        weights=P(axes),
+        uids=P(axes),
         next_uid=P(),
         time=P(),
         key=P(),
     )
 
 
-def _event_specs():
-    return SoupEvents(action=P(SOUP_AXIS), counterpart=P(SOUP_AXIS), loss=P(SOUP_AXIS))
+def _event_specs(axes=SOUP_AXIS):
+    return SoupEvents(action=P(axes), counterpart=P(axes), loss=P(axes))
 
 
-def _local_evolve(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
+def _local_evolve(config: SoupConfig, state: SoupState,
+                  axes=SOUP_AXIS) -> Tuple[SoupState, SoupEvents]:
     """Per-device body. ``state.weights``/``uids`` hold the LOCAL shard;
-    scalars and the key are replicated."""
+    scalars and the key are replicated.  ``axes`` is the mesh axis name (or
+    tuple: multislice DCN+ICI) the particle dimension shards over."""
     n = config.size
     w_loc = state.weights
     n_loc = w_loc.shape[0]
-    d = jax.lax.axis_index(SOUP_AXIS)
+    d = jax.lax.axis_index(axes)
     start = d * n_loc
     topo = config.topo
 
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
 
     # one collective: everyone sees the start-of-generation population
-    all_w = jax.lax.all_gather(w_loc, SOUP_AXIS, tiled=True)  # (N, P)
+    all_w = jax.lax.all_gather(w_loc, axes, tiled=True)  # (N, P)
 
     # --- attack ---------------------------------------------------------
     if config.attacking_rate > 0:
@@ -143,7 +157,7 @@ def _local_evolve(config: SoupConfig, state: SoupState) -> Tuple[SoupState, Soup
     if config.remove_zero:
         dead_now = dead_now | is_zero(w_loc, config.epsilon)
     local_deaths = dead_now.sum(dtype=jnp.int32)
-    deaths_by_dev = jax.lax.all_gather(local_deaths, SOUP_AXIS)  # (D,)
+    deaths_by_dev = jax.lax.all_gather(local_deaths, axes)  # (D,)
     my_uid_base = state.next_uid + jnp.sum(
         jnp.where(jnp.arange(deaths_by_dev.shape[0]) < d, deaths_by_dev, 0))
     new_w, new_uids, _, death_action, death_cp = _respawn(
@@ -152,7 +166,7 @@ def _local_evolve(config: SoupConfig, state: SoupState) -> Tuple[SoupState, Soup
 
     # --- event record (last action wins, shared tail) -------------------
     # uid of a global index: gather from the uid table
-    all_uids = jax.lax.all_gather(state.uids, SOUP_AXIS, tiled=True)
+    all_uids = jax.lax.all_gather(state.uids, axes, tiled=True)
     action, counterpart = _event_record(
         n_loc, attack_gate_loc, all_uids[attack_tgt_loc],
         learn_gate_loc, all_uids[learn_tgt_loc],
@@ -163,7 +177,7 @@ def _local_evolve(config: SoupConfig, state: SoupState) -> Tuple[SoupState, Soup
 
 
 def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
-                           wT_loc: jnp.ndarray):
+                           wT_loc: jnp.ndarray, axes=SOUP_AXIS):
     """Per-device popmajor generation body: ``wT_loc`` is the LOCAL (P, N/D)
     lane-major shard; ``state.weights`` is ignored (uids are the local shard,
     scalars/key replicated).
@@ -189,7 +203,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
 
     n = config.size
     n_loc = wT_loc.shape[1]
-    d = jax.lax.axis_index(SOUP_AXIS)
+    d = jax.lax.axis_index(axes)
     start = d * n_loc
     topo = config.topo
 
@@ -197,7 +211,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
 
     # --- attack (soup.py:56-61); last-attacker-wins, same as single-device
     if config.attacking_rate > 0:
-        all_wT = jax.lax.all_gather(wT_loc, SOUP_AXIS, axis=1, tiled=True)
+        all_wT = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
         attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
         attack_tgt = jax.random.randint(k_at, (n,), 0, n)
         att_idx = jax.ops.segment_max(
@@ -219,7 +233,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start, n_loc)
         learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
         if config.learn_from_severity > 0:
-            post_attack = jax.lax.all_gather(wT_loc, SOUP_AXIS, axis=1, tiled=True)
+            post_attack = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
             learned, _ = learn_epochs_popmajor(
                 topo, wT_loc, post_attack[:, learn_tgt_loc],
                 config.learn_from_severity, config.lr, config.train_mode)
@@ -241,7 +255,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     dead_zero = (is_zero(wT_loc, config.epsilon, axis=0) & ~dead_div) \
         if config.remove_zero else jnp.zeros(n_loc, bool)
     dead = dead_div | dead_zero
-    all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (N,) device order
+    all_dead = jax.lax.all_gather(dead, axes, tiled=True)  # (N,) device order
     rank = jnp.cumsum(all_dead) - 1
     rank_loc = jax.lax.dynamic_slice_in_dim(rank, start, n_loc)
     # every device draws the same global fresh population and keeps its rows:
@@ -258,7 +272,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     death_cp = jnp.where(dead, uids, -1)
 
     # --- event record (last action wins) --------------------------------
-    all_uids = jax.lax.all_gather(state.uids, SOUP_AXIS, tiled=True)
+    all_uids = jax.lax.all_gather(state.uids, axes, tiled=True)
     action, counterpart = _event_record(
         n_loc, attack_gate_loc, all_uids[attack_tgt_loc],
         learn_gate_loc, all_uids[learn_tgt_loc],
@@ -268,28 +282,30 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     return new_state, SoupEvents(action, counterpart, train_loss), wT_loc
 
 
-def _local_popmajor_step(config: SoupConfig, state: SoupState):
+def _local_popmajor_step(config: SoupConfig, state: SoupState,
+                         axes=SOUP_AXIS):
     """Single-step wrapper: transpose the local (N/D, P) shard in and out."""
     new_state, events, wT = _local_evolve_popmajor(config, state,
-                                                   state.weights.T)
+                                                   state.weights.T, axes)
     return new_state._replace(weights=wT.T), events
 
 
 @functools.partial(jax.jit, static_argnames=("config", "mesh"))
 def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
     """One generation with the particle axis sharded over ``mesh``."""
+    axes = _soup_axes(mesh)
     if config.layout == "popmajor":
         _check_popmajor(config)
-        body = functools.partial(_local_popmajor_step, config)
+        body = functools.partial(_local_popmajor_step, config, axes=axes)
     elif config.layout == "rowmajor":
-        body = functools.partial(_local_evolve, config)
+        body = functools.partial(_local_evolve, config, axes=axes)
     else:
         raise ValueError(f"unknown soup layout {config.layout!r}")
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(_state_specs(),),
-        out_specs=(_state_specs(), _event_specs()),
+        in_specs=(_state_specs(axes),),
+        out_specs=(_state_specs(axes), _event_specs(axes)),
         check_vma=False,
     )
     return fn(state)
@@ -304,6 +320,7 @@ def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations
     the local shard kept transposed (P, N/D) across generations — one
     transpose at entry/exit instead of two per step, mirroring the
     single-device ``soup.evolve`` fast path."""
+    axes = _soup_axes(mesh)
     if config.layout == "popmajor":
         _check_popmajor(config)
 
@@ -312,7 +329,8 @@ def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations
 
             def body(carry, _):
                 s, wT = carry
-                new_s, _ev, new_wT = _local_evolve_popmajor(config, s, wT)
+                new_s, _ev, new_wT = _local_evolve_popmajor(config, s, wT,
+                                                            axes)
                 return (new_s, new_wT), None
 
             (final, wT), _ = jax.lax.scan(
@@ -322,8 +340,8 @@ def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations
         fn = shard_map(
             local_run,
             mesh=mesh,
-            in_specs=(_state_specs(),),
-            out_specs=_state_specs(),
+            in_specs=(_state_specs(axes),),
+            out_specs=_state_specs(axes),
             check_vma=False,
         )
         return fn(state)
@@ -340,27 +358,39 @@ def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations
 def sharded_count(config: SoupConfig, mesh: Mesh, state: SoupState) -> jnp.ndarray:
     """(5,) global class histogram: local classify + psum."""
 
+    axes = _soup_axes(mesh)
+
     def local_count(w_loc):
         return count_classes(classify_batch(config.topo, w_loc, config.epsilon))
 
     fn = shard_map(
-        lambda w: jax.lax.psum(local_count(w), SOUP_AXIS),
+        lambda w: jax.lax.psum(local_count(w), axes),
         mesh=mesh,
-        in_specs=(P(SOUP_AXIS),),
+        in_specs=(P(axes),),
         out_specs=P(),
         check_vma=False,
     )
     return fn(state.weights)
 
 
-def make_sharded_state(config: SoupConfig, mesh: Mesh, key: jax.Array) -> SoupState:
-    """Seed a population already placed with the soup sharding."""
+def place_sharded_state(mesh: Mesh, state: SoupState) -> SoupState:
+    """Place an existing ``SoupState`` (fresh-seeded or checkpoint-restored)
+    with the soup sharding: particle-axis arrays sharded, scalars/key
+    replicated."""
+    n = state.weights.shape[0]
     n_dev = mesh.devices.size
-    if config.size % n_dev:
+    if n % n_dev:
+        # fail fast with the same clear message the fresh-start path gives —
+        # e.g. a checkpoint resumed on a host with a different device count
         raise ValueError(
-            f"soup size {config.size} must be divisible by the mesh's "
-            f"{n_dev} devices (each device owns an equal shard)")
-    state = seed(config, key)
-    specs = _state_specs()
+            f"soup size {n} must be divisible by the mesh's {n_dev} devices "
+            f"(each device owns an equal shard)")
+    specs = _state_specs(_soup_axes(mesh))
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), state, specs)
+
+
+def make_sharded_state(config: SoupConfig, mesh: Mesh, key: jax.Array) -> SoupState:
+    """Seed a population already placed with the soup sharding (divisibility
+    validated by ``place_sharded_state``)."""
+    return place_sharded_state(mesh, seed(config, key))

@@ -20,3 +20,16 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    """The 8-device virtual soup mesh (shared by the sharded-soup and
+    capture test modules)."""
+    from srnn_tpu.parallel import soup_mesh
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return soup_mesh()
