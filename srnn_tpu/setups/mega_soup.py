@@ -50,6 +50,11 @@ def build_parser():
                         "--checkpoint-every")
     p.add_argument("--resume", default=None, metavar="RUN_DIR",
                    help="continue a previous run from its latest checkpoint")
+    p.add_argument("--sharded", action="store_true",
+                   help="shard the particle axis over ALL visible devices "
+                        "(shard_map data parallel); trajectory capture then "
+                        "writes one .traj shard per process (multihost-safe) "
+                        "merged offline by read_sharded_store")
     return p
 
 
@@ -66,7 +71,8 @@ def _latest_checkpoint(run_dir: str):
 
 
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
-                  "train_mode", "layout", "epsilon", "capture_every")
+                  "train_mode", "layout", "epsilon", "capture_every",
+                  "sharded")
 
 
 def _save_config(run_dir: str, args) -> None:
@@ -105,53 +111,93 @@ def run(args):
         ckpt = _latest_checkpoint(args.resume)
     if args.capture_every and args.checkpoint_every % args.capture_every:
         raise SystemExit("--capture-every must divide --checkpoint-every")
+    if args.capture_every and args.generations % args.capture_every:
+        # otherwise the FINAL partial chunk (generations % checkpoint_every)
+        # fails evolve_captured's divisibility check hours into the run
+        raise SystemExit("--capture-every must divide --generations")
     cfg = _make_config(args)
+
+    mesh = None
+    if args.sharded:
+        from ..parallel import soup_mesh
+        mesh = soup_mesh()
 
     if args.resume:
         exp = Experiment.attach(args.resume)
         state = restore_checkpoint(ckpt)
+        if mesh is not None:
+            from ..parallel import place_sharded_state
+            state = place_sharded_state(mesh, state)
         exp.log(f"resumed from {os.path.basename(ckpt)} "
                 f"at generation {int(state.time)}")
     else:
         exp = Experiment("mega-soup", root=args.root, seed=args.seed).__enter__()
         _save_config(exp.dir, args)
-        state = seed(cfg, jax.random.key(args.seed))
+        if mesh is not None:
+            from ..parallel import make_sharded_state
+            state = make_sharded_state(cfg, mesh, jax.random.key(args.seed))
+        else:
+            state = seed(cfg, jax.random.key(args.seed))
         exp.log(f"mega-soup N={cfg.size} layout={cfg.layout} "
-                f"attack={cfg.attacking_rate} train={cfg.train}/{cfg.train_mode}")
+                f"attack={cfg.attacking_rate} train={cfg.train}/{cfg.train_mode}"
+                + (f" sharded over {mesh.devices.size} devices"
+                   if mesh is not None else ""))
+
+    def _count(s):
+        if mesh is not None:
+            from ..parallel import sharded_count
+            return np.asarray(sharded_count(cfg, mesh, s))
+        return np.asarray(count(cfg, s))
 
     store = None
     import time as _time
     try:
         if args.capture_every:
-            from ..utils import TrajStore, truncate_frames
+            from ..utils import TrajStore, truncate_sharded_frames
             traj_path = os.path.join(exp.dir, "soup.traj")
             if args.resume:
                 # drop frames captured AFTER the restored checkpoint (a kill
                 # between a capture flush and the next checkpoint finalizing)
-                # so the re-evolved generations aren't appended twice
-                truncate_frames(traj_path, int(state.time) // args.capture_every)
+                # so the re-evolved generations aren't appended twice —
+                # across every per-process shard in a sharded run
+                truncate_sharded_frames(
+                    traj_path, int(state.time) // args.capture_every)
             # resume APPENDS to the existing store (header-validated, torn
             # tail dropped) — previously captured frames are never lost
-            store = TrajStore(traj_path,
-                              n_particles=cfg.size,
-                              n_weights=cfg.topo.num_weights,
-                              mode="a" if args.resume else "w")
+            if mesh is not None:
+                from ..utils import open_process_shard
+                store = open_process_shard(cfg, traj_path,
+                                           mode="a" if args.resume else "w")
+            else:
+                store = TrajStore(traj_path,
+                                  n_particles=cfg.size,
+                                  n_weights=cfg.topo.num_weights,
+                                  mode="a" if args.resume else "w")
             if store.existing_frames:
                 exp.log(f"soup.traj: appending after "
                         f"{store.existing_frames} existing frames")
             exp.log(f"capturing every {args.capture_every} generations "
-                    f"to soup.traj")
-        counts = np.asarray(count(cfg, state))
+                    f"to soup.traj"
+                    + (f" ({jax.process_count()} process shards)"
+                       if mesh is not None and jax.process_count() > 1 else ""))
+        counts = _count(state)
         while int(state.time) < args.generations:
             chunk = min(args.checkpoint_every, args.generations - int(state.time))
             t0 = _time.perf_counter()
-            if store is not None:
+            if store is not None and mesh is not None:
+                from ..utils import sharded_evolve_captured
+                state = sharded_evolve_captured(cfg, mesh, state, chunk, store,
+                                                every=args.capture_every)
+            elif store is not None:
                 from ..utils import evolve_captured
                 state = evolve_captured(cfg, state, chunk, store,
                                         every=args.capture_every)
+            elif mesh is not None:
+                from ..parallel import sharded_evolve
+                state = sharded_evolve(cfg, mesh, state, generations=chunk)
             else:
                 state = evolve(cfg, state, generations=chunk)
-            counts = np.asarray(count(cfg, state))
+            counts = _count(state)
             dt = _time.perf_counter() - t0
             gen = int(state.time)
             exp.log(f"gen {gen}/{args.generations}  {chunk / dt:.2f} gens/s  "

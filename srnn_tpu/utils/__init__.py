@@ -1,13 +1,16 @@
-from .trajstore import (TrajStore, read_store, read_store_artifact,
-                        truncate_frames)
-from .capture import evolve_captured
+from .trajstore import (TrajStore, read_sharded_store, read_store,
+                        read_store_artifact, shard_path, truncate_frames,
+                        truncate_sharded_frames)
+from .capture import (evolve_captured, open_process_shard,
+                      sharded_evolve_captured)
 from .profiling import phase, timed, trace
 from .debug import checked_apply_to_weights, divergence_onset
 from .printing import PrintingObject
 
 __all__ = [
     "TrajStore", "read_store", "read_store_artifact", "truncate_frames",
-    "evolve_captured",
+    "read_sharded_store", "shard_path", "truncate_sharded_frames",
+    "evolve_captured", "open_process_shard", "sharded_evolve_captured",
     "phase", "timed", "trace",
     "checked_apply_to_weights", "divergence_onset",
     "PrintingObject",

@@ -259,10 +259,7 @@ def read_store(path: str, start: int = 0, count: Optional[int] = None
 def _read_store_py(path: str, start: int, count: Optional[int]
                    ) -> Dict[str, np.ndarray]:
     with open(path, "rb") as f:
-        head = f.read(_HEADER.size)
-        magic, version, _res, n, p = _HEADER.unpack(head)
-        if magic != _MAGIC or version != _VERSION:
-            raise OSError(f"{path}: not a trajstore file")
+        n, p = _parse_header(f, path)
         frame_bytes = _frame_bytes(n, p)
         body = frame_bytes - 4
         f.seek(0, os.SEEK_END)
@@ -309,9 +306,7 @@ def truncate_frames(path: str, keep: int) -> int:
     if not os.path.exists(path) or os.path.getsize(path) < _HEADER.size:
         return 0
     with open(path, "r+b") as f:
-        magic, version, _res, n, p = _HEADER.unpack(f.read(_HEADER.size))
-        if magic != _MAGIC or version != _VERSION:
-            raise OSError(f"{path}: not a trajstore file")
+        n, p = _parse_header(f, path)
         fb = _frame_bytes(n, p)
         f.seek(0, os.SEEK_END)
         frames = (f.tell() - _HEADER.size) // fb
@@ -326,3 +321,117 @@ def read_store_artifact(path: str) -> Dict[str, np.ndarray]:
     out = read_store(path)
     out.pop("generations")
     return out
+
+
+# ---------------------------------------------------------------------------
+# Multihost shards: one .traj per process, merged on read.
+#
+# At real multi-host mega-soup scale, pulling full GLOBAL frames through one
+# process gathers ~56 MB x every captured frame over DCN (round-3 gap).
+# Instead each process appends only its addressable particle rows to its own
+# shard file; the merge reader reassembles global frames offline.  Scales
+# the reference's never-lose-history registry (soup.py:37-43) to multihost.
+# ---------------------------------------------------------------------------
+
+
+def shard_path(base: str, process_index: int, num_processes: int) -> str:
+    """Per-process shard file name.  A single-process run keeps the plain
+    ``base`` path, so existing single-host artifacts/readers are unchanged."""
+    if num_processes <= 1:
+        return base
+    return f"{base}.p{process_index:04d}of{num_processes:04d}"
+
+
+def _find_shards(base: str):
+    import glob as _glob
+    import re
+
+    paths = sorted(_glob.glob(base + ".p*of*"))
+    shards = []
+    for p in paths:
+        m = re.search(r"\.p(\d+)of(\d+)$", p)
+        if m:
+            shards.append((int(m.group(1)), int(m.group(2)), p))
+    return shards
+
+
+def _parse_header(f, path: str):
+    """Validate the magic/version and return (n_particles, n_weights).
+    Single source for every reader/maintenance path."""
+    head = f.read(_HEADER.size)
+    if len(head) < _HEADER.size:
+        raise OSError(f"{path}: truncated header")
+    magic, version, _res, n, p = _HEADER.unpack(head)
+    if magic != _MAGIC or version != _VERSION:
+        raise OSError(f"{path}: not a trajstore file")
+    return n, p
+
+
+def store_frame_count(path: str) -> int:
+    """Number of complete frames in a store, from the header + file size
+    alone (no frame data read)."""
+    with open(path, "rb") as f:
+        n, p = _parse_header(f, path)
+        f.seek(0, os.SEEK_END)
+        return (f.tell() - _HEADER.size) // _frame_bytes(n, p)
+
+
+def read_sharded_store(base: str, start: int = 0,
+                       count: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Merge per-process shards of a captured run into GLOBAL frames.
+
+    Falls back to ``read_store(base)`` when no ``.pNNNNofMMMM`` shards
+    exist (single-process store).  Shards are concatenated in process
+    order along the particle axis — processes own contiguous global row
+    blocks (``capture.sharded_evolve_captured``'s layout).  A run killed
+    mid-capture may leave shards at different lengths; only frames present
+    in EVERY shard are returned (the global frame is otherwise torn).
+
+    Only the requested [start, start+count) window is read from each
+    shard — a mega-soup global frame is ~56 MB, so reading whole shards to
+    serve one frame would not scale.
+    """
+    shards = _find_shards(base)
+    if not shards:
+        return read_store(base, start, count)
+    if os.path.exists(base):
+        # a plain base store PLUS shards means the process count changed
+        # across a resume; merging would silently drop one of the two
+        # histories — refuse instead of losing frames
+        raise OSError(
+            f"{base}: both a single-process store and per-process shards "
+            "exist; a resume must keep the original process count (or the "
+            "histories must be merged explicitly)")
+    num = shards[0][1]
+    have = sorted(s[0] for s in shards)
+    if have != list(range(num)) or any(s[1] != num for s in shards):
+        raise OSError(
+            f"{base}: incomplete shard set {have} (expected 0..{num - 1})")
+    complete = min(store_frame_count(p) for _, _, p in shards)
+    count = complete - start if count is None else count
+    if count < 0 or start + count > complete:
+        raise OSError(f"{base}: range [{start}, {start + count}) exceeds the "
+                      f"{complete} complete merged frames")
+    parts = [read_store(p, start, count) for _, _, p in shards]
+    gens = parts[0]["generations"]
+    for p in parts[1:]:
+        if not np.array_equal(p["generations"], gens):
+            raise OSError(f"{base}: shard generation sequences disagree")
+    out = {"generations": gens}
+    for key in ("weights", "uids", "action", "counterpart", "loss"):
+        out[key] = np.concatenate([p[key] for p in parts], axis=1)
+    return out
+
+
+def truncate_sharded_frames(base: str, keep: int) -> int:
+    """Resume reconciliation across shards: truncate the base store AND
+    every shard to ``keep`` frames.  Returns the resulting complete-frame
+    count (min across shards)."""
+    shards = _find_shards(base)
+    if not shards:
+        return truncate_frames(base, keep)
+    if os.path.exists(base):
+        raise OSError(
+            f"{base}: both a single-process store and per-process shards "
+            "exist; a resume must keep the original process count")
+    return min(truncate_frames(p, keep) for _, _, p in shards)

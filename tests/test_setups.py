@@ -177,3 +177,28 @@ def test_experiment_wall_seconds_cumulative(tmp_path):
     exp2.__exit__(None, None, None)
     second = json.load(open(meta_path))["wall_seconds"]
     assert second >= first + 0.05
+
+
+def test_mega_soup_sharded_capture_and_resume(tmp_path):
+    """--sharded runs the soup over the 8-device mesh with capture; an
+    interrupted sharded run resumes bit-exactly (saved config keeps
+    sharded=True) and the store appends rather than truncates."""
+    from srnn_tpu.experiment import restore_checkpoint
+    from srnn_tpu.utils import read_sharded_store
+
+    d_full = REGISTRY["mega_soup"](
+        ["--smoke", "--root", str(tmp_path / "full"), "--sharded",
+         "--capture-every", "2"])
+    d_half = REGISTRY["mega_soup"](
+        ["--smoke", "--root", str(tmp_path / "half"), "--sharded",
+         "--capture-every", "2", "--generations", "4"])
+    d_resumed = REGISTRY["mega_soup"](["--smoke", "--resume", d_half])
+    assert d_resumed == d_half
+
+    want = restore_checkpoint(os.path.join(d_full, "ckpt-gen00000006"))
+    got = restore_checkpoint(os.path.join(d_half, "ckpt-gen00000006"))
+    np.testing.assert_array_equal(np.asarray(want.weights),
+                                  np.asarray(got.weights))
+    out = read_sharded_store(os.path.join(d_half, "soup.traj"))
+    assert out["generations"].tolist() == [2, 4, 6]
+    np.testing.assert_array_equal(out["weights"][-1], np.asarray(got.weights))
