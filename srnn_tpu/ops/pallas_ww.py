@@ -7,7 +7,10 @@ weightwise transform compiles to per-particle (14x4)@(4x2) batched matmuls —
 **population-major**: the particle axis lives on the 128-wide lane
 dimension, per-particle weights become per-lane scalars, and the whole MLP
 unrolls into ~14 fused multiply-adds on (P, lane-block) tiles held in VMEM.
-One HBM read + one write per step is the roof; this kernel sits on it.
+Chaining ``steps`` applications per HBM round-trip removes the bandwidth
+roof entirely (measured: ~0.3 GB/s HBM at steps=2000 vs the 819 GB/s
+spec); the kernel is VPU-compute-bound at ~2.2 Tflop/s f32 — see the
+roofline table in RESULTS.md.
 
 Layout: ``wT`` is the transposed population, shape (P, N) — row p holds
 weight p of every particle.  The positional-encoding coordinates
