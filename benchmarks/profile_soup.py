@@ -1,0 +1,201 @@
+"""Phase breakdown of one popmajor soup generation (VERDICT r3 item 2:
+"profile, then close, the soup-generation gap").
+
+The mega-soup generation runs ~100x below the raw self-application
+kernel's rate; this tool attributes the gap by timing ISOLATED jitted
+sub-programs of the generation at mega-N, plus the composed generation
+itself:
+
+  * ``rng``      — key splits + gate/target draws (uniform + randint)
+  * ``resolve``  — last-attacker-wins victim resolution (segment_max
+                   scatter over N)
+  * ``gather``   — attacker-column gather wT[:, att] (14 x N rows)
+  * ``apply``    — the popmajor weightwise forward on pre-gathered inputs
+  * ``attack``   — gather + apply + select (the full attack phase)
+  * ``freshinit`` — init_population(N).T (respawn replacement draws —
+                   ~14M threefry floats per generation at N=1M)
+  * ``respawn``  — death masks + fresh init + select + uid cumsum
+  * ``generation`` — the real evolve step (scan of G amortized)
+
+Timing uses scalar readback (the tunneled backend's block_until_ready
+does not synchronize — same convention as bench.py).  Optionally wraps
+the composed generation in a ``jax.profiler`` trace for offline viewing.
+
+Run: ``python benchmarks/profile_soup.py [--n 1000000] [--gens 20]
+[--trace DIR] [--preset apply|full]``.  Prints one JSON line per phase.
+"""
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from srnn_tpu import Topology, init_population
+from srnn_tpu.ops.popmajor import ww_forward_popmajor
+from srnn_tpu.ops.predicates import is_diverged, is_zero
+from srnn_tpu.soup import SoupConfig, evolve, seed
+
+
+def _time(fn, *args, repeats=5):
+    """Median seconds per call of a jitted fn returning (out..., scalar)."""
+    out = fn(*args)
+    _sync = float(jax.tree.leaves(out)[-1])  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _sync = float(jax.tree.leaves(fn(*args))[-1])
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def _gen_cfg(n: int, preset: str) -> SoupConfig:
+    """The composed-generation config — ONE source for both the timed rows
+    and the optional profiler trace, so the trace shows the same dynamics
+    the JSON rows measure."""
+    dyn = dict(attacking_rate=0.1, learn_from_rate=-1.0, train=0) \
+        if preset == "apply" else \
+        dict(attacking_rate=0.1, learn_from_rate=0.1, learn_from_severity=1,
+             train=10)
+    return SoupConfig(topo=Topology("weightwise", width=2, depth=2), size=n,
+                      remove_divergent=True, remove_zero=True,
+                      layout="popmajor", **dyn)
+
+
+def phase_breakdown(n: int, gens: int, preset: str):
+    topo = Topology("weightwise", width=2, depth=2)
+    key = jax.random.key(0)
+    wT = (init_population(topo, key, n) * 0.05).T
+
+    rows = []
+
+    def report(phase, seconds):
+        rows.append({"phase": phase, "n": n,
+                     "ms": round(seconds * 1e3, 3)})
+
+    # rng: the per-generation draw set
+    @jax.jit
+    def rng(key):
+        key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(key, 6)
+        gate = jax.random.uniform(k_ag, (n,)) < 0.1
+        tgt = jax.random.randint(k_at, (n,), 0, n)
+        return key, gate, tgt, (gate.sum() + tgt.sum()).astype(jnp.float32)
+
+    report("rng", _time(rng, key))
+    _, gate, tgt, _ = rng(key)
+
+    @jax.jit
+    def resolve(gate, tgt):
+        att = jax.ops.segment_max(
+            jnp.where(gate, jnp.arange(n), -1), tgt, num_segments=n)
+        return att, att.sum().astype(jnp.float32)
+
+    report("resolve", _time(resolve, gate, tgt))
+    att, _ = resolve(gate, tgt)
+    att_c = jnp.clip(att, 0)
+
+    @jax.jit
+    def gather(wT, att_c):
+        g = wT[:, att_c]
+        return g, g.sum()
+
+    report("gather", _time(gather, wT, att_c))
+    attacker, _ = gather(wT, att_c)
+
+    @jax.jit
+    def apply_only(attacker, wT):
+        out = ww_forward_popmajor(topo, attacker, wT)
+        return out, out.sum()
+
+    report("apply", _time(apply_only, attacker, wT))
+
+    @jax.jit
+    def attack(wT, att, att_c):
+        out = ww_forward_popmajor(topo, wT[:, att_c], wT)
+        new = jnp.where((att >= 0)[None, :], out, wT)
+        return new, new.sum()
+
+    report("attack", _time(attack, wT, att, att_c))
+
+    @jax.jit
+    def freshinit(key):
+        f = init_population(topo, key, n).T
+        return f, f.sum()
+
+    report("freshinit", _time(freshinit, key))
+
+    @jax.jit
+    def respawn(wT, key):
+        dead = is_diverged(wT, axis=0) | is_zero(wT, 1e-4, axis=0)
+        fresh = init_population(topo, key, n).T
+        new = jnp.where(dead[None, :], fresh, wT)
+        rank = jnp.cumsum(dead) - 1
+        return new, rank, new.sum() + rank.sum().astype(wT.dtype)
+
+    report("respawn", _time(respawn, wT, key))
+
+    # the composed real generation, amortized over a scan
+    cfg = _gen_cfg(n, preset)
+    state = seed(cfg, jax.random.key(1))
+
+    # fused respawn-draw twin of the composed generation
+    cfg_fused = cfg._replace(respawn_draws="fused")
+
+    @functools.partial(jax.jit, static_argnames=())
+    def gen_scan(state):
+        fin = evolve(cfg, state, generations=gens)
+        return fin, fin.weights.sum()
+
+    secs = _time(gen_scan, state, repeats=3) / gens
+    rows.append({"phase": f"generation[{preset}]", "n": n,
+                 "ms": round(secs * 1e3, 3),
+                 "gens_per_sec": round(1.0 / secs, 2)})
+
+    @functools.partial(jax.jit, static_argnames=())
+    def gen_scan_fused(state):
+        fin = evolve(cfg_fused, state, generations=gens)
+        return fin, fin.weights.sum()
+
+    secs = _time(gen_scan_fused, state, repeats=3) / gens
+    rows.append({"phase": f"generation[{preset},fused-respawn]", "n": n,
+                 "ms": round(secs * 1e3, 3),
+                 "gens_per_sec": round(1.0 / secs, 2)})
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1_000_000)
+    p.add_argument("--gens", type=int, default=20)
+    p.add_argument("--preset", choices=("apply", "full"), default="apply")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="also record a jax.profiler trace of the composed "
+                        "generation scan into DIR")
+    args = p.parse_args()
+
+    from srnn_tpu.utils.backend import ensure_backend, watchdog
+
+    cancel = watchdog(1800.0, on_fire=lambda: print(json.dumps(
+        {"phase": "profile_soup", "error": "watchdog: wedged > 1800s"}),
+        flush=True))
+    ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=False)
+    rows = phase_breakdown(args.n, args.gens, args.preset)
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    if args.trace:
+        cfg = _gen_cfg(args.n, args.preset)
+        state = seed(cfg, jax.random.key(1))
+        fin = evolve(cfg, state, generations=args.gens)  # compiled above
+        float(fin.weights.sum())
+        with jax.profiler.trace(args.trace):
+            fin = evolve(cfg, state, generations=args.gens)
+            float(fin.weights.sum())
+        print(json.dumps({"trace": args.trace}), flush=True)
+    cancel()
+
+
+if __name__ == "__main__":
+    main()

@@ -44,8 +44,10 @@ def _dynamics(preset: str, train_mode: str = "sequential") -> dict:
 
 def bench_size(preset: str, n: int, generations: int = 50,
                repeats: int = 3, layout: str = "rowmajor",
-               train_mode: str = "sequential", sharded: bool = False) -> dict:
+               train_mode: str = "sequential", sharded: bool = False,
+               respawn_draws: str = "perparticle") -> dict:
     dyn = _dynamics(preset, train_mode)
+    dyn["respawn_draws"] = respawn_draws
     if preset == "mixed":
         third = n // 3
         cfg = MultiSoupConfig(
@@ -103,6 +105,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
     return {
         "metric": f"soup-generations/sec[{preset}]",
         "layout": layout,
+        "respawn_draws": respawn_draws,
         "sharded_devices": jax.device_count() if sharded else 0,
         "particles": n,
         "generations": generations,
@@ -133,6 +136,11 @@ def main():
                    help="run the soup sharded over ALL visible devices "
                         "(all presets incl. the heterogeneous 'mixed'; "
                         "shard_map data parallel)")
+    p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
+                   default="perparticle",
+                   help="'fused': one-call respawn replacement draw (same "
+                        "iid glorot law, different stream) — the mega-soup "
+                        "fast path; see SoupConfig.respawn_draws")
     args = p.parse_args()
     # the tunneled TPU backend flakes at init (sometimes raising, sometimes
     # wedging): probe with retries AND bound each phase with a watchdog that
@@ -157,7 +165,8 @@ def main():
         cancel = arm(f"size {n}", 2400.0)
         print(json.dumps(bench_size(args.preset, n, args.generations,
                                     args.repeats, args.layout,
-                                    args.train_mode, args.sharded)))
+                                    args.train_mode, args.sharded,
+                                    args.respawn_draws)))
     cancel()
 
 

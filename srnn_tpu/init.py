@@ -8,8 +8,11 @@ Matching these distributions matters — the fixpoint-density experiment
 statistics are a direct function of the init law.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .topology import Topology
 
@@ -36,6 +39,82 @@ def init_flat(topo: Topology, key: jax.Array, dtype=jnp.float32) -> jnp.ndarray:
         else:
             parts.append(_glorot_uniform(k, shape, dtype).reshape(-1))
     return jnp.concatenate(parts)
+
+
+# ---------------------------------------------------------------------------
+# Fused mega-population draws (the soup-respawn fast path).
+#
+# ``init_population`` splits N per-particle keys and vmaps tiny per-layer
+# draws — faithful to "construct a fresh keras net per particle" and the
+# right default, but at mega-soup scale the respawn phase pays it EVERY
+# generation (N=1M: ~1M key splits + 3M tiny uniform calls ≈ 83% of an
+# apply-only generation's cost in the profile_soup breakdown).  For the
+# variants whose init law is pure per-weight glorot_uniform (weightwise /
+# aggregating / fft — everything except the recurrent variant's orthogonal
+# kernels), the whole population init is ONE U(-1, 1) draw of shape (P, N)
+# scaled by a constant per-row limit vector: the same iid law, one threefry
+# call.  A DIFFERENT stream than init_population (distributionally
+# identical), so it is opt-in via ``SoupConfig.respawn_draws='fused'``.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _glorot_limit_rows(topo: Topology) -> np.ndarray:
+    """(P,) per-weight glorot_uniform limits, in flat order."""
+    assert topo.variant != "recurrent", (
+        "fused init is undefined for orthogonal recurrent kernels")
+    rows = []
+    for (a, b) in topo.layer_shapes:
+        rows.append(np.full(a * b, np.sqrt(6.0 / (a + b)), np.float32))
+    return np.concatenate(rows)
+
+
+def supports_fused_init(topo: Topology) -> bool:
+    """True when the variant's init law is pure glorot_uniform (no
+    orthogonal kernels), i.e. the fused draw is exactly the same law."""
+    return topo.variant != "recurrent"
+
+
+def init_popmajor_fused(topo: Topology, key: jax.Array, n: int,
+                        dtype=jnp.float32) -> jnp.ndarray:
+    """Sample ``n`` particles as ONE fused (P, n) lane-layout draw.
+
+    Same distribution as ``init_population(topo, key, n).T`` (iid
+    U(-limit_p, limit_p) per weight), different stream.  Row-major callers
+    transpose; the draw is generated lane-major so the popmajor and
+    row-major layouts consume bitwise-identical values.
+    """
+    if not supports_fused_init(topo):
+        raise ValueError(
+            f"variant {topo.variant!r} has orthogonal kernels; fused init "
+            "is only defined for pure-glorot variants")
+    lim = jnp.asarray(_glorot_limit_rows(topo), dtype)
+    u = jax.random.uniform(key, (topo.num_weights, n), dtype,
+                           minval=-1.0, maxval=1.0)
+    return u * lim[:, None]
+
+
+def fresh_rows(topo: Topology, key: jax.Array, n: int,
+               draws: str = "perparticle") -> jnp.ndarray:
+    """Respawn replacements in row-major (n, P) layout.  ``draws='fused'``
+    takes the one-call path for pure-glorot variants and falls back to the
+    per-particle draw for the recurrent variant."""
+    if draws == "fused" and supports_fused_init(topo):
+        return init_popmajor_fused(topo, key, n).T
+    if draws not in ("perparticle", "fused"):
+        raise ValueError(f"unknown respawn_draws {draws!r}")
+    return init_population(topo, key, n)
+
+
+def fresh_lanes(topo: Topology, key: jax.Array, n: int,
+                draws: str = "perparticle") -> jnp.ndarray:
+    """Respawn replacements in lane-major (P, n) layout (same values as
+    ``fresh_rows(...).T``)."""
+    if draws == "fused" and supports_fused_init(topo):
+        return init_popmajor_fused(topo, key, n)
+    if draws not in ("perparticle", "fused"):
+        raise ValueError(f"unknown respawn_draws {draws!r}")
+    return init_population(topo, key, n).T
 
 
 # Chunk size for mega-population init.  The orthogonal initializer lowers to

@@ -23,7 +23,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from .init import init_population
+from .init import fresh_lanes, init_population
 from .nets.cross import cross_apply
 from .ops.predicates import DEFAULT_EPSILON, count_classes
 from .engine import classify_batch
@@ -57,6 +57,10 @@ class MultiSoupConfig(NamedTuple):
     # (ops/popmajor*.py) — same dynamics, particle axis on the TPU lanes;
     # requires shuffler='not' on every topo (soup._check_popmajor rationale)
     layout: str = "rowmajor"
+    # respawn replacement draws — see SoupConfig.respawn_draws; 'fused'
+    # applies per type where the init law allows (the recurrent type always
+    # draws per-particle)
+    respawn_draws: str = "perparticle"
 
     @property
     def total(self) -> int:
@@ -78,7 +82,8 @@ class MultiSoupConfig(NamedTuple):
             learn_from_severity=self.learn_from_severity,
             remove_divergent=self.remove_divergent,
             remove_zero=self.remove_zero, epsilon=self.epsilon,
-            lr=self.lr, train_mode=self.train_mode)
+            lr=self.lr, train_mode=self.train_mode,
+            respawn_draws=self.respawn_draws)
 
 
 class MultiSoupState(NamedTuple):
@@ -221,7 +226,7 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
         dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
             if config.remove_zero else jnp.zeros(n_t, bool)
         dead = dead_div | dead_zero
-        fresh = init_population(topo, re_keys[t], n_t).T
+        fresh = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
         wT_t = jnp.where(dead[None, :], fresh, wT_t)
         rank = jnp.cumsum(dead) - 1
         base = state.next_uid + total_deaths

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..init import init_population
+from ..init import fresh_lanes, fresh_rows, init_population
 from ..multisoup import (
     MultiSoupConfig,
     MultiSoupEvents,
@@ -171,7 +171,7 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
         all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
         rank = jnp.cumsum(all_dead) - 1
         rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
-        fresh = init_population(topo, re_keys[t], n_t)
+        fresh = fresh_rows(topo, re_keys[t], n_t, config.respawn_draws)
         fresh_loc = jax.lax.dynamic_slice_in_dim(fresh, d * n_loc, n_loc,
                                                  axis=0)
         w_t = jnp.where(dead[:, None], fresh_loc, w_t)
@@ -297,9 +297,9 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
         all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
         rank = jnp.cumsum(all_dead) - 1
         rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
-        fresh = init_population(topo, re_keys[t], n_t)
-        freshT_loc = jax.lax.dynamic_slice_in_dim(fresh, d * n_loc, n_loc,
-                                                  axis=0).T
+        freshT = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
+        freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, d * n_loc, n_loc,
+                                                  axis=1)
         wT_t = jnp.where(dead[None, :], freshT_loc, wT_t)
         uid_base = state.next_uid + total_deaths
         uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),

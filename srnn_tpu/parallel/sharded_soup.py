@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..init import init_population
+from ..init import fresh_lanes, init_population
 from ..nets import apply_to_weights
 from ..ops.predicates import count_classes, is_diverged, is_zero
 from ..soup import (
@@ -258,10 +258,11 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     all_dead = jax.lax.all_gather(dead, axes, tiled=True)  # (N,) device order
     rank = jnp.cumsum(all_dead) - 1
     rank_loc = jax.lax.dynamic_slice_in_dim(rank, start, n_loc)
-    # every device draws the same global fresh population and keeps its rows:
-    # bitwise-identical replacements to the single-device k_re stream
-    fresh = init_population(topo, k_re, n)
-    freshT_loc = jax.lax.dynamic_slice_in_dim(fresh, start, n_loc, axis=0).T
+    # every device draws the same global fresh population and keeps its
+    # columns: bitwise-identical replacements to the single-device k_re
+    # stream (in either respawn_draws mode)
+    freshT = fresh_lanes(topo, k_re, n, config.respawn_draws)
+    freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, start, n_loc, axis=1)
     wT_loc = jnp.where(dead[None, :], freshT_loc, wT_loc)
     uids = jnp.where(dead, state.next_uid + rank_loc.astype(jnp.int32),
                      state.uids)

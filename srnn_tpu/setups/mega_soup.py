@@ -50,6 +50,12 @@ def build_parser():
                         "--checkpoint-every")
     p.add_argument("--resume", default=None, metavar="RUN_DIR",
                    help="continue a previous run from its latest checkpoint")
+    p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
+                   default="fused",
+                   help="respawn replacement draws: 'fused' (default here — "
+                        "one-call draw, same iid glorot law, the mega-scale "
+                        "fast path) or 'perparticle' (seed-identical "
+                        "reference-style per-net draws)")
     p.add_argument("--sharded", action="store_true",
                    help="shard the particle axis over ALL visible devices "
                         "(shard_map data parallel); trajectory capture then "
@@ -72,7 +78,7 @@ def _latest_checkpoint(run_dir: str):
 
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
                   "train_mode", "layout", "epsilon", "capture_every",
-                  "sharded")
+                  "sharded", "respawn_draws")
 
 
 def _save_config(run_dir: str, args) -> None:
@@ -90,9 +96,17 @@ def _load_config(run_dir: str, args) -> None:
     with open(path) as f:
         saved = json.load(f)
     for k in _CONFIG_FIELDS:
-        # .get: config.json files written before capture_every was persisted
-        # fall back to the CLI value rather than failing the resume
-        setattr(args, k, saved.get(k, getattr(args, k)))
+        if k == "respawn_draws":
+            # configs written before this field existed ran the only
+            # behavior of their time — per-particle draws.  Falling back to
+            # the CLI value (default now 'fused') would silently change the
+            # run's respawn stream mid-resume.
+            setattr(args, k, saved.get(k, "perparticle"))
+        else:
+            # .get: config.json files written before the field was persisted
+            # fall back to the CLI value rather than failing the resume
+            # (safe for these fields: each CLI default matches old behavior)
+            setattr(args, k, saved.get(k, getattr(args, k)))
 
 
 def run(args):
@@ -232,6 +246,7 @@ def _make_config(args) -> SoupConfig:
         remove_zero=True,
         epsilon=args.epsilon,
         layout=args.layout,
+        respawn_draws=args.respawn_draws,
     )
 
 

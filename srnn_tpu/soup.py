@@ -38,7 +38,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .init import init_population
+from .init import fresh_lanes, fresh_rows, init_population
 from .nets import apply_to_weights, compute_samples
 from .ops.predicates import DEFAULT_EPSILON, count_classes, is_diverged, is_zero
 from .topology import Topology
@@ -68,11 +68,20 @@ class SoupConfig(NamedTuple):
     train_mode: str = "sequential"
     mode: str = "parallel"          # 'parallel' | 'sequential'
     # 'rowmajor' keeps (N, P) arrays and vmaps per particle; 'popmajor'
-    # (weightwise + parallel mode only) transposes the generation to (P, N)
-    # so the particle axis rides the TPU lanes and the train/learn gradient
+    # (parallel mode only) transposes the generation to (P, N) so the
+    # particle axis rides the TPU lanes and the train/learn gradient
     # steps stay elementwise — ~4-16x faster phases at N=1M (see
-    # ops/popmajor.py).  Same math up to float reassociation.
+    # ops/popmajor*.py).  Same math up to float reassociation.
     layout: str = "rowmajor"        # 'rowmajor' | 'popmajor'
+    # 'perparticle' (default) draws respawn replacements exactly like
+    # seeding — one keras-style init per particle (reference soup.py:77-86
+    # constructs a fresh net).  'fused' draws the whole replacement
+    # population as ONE U(-1,1)*(per-weight glorot limit) tensor — the
+    # identical iid law for the pure-glorot variants, a different stream;
+    # at N=1M the per-particle path is ~80% of an apply-only generation
+    # (benchmarks/profile_soup.py), the fused path is one threefry call.
+    # The recurrent variant (orthogonal kernels) always draws per-particle.
+    respawn_draws: str = "perparticle"  # 'perparticle' | 'fused'
 
 
 class SoupState(NamedTuple):
@@ -136,7 +145,7 @@ def _respawn(config: SoupConfig, w, uids, uid_base, key):
     dead_div = is_diverged(w) if config.remove_divergent else jnp.zeros(w.shape[0], bool)
     dead_zero = (is_zero(w, config.epsilon) & ~dead_div) if config.remove_zero else jnp.zeros(w.shape[0], bool)
     dead = dead_div | dead_zero
-    fresh = init_population(config.topo, key, w.shape[0])
+    fresh = fresh_rows(config.topo, key, w.shape[0], config.respawn_draws)
     new_w = jnp.where(dead[:, None], fresh, w)
     # fresh uids: rank among the dead, offset by the block base
     rank = jnp.cumsum(dead) - 1
@@ -288,7 +297,7 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
     dead_zero = (is_zero(wT, config.epsilon, axis=0) & ~dead_div) \
         if config.remove_zero else jnp.zeros(n, bool)
     dead = dead_div | dead_zero
-    fresh = init_population(topo, k_re, n).T
+    fresh = fresh_lanes(topo, k_re, n, config.respawn_draws)
     wT = jnp.where(dead[None, :], fresh, wT)
     rank = jnp.cumsum(dead) - 1
     uids = jnp.where(dead, state.next_uid + rank.astype(jnp.int32), state.uids)
@@ -386,6 +395,10 @@ def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState,
 @functools.partial(jax.jit, static_argnames=("config",))
 def evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
     """One generation (``Soup.evolve`` body, ``soup.py:51-87``)."""
+    if config.mode == "sequential" and config.respawn_draws != "perparticle":
+        raise ValueError(
+            "mode='sequential' is the strict-parity mode and requires "
+            "respawn_draws='perparticle'")
     if config.layout == "popmajor":
         _check_popmajor(config)
         new_state, events, wT = _evolve_parallel_popmajor(config, state,

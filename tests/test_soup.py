@@ -320,3 +320,55 @@ def test_parallel_vs_sequential_distribution():
     assert par_r.mean(0)[0] <= 0.2
     # sequential keeps a small residual divergent mass — present but < 4/100
     assert 0.0 < seq_r.mean(0)[0] < 4.0
+
+
+# ------------------------------------------------- fused respawn draws
+
+
+def test_fused_respawn_layouts_agree_and_law_is_bounded():
+    """respawn_draws='fused' draws the SAME (P, N) replacement tensor for
+    both layouts (row-major transposes it), so popmajor and rowmajor stay
+    in lockstep; and every replacement obeys the per-weight glorot bound."""
+    from srnn_tpu.init import _glorot_limit_rows, init_popmajor_fused
+
+    dyn = dict(attacking_rate=0.5, learn_from_rate=-1.0, train=0,
+               remove_divergent=True, remove_zero=True,
+               respawn_draws="fused")
+    cfg_row = mkconfig(size=24, **dyn)
+    cfg_pop = mkconfig(size=24, layout="popmajor", **dyn)
+    st = seed(cfg_row, jax.random.key(13))
+    row = evolve(cfg_row, st, generations=12)
+    pop = evolve(cfg_pop, st, generations=12)
+    np.testing.assert_array_equal(np.asarray(row.uids), np.asarray(pop.uids))
+    np.testing.assert_allclose(np.asarray(row.weights), np.asarray(pop.weights),
+                               rtol=1e-3, atol=1e-5)
+    assert int(row.next_uid) > 24  # respawns actually happened
+
+    lim = _glorot_limit_rows(WW)
+    draw = np.asarray(init_popmajor_fused(WW, jax.random.key(0), 1000))
+    assert (np.abs(draw) <= lim[:, None] + 1e-7).all()
+    # per-row spread uses each row's OWN limit (WW limits span 1.0..1.41,
+    # so a global-bound bug would fail the larger rows' maxima here)
+    assert (draw.max(axis=1) > 0.9 * lim).all()
+
+
+def test_fused_respawn_rejected_in_sequential_parity_mode():
+    cfg = mkconfig(mode="sequential", respawn_draws="fused",
+                   remove_divergent=True)
+    with pytest.raises(ValueError):
+        evolve_step(cfg, seed(mkconfig(), jax.random.key(0)))
+
+
+def test_fused_respawn_recurrent_falls_back_per_particle():
+    """The recurrent variant's orthogonal kernels have no fused law; the
+    fused flag silently keeps the per-particle draw for it (documented),
+    so mixed soups can use 'fused' globally."""
+    rnn = Topology("recurrent", width=2, depth=2)
+    cfg = SoupConfig(topo=rnn, size=8, attacking_rate=0.5,
+                     remove_divergent=True, remove_zero=True,
+                     respawn_draws="fused")
+    cfg_pp = cfg._replace(respawn_draws="perparticle")
+    st = seed(cfg, jax.random.key(3))
+    a = evolve(cfg, st, generations=10)
+    b = evolve(cfg_pp, st, generations=10)
+    np.testing.assert_array_equal(np.asarray(a.weights), np.asarray(b.weights))
