@@ -45,9 +45,11 @@ def _dynamics(preset: str, train_mode: str = "sequential") -> dict:
 def bench_size(preset: str, n: int, generations: int = 50,
                repeats: int = 3, layout: str = "rowmajor",
                train_mode: str = "sequential", sharded: bool = False,
-               respawn_draws: str = "perparticle") -> dict:
+               respawn_draws: str = "perparticle",
+               train_impl: str = "xla") -> dict:
     dyn = _dynamics(preset, train_mode)
     dyn["respawn_draws"] = respawn_draws
+    dyn["train_impl"] = train_impl
     if preset == "mixed":
         third = n // 3
         cfg = MultiSoupConfig(
@@ -106,6 +108,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
         "metric": f"soup-generations/sec[{preset}]",
         "layout": layout,
         "respawn_draws": respawn_draws,
+        "train_impl": train_impl,
         "sharded_devices": jax.device_count() if sharded else 0,
         "particles": n,
         "generations": generations,
@@ -141,6 +144,11 @@ def main():
                    help="'fused': one-call respawn replacement draw (same "
                         "iid glorot law, different stream) — the mega-soup "
                         "fast path; see SoupConfig.respawn_draws")
+    p.add_argument("--train-impl", choices=("xla", "pallas"),
+                   default="xla",
+                   help="'pallas': fused VMEM batch-1 SGD chain for the "
+                        "weightwise popmajor train/learn phases "
+                        "(ops/pallas_ww_train.py)")
     args = p.parse_args()
     # the tunneled TPU backend flakes at init (sometimes raising, sometimes
     # wedging): probe with retries AND bound each phase with a watchdog that
@@ -166,7 +174,7 @@ def main():
         print(json.dumps(bench_size(args.preset, n, args.generations,
                                     args.repeats, args.layout,
                                     args.train_mode, args.sharded,
-                                    args.respawn_draws)))
+                                    args.respawn_draws, args.train_impl)))
     cancel()
 
 

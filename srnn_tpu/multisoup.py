@@ -61,6 +61,8 @@ class MultiSoupConfig(NamedTuple):
     # applies per type where the init law allows (the recurrent type always
     # draws per-particle)
     respawn_draws: str = "perparticle"
+    # see SoupConfig.train_impl; applies per type where supported
+    train_impl: str = "xla"
 
     @property
     def total(self) -> int:
@@ -83,7 +85,8 @@ class MultiSoupConfig(NamedTuple):
             remove_divergent=self.remove_divergent,
             remove_zero=self.remove_zero, epsilon=self.epsilon,
             lr=self.lr, train_mode=self.train_mode,
-            respawn_draws=self.respawn_draws)
+            respawn_draws=self.respawn_draws,
+            train_impl=self.train_impl)
 
 
 class MultiSoupState(NamedTuple):
@@ -206,7 +209,8 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
             if config.learn_from_severity > 0:
                 learned, _ = learn_epochs_popmajor(
                     topo, wT_t, wT_t[:, learn_tgt],
-                    config.learn_from_severity, config.lr, config.train_mode)
+                    config.learn_from_severity, config.lr, config.train_mode,
+                    config.train_impl)
                 wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
             learn_cp = state.uids[t][learn_tgt]
         else:
@@ -216,7 +220,8 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
         # --- train ------------------------------------------------------
         if config.train > 0:
             wT_t, loss_t = train_epochs_popmajor(
-                topo, wT_t, config.train, config.lr, config.train_mode)
+                topo, wT_t, config.train, config.lr, config.train_mode,
+                config.train_impl)
         else:
             loss_t = jnp.zeros(n_t, wT_t.dtype)
 
@@ -267,6 +272,10 @@ def evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
         return new_state._replace(weights=tuple(wT.T for wT in wTs)), events
     if config.layout != "rowmajor":
         raise ValueError(f"unknown multisoup layout {config.layout!r}")
+    if config.train_impl == "pallas":
+        raise ValueError(
+            "train_impl='pallas' is the popmajor lane kernel; the "
+            "row-major multisoup needs train_impl='xla'")
     n = config.total
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)

@@ -1,0 +1,201 @@
+"""Fused Pallas TPU kernel for weightwise batch-1 sequential SGD.
+
+The full-dynamics soup's dominant cost is the training phase: ``train``
+epochs of batch_size=1 SGD are ``epochs * P`` sequential gradient steps
+(reference ``network.py:613-617`` semantics), and the XLA scan pays ~2-3
+HBM round-trips of the (P, N) population per step — ~140 round-trips per
+generation at the paper's train=10.  This kernel runs the ENTIRE flattened
+epoch*sample chain inside VMEM per lane block: one HBM read + one write of
+the population per ``train()`` phase, like ``pallas_ww.py`` does for
+chained self-application.
+
+The backward pass is hand-derived for the LINEAR activation (the science
+default every reference experiment effectively ran — SURVEY quirk
+§2.4.11): with h_{l+1}[j] = sum_i h_l[i] * W_l[i, j], the per-sample
+gradients are
+
+    dL/dpred         = 2 (pred - y)
+    dL/dW_l[i, j]    = dh_{l+1}[j] * h_l[i]
+    dh_l[i]          = sum_j dh_{l+1}[j] * W_l[i, j]
+
+all elementwise over the lane axis (per-particle parameters are per-lane
+scalars).  Per-step math mirrors ``ops/popmajor._ww_seq_sgd_flat``: the
+sample snapshot refreshes at each epoch top (self-training) or stays fixed
+(imitation / learn_from), updates run in enumeration order, and the
+returned loss is the last epoch's mean PRE-update loss (keras history
+semantics).  Parity with the XLA path is tested to float tolerance
+(reassociation differs).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..topology import Topology, normalized_weight_coords
+
+LANE_BLOCK = 2048  # particles per grid step (matches pallas_ww)
+
+
+def _sgd_chain(topo: Topology, w, snap_source, epochs: int, lr: float,
+               coords_ref, refresh: bool):
+    """The flattened epochs x samples batch-1 SGD chain on one (P, B) lane
+    block.  ``snap_source`` supplies the fixed imitation target when
+    ``refresh`` is False; ignored otherwise.  Returns (w, last_loss (B,))."""
+    p = topo.num_weights
+    shapes = topo.layer_shapes
+    offs = topo.offsets
+
+    # carry the population as a TUPLE of row vectors: per-sample updates
+    # touch rows in place with no (P, B) re-stack per step (a per-sample
+    # stack+index pattern is quadratic in P for both tracing and the
+    # interpreter)
+    rows0 = tuple(w[r] for r in range(p))
+    snap_rows = None if refresh else tuple(snap_source[r] for r in range(p))
+
+    def epoch(rows, _):
+        snap = rows if refresh else snap_rows
+        loss_acc = jnp.zeros_like(rows[0])
+        rows = list(rows)
+        for s in range(p):
+            x = snap[s]
+            feats = [x] + [coords_ref[s, k] + jnp.zeros_like(x)
+                           for k in range(3)]
+            # forward, keeping every layer's activations for the backward
+            acts = [feats]
+            h = feats
+            for (a, b), o in zip(shapes, offs):
+                nxt = []
+                for j in range(b):
+                    acc = h[0] * rows[o + j]
+                    for i in range(1, a):
+                        acc = acc + h[i] * rows[o + i * b + j]
+                    nxt.append(acc)
+                acts.append(nxt)
+                h = nxt
+            pred = h[0]
+            loss_acc = loss_acc + (pred - x) * (pred - x)
+            # backward (linear layers), building per-row weight updates
+            dh = [2.0 * (pred - x)]
+            grads = [None] * p
+            for li in range(len(shapes) - 1, -1, -1):
+                a, b = shapes[li]
+                o = offs[li]
+                prev = acts[li]
+                dprev = []
+                for i in range(a):
+                    acc = dh[0] * rows[o + i * b + 0]
+                    for j in range(1, b):
+                        acc = acc + dh[j] * rows[o + i * b + j]
+                    dprev.append(acc)
+                    for j in range(b):
+                        grads[o + i * b + j] = dh[j] * prev[i]
+                dh = dprev
+            for r in range(p):
+                rows[r] = rows[r] - lr * grads[r]
+        return tuple(rows), loss_acc / p
+
+    (rows, last_loss), _ = jax.lax.scan(
+        lambda c, _: (epoch(c[0], None), None),
+        (rows0, jnp.zeros_like(w[0])), None, length=epochs)
+    return jnp.stack(rows), last_loss
+
+
+def _train_kernel(coords_ref, w_ref, out_ref, loss_ref, *, topo, epochs, lr):
+    w, loss = _sgd_chain(topo, w_ref[:, :], None, epochs, lr, coords_ref,
+                         refresh=True)
+    out_ref[:, :] = w
+    loss_ref[0, :] = loss
+
+
+def _learn_kernel(coords_ref, w_ref, other_ref, out_ref, loss_ref, *,
+                  topo, epochs, lr):
+    w, loss = _sgd_chain(topo, w_ref[:, :], other_ref[:, :], epochs, lr,
+                         coords_ref, refresh=False)
+    out_ref[:, :] = w
+    loss_ref[0, :] = loss
+
+
+def _supported(topo: Topology) -> None:
+    assert topo.variant == "weightwise"
+    if topo.activation != "linear":
+        raise ValueError(
+            "the fused Pallas SGD kernel hand-derives the linear backward; "
+            f"activation={topo.activation!r} uses the XLA path")
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("topo", "epochs", "lr", "interpret"))
+def ww_train_epochs_pallas(topo: Topology, wT: jnp.ndarray, epochs: int,
+                           lr: float = 0.01, interpret: bool = False):
+    """``epochs`` of batch-1 sequential self-training, entire chain fused
+    in VMEM per lane block.  Same semantics as
+    ``ops.popmajor.ww_train_epochs_popmajor(mode='sequential')``.
+    Returns (new_wT, last epoch per-particle loss (N,))."""
+    _supported(topo)
+    p, n = wT.shape
+    block = min(LANE_BLOCK, n)
+    pad = (-n) % block
+    if pad:
+        wT = jnp.pad(wT, ((0, 0), (0, pad)))
+    padded = n + pad
+    coords = jnp.asarray(normalized_weight_coords(topo), wT.dtype)
+    out, loss = pl.pallas_call(
+        functools.partial(_train_kernel, topo=topo, epochs=epochs,
+                          lr=float(lr)),
+        out_shape=(jax.ShapeDtypeStruct((p, padded), wT.dtype),
+                   jax.ShapeDtypeStruct((1, padded), wT.dtype)),
+        grid=(padded // block,),
+        in_specs=[
+            pl.BlockSpec((p, 3), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((p, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec((p, block), lambda i: (0, i),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, block), lambda i: (0, i),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(coords, wT)
+    return (out[:, :n], loss[0, :n]) if pad else (out, loss[0])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("topo", "severity", "lr", "interpret"))
+def ww_learn_epochs_pallas(topo: Topology, wT: jnp.ndarray,
+                           otherT: jnp.ndarray, severity: int,
+                           lr: float = 0.01, interpret: bool = False):
+    """``severity`` imitation epochs toward the counterparts' (fixed)
+    samples, fused in VMEM.  Same semantics as
+    ``ops.popmajor.ww_learn_epochs_popmajor(mode='sequential')``."""
+    _supported(topo)
+    p, n = wT.shape
+    block = min(LANE_BLOCK, n)
+    pad = (-n) % block
+    if pad:
+        wT = jnp.pad(wT, ((0, 0), (0, pad)))
+        otherT = jnp.pad(otherT, ((0, 0), (0, pad)))
+    padded = n + pad
+    coords = jnp.asarray(normalized_weight_coords(topo), wT.dtype)
+    out, loss = pl.pallas_call(
+        functools.partial(_learn_kernel, topo=topo, epochs=severity,
+                          lr=float(lr)),
+        out_shape=(jax.ShapeDtypeStruct((p, padded), wT.dtype),
+                   jax.ShapeDtypeStruct((1, padded), wT.dtype)),
+        grid=(padded // block,),
+        in_specs=[
+            pl.BlockSpec((p, 3), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((p, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((p, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec((p, block), lambda i: (0, i),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, block), lambda i: (0, i),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(coords, wT, otherT)
+    return (out[:, :n], loss[0, :n]) if pad else (out, loss[0])

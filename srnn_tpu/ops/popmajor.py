@@ -260,8 +260,24 @@ def apply_popmajor(topo: Topology, selfT: jnp.ndarray,
     return kvec_apply_popmajor(topo, selfT, targetT)
 
 
+def _use_pallas_sgd(topo: Topology, mode: str, impl: str) -> bool:
+    """The fused Pallas SGD chain applies to the weightwise variant's
+    batch-1 sequential mode with the linear activation (hand-derived
+    backward).  Non-TPU backends run it in the (slow) interpreter, so the
+    XLA path stays the default there."""
+    return (impl == "pallas" and topo.variant == "weightwise"
+            and mode == "sequential" and topo.activation == "linear")
+
+
 def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
-                          lr: float = DEFAULT_LR, mode: str = "sequential"):
+                          lr: float = DEFAULT_LR, mode: str = "sequential",
+                          impl: str = "xla"):
+    if _use_pallas_sgd(topo, mode, impl):
+        from .pallas_ww_train import ww_train_epochs_pallas
+
+        return ww_train_epochs_pallas(
+            topo, wT, epochs, lr,
+            interpret=jax.default_backend() != "tpu")
     if topo.variant == "weightwise":
         return ww_train_epochs_popmajor(topo, wT, epochs, lr, mode)
     if topo.variant == "recurrent":
@@ -275,7 +291,13 @@ def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
 
 def learn_epochs_popmajor(topo: Topology, wT: jnp.ndarray, otherT: jnp.ndarray,
                           severity: int, lr: float = DEFAULT_LR,
-                          mode: str = "sequential"):
+                          mode: str = "sequential", impl: str = "xla"):
+    if _use_pallas_sgd(topo, mode, impl):
+        from .pallas_ww_train import ww_learn_epochs_pallas
+
+        return ww_learn_epochs_pallas(
+            topo, wT, otherT, severity, lr,
+            interpret=jax.default_backend() != "tpu")
     if topo.variant == "weightwise":
         return ww_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)
     if topo.variant == "recurrent":

@@ -20,7 +20,7 @@ under matched keys (tests assert):
   * respawn uids use the GLOBAL per-type dead-rank (all_gather of the
     death mask + cumsum) with the single-device type-major block order,
     and fresh replacements replicate the single-device per-type draw
-    (``init_population(topo, re_keys[t], N_t)``) and slice the local rows.
+    (``fresh_rows(topo, re_keys[t], N_t)``) and slice the local rows.
 
 All integer state (uids, next_uid, event actions/counterparts) is EXACT.
 Weights match to reduction-reassociation tolerance, not bitwise: the
@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..init import fresh_lanes, fresh_rows, init_population
+from ..init import fresh_lanes, fresh_rows
 from ..multisoup import (
     MultiSoupConfig,
     MultiSoupEvents,
@@ -274,7 +274,8 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
                                                  tiled=True)
                 learned, _ = learn_epochs_popmajor(
                     topo, wT_t, post_attack[:, learn_tgt],
-                    config.learn_from_severity, config.lr, config.train_mode)
+                    config.learn_from_severity, config.lr, config.train_mode,
+                    config.train_impl)
                 wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
             learn_cp = all_uids_t[t][learn_tgt]
         else:
@@ -284,7 +285,8 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
         # --- train ------------------------------------------------------
         if config.train > 0:
             wT_t, loss_t = train_epochs_popmajor(
-                topo, wT_t, config.train, config.lr, config.train_mode)
+                topo, wT_t, config.train, config.lr, config.train_mode,
+                config.train_impl)
         else:
             loss_t = jnp.zeros(n_loc, wT_t.dtype)
 

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..init import fresh_lanes, init_population
+from ..init import fresh_lanes
 from ..nets import apply_to_weights
 from ..ops.predicates import count_classes, is_diverged, is_zero
 from ..soup import (
@@ -190,7 +190,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         particle learning from a just-attacked victim sees the same
         post-attack weights the single-device path uses;
       * respawn draws the SAME global fresh population
-        (``init_population(topo, k_re, N)``) on every device and slices its
+        (``fresh_lanes(topo, k_re, N)``) on every device and slices its
         shard, and fresh uids use the GLOBAL dead-rank (all_gather of the
         death mask + cumsum) — identical uids, identical weights.
 
@@ -236,7 +236,8 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
             post_attack = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
             learned, _ = learn_epochs_popmajor(
                 topo, wT_loc, post_attack[:, learn_tgt_loc],
-                config.learn_from_severity, config.lr, config.train_mode)
+                config.learn_from_severity, config.lr, config.train_mode,
+                config.train_impl)
             wT_loc = jnp.where(learn_gate_loc[None, :], learned, wT_loc)
     else:
         learn_gate_loc = jnp.zeros(n_loc, bool)
@@ -245,7 +246,8 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     # --- train (soup.py:69-76) ------------------------------------------
     if config.train > 0:
         wT_loc, train_loss = train_epochs_popmajor(
-            topo, wT_loc, config.train, config.lr, config.train_mode)
+            topo, wT_loc, config.train, config.lr, config.train_mode,
+            config.train_impl)
     else:
         train_loss = jnp.zeros(n_loc, wT_loc.dtype)
 

@@ -82,6 +82,12 @@ class SoupConfig(NamedTuple):
     # (benchmarks/profile_soup.py), the fused path is one threefry call.
     # The recurrent variant (orthogonal kernels) always draws per-particle.
     respawn_draws: str = "perparticle"  # 'perparticle' | 'fused'
+    # 'pallas' fuses the ENTIRE batch-1 sequential SGD chain (train and
+    # learn_from phases) in VMEM per lane block — one HBM round trip per
+    # phase instead of one per sample step (~140 at train=10).  Weightwise
+    # + popmajor + sequential + linear activation only (hand-derived
+    # backward, ops/pallas_ww_train.py); parity-tested vs the XLA path.
+    train_impl: str = "xla"             # 'xla' | 'pallas'
 
 
 class SoupState(NamedTuple):
@@ -277,7 +283,7 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         if config.learn_from_severity > 0:
             learned, _ = learn_epochs_popmajor(
                 topo, wT, wT[:, learn_tgt], config.learn_from_severity,
-                config.lr, config.train_mode)
+                config.lr, config.train_mode, config.train_impl)
             wT = jnp.where(learn_gate[None, :], learned, wT)
     else:
         learn_gate = jnp.zeros(n, bool)
@@ -286,7 +292,8 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
     # --- train (soup.py:69-76) ------------------------------------------
     if config.train > 0:
         wT, train_loss = train_epochs_popmajor(
-            topo, wT, config.train, config.lr, config.train_mode)
+            topo, wT, config.train, config.lr, config.train_mode,
+            config.train_impl)
     else:
         train_loss = jnp.zeros(n, wT.dtype)
 
@@ -325,6 +332,18 @@ def _check_popmajor(config: SoupConfig) -> None:
             "layout='popmajor' requires shuffler='not': a per-particle "
             "random permutation of the weight axis is a per-lane gather "
             "that defeats the lane layout — use layout='rowmajor'")
+    if config.train_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown train_impl {config.train_impl!r}")
+    if config.train_impl == "pallas" and (
+            config.topo.variant != "weightwise"
+            or config.train_mode != "sequential"
+            or config.topo.activation != "linear"):
+        raise ValueError(
+            "train_impl='pallas' fuses the weightwise batch-1 sequential "
+            "SGD chain with a hand-derived LINEAR backward; this config "
+            f"(variant={config.topo.variant!r}, "
+            f"train_mode={config.train_mode!r}, "
+            f"activation={config.topo.activation!r}) needs train_impl='xla'")
 
 
 def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
@@ -399,6 +418,10 @@ def evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEv
         raise ValueError(
             "mode='sequential' is the strict-parity mode and requires "
             "respawn_draws='perparticle'")
+    if config.train_impl == "pallas" and config.layout != "popmajor":
+        raise ValueError(
+            "train_impl='pallas' is the popmajor lane kernel; "
+            "layout='rowmajor' needs train_impl='xla'")
     if config.layout == "popmajor":
         _check_popmajor(config)
         new_state, events, wT = _evolve_parallel_popmajor(config, state,

@@ -317,8 +317,10 @@ def truncate_frames(path: str, keep: int) -> int:
 
 def read_store_artifact(path: str) -> Dict[str, np.ndarray]:
     """Read a whole store in the soup-artifact shape ``srnn_tpu.viz``
-    consumes (weights/uids/action/counterpart/loss keys)."""
-    out = read_store(path)
+    consumes (weights/uids/action/counterpart/loss keys).  Accepts both a
+    single-process store and the base path of a per-process shard set
+    (merged via :func:`read_sharded_store`)."""
+    out = read_sharded_store(path)
     out.pop("generations")
     return out
 

@@ -395,21 +395,33 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
     """Walk ``directory`` recursively; for every known artifact whose run
     dir has no rendered .png yet (unless ``redo``), render all applicable
     views (``search_and_apply``, ``visualization.py:255-275``)."""
+    import re
+
     outputs = []
     for root, _dirs, files in os.walk(directory):
-        for f in files:  # native trajectory stores render like soup artifacts
+        # native trajectory stores render like soup artifacts; a multihost
+        # capture leaves only per-process shards (soup.traj.pNNNNofMMMM) —
+        # collapse those to their base name so the merged store renders once
+        bases = set()
+        for f in files:
             if f.endswith(".traj"):
-                stem = f[:-5] + "_trajectories_3d"
-                done = all(os.path.exists(os.path.join(root, stem + ext))
-                           for ext in (".png", ".html"))
-                if done and not redo:
-                    continue
-                from .utils import read_store_artifact
-                try:
-                    outputs += _render_traj_views(
-                        read_store_artifact(os.path.join(root, f)), root, stem)
-                except Exception as e:
-                    print(f"viz: skipping {f} in {root}: {e!r}")
+                bases.add(f)
+            else:
+                m = re.match(r"(.+\.traj)\.p\d+of\d+$", f)
+                if m:
+                    bases.add(m.group(1))
+        for f in sorted(bases):
+            stem = f[:-5] + "_trajectories_3d"
+            done = all(os.path.exists(os.path.join(root, stem + ext))
+                       for ext in (".png", ".html"))
+            if done and not redo:
+                continue
+            from .utils import read_store_artifact
+            try:
+                outputs += _render_traj_views(
+                    read_store_artifact(os.path.join(root, f)), root, stem)
+            except Exception as e:
+                print(f"viz: skipping {f} in {root}: {e!r}")
         basenames = {f.rsplit(".", 1)[0] for f in files
                      if f.endswith((".npz", ".json"))}
         for base, (renderer, marker) in RENDERERS.items():

@@ -51,3 +51,64 @@ def test_jnp_fallback_matches_vmap():
     ref = jax.vmap(lambda w: apply_to_weights(WW, w, w))(pop)
     out = ww_apply_population_jnp(WW, pop.T)
     np.testing.assert_allclose(np.asarray(out.T), np.asarray(ref), rtol=1e-5, atol=1e-7)
+
+
+# --------------------------------------------- fused sequential-SGD kernel
+
+
+def test_pallas_train_matches_xla_chain():
+    """The hand-derived linear backward reproduces jax.grad's batch-1
+    sequential chain (ops/popmajor._ww_seq_sgd_flat) to float tolerance."""
+    from srnn_tpu.ops.pallas_ww_train import (ww_learn_epochs_pallas,
+                                              ww_train_epochs_pallas)
+    from srnn_tpu.ops.popmajor import (ww_learn_epochs_popmajor,
+                                       ww_train_epochs_popmajor)
+
+    # width=3 exercises a non-default shape; P stays small — interpret-mode
+    # compile time grows superlinearly in the chain length (P^2 per epoch)
+    topo = Topology("weightwise", width=3, depth=2)
+    wT = (init_population(topo, jax.random.key(0), 40) * 0.3).T
+    ref_w, ref_l = ww_train_epochs_popmajor(topo, wT, 3)
+    got_w, got_l = ww_train_epochs_pallas(topo, wT, 3, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_w), np.asarray(ref_w),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref_l),
+                               rtol=1e-5, atol=1e-6)
+
+    other = (init_population(topo, jax.random.key(1), 40) * 0.3).T
+    ref_w, ref_l = ww_learn_epochs_popmajor(topo, wT, other, 2)
+    got_w, got_l = ww_learn_epochs_pallas(topo, wT, other, 2, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_w), np.asarray(ref_w),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref_l),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_train_soup_parity_and_fences():
+    """A full-dynamics popmajor soup with train_impl='pallas' tracks the
+    XLA-path soup; unsupported configs are rejected upfront."""
+    import pytest
+
+    from srnn_tpu.soup import SoupConfig, evolve, evolve_step, seed
+
+    topo = Topology("weightwise", width=2, depth=2)
+    cfg_x = SoupConfig(topo=topo, size=12, attacking_rate=0.4,
+                       learn_from_rate=0.3, learn_from_severity=1, train=2,
+                       remove_divergent=True, remove_zero=True,
+                       layout="popmajor")
+    cfg_p = cfg_x._replace(train_impl="pallas")
+    st = seed(cfg_x, jax.random.key(2))
+    ref = evolve(cfg_x, st, generations=4)
+    got = evolve(cfg_p, st, generations=4)
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(got.uids))
+    np.testing.assert_allclose(np.asarray(ref.weights),
+                               np.asarray(got.weights), rtol=1e-4, atol=1e-5)
+
+    with pytest.raises(ValueError):  # rowmajor never reaches the kernel
+        evolve_step(cfg_p._replace(layout="rowmajor"), st)
+    with pytest.raises(ValueError):  # full_batch has no sequential chain
+        evolve_step(cfg_p._replace(train_mode="full_batch"), st)
+    sig = Topology("weightwise", width=2, depth=2, activation="sigmoid")
+    with pytest.raises(ValueError):  # nonlinear backward not hand-derived
+        evolve_step(cfg_p._replace(topo=sig), seed(cfg_x._replace(topo=sig),
+                                                   jax.random.key(0)))

@@ -315,3 +315,28 @@ def test_sharded_capture_kill_resume_with_mergeable_shards(tmp_path, mesh):
     run_shard(0, 2, "a")
     merged2 = read_sharded_store(base)
     assert merged2["generations"].shape[0] == 5  # torn 6th frame excluded
+
+
+def test_sharded_artifact_renders_in_viz(tmp_path, mesh):
+    """read_store_artifact accepts a shard-set base path, so the analysis
+    pipeline (viz) consumes multihost captures unchanged."""
+    from srnn_tpu import viz
+    from srnn_tpu.parallel import make_sharded_state
+    from srnn_tpu.utils import (open_process_shard, read_store_artifact,
+                                sharded_evolve_captured)
+
+    cfg = _sharded_cap_cfg()
+    base = str(tmp_path / "soup.traj")
+    for pi in range(2):
+        st = make_sharded_state(cfg, mesh, jax.random.key(5))
+        with open_process_shard(cfg, base, process_index=pi,
+                                num_processes=2) as store:
+            sharded_evolve_captured(cfg, mesh, st, 6, store, every=2,
+                                    process_index=pi, num_processes=2)
+    art = read_store_artifact(base)
+    assert art["weights"].shape == (3, cfg.size, cfg.topo.num_weights)
+    img = viz.plot_latent_trajectories_3d(art, str(tmp_path / "m.png"))
+    assert os.path.getsize(img) > 5000
+    # the run-dir walker discovers shard sets too (no plain .traj exists)
+    outputs = viz.search_and_apply(str(tmp_path))
+    assert any("soup_trajectories_3d" in o for o in outputs)
