@@ -265,8 +265,12 @@ def _use_pallas_sgd(topo: Topology, mode: str, impl: str) -> bool:
     batch-1 sequential mode with the linear activation (hand-derived
     backward).  Non-TPU backends run it in the (slow) interpreter, so the
     XLA path stays the default there."""
+    # unrolled-chain length grows ~P^2 per epoch; beyond small science
+    # topologies the compile cost dwarfs the fusion win, so big particles
+    # keep the XLA scan
     return (impl == "pallas" and topo.variant == "weightwise"
-            and mode == "sequential" and topo.activation == "linear")
+            and mode == "sequential" and topo.activation == "linear"
+            and topo.num_weights <= 64)
 
 
 def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
