@@ -80,7 +80,9 @@ def _measure(topo, n, steps, calls):
     # throughput is magnitude-independent
     wT = (init_population(topo, jax.random.key(0), n) * 0.05).T
 
-    use_pallas = jax.default_backend() == "tpu"  # Mosaic kernel is TPU-only
+    from srnn_tpu.ops.pallas_ww import native_mosaic_backend
+
+    use_pallas = native_mosaic_backend()
 
     @jax.jit
     def run(wT):

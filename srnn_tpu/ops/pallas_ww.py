@@ -37,6 +37,17 @@ from ..topology import Topology, normalized_weight_coords
 LANE_BLOCK = 2048  # particles per grid step; (14, 2048) f32 tiles = 112 KiB
 
 
+def native_mosaic_backend() -> bool:
+    """True when the default backend lowers Mosaic kernels natively.
+
+    Conservative: only 'tpu'.  The tunneled 'axon' backend advertises a
+    remote Pallas compile path (PALLAS_AXON_REMOTE_COMPILE) but has never
+    been verified to lower these kernels — extend the set once proven on a
+    live tunnel.  Shared by bench.py and the popmajor SGD dispatch so the
+    two sites cannot diverge."""
+    return jax.default_backend() == "tpu"
+
+
 def _ww_kernel(coords_ref, w_ref, out_ref, *, topo: Topology, steps: int):
     """One lane-block: w_ref/out_ref are (P, BN) VMEM tiles; coords_ref is
     the (P, 3) normalized positional-encoding table (same for all blocks).

@@ -261,16 +261,40 @@ def apply_popmajor(topo: Topology, selfT: jnp.ndarray,
 
 
 def _use_pallas_sgd(topo: Topology, mode: str, impl: str) -> bool:
-    """The fused Pallas SGD chain applies to the weightwise variant's
-    batch-1 sequential mode with the linear activation (hand-derived
-    backward).  Non-TPU backends run it in the (slow) interpreter, so the
-    XLA path stays the default there."""
-    # unrolled-chain length grows ~P^2 per epoch; beyond small science
-    # topologies the compile cost dwarfs the fusion win, so big particles
-    # keep the XLA scan
-    return (impl == "pallas" and topo.variant == "weightwise"
-            and mode == "sequential" and topo.activation == "linear"
-            and topo.num_weights <= 64)
+    """Route to the fused Pallas SGD chain?  Applies to the weightwise
+    variant's batch-1 sequential mode with the linear activation
+    (hand-derived backward).  Other variants/modes fall back silently — the
+    heterogeneous multisoup dispatches per type by design — but a
+    weightwise config that CANNOT take the kernel raises rather than
+    silently executing the XLA path under a 'pallas' label."""
+    if impl != "pallas":
+        return False
+    if (topo.variant != "weightwise" or mode != "sequential"
+            or topo.activation != "linear"):
+        return False  # per-type / per-mode fallback (multisoup dispatch)
+    if topo.num_weights > 64:
+        # unrolled-chain length grows ~P^2 per epoch; beyond small science
+        # topologies the compile cost dwarfs the fusion win
+        raise ValueError(
+            f"train_impl='pallas' supports weightwise particles up to 64 "
+            f"weights (got {topo.num_weights}); use train_impl='xla'")
+    return True
+
+
+def _pallas_interpret(n: int) -> bool:
+    """Interpreter only at test scale on non-Mosaic backends; at
+    population scale it would be a silent near-hang, so demand the XLA
+    path explicitly instead."""
+    from .pallas_ww import native_mosaic_backend
+
+    if native_mosaic_backend():
+        return False
+    if n <= 4096:
+        return True
+    raise ValueError(
+        "train_impl='pallas' needs a native Mosaic backend at this "
+        "population size (the interpreter would be pathologically slow); "
+        "use train_impl='xla' on this platform")
 
 
 def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
@@ -280,8 +304,7 @@ def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
         from .pallas_ww_train import ww_train_epochs_pallas
 
         return ww_train_epochs_pallas(
-            topo, wT, epochs, lr,
-            interpret=jax.default_backend() != "tpu")
+            topo, wT, epochs, lr, interpret=_pallas_interpret(wT.shape[1]))
     if topo.variant == "weightwise":
         return ww_train_epochs_popmajor(topo, wT, epochs, lr, mode)
     if topo.variant == "recurrent":
@@ -301,7 +324,7 @@ def learn_epochs_popmajor(topo: Topology, wT: jnp.ndarray, otherT: jnp.ndarray,
 
         return ww_learn_epochs_pallas(
             topo, wT, otherT, severity, lr,
-            interpret=jax.default_backend() != "tpu")
+            interpret=_pallas_interpret(wT.shape[1]))
     if topo.variant == "weightwise":
         return ww_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)
     if topo.variant == "recurrent":

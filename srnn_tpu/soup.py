@@ -337,13 +337,16 @@ def _check_popmajor(config: SoupConfig) -> None:
     if config.train_impl == "pallas" and (
             config.topo.variant != "weightwise"
             or config.train_mode != "sequential"
-            or config.topo.activation != "linear"):
+            or config.topo.activation != "linear"
+            or config.topo.num_weights > 64):
         raise ValueError(
             "train_impl='pallas' fuses the weightwise batch-1 sequential "
             "SGD chain with a hand-derived LINEAR backward; this config "
+            "(up to 64 weights); this config "
             f"(variant={config.topo.variant!r}, "
             f"train_mode={config.train_mode!r}, "
-            f"activation={config.topo.activation!r}) needs train_impl='xla'")
+            f"activation={config.topo.activation!r}, "
+            f"P={config.topo.num_weights}) needs train_impl='xla'")
 
 
 def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
