@@ -325,3 +325,45 @@ def restore_checkpoint(path: str) -> SoupState:
     with ocp.PyTreeCheckpointer() as ckptr:
         tree = ckptr.restore(path)
     return _soup_state_from_pytree(tree)
+
+
+def save_multi_checkpoint(path: str, state) -> str:
+    """Resumable checkpoint of a heterogeneous (``MultiSoupState``) soup:
+    per-type weights/uids lists + scalars + raw PRNG key data."""
+    import orbax.checkpoint as ocp
+
+    tree = {
+        "weights": list(state.weights),
+        "uids": list(state.uids),
+        "next_uid": state.next_uid,
+        "time": state.time,
+        "key_data": jax.random.key_data(state.key),
+        "key_impl": str(jax.random.key_impl(state.key)),
+    }
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=True)
+    return path
+
+
+def restore_multi_checkpoint(path: str):
+    """Load a :func:`save_multi_checkpoint` back into a ``MultiSoupState``
+    (bit-exact continuation, same PRNG stream)."""
+    import orbax.checkpoint as ocp
+
+    import jax.numpy as jnp
+
+    from .multisoup import MultiSoupState
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(path)
+    key = jax.random.wrap_key_data(
+        jnp.asarray(tree["key_data"]), impl=str(tree["key_impl"]))
+    return MultiSoupState(
+        weights=tuple(jnp.asarray(w) for w in tree["weights"]),
+        uids=tuple(jnp.asarray(u) for u in tree["uids"]),
+        next_uid=jnp.asarray(tree["next_uid"]),
+        time=jnp.asarray(tree["time"]),
+        key=key,
+    )

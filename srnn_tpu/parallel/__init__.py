@@ -8,6 +8,7 @@ from .sharded_soup import (
 )
 from .sharded_multisoup import (
     make_sharded_multi_state,
+    place_sharded_multi_state,
     sharded_evolve_multi,
     sharded_evolve_multi_step,
     sharded_count_multi,
@@ -35,6 +36,7 @@ __all__ = [
     "sharded_evolve",
     "sharded_count",
     "make_sharded_multi_state",
+    "place_sharded_multi_state",
     "sharded_evolve_multi_step",
     "sharded_evolve_multi",
     "sharded_count_multi",

@@ -61,8 +61,7 @@ from ..soup import (
 from .mesh import SOUP_AXIS
 
 
-def _mstate_specs(config: MultiSoupConfig) -> MultiSoupState:
-    t = len(config.topos)
+def _mstate_specs(t: int) -> MultiSoupState:
     return MultiSoupState(
         weights=tuple(P(SOUP_AXIS) for _ in range(t)),
         uids=tuple(P(SOUP_AXIS) for _ in range(t)),
@@ -352,8 +351,8 @@ def sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(_mstate_specs(config),),
-        out_specs=(_mstate_specs(config), _mevent_specs(config)),
+        in_specs=(_mstate_specs(len(config.topos)),),
+        out_specs=(_mstate_specs(len(config.topos)), _mevent_specs(config)),
         check_vma=False,
     )
     return fn(state)
@@ -388,8 +387,8 @@ def sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         fn = shard_map(
             local_run_t,
             mesh=mesh,
-            in_specs=(_mstate_specs(config),),
-            out_specs=_mstate_specs(config),
+            in_specs=(_mstate_specs(len(config.topos)),),
+            out_specs=_mstate_specs(len(config.topos)),
             check_vma=False,
         )
         return fn(state)
@@ -405,8 +404,8 @@ def sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
     fn = shard_map(
         local_run,
         mesh=mesh,
-        in_specs=(_mstate_specs(config),),
-        out_specs=_mstate_specs(config),
+        in_specs=(_mstate_specs(len(config.topos)),),
+        out_specs=_mstate_specs(len(config.topos)),
         check_vma=False,
     )
     return fn(state)
@@ -433,17 +432,24 @@ def sharded_count_multi(config: MultiSoupConfig, mesh: Mesh,
     return fn(*state.weights)
 
 
-def make_sharded_multi_state(config: MultiSoupConfig, mesh: Mesh,
-                             key: jax.Array) -> MultiSoupState:
-    """Seed a mixed population already placed with the per-type sharding."""
+def place_sharded_multi_state(mesh: Mesh, state: MultiSoupState
+                              ) -> MultiSoupState:
+    """Place an existing ``MultiSoupState`` (fresh-seeded or
+    checkpoint-restored) with the per-type particle sharding."""
     n_dev = mesh.devices.size
-    for t, n_t in enumerate(config.sizes):
-        if n_t % n_dev:
+    for t, w in enumerate(state.weights):
+        if w.shape[0] % n_dev:
             raise ValueError(
-                f"type-{t} population {n_t} must be divisible by the mesh's "
-                f"{n_dev} devices (each device owns an equal shard per type)")
-    state = seed_multi(config, key)
-    specs = _mstate_specs(config)
+                f"type-{t} population {w.shape[0]} must be divisible by the "
+                f"mesh's {n_dev} devices (each device owns an equal shard "
+                "per type)")
+    specs = _mstate_specs(len(state.weights))
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         state, specs)
+
+
+def make_sharded_multi_state(config: MultiSoupConfig, mesh: Mesh,
+                             key: jax.Array) -> MultiSoupState:
+    """Seed a mixed population already placed with the per-type sharding."""
+    return place_sharded_multi_state(mesh, seed_multi(config, key))

@@ -11,6 +11,7 @@ from . import (  # noqa: F401  (import for registration side effect)
     fixpoint_density,
     known_fixpoint_variation,
     learn_from_soup,
+    mega_multisoup,
     mega_soup,
     mixed_self_fixpoints,
     mixed_soup,

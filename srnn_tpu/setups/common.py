@@ -11,6 +11,7 @@ and registers itself so ``python -m srnn_tpu.setups <name>`` dispatches.
 """
 
 import argparse
+import os
 from typing import Callable, Dict, Tuple
 
 import jax
@@ -81,3 +82,45 @@ def log_sweep(exp: Experiment, name: str, data: dict):
 
 def log_counters(exp: Experiment, name: str, counts) -> None:
     exp.log(f"{name}: {format_counters(counts)}", counts=np.asarray(counts), name=name)
+
+
+# ---- shared mega-run plumbing (mega_soup / mega_multisoup) ----------------
+
+
+def latest_checkpoint(run_dir: str) -> str:
+    """Newest FINALIZED ckpt-gen* dir (a kill during save leaves orbax tmp
+    dirs named ckpt-genNNN.orbax-checkpoint-tmp-* that must not be picked
+    up; the isdigit filter excludes them)."""
+    import glob as _glob
+
+    ckpts = sorted(
+        (p for p in _glob.glob(os.path.join(run_dir, "ckpt-gen*"))
+         if p.rsplit("gen", 1)[1].isdigit()),
+        key=lambda p: int(p.rsplit("gen", 1)[1]))
+    if not ckpts:
+        raise FileNotFoundError(
+            f"no finalized ckpt-gen* checkpoints under {run_dir}")
+    return ckpts[-1]
+
+
+def save_run_config(run_dir: str, args, fields) -> None:
+    import json as _json
+
+    with open(os.path.join(run_dir, "config.json"), "w") as f:
+        _json.dump({k: getattr(args, k) for k in fields}, f, indent=1)
+
+
+def load_run_config(run_dir: str, args, fields, legacy_defaults=None) -> None:
+    """Resume continues the ORIGINAL run's dynamics: saved fields override
+    the CLI.  ``legacy_defaults`` pins fields whose CLI default no longer
+    matches the behavior that existed when old configs were written (e.g.
+    respawn_draws) — falling back to the new CLI default would silently
+    change a resumed run's dynamics."""
+    import json as _json
+
+    with open(os.path.join(run_dir, "config.json")) as f:
+        saved = _json.load(f)
+    legacy = legacy_defaults or {}
+    for k in fields:
+        fallback = legacy.get(k, getattr(args, k))
+        setattr(args, k, saved.get(k, fallback))

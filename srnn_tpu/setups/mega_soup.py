@@ -16,8 +16,6 @@ Interrupted runs continue from the last checkpoint on the SAME PRNG stream,
 so an interrupted-and-resumed run reproduces an uninterrupted one exactly.
 """
 
-import glob
-import json
 import os
 import sys
 
@@ -28,7 +26,8 @@ from ..experiment import (Experiment, counters_dict, format_counters,
                           restore_checkpoint, save_checkpoint)
 from ..soup import SoupConfig, count, evolve, seed
 from ..topology import Topology
-from .common import base_parser, register
+from .common import (base_parser, latest_checkpoint,
+                     load_run_config, register, save_run_config)
 
 
 def build_parser():
@@ -64,49 +63,9 @@ def build_parser():
     return p
 
 
-def _latest_checkpoint(run_dir: str):
-    # only finalized checkpoints: a kill during save leaves orbax tmp dirs
-    # (ckpt-genNNN.orbax-checkpoint-tmp-*) that must not be picked up
-    ckpts = sorted(
-        (p for p in glob.glob(os.path.join(run_dir, "ckpt-gen*"))
-         if p.rsplit("gen", 1)[1].isdigit()),
-        key=lambda p: int(p.rsplit("gen", 1)[1]))
-    if not ckpts:
-        raise FileNotFoundError(f"no finalized ckpt-gen* checkpoints under {run_dir}")
-    return ckpts[-1]
-
-
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
                   "train_mode", "layout", "epsilon", "capture_every",
                   "sharded", "respawn_draws")
-
-
-def _save_config(run_dir: str, args) -> None:
-    with open(os.path.join(run_dir, "config.json"), "w") as f:
-        json.dump({k: getattr(args, k) for k in _CONFIG_FIELDS}, f, indent=1)
-
-
-def _load_config(run_dir: str, args) -> None:
-    """Resume must continue the ORIGINAL run's dynamics (size, rates, train
-    schedule, layout) AND its capture cadence — a resume that omits
-    ``--capture-every`` must not silently stop capturing.  The horizon
-    (``--generations``) and checkpoint cadence stay CLI-controlled —
-    extending a finished run is legitimate."""
-    path = os.path.join(run_dir, "config.json")
-    with open(path) as f:
-        saved = json.load(f)
-    for k in _CONFIG_FIELDS:
-        if k == "respawn_draws":
-            # configs written before this field existed ran the only
-            # behavior of their time — per-particle draws.  Falling back to
-            # the CLI value (default now 'fused') would silently change the
-            # run's respawn stream mid-resume.
-            setattr(args, k, saved.get(k, "perparticle"))
-        else:
-            # .get: config.json files written before the field was persisted
-            # fall back to the CLI value rather than failing the resume
-            # (safe for these fields: each CLI default matches old behavior)
-            setattr(args, k, saved.get(k, getattr(args, k)))
 
 
 def run(args):
@@ -121,8 +80,12 @@ def run(args):
     # a bad invocation can never leave a run dir without meta.json
     ckpt = None
     if args.resume:
-        _load_config(args.resume, args)  # original dynamics win over CLI
-        ckpt = _latest_checkpoint(args.resume)
+        # original dynamics win over CLI; legacy configs written before
+        # respawn_draws existed ran per-particle draws — the new 'fused'
+        # CLI default must not silently change a resumed run's stream
+        load_run_config(args.resume, args, _CONFIG_FIELDS,
+                        legacy_defaults={"respawn_draws": "perparticle"})
+        ckpt = latest_checkpoint(args.resume)
     if args.capture_every and args.checkpoint_every % args.capture_every:
         raise SystemExit("--capture-every must divide --checkpoint-every")
     if args.capture_every and args.generations % args.capture_every:
@@ -146,7 +109,7 @@ def run(args):
                 f"at generation {int(state.time)}")
     else:
         exp = Experiment("mega-soup", root=args.root, seed=args.seed).__enter__()
-        _save_config(exp.dir, args)
+        save_run_config(exp.dir, args, _CONFIG_FIELDS)
         if mesh is not None:
             from ..parallel import make_sharded_state
             state = make_sharded_state(cfg, mesh, jax.random.key(args.seed))

@@ -341,8 +341,8 @@ def _check_popmajor(config: SoupConfig) -> None:
             or config.topo.num_weights > 64):
         raise ValueError(
             "train_impl='pallas' fuses the weightwise batch-1 sequential "
-            "SGD chain with a hand-derived LINEAR backward; this config "
-            "(up to 64 weights); this config "
+            "SGD chain with a hand-derived LINEAR backward for particles "
+            "up to 64 weights; this config "
             f"(variant={config.topo.variant!r}, "
             f"train_mode={config.train_mode!r}, "
             f"activation={config.topo.activation!r}, "

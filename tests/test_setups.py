@@ -17,9 +17,10 @@ ALL = sorted(REGISTRY)
 def test_registry_covers_reference_scripts_plus_mega_soup():
     assert ALL == [
         "applying_fixpoints", "fixpoint_density", "known_fixpoint_variation",
-        "learn_from_soup", "mega_soup", "mixed_self_fixpoints", "mixed_soup",
-        "network_trajectorys", "soup_trajectorys", "training_fixpoints",
-    ]  # the nine reference scripts + the mega-soup north-star entry point
+        "learn_from_soup", "mega_multisoup", "mega_soup",
+        "mixed_self_fixpoints", "mixed_soup", "network_trajectorys",
+        "soup_trajectorys", "training_fixpoints",
+    ]  # the nine reference scripts + the two mega-scale entry points
 
 
 @pytest.mark.parametrize("name", ALL)
@@ -202,3 +203,32 @@ def test_mega_soup_sharded_capture_and_resume(tmp_path):
     out = read_sharded_store(os.path.join(d_half, "soup.traj"))
     assert out["generations"].tolist() == [2, 4, 6]
     np.testing.assert_array_equal(out["weights"][-1], np.asarray(got.weights))
+
+
+def test_mega_multisoup_bit_exact_resume_and_sharded(tmp_path):
+    """The heterogeneous mega-soup entry point checkpoints MultiSoupState
+    and resumes bit-exactly; the sharded path produces a valid run too."""
+    from srnn_tpu.experiment import restore_multi_checkpoint
+
+    d_full = REGISTRY["mega_multisoup"](
+        ["--smoke", "--root", str(tmp_path / "full")])
+    d_half = REGISTRY["mega_multisoup"](
+        ["--smoke", "--root", str(tmp_path / "half"), "--generations", "4"])
+    d_resumed = REGISTRY["mega_multisoup"](
+        ["--smoke", "--resume", d_half, "--attacking-rate", "0.9"])
+    assert d_resumed == d_half
+
+    want = restore_multi_checkpoint(os.path.join(d_full, "ckpt-gen00000006"))
+    got = restore_multi_checkpoint(os.path.join(d_half, "ckpt-gen00000006"))
+    for t in range(3):
+        np.testing.assert_array_equal(np.asarray(want.weights[t]),
+                                      np.asarray(got.weights[t]))
+        np.testing.assert_array_equal(np.asarray(want.uids[t]),
+                                      np.asarray(got.uids[t]))
+    assert int(got.time) == 6
+    log = open(os.path.join(d_half, "log.txt")).read()
+    assert "resumed from ckpt-gen00000004" in log and "done:" in log
+
+    d_sh = REGISTRY["mega_multisoup"](
+        ["--smoke", "--root", str(tmp_path / "sh"), "--sharded"])
+    assert "done:" in open(os.path.join(d_sh, "log.txt")).read()
