@@ -14,9 +14,11 @@ logging, and the sharded (ICI data-parallel) path.
     python -m srnn_tpu.setups mega_multisoup --size 1000000 --generations 1000
     python -m srnn_tpu.setups mega_multisoup --resume experiments/exp-mega-multisoup-…-0
 
-Trajectory capture stays with the homogeneous ``mega_soup`` entry point
-(the heterogeneous store would need one `.traj` per type — a documented
-boundary, not an accident).
+Trajectory capture writes one ``.traj`` store per type (``soup.t0.traj``,
+``soup.t1.traj``, ...) via ``utils.evolve_multi_captured``; capture under
+sharding stays with the homogeneous ``mega_soup`` entry point (per-process
+AND per-type shards would compose, but nothing exercises it yet — a
+documented boundary, not an accident).
 """
 
 import os
@@ -52,6 +54,11 @@ def build_parser():
                    default="fused")
     p.add_argument("--train-impl", choices=("xla", "pallas"), default="xla")
     p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--capture-every", type=int, default=0, metavar="K",
+                   help="stream every K-th generation's per-type frames to "
+                        "soup.tN.traj stores (0 = off); must divide "
+                        "--checkpoint-every and --generations; not combined "
+                        "with --sharded")
     p.add_argument("--resume", default=None, metavar="RUN_DIR")
     p.add_argument("--sharded", action="store_true",
                    help="shard every type's particle axis over ALL visible "
@@ -61,7 +68,8 @@ def build_parser():
 
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate",
                   "learn_from_severity", "train", "train_mode", "layout",
-                  "epsilon", "sharded", "respawn_draws", "train_impl")
+                  "epsilon", "sharded", "respawn_draws", "train_impl",
+                  "capture_every")
 
 
 def _make_config(args, n_dev: int = 1) -> MultiSoupConfig:
@@ -113,6 +121,14 @@ def run(args):
     if args.resume:
         load_run_config(args.resume, args, _CONFIG_FIELDS)
         ckpt = latest_checkpoint(args.resume)
+    if args.capture_every and args.checkpoint_every % args.capture_every:
+        raise SystemExit("--capture-every must divide --checkpoint-every")
+    if args.capture_every and args.generations % args.capture_every:
+        raise SystemExit("--capture-every must divide --generations")
+    if args.capture_every and args.sharded:
+        raise SystemExit("--capture-every is single-process for the "
+                         "heterogeneous soup; drop --sharded (the "
+                         "homogeneous mega_soup captures under sharding)")
     mesh = None
     n_dev = 1
     if args.sharded:
@@ -123,11 +139,26 @@ def run(args):
             raise SystemExit(
                 f"--sharded needs --size divisible by the {n_dev} visible "
                 f"devices (got {args.size})")
+        if args.size < 3 * n_dev:
+            # the per-type rounding below would otherwise zero out a type
+            # and silently run a homogeneous soup from this entry point
+            raise SystemExit(
+                f"--sharded needs --size >= 3x the {n_dev} visible devices "
+                "so every type keeps at least one shard per device")
     cfg = _make_config(args, n_dev)
 
     if args.resume:
         exp = Experiment.attach(args.resume)
         state = restore_multi_checkpoint(ckpt)
+        got = tuple(w.shape[0] for w in state.weights)
+        if got != cfg.sizes:
+            # per-type sizes derive from the CURRENT device count under
+            # --sharded; a resume on a different mesh would slice the
+            # restored arrays with wrong offsets deep in jit otherwise
+            raise SystemExit(
+                f"checkpointed per-type sizes {got} do not match this "
+                f"host's derived sizes {cfg.sizes}; resume on the original "
+                "device count")
         if mesh is not None:
             from ..parallel import place_sharded_multi_state
             state = place_sharded_multi_state(mesh, state)
@@ -160,14 +191,39 @@ def run(args):
             return sharded_evolve_multi(cfg, mesh, s, generations=gens)
         return evolve_multi(cfg, s, generations=gens)
 
+    stores = None
     import time as _time
     try:
+        if args.capture_every:
+            from ..utils import TrajStore, truncate_frames
+            paths = [os.path.join(exp.dir, f"soup.t{t}.traj")
+                     for t in range(len(cfg.topos))]
+            if args.resume:
+                # reconcile every per-type store to the restored checkpoint
+                # so re-evolved generations aren't appended twice
+                for path in paths:
+                    truncate_frames(path,
+                                    int(state.time) // args.capture_every)
+            stores = [TrajStore(path, n_particles=cfg.sizes[t],
+                                n_weights=cfg.topos[t].num_weights,
+                                mode="a" if args.resume else "w")
+                      for t, path in enumerate(paths)]
+            if stores[0].existing_frames:
+                exp.log(f"soup.t*.traj: appending after "
+                        f"{stores[0].existing_frames} existing frames")
+            exp.log(f"capturing every {args.capture_every} generations to "
+                    f"{len(stores)} per-type stores")
         counts = _count(state)
         while int(state.time) < args.generations:
             chunk = min(args.checkpoint_every,
                         args.generations - int(state.time))
             t0 = _time.perf_counter()
-            state = _evolve(state, chunk)
+            if stores is not None:
+                from ..utils import evolve_multi_captured
+                state = evolve_multi_captured(cfg, state, chunk, stores,
+                                              every=args.capture_every)
+            else:
+                state = _evolve(state, chunk)
             counts = _count(state)
             dt = _time.perf_counter() - t0
             gen = int(state.time)
@@ -179,7 +235,12 @@ def run(args):
                                   state)
         exp.log(f"done: {_format_type_counts(counts)}")
     finally:
-        exp.__exit__(*sys.exc_info())
+        try:
+            if stores is not None:
+                for store in stores:
+                    store.close()
+        finally:
+            exp.__exit__(*sys.exc_info())
     return exp.dir
 
 

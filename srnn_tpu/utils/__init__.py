@@ -1,8 +1,8 @@
 from .trajstore import (TrajStore, read_sharded_store, read_store,
                         read_store_artifact, shard_path, truncate_frames,
                         truncate_sharded_frames)
-from .capture import (evolve_captured, open_process_shard,
-                      sharded_evolve_captured)
+from .capture import (evolve_captured, evolve_multi_captured,
+                      open_process_shard, sharded_evolve_captured)
 from .profiling import phase, timed, trace
 from .debug import checked_apply_to_weights, divergence_onset
 from .printing import PrintingObject
@@ -10,7 +10,8 @@ from .printing import PrintingObject
 __all__ = [
     "TrajStore", "read_store", "read_store_artifact", "truncate_frames",
     "read_sharded_store", "shard_path", "truncate_sharded_frames",
-    "evolve_captured", "open_process_shard", "sharded_evolve_captured",
+    "evolve_captured", "evolve_multi_captured",
+    "open_process_shard", "sharded_evolve_captured",
     "phase", "timed", "trace",
     "checked_apply_to_weights", "divergence_onset",
     "PrintingObject",

@@ -51,6 +51,41 @@ def evolve_captured(
     return state
 
 
+def evolve_multi_captured(
+    config,
+    state,
+    generations: int,
+    stores,
+    every: int = 1,
+):
+    """Heterogeneous-soup twin of :func:`evolve_captured`: one
+    :class:`TrajStore` per TYPE (``stores[t]`` holds type t's (N_t, P_t)
+    frames), so the mixed mega-soup's history survives at scale the same
+    way the homogeneous one's does.  Returns the final state."""
+    from ..multisoup import evolve_multi, evolve_multi_step
+
+    if generations % every != 0:
+        raise ValueError(
+            f"generations={generations} not divisible by every={every}")
+    if len(stores) != len(config.topos):
+        raise ValueError(f"need one store per type "
+                         f"({len(config.topos)}), got {len(stores)}")
+    for _ in range(generations // every):
+        if every > 1:
+            state = evolve_multi(config, state, generations=every - 1)
+        state, events = evolve_multi_step(config, state)
+        frame = jax.device_get(
+            (state.time, state.weights, state.uids,
+             events.action, events.counterpart, events.loss))
+        t, ws, uids, action, counterpart, loss = frame
+        for i, store in enumerate(stores):
+            store.append(int(t), ws[i], uids[i], action[i], counterpart[i],
+                         loss[i])
+    for store in stores:
+        store.flush()
+    return state
+
+
 # ---------------------------------------------------------------------------
 # Multihost-aware sharded capture (round-3 gap: the path above pulls FULL
 # global frames to one host — ~56 MB x every captured frame over DCN at real

@@ -232,3 +232,24 @@ def test_mega_multisoup_bit_exact_resume_and_sharded(tmp_path):
     d_sh = REGISTRY["mega_multisoup"](
         ["--smoke", "--root", str(tmp_path / "sh"), "--sharded"])
     assert "done:" in open(os.path.join(d_sh, "log.txt")).read()
+
+
+def test_mega_multisoup_per_type_capture_survives_resume(tmp_path):
+    """Per-type .traj stores capture the heterogeneous soup and append
+    across a resume (homogeneous mega_soup capture semantics, per type)."""
+    from srnn_tpu.utils import read_store
+
+    d = REGISTRY["mega_multisoup"](
+        ["--smoke", "--root", str(tmp_path), "--generations", "4",
+         "--capture-every", "2"])
+    pre = read_store(os.path.join(d, "soup.t0.traj"))
+    assert pre["generations"].tolist() == [2, 4]
+    d_resumed = REGISTRY["mega_multisoup"](["--smoke", "--resume", d])
+    assert d_resumed == d
+    for t, n_t in enumerate((16, 16, 16)):  # smoke split of 48
+        out = read_store(os.path.join(d, f"soup.t{t}.traj"))
+        assert out["generations"].tolist() == [2, 4, 6]
+        assert out["weights"].shape[1] == n_t
+    np.testing.assert_array_equal(
+        read_store(os.path.join(d, "soup.t0.traj"))["weights"][:2],
+        pre["weights"])
