@@ -1,3 +1,4 @@
+import os
 import sys
 
 from . import REGISTRY
@@ -9,7 +10,17 @@ def main(argv=None):
         names = "\n  ".join(sorted(REGISTRY))
         print(f"usage: python -m srnn_tpu.setups <name> [flags]\n\nnames:\n  {names}")
         return 2 if argv and argv[0] not in ("-h", "--help") else 0
-    return REGISTRY[argv[0]](argv[1:]) and 0
+    if os.environ.get("SRNN_SETUPS_PLATFORM") == "cpu":
+        # config-level CPU pin for subprocess callers (tests, CI): the axon
+        # sitecustomize overrides the JAX_PLATFORMS env var at register()
+        # time, so the env route cannot keep a child off a wedged tunnel
+        from ..utils.backend import force_cpu
+
+        force_cpu()
+    out = REGISTRY[argv[0]](argv[1:])
+    if isinstance(out, str):
+        print(out)  # the run directory — scriptable like the run() API
+    return 0
 
 
 if __name__ == "__main__":

@@ -121,6 +121,8 @@ def run(args):
     if args.resume:
         load_run_config(args.resume, args, _CONFIG_FIELDS)
         ckpt = latest_checkpoint(args.resume)
+    if args.capture_every < 0:
+        raise SystemExit("--capture-every must be >= 0")
     if args.capture_every and args.checkpoint_every % args.capture_every:
         raise SystemExit("--capture-every must divide --checkpoint-every")
     if args.capture_every and args.generations % args.capture_every:
@@ -208,6 +210,14 @@ def run(args):
                                 n_weights=cfg.topos[t].num_weights,
                                 mode="a" if args.resume else "w")
                       for t, path in enumerate(paths)]
+            frames = {s_.existing_frames for s_ in stores}
+            if len(frames) > 1:
+                # one torn/missing per-type store would otherwise restart
+                # fresh while siblings keep history, silently misaligning
+                # frame indices across types
+                raise SystemExit(
+                    f"per-type stores disagree on existing frames {frames}; "
+                    "repair or remove soup.t*.traj before resuming")
             if stores[0].existing_frames:
                 exp.log(f"soup.t*.traj: appending after "
                         f"{stores[0].existing_frames} existing frames")

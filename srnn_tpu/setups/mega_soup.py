@@ -86,6 +86,8 @@ def run(args):
         load_run_config(args.resume, args, _CONFIG_FIELDS,
                         legacy_defaults={"respawn_draws": "perparticle"})
         ckpt = latest_checkpoint(args.resume)
+    if args.capture_every < 0:
+        raise SystemExit("--capture-every must be >= 0")
     if args.capture_every and args.checkpoint_every % args.capture_every:
         raise SystemExit("--capture-every must divide --checkpoint-every")
     if args.capture_every and args.generations % args.capture_every:

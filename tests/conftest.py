@@ -7,12 +7,44 @@ jax.config.update instead.  XLA_FLAGS is still read at backend-init time,
 which hasn't happened yet, so the env route works for the device count."""
 
 import os
+import shutil
 import sys
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
+
+# A test run hard-killed mid-compile can leave a truncated entry in the
+# shared compilation cache, and XLA SEGFAULTS deserializing it on every
+# later run (observed: repeatable crash in backend_compile_and_load until
+# the cache was wiped).  Crash detection: a marker file exists for the
+# duration of a session; finding one at startup means the previous run
+# died uncleanly — wipe the cache rather than risk reading poison.
+_CACHE_DIR = os.environ["JAX_COMPILATION_CACHE_DIR"]
+_CRASH_MARKER = os.path.join(_CACHE_DIR, ".session_running") if _CACHE_DIR else None
+if _CRASH_MARKER:
+    if os.path.exists(_CRASH_MARKER):
+        # the marker records the owning pid: a LIVE owner is a concurrent
+        # session (leave its cache alone); a dead one crashed mid-write and
+        # its cache may hold truncated poison — wipe
+        try:
+            owner = int(open(_CRASH_MARKER).read().strip() or "0")
+        except (OSError, ValueError):
+            owner = 0
+        if not (owner and os.path.exists(f"/proc/{owner}")):
+            shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    with open(_CRASH_MARKER, "w") as _f:
+        _f.write(str(os.getpid()))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _CRASH_MARKER:
+        try:
+            os.remove(_CRASH_MARKER)
+        except OSError:
+            pass
 
 import jax
 

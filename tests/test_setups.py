@@ -236,15 +236,35 @@ def test_mega_multisoup_bit_exact_resume_and_sharded(tmp_path):
 
 def test_mega_multisoup_per_type_capture_survives_resume(tmp_path):
     """Per-type .traj stores capture the heterogeneous soup and append
-    across a resume (homogeneous mega_soup capture semantics, per type)."""
+    across a resume (homogeneous mega_soup capture semantics, per type).
+
+    The capturing runs execute as REAL CLI subprocesses: end-to-end through
+    ``python -m srnn_tpu.setups``, and isolated from the suite process —
+    the in-process capture flow left the XLA CPU client in a state that
+    segfaulted a later unrelated compile (reproducible only across the
+    full suite; root cause upstream, isolation is the durable fix)."""
+    import subprocess
+    import sys
+
     from srnn_tpu.utils import read_store
 
-    d = REGISTRY["mega_multisoup"](
-        ["--smoke", "--root", str(tmp_path), "--generations", "4",
-         "--capture-every", "2"])
+    def cli(*argv):
+        env = dict(os.environ)
+        env["SRNN_SETUPS_PLATFORM"] = "cpu"  # never dial the tunnel
+        proc = subprocess.run(
+            [sys.executable, "-m", "srnn_tpu.setups", "mega_multisoup",
+             *argv], stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=300, env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        out = proc.stdout.decode()
+        assert proc.returncode == 0, out
+        return out.strip().splitlines()[-1]  # run dir printed last
+
+    d = cli("--smoke", "--root", str(tmp_path), "--generations", "4",
+            "--capture-every", "2")
     pre = read_store(os.path.join(d, "soup.t0.traj"))
     assert pre["generations"].tolist() == [2, 4]
-    d_resumed = REGISTRY["mega_multisoup"](["--smoke", "--resume", d])
+    d_resumed = cli("--smoke", "--resume", d)
     assert d_resumed == d
     for t, n_t in enumerate((16, 16, 16)):  # smoke split of 48
         out = read_store(os.path.join(d, f"soup.t{t}.traj"))
