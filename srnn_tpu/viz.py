@@ -321,7 +321,7 @@ def _render_soup(run_dir: str, path: str) -> List[str]:
 
 def _render_sweep(run_dir: str, path: str) -> List[str]:
     data = load_artifact(path)
-    names_path = os.path.join(run_dir, "all_names")
+    names_path = os.path.join(os.path.dirname(path), "all_names")
     names = load_artifact(names_path) if os.path.exists(names_path + ".json") \
         else [f"series {i}" for i in range(len(data))]
     return [line_plot(data, names, os.path.join(run_dir, "sweep.png"))]
@@ -329,7 +329,7 @@ def _render_sweep(run_dir: str, path: str) -> List[str]:
 
 def _render_counters(run_dir: str, path: str) -> List[str]:
     counters = load_artifact(path)
-    names_path = os.path.join(run_dir, "all_names")
+    names_path = os.path.join(os.path.dirname(path), "all_names")
     names = load_artifact(names_path) if os.path.exists(names_path + ".json") \
         else [f"exp {i}" for i in range(np.atleast_2d(counters).shape[0])]
     return [plot_bars(counters, names, os.path.join(run_dir, "counters.png"))]
@@ -345,7 +345,7 @@ def _render_mega_curve(run_dir: str, path: str) -> List[str]:
     ``generation`` + ``counts``)."""
     import json as _json
 
-    events_path = os.path.join(run_dir, "events.jsonl")
+    events_path = os.path.join(os.path.dirname(path), "events.jsonl")
     if not os.path.exists(events_path):
         return []
     gens, series = [], {name: [] for name in CLASS_NAMES}
@@ -391,14 +391,47 @@ RENDERERS = {
 }
 
 
-def search_and_apply(directory: str, redo: bool = False) -> List[str]:
+def search_and_apply(directory: str, redo: bool = False,
+                     out_dir: Optional[str] = None) -> List[str]:
     """Walk ``directory`` recursively; for every known artifact whose run
     dir has no rendered .png yet (unless ``redo``), render all applicable
-    views (``search_and_apply``, ``visualization.py:255-275``)."""
+    views (``search_and_apply``, ``visualization.py:255-275``).
+
+    Reference-format dill artifacts (``trajectorys.dill`` / ``soup.dill``,
+    the exact filenames the reference CLI targets at
+    ``visualization.py:255-275``) render too, via the 2019-artifact shim
+    loader — a migration path for existing reference result trees.  Because
+    such trees may be read-only, ``out_dir`` mirrors the directory
+    structure somewhere writable for EVERY render in the walk (renderers
+    keep reading their inputs from the source tree); ``None`` renders next
+    to each artifact like the reference CLI does.
+    """
     import re
 
     outputs = []
+    directory = os.path.normpath(directory)
     for root, _dirs, files in os.walk(directory):
+        render_dir = root if out_dir is None else os.path.join(
+            out_dir, os.path.relpath(root, directory))
+        # done-detection must look where the renders actually go
+        rendered = files if render_dir == root else (
+            sorted(os.listdir(render_dir)) if os.path.isdir(render_dir)
+            else [])
+        for f in sorted(files):
+            if f not in ("trajectorys.dill", "soup.dill"):
+                continue
+            stem = f[:-5] + "_ref_trajectories_3d"
+            done = all(stem + ext in rendered for ext in (".png", ".html"))
+            if done and not redo:
+                continue
+            from . import reference_artifacts as ref
+            try:
+                art = ref.trajectory_artifact(
+                    ref.load_artifact(os.path.join(root, f)))
+                os.makedirs(render_dir, exist_ok=True)
+                outputs += _render_traj_views(art, render_dir, stem)
+            except Exception as e:  # empty without_particles() shells etc.
+                print(f"viz: skipping {f} in {root}: {e!r}")
         # native trajectory stores render like soup artifacts; a multihost
         # capture leaves only per-process shards (soup.traj.pNNNNofMMMM) —
         # collapse those to their base name so the merged store renders once
@@ -412,14 +445,15 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
                     bases.add(m.group(1))
         for f in sorted(bases):
             stem = f[:-5] + "_trajectories_3d"
-            done = all(os.path.exists(os.path.join(root, stem + ext))
-                       for ext in (".png", ".html"))
+            done = all(stem + ext in rendered for ext in (".png", ".html"))
             if done and not redo:
                 continue
             from .utils import read_store_artifact
             try:
+                os.makedirs(render_dir, exist_ok=True)
                 outputs += _render_traj_views(
-                    read_store_artifact(os.path.join(root, f)), root, stem)
+                    read_store_artifact(os.path.join(root, f)), render_dir,
+                    stem)
             except Exception as e:
                 print(f"viz: skipping {f} in {root}: {e!r}")
         basenames = {f.rsplit(".", 1)[0] for f in files
@@ -428,27 +462,28 @@ def search_and_apply(directory: str, redo: bool = False) -> List[str]:
             if base not in basenames:
                 continue
             done_marker = any(f.endswith(".png") and f.startswith(marker)
-                              for f in files)
+                              for f in rendered)
             if base in ("trajectorys", "soup"):
                 # trajectory renderers also emit the interactive HTML twin;
                 # any PNG without its own .html sibling (pre-HTML run dirs,
                 # partial multi-variant failure) must be revisited so the
                 # walker backfills the missing HTML
-                pngs = [f for f in files
+                pngs = [f for f in rendered
                         if f.endswith(".png") and f.startswith(marker)]
                 done_marker = bool(pngs) and all(
-                    f[:-4] + ".html" in files for f in pngs)
+                    f[:-4] + ".html" in rendered for f in pngs)
             if base == "config" and done_marker:
                 # events.jsonl is append-only (resumed runs grow it): the
                 # curve is only done if at least as new as the event log
-                png = os.path.join(root, marker + ".png")
+                png = os.path.join(render_dir, marker + ".png")
                 ev = os.path.join(root, "events.jsonl")
                 done_marker = not os.path.exists(ev) or \
                     os.path.getmtime(png) >= os.path.getmtime(ev)
             if done_marker and not redo:
                 continue
             try:
-                outputs += renderer(root, os.path.join(root, base))
+                os.makedirs(render_dir, exist_ok=True)
+                outputs += renderer(render_dir, os.path.join(root, base))
             except Exception as e:  # keep walking like the reference CLI
                 print(f"viz: skipping {base} in {root}: {e!r}")
     return outputs
@@ -459,8 +494,11 @@ def main(argv=None):
     p.add_argument("-i", "--in-dir", dest="in_dir", default="experiments",
                    help="directory tree to scan (visualization.py:20-24)")
     p.add_argument("--redo", action="store_true", help="re-render existing plots")
+    p.add_argument("-o", "--out-dir", dest="out_dir", default=None,
+                   help="mirror renders of reference .dill artifacts here "
+                        "(for read-only result trees)")
     args = p.parse_args(argv)
-    outs = search_and_apply(args.in_dir, redo=args.redo)
+    outs = search_and_apply(args.in_dir, redo=args.redo, out_dir=args.out_dir)
     for o in outs:
         print(o)
     return 0
