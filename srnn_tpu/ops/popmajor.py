@@ -281,6 +281,23 @@ def _use_pallas_sgd(topo: Topology, mode: str, impl: str) -> bool:
     return True
 
 
+def resolved_train_impl(topo: Topology, mode: str, impl: str) -> str:
+    """The impl the train phase will ACTUALLY run for this type: 'pallas'
+    only where the fused kernel applies, else 'xla'.
+
+    The multisoup dispatch falls back per type silently by design
+    (``_use_pallas_sgd``); run headers should surface the resolution so a
+    ``train_impl='pallas'`` run states which types took the kernel rather
+    than leaving it to be inferred from the fence rules."""
+    try:
+        return "pallas" if _use_pallas_sgd(topo, mode, impl) else "xla"
+    except ValueError:
+        # homogeneous-soup entry points re-raise via _check_popmajor;
+        # for reporting purposes the effective impl is still the kernel's
+        # refusal -> XLA
+        return "xla"
+
+
 def _pallas_interpret(n: int) -> bool:
     """Interpreter only at test scale on non-Mosaic backends; at
     population scale it would be a silent near-hang, so demand the XLA

@@ -175,9 +175,13 @@ def run(args):
             state = make_sharded_multi_state(cfg, mesh, jax.random.key(args.seed))
         else:
             state = seed_multi(cfg, jax.random.key(args.seed))
+        from ..ops.popmajor import resolved_train_impl
+        impls = ",".join(
+            f"{t.variant}={resolved_train_impl(t, cfg.train_mode, cfg.train_impl)}"
+            for t in cfg.topos) if cfg.layout == "popmajor" else cfg.train_impl
         exp.log(f"mega-multisoup N={cfg.total} sizes={cfg.sizes} "
                 f"layout={cfg.layout} attack={cfg.attacking_rate} "
-                f"train={cfg.train}/{cfg.train_mode}"
+                f"train={cfg.train}/{cfg.train_mode} train_impl={impls}"
                 + (f" sharded over {mesh.devices.size} devices"
                    if mesh is not None else ""))
 

@@ -166,6 +166,15 @@ def sharded_evolve_captured(
 
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if num_processes is None else num_processes
+    if pc != jax.process_count() and jax.process_count() > 1:
+        # explicit counts only simulate a multi-process layout on a
+        # SINGLE-process runtime (tests); on a real multi-process runtime a
+        # mismatched count would route through the plain-slice path below,
+        # which would try to materialize non-addressable rows
+        raise ValueError(
+            f"num_processes={pc} does not match the live runtime's "
+            f"{jax.process_count()} processes; omit the explicit counts "
+            "under a real multi-process launcher")
     n_loc = config.size // pc
     if store.n != n_loc:
         raise ValueError(

@@ -419,6 +419,17 @@ def read_sharded_store(base: str, start: int = 0,
     for p in parts[1:]:
         if not np.array_equal(p["generations"], gens):
             raise OSError(f"{base}: shard generation sequences disagree")
+    if len(gens) > 1 and not np.all(np.diff(gens) > 0):
+        # shards agree but the shared timeline itself runs backwards: a
+        # mis-reconciled resume (truncate_sharded_frames skipped, or applied
+        # to only some shards before new appends) — e.g. [2, 4, 2, 4, 6]
+        import warnings
+
+        warnings.warn(
+            f"{base}: merged generation sequence is not strictly "
+            "increasing — a resume appended without truncating frames "
+            "past the checkpoint; run truncate_sharded_frames before "
+            "appending to repair the store", stacklevel=2)
     out = {"generations": gens}
     for key in ("weights", "uids", "action", "counterpart", "loss"):
         out[key] = np.concatenate([p[key] for p in parts], axis=1)

@@ -18,22 +18,46 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
 # A test run hard-killed mid-compile can leave a truncated entry in the
 # shared compilation cache, and XLA SEGFAULTS deserializing it on every
 # later run (observed: repeatable crash in backend_compile_and_load until
-# the cache was wiped).  Crash detection: a marker file exists for the
-# duration of a session; finding one at startup means the previous run
-# died uncleanly — wipe the cache rather than risk reading poison.
+# the cache was wiped).  Crash detection: a PER-SESSION marker file
+# (.session_running.<pid>) exists for the duration of each session, so
+# concurrent sessions never clobber each other's markers; finding a marker
+# whose owner pid is dead at startup means that run died uncleanly — wipe
+# the cache, unless another session is LIVE right now (its in-flight
+# compiles would be yanked out from under it; the poison, if any, will be
+# caught by whichever session starts after everything quiesces).
 _CACHE_DIR = os.environ["JAX_COMPILATION_CACHE_DIR"]
-_CRASH_MARKER = os.path.join(_CACHE_DIR, ".session_running") if _CACHE_DIR else None
+_CRASH_MARKER = os.path.join(
+    _CACHE_DIR, f".session_running.{os.getpid()}") if _CACHE_DIR else None
 if _CRASH_MARKER:
-    if os.path.exists(_CRASH_MARKER):
-        # the marker records the owning pid: a LIVE owner is a concurrent
-        # session (leave its cache alone); a dead one crashed mid-write and
-        # its cache may hold truncated poison — wipe
+    import glob as _glob
+
+    _stale, _live = [], []
+    for _m in _glob.glob(os.path.join(_CACHE_DIR, ".session_running.*")):
         try:
-            owner = int(open(_CRASH_MARKER).read().strip() or "0")
+            _owner = int(_m.rsplit(".", 1)[1])
+        except ValueError:
+            _owner = 0
+        (_live if _owner and os.path.exists(f"/proc/{_owner}")
+         else _stale).append(_m)
+    # legacy single-marker name from earlier rounds (pid recorded INSIDE
+    # the file): still counts — a crash under the old conftest must not
+    # leave its poison undetected after the upgrade
+    _legacy = os.path.join(_CACHE_DIR, ".session_running")
+    if os.path.exists(_legacy):
+        try:
+            _owner = int(open(_legacy).read().strip() or "0")
         except (OSError, ValueError):
-            owner = 0
-        if not (owner and os.path.exists(f"/proc/{owner}")):
-            shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+            _owner = 0
+        (_live if _owner and os.path.exists(f"/proc/{_owner}")
+         else _stale).append(_legacy)
+    if _stale and not _live:
+        shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+    else:
+        for _m in _stale:  # dead markers under a live session: just tidy
+            try:
+                os.remove(_m)
+            except OSError:
+                pass
     os.makedirs(_CACHE_DIR, exist_ok=True)
     with open(_CRASH_MARKER, "w") as _f:
         _f.write(str(os.getpid()))
