@@ -24,6 +24,7 @@ Prints one JSON line per size.
 
 import argparse
 import json
+import os
 import time
 
 import jax
@@ -46,10 +47,14 @@ def bench_size(preset: str, n: int, generations: int = 50,
                repeats: int = 3, layout: str = "rowmajor",
                train_mode: str = "sequential", sharded: bool = False,
                respawn_draws: str = "perparticle",
-               train_impl: str = "xla") -> dict:
+               train_impl: str = "xla", attack_impl: str = "full") -> dict:
     dyn = _dynamics(preset, train_mode)
     dyn["respawn_draws"] = respawn_draws
     dyn["train_impl"] = train_impl
+    if preset != "mixed":
+        # the heterogeneous config has no attack_impl knob (per-type
+        # cross-attack gathers are structural); homogeneous soups do
+        dyn["attack_impl"] = attack_impl
     if preset == "mixed":
         third = n // 3
         cfg = MultiSoupConfig(
@@ -109,6 +114,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
         "layout": layout,
         "respawn_draws": respawn_draws,
         "train_impl": train_impl,
+        "attack_impl": attack_impl if preset != "mixed" else "n/a",
         "sharded_devices": jax.device_count() if sharded else 0,
         "particles": n,
         "generations": generations,
@@ -149,6 +155,11 @@ def main():
                    help="'pallas': fused VMEM batch-1 SGD chain for the "
                         "weightwise popmajor train/learn phases "
                         "(ops/pallas_ww_train.py)")
+    p.add_argument("--attack-impl", choices=("full", "compact"),
+                   default="full",
+                   help="'compact': transform only the attacked lanes "
+                        "(fixed-capacity compaction + scatter; popmajor, "
+                        "non-mixed presets)")
     args = p.parse_args()
     # the tunneled TPU backend flakes at init (sometimes raising, sometimes
     # wedging): probe with retries AND bound each phase with a watchdog that
@@ -167,14 +178,24 @@ def main():
             flush=True))
 
     cancel = arm("backend init", 600.0)
-    ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=False)
+    platform, _ = ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=False)
+    if platform == "cpu" and int(os.environ.get("SRNN_REQUIRE_TPU", "0")):
+        # a plugin that registers-then-falls-back leaves a healthy CPU
+        # backend with no exception — without this gate, CPU timings would
+        # be appended under an accelerator label
+        print(json.dumps({"error": f"SRNN_REQUIRE_TPU: live platform is "
+                                   f"{platform!r}"}), flush=True)
+        raise SystemExit(3)
     for n in args.sizes:
         cancel()
         cancel = arm(f"size {n}", 2400.0)
-        print(json.dumps(bench_size(args.preset, n, args.generations,
-                                    args.repeats, args.layout,
-                                    args.train_mode, args.sharded,
-                                    args.respawn_draws, args.train_impl)))
+        row = bench_size(args.preset, n, args.generations,
+                         args.repeats, args.layout,
+                         args.train_mode, args.sharded,
+                         args.respawn_draws, args.train_impl,
+                         args.attack_impl)
+        row["platform"] = platform
+        print(json.dumps(row))
     cancel()
 
 

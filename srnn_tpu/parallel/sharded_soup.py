@@ -218,8 +218,19 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
             jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
         att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
         has_attacker = att_loc >= 0
-        attacked = apply_popmajor(topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc)
-        wT_loc = jnp.where(has_attacker[None, :], attacked, wT_loc)
+        if config.attack_impl == "compact":
+            from ..soup import _attack_capacity, _attack_popmajor_compact
+
+            # per-shard capacity over the shard's own lane count; a shard
+            # that overflows falls back to full width for that step only
+            wT_loc = _attack_popmajor_compact(
+                topo, wT_loc, att_loc, has_attacker,
+                _attack_capacity(n_loc, config.attacking_rate),
+                source=all_wT)
+        else:
+            attacked = apply_popmajor(
+                topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc)
+            wT_loc = jnp.where(has_attacker[None, :], attacked, wT_loc)
         attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
         attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
     else:
@@ -301,6 +312,10 @@ def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
         _check_popmajor(config)
         body = functools.partial(_local_popmajor_step, config, axes=axes)
     elif config.layout == "rowmajor":
+        if config.attack_impl != "full":
+            raise ValueError(
+                "attack_impl='compact' compacts lanes of the popmajor "
+                "layout; layout='rowmajor' needs attack_impl='full'")
         body = functools.partial(_local_evolve, config, axes=axes)
     else:
         raise ValueError(f"unknown soup layout {config.layout!r}")

@@ -88,6 +88,19 @@ class SoupConfig(NamedTuple):
     # + popmajor + sequential + linear activation only (hand-derived
     # backward, ops/pallas_ww_train.py); parity-tested vs the XLA path.
     train_impl: str = "xla"             # 'xla' | 'pallas'
+    # Attack-phase execution (popmajor only).  'full' transforms all N
+    # lanes and selects (one gather + one forward over the whole
+    # population).  'compact' exploits that at the paper's rates only
+    # ~1-e^-rate of victims receive any attack (reference soup.py:56-61):
+    # compact the attacked lanes into a fixed capacity block (mean + 8 sd
+    # of the attacker Binomial), gather/transform only those, and scatter
+    # back — ~1/rate less gather+forward traffic.  Unattacked lanes are
+    # untouched (bitwise); attacked lanes agree with the full path up to
+    # FMA contraction (<=1 ulp — the compiler may fuse the multiply-add
+    # chain differently at the narrower block width).  The capacity
+    # overflow branch (mean + 8 sd bound, P < 1e-14) falls back to the
+    # full path via lax.cond, so semantics never depend on the bound.
+    attack_impl: str = "full"           # 'full' | 'compact'
 
 
 class SoupState(NamedTuple):
@@ -243,6 +256,63 @@ def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, S
     return new_state, SoupEvents(action, counterpart, train_loss)
 
 
+def _attack_capacity(n: int, rate: float) -> int:
+    """Static lane capacity for the compacted attack block: mean + 8 sd of
+    the attacker count Binomial(n, rate) (an upper bound on distinct
+    victims), rounded up to a 128-lane multiple.  P(overflow) < 1e-14."""
+    import math
+
+    rate = min(max(rate, 0.0), 1.0)
+    mean = n * rate
+    sd = math.sqrt(n * rate * (1.0 - rate))
+    cap = int(math.ceil(mean + 8.0 * sd)) + 16
+    return min(n, ((cap + 127) // 128) * 128)
+
+
+def _attack_popmajor_compact(topo: Topology, wT: jnp.ndarray,
+                             att_idx: jnp.ndarray, has_attacker: jnp.ndarray,
+                             cap: int, source: Optional[jnp.ndarray] = None
+                             ) -> jnp.ndarray:
+    """Attack phase over compacted attacked-victim lanes only.
+
+    The per-lane transform is elementwise in the lane dimension, so
+    computing it on a gathered subset and scattering back is
+    value-preserving up to FMA contraction (the compiler may fuse the
+    multiply-add chain differently at the narrower width — observed <=1
+    ulp on XLA:CPU); unattacked lanes are bitwise untouched.  ``cap``
+    lanes are processed; overflow (more attacked victims than ``cap``)
+    returns the full-width computation via ``lax.cond`` instead.
+
+    ``source`` is the matrix attacker columns are drawn from — ``wT``
+    itself on one device; the all-gathered global population under
+    sharding, where ``att_idx`` holds GLOBAL indices and victims are
+    local lanes of ``wT``.
+    """
+    from .ops.popmajor import apply_popmajor
+
+    n = wT.shape[1]
+    src = wT if source is None else source
+
+    def compact(_):
+        victims = jnp.nonzero(has_attacker, size=cap, fill_value=n)[0]
+        safe = jnp.where(victims < n, victims, 0)  # gather-safe clone slot
+        attacker_w = src[:, jnp.clip(att_idx, 0)[safe]]
+        new = apply_popmajor(topo, attacker_w, wT[:, safe])
+        # scatter through the UNclipped indices: the fill slots are out of
+        # bounds and mode='drop' discards them — a clipped fill index would
+        # race a stale write against lane 0's real update
+        return wT.at[:, victims].set(new, mode="drop")
+
+    def full(_):
+        attacked = apply_popmajor(topo, src[:, jnp.clip(att_idx, 0)], wT)
+        return jnp.where(has_attacker[None, :], attacked, wT)
+
+    if cap >= n:
+        return full(None)
+    overflow = has_attacker.sum(dtype=jnp.int32) > cap
+    return jax.lax.cond(overflow, full, compact, None)
+
+
 def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
                              wT: jnp.ndarray) -> Tuple[SoupState, SoupEvents, jnp.ndarray]:
     """Population-major twin of ``_evolve_parallel`` (all variants — the
@@ -270,8 +340,13 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         att_idx = jax.ops.segment_max(
             jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
         has_attacker = att_idx >= 0
-        attacked = apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT)
-        wT = jnp.where(has_attacker[None, :], attacked, wT)
+        if config.attack_impl == "compact":
+            wT = _attack_popmajor_compact(
+                topo, wT, att_idx, has_attacker,
+                _attack_capacity(n, config.attacking_rate))
+        else:
+            attacked = apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT)
+            wT = jnp.where(has_attacker[None, :], attacked, wT)
     else:
         attack_gate = jnp.zeros(n, bool)
         attack_tgt = jnp.zeros(n, jnp.int32)
@@ -334,6 +409,8 @@ def _check_popmajor(config: SoupConfig) -> None:
             "that defeats the lane layout — use layout='rowmajor'")
     if config.train_impl not in ("xla", "pallas"):
         raise ValueError(f"unknown train_impl {config.train_impl!r}")
+    if config.attack_impl not in ("full", "compact"):
+        raise ValueError(f"unknown attack_impl {config.attack_impl!r}")
     if config.train_impl == "pallas" and (
             config.topo.variant != "weightwise"
             or config.train_mode != "sequential"
@@ -425,6 +502,10 @@ def evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEv
         raise ValueError(
             "train_impl='pallas' is the popmajor lane kernel; "
             "layout='rowmajor' needs train_impl='xla'")
+    if config.attack_impl != "full" and config.layout != "popmajor":
+        raise ValueError(
+            "attack_impl='compact' compacts lanes of the popmajor layout; "
+            "layout='rowmajor' needs attack_impl='full'")
     if config.layout == "popmajor":
         _check_popmajor(config)
         new_state, events, wT = _evolve_parallel_popmajor(config, state,

@@ -99,6 +99,51 @@ def test_sharded_popmajor_multigeneration_bitwise(mesh):
     assert int(counts.sum()) == 24
 
 
+def test_sharded_popmajor_compact_attack_matches_unsharded(mesh):
+    """attack_impl='compact' under sharding: per-shard compaction against
+    the all-gathered population.  uids/gates exact (same PRNG stream);
+    weights to FMA-contraction tolerance (the compact block width differs
+    from the full path's).  Sized so per-shard capacity < per-shard lanes
+    — the compact branch genuinely runs on every shard."""
+    from srnn_tpu.soup import _attack_capacity, evolve
+
+    n_dev = mesh.devices.size
+    cfg = SoupConfig(topo=WW, size=512 * n_dev, attacking_rate=0.05,
+                     train=1, remove_divergent=True, remove_zero=True,
+                     layout="popmajor", respawn_draws="fused",
+                     attack_impl="compact")
+    assert _attack_capacity(512, cfg.attacking_rate) < 512
+    s0 = seed(cfg, jax.random.key(9))
+    # one generation: the only difference is FMA contraction inside the
+    # compact attack block -> ulp-tight
+    ref1 = evolve(cfg._replace(attack_impl="full"), s0, generations=1)
+    sh1 = sharded_evolve(cfg, mesh,
+                         make_sharded_state(cfg, mesh, jax.random.key(9)),
+                         generations=1)
+    np.testing.assert_array_equal(np.asarray(ref1.uids), np.asarray(sh1.uids))
+    np.testing.assert_allclose(np.asarray(sh1.weights),
+                               np.asarray(ref1.weights),
+                               rtol=1e-4, atol=1e-6)
+    # four generations: ulp seeds amplify through the train-phase dynamics
+    # (sensitive directions grow); uids stay exact, weights stay close
+    ref = evolve(cfg._replace(attack_impl="full"), s0, generations=4)
+    sh = sharded_evolve(cfg, mesh,
+                        make_sharded_state(cfg, mesh, jax.random.key(9)),
+                        generations=4)
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(sh.uids))
+    f, c = np.asarray(ref.weights), np.asarray(sh.weights)
+    finite = np.isfinite(f).all(axis=1) & np.isfinite(c).all(axis=1)
+    np.testing.assert_allclose(c[finite], f[finite], rtol=5e-3, atol=1e-6)
+
+
+def test_sharded_rowmajor_rejects_compact_attack(mesh):
+    cfg = SoupConfig(topo=WW, size=16, attacking_rate=0.3,
+                     attack_impl="compact")
+    with pytest.raises(ValueError, match="attack_impl"):
+        sharded_evolve_step(cfg, mesh,
+                            make_sharded_state(cfg, mesh, jax.random.key(0)))
+
+
 def test_sharded_popmajor_aggregating_matches_unsharded(mesh):
     """All variants ride the sharded lane layout now; the aggregating soup's
     sharded popmajor step must match the single-device popmajor step

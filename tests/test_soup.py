@@ -224,6 +224,81 @@ def test_popmajor_record_and_count():
     assert int(count(cfg, final).sum()) == 12
 
 
+def test_attack_impl_compact_matches_full_multi_generation():
+    """attack_impl='compact' computes the transform on compacted attacked
+    lanes only.  Same PRNG stream -> same gates/targets/respawns (uids
+    EXACT); weights agree up to FMA contraction on the attacked lanes
+    (<=1 ulp per step, here bounded loosely across 6 generations of
+    dynamics).  The config is sized so the capacity (mean + 8 sd, 128-lane
+    rounded) is genuinely below N — i.e. the compact branch, not the
+    cap>=n full fallback, is what runs."""
+    from srnn_tpu.soup import _attack_capacity
+
+    cfg_full = mkconfig(size=512, attacking_rate=0.05, train=1,
+                        remove_divergent=True, remove_zero=True,
+                        layout="popmajor", respawn_draws="fused")
+    assert _attack_capacity(512, 0.05) < 512
+    cfg_compact = cfg_full._replace(attack_impl="compact")
+    st = seed(cfg_full, jax.random.key(11))
+    full = evolve(cfg_full, st, generations=6)
+    compact = evolve(cfg_compact, st, generations=6)
+    np.testing.assert_array_equal(np.asarray(full.uids),
+                                  np.asarray(compact.uids))
+    f, c = np.asarray(full.weights), np.asarray(compact.weights)
+    finite = np.isfinite(f).all(axis=1) & np.isfinite(c).all(axis=1)
+    np.testing.assert_allclose(c[finite], f[finite], rtol=1e-5, atol=1e-7)
+
+
+def test_attack_compact_overflow_falls_back_to_full():
+    """A capacity smaller than the attacked-lane count must trigger the
+    lax.cond fallback: EVERY lane must carry the full path's update (the
+    compact branch could only have written ``cap`` of them), to ulp
+    tolerance (branch compilation inside lax.cond may contract FMAs
+    differently than the standalone expression)."""
+    from srnn_tpu.soup import _attack_popmajor_compact
+    from srnn_tpu.ops.popmajor import apply_popmajor
+
+    n = 32
+    wT = jax.random.normal(jax.random.key(0), (WW.num_weights, n))
+    att_idx = jnp.arange(n) % 7          # every lane attacked
+    has_attacker = jnp.ones(n, bool)
+    want = jnp.where(has_attacker[None, :],
+                     apply_popmajor(WW, wT[:, jnp.clip(att_idx, 0)], wT), wT)
+    got = _attack_popmajor_compact(WW, wT, att_idx, has_attacker, cap=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-7)
+    # and none kept its pre-attack value (which a dropped-overflow compact
+    # write pattern would leave behind)
+    assert not np.any(np.all(np.asarray(got) == np.asarray(wT), axis=0))
+
+
+def test_attack_compact_partial_lanes():
+    """Sparse attacks (the realistic regime): unattacked lanes are BITWISE
+    untouched; attacked lanes match the full path to <=1-ulp (FMA
+    contraction at the narrower block width)."""
+    from srnn_tpu.soup import _attack_popmajor_compact
+    from srnn_tpu.ops.popmajor import apply_popmajor
+
+    n = 48
+    wT = jax.random.normal(jax.random.key(2), (WW.num_weights, n))
+    has_attacker = (jnp.arange(n) % 11) == 0
+    att_idx = jnp.where(has_attacker, (jnp.arange(n) * 5) % n, -1)
+    want = jnp.where(has_attacker[None, :],
+                     apply_popmajor(WW, wT[:, jnp.clip(att_idx, 0)], wT), wT)
+    got = _attack_popmajor_compact(WW, wT, att_idx, has_attacker, cap=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-7)
+    unchanged = ~np.asarray(has_attacker)
+    np.testing.assert_array_equal(np.asarray(got)[:, unchanged],
+                                  np.asarray(wT)[:, unchanged])
+
+
+def test_attack_compact_rejects_rowmajor():
+    with pytest.raises(ValueError, match="attack_impl"):
+        evolve_step(mkconfig(attack_impl="compact"),
+                    seed(mkconfig(), jax.random.key(0)))
+
+
 def test_popmajor_rejects_unsupported_configs():
     with pytest.raises(ValueError):
         evolve_step(mkconfig(layout="popmajor", mode="sequential"),
