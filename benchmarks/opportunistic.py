@@ -1,0 +1,195 @@
+"""Opportunistic TPU capture: grab real-TPU numbers whenever the tunnel is
+healthy, not only at end-of-round bench time.
+
+Round-4 postmortem (VERDICT r4, "What's missing" #2): every perf lever of
+that round went TPU-unmeasured because the tunneled backend was wedged at
+the one moment the driver ran ``bench.py``.  This harness decouples
+measurement from that moment: invoke it repeatedly throughout a build
+session (cheap when the tunnel is down — one bounded probe subprocess);
+on ANY healthy window it captures the full TPU row set and appends
+timestamped JSONL evidence either way.
+
+Usage:
+  python benchmarks/opportunistic.py --probe-only      # log tunnel state
+  python benchmarks/opportunistic.py                   # probe, then rows
+  python benchmarks/opportunistic.py --rows kernel soup_apply
+  python benchmarks/opportunistic.py --log PATH        # default
+                                                       # results_tpu/opportunistic_log.jsonl
+
+Design rules (inherited from ``bench.py``'s round-4 rework):
+  * the parent process NEVER imports jax — it cannot wedge;
+  * every child is a fresh subprocess with its own timeout (tunnel init
+    luck is per-process), killed on hang, its last JSON stdout line kept;
+  * children must come up on the accelerator or die: ``SRNN_REQUIRE_TPU=1``
+    makes the probe child exit nonzero on a CPU backend, so a silent
+    axon→cpu fallback can never masquerade as a TPU measurement.
+
+The row set covers every round-4/5 perf lever that lacks TPU evidence
+(workload: reference ``soup.py:51-87`` at BASELINE.json scale):
+  kernel          bench.py Pallas apply kernel @ N=1M
+  soup_apply      apply-only gens/s, rowmajor vs popmajor
+  soup_fused      apply-only popmajor, respawn_draws fused vs perparticle
+  soup_full       full dynamics popmajor, train_impl xla vs pallas
+  soup_mixed      heterogeneous multisoup, rowmajor vs popmajor
+  train_generality popmajor train phase timings for the cases the pallas
+                  kernel fences out (aggregating/fft/sigmoid) vs the fenced
+                  weightwise-linear case — the data VERDICT r4 item 6 asks
+                  for (reference train semantics: ``network.py:613-617``)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LOG = os.path.join(REPO, "results_tpu", "opportunistic_log.jsonl")
+
+PROBE_TIMEOUT_S = 240.0
+ROW_TIMEOUT_S = 1500.0
+
+_PROBE_SRC = r"""
+import os, sys, time
+t0 = time.time()
+from srnn_tpu.utils.backend import ensure_backend
+platform, fell_back = ensure_backend(retries=2, sleep_s=5.0,
+                                     fallback_cpu=False)
+import jax
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.float32)
+val = float((x @ x)[0, 0])  # forces a real device round-trip
+ok = platform not in ("cpu",)
+print(f"@@PROBE {platform} {val} {time.time()-t0:.1f}", flush=True)
+sys.exit(0 if ok or not int(os.environ.get("SRNN_REQUIRE_TPU", "0")) else 3)
+"""
+
+
+def _spawn(cmd, timeout_s, extra_env=None):
+    """Run one child; return (status, seconds, stdout_lines, stderr_tail)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the axon plugin register
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_opportunistic_cache")
+    if extra_env:
+        env.update(extra_env)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=env, timeout=timeout_s,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        status = "ok" if proc.returncode == 0 else f"exit:{proc.returncode}"
+        out, err = proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        status = "timeout"
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+    return status, time.time() - t0, out.splitlines(), err[-2000:]
+
+
+def probe():
+    """Bounded tunnel-health probe in a throwaway child."""
+    status, dt, lines, err = _spawn(
+        [sys.executable, "-c", _PROBE_SRC], PROBE_TIMEOUT_S,
+        {"SRNN_REQUIRE_TPU": "1"})
+    platform = None
+    for line in lines:
+        if line.startswith("@@PROBE "):
+            platform = line.split()[1]
+    return {"event": "probe", "status": status, "platform": platform,
+            "seconds": round(dt, 1), "stderr": err if status != "ok" else ""}
+
+
+def _json_rows(lines):
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+    return rows
+
+
+def _soup_cmd(preset, **kw):
+    cmd = [sys.executable, "benchmarks/soup_throughput.py",
+           "--preset", preset, "--sizes", str(kw.pop("n", 1_000_000)),
+           "--generations", str(kw.pop("generations", 50)),
+           "--repeats", str(kw.pop("repeats", 3))]
+    for flag, val in kw.items():
+        cmd += [f"--{flag.replace('_', '-')}", str(val)]
+    return cmd
+
+
+ROWS = {
+    "kernel": [
+        ([sys.executable, "bench.py"],
+         {"SRNN_BENCH_DEADLINE_S": "1200", "SRNN_BENCH_RAMP_TIMEOUT_S": "240",
+          "SRNN_BENCH_FULL_TIMEOUT_S": "600"}),
+    ],
+    "soup_apply": [
+        (_soup_cmd("apply", layout="rowmajor"), None),
+        (_soup_cmd("apply", layout="popmajor"), None),
+    ],
+    "soup_fused": [
+        (_soup_cmd("apply", layout="popmajor", respawn_draws="fused"), None),
+        (_soup_cmd("apply", layout="popmajor", respawn_draws="fused",
+                   attack_impl="compact"), None),
+    ],
+    "soup_full": [
+        (_soup_cmd("full", layout="popmajor", train_impl="xla"), None),
+        (_soup_cmd("full", layout="popmajor", train_impl="pallas"), None),
+    ],
+    "soup_mixed": [
+        (_soup_cmd("mixed", layout="rowmajor"), None),
+        (_soup_cmd("mixed", layout="popmajor"), None),
+    ],
+    "train_generality": [
+        ([sys.executable, "benchmarks/train_generality.py"], None),
+    ],
+}
+
+
+def append_log(log_path, record):
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+              **record}
+    with open(log_path, "a") as fh:
+        fh.write(json.dumps(record) + "\n")
+    return record
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--log", default=DEFAULT_LOG)
+    p.add_argument("--probe-only", action="store_true")
+    p.add_argument("--rows", nargs="*", choices=sorted(ROWS),
+                   default=sorted(ROWS))
+    p.add_argument("--row-timeout", type=float, default=ROW_TIMEOUT_S)
+    args = p.parse_args(argv)
+
+    pr = append_log(args.log, probe())
+    print(json.dumps(pr), flush=True)
+    if args.probe_only or pr["status"] != "ok":
+        return 0 if pr["status"] == "ok" else 1
+
+    failures = 0
+    for row in args.rows:
+        for cmd, extra_env in ROWS[row]:
+            env = {"SRNN_REQUIRE_TPU": "1", **(extra_env or {})}
+            status, dt, lines, err = _spawn(cmd, args.row_timeout, env)
+            rec = append_log(args.log, {
+                "event": "capture", "row": row, "cmd": " ".join(cmd[1:]),
+                "status": status, "seconds": round(dt, 1),
+                "results": _json_rows(lines),
+                "stderr": err if status != "ok" else ""})
+            print(json.dumps(rec), flush=True)
+            failures += status != "ok"
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Measure the popmajor TRAIN phase for the configs the Pallas SGD kernel
+fences out, against the fenced weightwise-linear case.
+
+VERDICT r4 item 6: ``train_impl='pallas'`` is fenced to weightwise /
+linear / sequential / P<=64 (``soup.py:324-349``).  Is that fence leaving
+>2x on the table anywhere?  This harness times a train-only soup
+generation (attack/learn_from off, train=10 — isolating the batch-1
+sequential SGD chain plus respawn, reference ``network.py:613-617``
+semantics) at the mega-soup scale for:
+
+  ww-linear/pallas     the fused VMEM kernel (the yardstick)
+  ww-linear/xla        same math under the XLA scan
+  ww-sigmoid/xla       fenced out: nonlinear backward
+  aggregating/xla      fenced out: k-vector forward (popmajor_kvec path)
+  fft/xla              fenced out: FFT round trip per epoch
+  recurrent/xla        fenced out: sequential-in-P scan (popmajor_rnn path)
+
+Output: one JSON line per config with per-particle-generation cost; the
+decision rule from the VERDICT ("extend the kernel if any fenced-out case
+is >2x off the weightwise-pallas per-particle cost, else document the
+non-goal") reads straight off the ``x_vs_ww_pallas`` field.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+
+from srnn_tpu import Topology
+from srnn_tpu.soup import SoupConfig, evolve, seed
+
+CONFIGS = (
+    ("ww-linear/pallas", Topology("weightwise", width=2, depth=2), "pallas"),
+    ("ww-linear/xla", Topology("weightwise", width=2, depth=2), "xla"),
+    ("ww-sigmoid/xla",
+     Topology("weightwise", width=2, depth=2, activation="sigmoid"), "xla"),
+    ("aggregating/xla", Topology("aggregating", width=2, depth=2), "xla"),
+    ("fft/xla", Topology("fft", width=2, depth=2), "xla"),
+    ("recurrent/xla", Topology("recurrent", width=2, depth=2), "xla"),
+)
+
+
+def bench_config(name, topo, train_impl, n, generations, repeats):
+    cfg = SoupConfig(
+        topo=topo, size=n, attacking_rate=-1.0, learn_from_rate=-1.0,
+        train=10, remove_divergent=True, remove_zero=True,
+        layout="popmajor", train_impl=train_impl)
+    state = seed(cfg, jax.random.key(0))
+
+    def run(s):
+        out = evolve(cfg, s, generations=generations)
+        return float(out.weights.sum())  # scalar readback = real sync on axon
+
+    run(state)  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        run(state)
+    dt = (time.perf_counter() - t0) / repeats
+    return {
+        "metric": "train-phase gens/sec", "config": name,
+        "particles": n, "generations": generations, "train": 10,
+        "value": round(generations / dt, 3),
+        "ns_per_particle_generation": round(dt / generations / n * 1e9, 2),
+        "unit": "generations/s",
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1_000_000)
+    p.add_argument("--generations", type=int, default=20)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--configs", nargs="*",
+                   choices=[c[0] for c in CONFIGS],
+                   default=[c[0] for c in CONFIGS])
+    args = p.parse_args(argv)
+
+    import os
+
+    from srnn_tpu.utils.backend import ensure_backend
+    platform, _ = ensure_backend(retries=3, sleep_s=10.0, fallback_cpu=False)
+    if platform == "cpu" and int(os.environ.get("SRNN_REQUIRE_TPU", "0")):
+        print(json.dumps({"error": f"SRNN_REQUIRE_TPU: live platform is "
+                                   f"{platform!r}"}), flush=True)
+        raise SystemExit(3)
+
+    rows = []
+    for name, topo, impl in CONFIGS:
+        if name not in args.configs:
+            continue
+        row = bench_config(name, topo, impl, args.n,
+                           args.generations, args.repeats)
+        row["platform"] = platform
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    yard = next((r for r in rows if r["config"] == "ww-linear/pallas"), None)
+    if yard:
+        for r in rows:
+            r["x_vs_ww_pallas"] = round(
+                r["ns_per_particle_generation"]
+                / yard["ns_per_particle_generation"], 2)
+        print(json.dumps({"summary": {
+            r["config"]: r["x_vs_ww_pallas"] for r in rows}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
