@@ -1,30 +1,68 @@
 """Attractor explorations — the ``fixpoint-2.ipynb`` notebook as a script.
 
-The reference notebook (cells 0-24) probes four phenomena around weightwise
-self-application; each section below reproduces one, printing its finding
-and (optionally) saving a plot.  Run: ``python examples/attractors.py``.
+The reference notebook (cells 0-24) probes phenomena around networks as
+attractors; each section below reproduces one, printing its finding and
+saving figures under ``examples/figures/``.  Run headless:
+``python examples/attractors.py``.
 
 1. Training f(x)=x on a single point: SGD on one sample drives the net to
-   reproduce that sample — the simplest "learn to be a fixpoint" picture.
+   reproduce that sample — the simplest "learn to be a fixpoint" picture
+   (notebook cells 8-13).
 2. Untrained random nets are attractors too: repeated self-application
-   almost always converges *somewhere* (zero or infinity), rarely wanders.
+   almost always converges *somewhere* (zero or infinity), rarely wanders
+   (cells 16-19).
 3. Chains/cycles of networks: apply net A to net B's weights and vice versa
    — two-element cycles where each rewrites the other.
 4. Offset perturbation: nudge an attractor's weights and watch the return
    (or escape) — the notebook-scale version of known-fixpoint-variation.
+5. Point trajectories through a CYCLE of networks (cells 20-21): feed a
+   point x through n nets cyclically, x_{t+1} = f_{t mod n}(x_t); the
+   composed map's attractor shows up as a per-dimension trajectory.
+6. The same cycle with a constant offset added per application (cells
+   22-23) — shifting every net's fixpoint away from zero.
+7. Basin of attraction around the identity fixpoint: sweep perturbation
+   scales (``fixtures.vary``), measure the fraction of perturbed nets that
+   remain/return to a fixpoint vs fall to zero/divergence — the example-
+   scale twin of ``setups/known_fixpoint_variation``.
+
+Deviation note for 5/6: the notebook's point-iterated nets are keras
+``Dense`` layers WITH biases; this framework's nets are its standard
+bias-free MLPs (``Topology`` semantics, reference ``network.py:80``), so
+the qualitative picture (spiral/decay to an attractor, offset shifting it)
+is the reproduction target, not the exact trajectories — without biases an
+un-offset linear cycle's only finite attractor is 0.
 """
+
+import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from srnn_tpu import (Topology, init_flat, init_population, is_diverged,
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from srnn_tpu import (Topology, init_flat, init_population, is_diverged,  # noqa: E402
                       is_zero, run_fixpoint)
-from srnn_tpu.fixtures import identity_fixpoint_flat, vary
-from srnn_tpu.netops import attack, self_attack
-from srnn_tpu.train import fit_epoch
+from srnn_tpu.fixtures import identity_fixpoint_flat, vary  # noqa: E402
+from srnn_tpu.netops import apply_to_weights, attack, self_attack  # noqa: E402
+from srnn_tpu.ops.mlp import mlp_forward  # noqa: E402
+from srnn_tpu.ops.predicates import is_fixpoint  # noqa: E402
+from srnn_tpu.train import fit_epoch  # noqa: E402
 
 TOPO = Topology("weightwise", width=2, depth=2)
+FIG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "figures")
+
+
+def _savefig(fig, name):
+    os.makedirs(FIG_DIR, exist_ok=True)
+    path = os.path.join(FIG_DIR, name)
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return path
 
 
 def single_point_training(steps: int = 400):
@@ -76,11 +114,90 @@ def offset_perturbation(scale: float = 1e-4, steps: int = 50):
     return drift0, drift
 
 
+def network_cycle_trajectories(n_models: int = 4, steps: int = 100,
+                               starts: int = 2, offset: float = 0.0):
+    """Cells 20-23: iterate points through a cycle of R^2 -> R^2 nets,
+    optionally adding ``offset`` to every prediction."""
+    # the framework's 2-in/2-out MLP: the aggregating variant's net shape
+    # (reference network.py:324-333) doubles as the notebook's DIM=2 model
+    net_topo = Topology("aggregating", width=2, depth=2, aggregates=2)
+    keys = jax.random.split(jax.random.key(20), n_models)
+    models = [init_flat(net_topo, k) for k in keys]
+
+    fig, axes = plt.subplots(1, starts, figsize=(5 * starts, 3.2),
+                             squeeze=False)
+    finals = []
+    for s in range(starts):
+        x = jax.random.uniform(jax.random.key(100 + s), (2,))
+        traj = [np.asarray(x)]
+        for t in range(steps):
+            x = mlp_forward(net_topo, models[t % n_models], x[None, :])[0]
+            x = x + offset
+            traj.append(np.asarray(x))
+        traj = np.stack(traj)
+        finals.append(traj[-1])
+        ax = axes[0, s]
+        ax.plot(traj[:, 0], label="dim 0")
+        ax.plot(traj[:, 1], label="dim 1")
+        ax.set_xlabel("application t")
+        ax.set_title(f"start {s}, offset={offset:g}")
+        ax.legend()
+    tag = "offset" if offset else "cycle"
+    path = _savefig(fig, f"network_{tag}_trajectories.png")
+    label = "5. network-cycle" if not offset else "6. offset-cycle"
+    print(f"{label} trajectories ({n_models} nets, {steps} applications): "
+          f"final points {[np.round(f, 4).tolist() for f in finals]} -> {path}")
+    return finals
+
+
+def basin_of_attraction(scales=tuple(10.0 ** -e for e in range(9, -1, -1)),
+                        trials: int = 64, steps: int = 30,
+                        epsilon: float = 1e-4):
+    """Cells 24 ('is a trained net also an attractor?') meets
+    known-fixpoint-variation: perturb the identity fixpoint at each scale
+    (``fixtures.vary``), self-apply ``steps`` times, and classify the
+    survivors — the basin boundary shows up as the scale where the
+    still-a-fixpoint fraction collapses."""
+    fp = identity_fixpoint_flat(TOPO)
+    rows = []
+    for scale in scales:
+        keys = jax.random.split(jax.random.fold_in(jax.random.key(7), hash(scale) & 0x7FFFFFFF), trials)
+        perturbed = jnp.stack([vary(k, fp, scale) for k in keys])
+        res = run_fixpoint(TOPO, perturbed, step_limit=steps, epsilon=epsilon)
+        w = res.weights
+        still_fix = np.asarray(jax.vmap(
+            lambda wi: is_fixpoint(
+                functools.partial(apply_to_weights, TOPO, wi), wi,
+                epsilon=epsilon))(w))
+        diverged = np.asarray(jax.vmap(is_diverged)(w))
+        zero = np.asarray(jax.vmap(lambda wi: is_zero(wi, epsilon))(w))
+        rows.append((scale, still_fix.mean(), zero.mean(), diverged.mean()))
+
+    rows_a = np.asarray(rows)
+    fig, ax = plt.subplots(figsize=(6, 3.6))
+    ax.semilogx(rows_a[:, 0], rows_a[:, 1], "o-", label="still a fixpoint")
+    ax.semilogx(rows_a[:, 0], rows_a[:, 2], "s--", label="fell to zero")
+    ax.semilogx(rows_a[:, 0], rows_a[:, 3], "^:", label="diverged")
+    ax.set_xlabel("perturbation scale")
+    ax.set_ylabel(f"fraction of {trials} trials after {steps} applications")
+    ax.set_title("basin of attraction around the identity fixpoint")
+    ax.legend()
+    path = _savefig(fig, "basin_of_attraction.png")
+    edge = next((s for s, f, _, _ in rows if f < 0.5), None)
+    print(f"7. basin of attraction: fixpoint fraction collapses near "
+          f"scale {edge:g} -> {path}" if edge is not None else
+          f"7. basin of attraction: fixpoint survives every scale -> {path}")
+    return rows
+
+
 def main():
     single_point_training()
     random_nets_converge()
     two_net_cycle()
     offset_perturbation()
+    network_cycle_trajectories(offset=0.0)
+    network_cycle_trajectories(offset=0.1)
+    basin_of_attraction()
 
 
 if __name__ == "__main__":

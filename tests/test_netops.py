@@ -78,3 +78,16 @@ def test_attractor_examples_run():
     assert a.shape == (14,)
     drift0, drift = attractors.offset_perturbation(scale=1e-6, steps=10)
     assert drift0 > 0
+    # cycle themes (notebook cells 20-23): bias-free linear cycle decays
+    # to 0; a constant offset moves the attractor off zero and both starts
+    # land on the SAME point (it is a property of the composed map)
+    finals = attractors.network_cycle_trajectories(steps=60, starts=2)
+    assert all(np.abs(f).max() < 1e-3 for f in finals)
+    off = attractors.network_cycle_trajectories(steps=60, starts=2,
+                                                offset=0.1)
+    assert np.abs(off[0]).max() > 1e-3
+    np.testing.assert_allclose(off[0], off[1], atol=1e-5)
+    # basin sweep: tiny perturbations keep the fixpoint, huge ones lose it
+    rows = attractors.basin_of_attraction(
+        scales=(1e-8, 1e0), trials=8, steps=10)
+    assert rows[0][1] == 1.0 and rows[-1][1] < 1.0
