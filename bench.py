@@ -54,6 +54,16 @@ BASELINE_PER_CHIP = 10_000_000 / 32  # BASELINE.json north star, v4-32
 DEADLINE_S = float(os.environ.get("SRNN_BENCH_DEADLINE_S", "1400"))
 RAMP_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_RAMP_TIMEOUT_S", "420"))
 FULL_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_FULL_TIMEOUT_S", "650"))
+# r4 lesson: after the first ramp attempt hangs for the full 420s, two more
+# 420s attempts learn nothing new — retries get a shorter leash, and
+# production-scale attempts are SPACED so they sample different tunnel
+# states instead of hammering the same wedge back-to-back
+RAMP_RETRY_TIMEOUT_S = float(
+    os.environ.get("SRNN_BENCH_RAMP_RETRY_TIMEOUT_S", "240"))
+RETRY_SPACING_S = float(os.environ.get("SRNN_BENCH_RETRY_SPACING_S", "150"))
+# spacing only makes sense at production proportions; test-scale timeouts
+# (seconds) must not inherit multi-minute sleeps
+SPACING_MIN_TIMEOUT_S = 300.0
 RAMP_ATTEMPTS = 3
 FULL_ATTEMPTS = 2
 # deadline slice the ramp/full stages may NOT eat into: keeps the cpu-rescue
@@ -227,13 +237,19 @@ def _orchestrate(result):
     def remaining():
         return DEADLINE_S - (time.monotonic() - t_start)
 
-    def run_stage(stage, attempts, per_timeout, stage_env=None, reserve=0.0):
+    def run_stage(stage, attempts, per_timeout, stage_env=None, reserve=0.0,
+                  retry_timeout=None):
+        # retries never get a LONGER leash than the stage's own timeout
+        # (an operator-lowered SRNN_BENCH_RAMP_TIMEOUT_S must win)
+        retry_want = per_timeout if retry_timeout is None \
+            else min(per_timeout, retry_timeout)
         for i in range(attempts):
             if remaining() - reserve <= 10:
                 errors.append(f"{stage}: deadline exhausted"
                               + (" (rescue slice reserved)" if reserve else ""))
                 return None
-            t = min(per_timeout, remaining() - reserve)
+            want = per_timeout if i == 0 else retry_want
+            t = min(want, remaining() - reserve)
             r, err = _run_child(stage, t, stage_env or env)
             if r is not None:
                 return r
@@ -241,6 +257,17 @@ def _orchestrate(result):
             print(f"bench: {errors[-1]}; retrying in a fresh process"
                   if i + 1 < attempts else f"bench: {errors[-1]}",
                   file=sys.stderr, flush=True)
+            # a HANG at production scale: space the next attempt out so it
+            # samples a different tunnel state (back-to-back retries after
+            # a 400s wedge learned nothing in r4).  Production-ness is the
+            # STAGE's configured timeout (test-scale stages must not
+            # inherit multi-minute sleeps); never sleep into the reserve
+            # or below the NEXT attempt's own budget
+            if (err and err.startswith("timeout") and i + 1 < attempts
+                    and per_timeout >= SPACING_MIN_TIMEOUT_S
+                    and remaining() - reserve
+                    > RETRY_SPACING_S + retry_want + 30):
+                time.sleep(RETRY_SPACING_S)
         return None
 
     def take(measured, stage_tag):
@@ -252,36 +279,55 @@ def _orchestrate(result):
         else:
             result.pop("stage", None)
 
-    ramp = run_stage("ramp", RAMP_ATTEMPTS, RAMP_TIMEOUT_S,
-                     reserve=RESCUE_RESERVE_S)
-    if ramp is not None:
-        take(ramp, "ramp-only")
-
-    # once any accelerator measurement exists the rescue leg is moot, so
-    # the full stage may spend the whole remaining deadline
-    full = run_stage("full", FULL_ATTEMPTS, FULL_TIMEOUT_S,
-                     reserve=0.0 if ramp is not None else RESCUE_RESERVE_S)
-    if full is not None:
-        # keep the BEST measurement: a full-stage child whose own backend
-        # init fell back to host CPU (per-process tunnel luck) must not
-        # overwrite a real accelerator ramp number with a degraded one
-        accel_ramp = ramp is not None and not ramp["backend"].endswith(
-            ("-fallback", "-forced"))
-        if full["backend"].endswith("-fallback") and accel_ramp:
-            errors.append("full stage fell back to CPU; keeping the "
-                          "accelerator ramp measurement")
-        else:
-            take(full, None)
-
-    if ramp is None and full is None:
-        # every accelerator attempt wedged or failed — a labeled host-CPU
-        # number is strictly more information than value=0 (the r3 scorecard)
+    def run_rescue():
+        # a labeled host-CPU number is strictly more information than
+        # value=0 (the r3 scorecard)
         cpu_env = dict(env)
         cpu_env["SRNN_BENCH_PLATFORM"] = "cpu"
         # the hang hook simulates a wedged TUNNEL; a CPU-pinned rescue child
         # never dials it, so the simulated wedge does not apply
         cpu_env.pop("SRNN_BENCH_TEST_HANG", None)
-        rescue = run_stage("full", 1, 300.0, stage_env=cpu_env)
+        return run_stage("full", 1, 300.0, stage_env=cpu_env)
+
+    ramp = run_stage("ramp", RAMP_ATTEMPTS, RAMP_TIMEOUT_S,
+                     reserve=RESCUE_RESERVE_S,
+                     retry_timeout=RAMP_RETRY_TIMEOUT_S)
+    if ramp is not None:
+        take(ramp, "ramp-only")
+
+    banked = None
+    if ramp is None:
+        # every ramp attempt wedged: BANK the rescue number NOW (r4's
+        # policy only ran it after the full attempts also burned their
+        # budget), then still spend the remaining window on accelerator
+        # retries — a later success simply overwrites the banked row
+        banked = run_rescue()
+        if banked is not None:
+            take(banked, "cpu-rescue")
+
+    # once any measurement exists the final rescue leg is moot, so the
+    # full stage may spend the whole remaining deadline
+    full = run_stage("full", FULL_ATTEMPTS, FULL_TIMEOUT_S,
+                     reserve=0.0 if (ramp is not None or banked is not None)
+                     else RESCUE_RESERVE_S)
+    if full is not None:
+        # keep the BEST measurement: a full-stage child whose own backend
+        # init fell back to host CPU (per-process tunnel luck) must not
+        # overwrite a real accelerator ramp number — nor the banked rescue
+        # row (the fallback full run is the same degraded CPU workload,
+        # only unlabeled)
+        accel_ramp = ramp is not None and not ramp["backend"].endswith(
+            ("-fallback", "-forced"))
+        if full["backend"].endswith("-fallback") and (
+                accel_ramp or banked is not None):
+            errors.append("full stage fell back to CPU; keeping the "
+                          + ("accelerator ramp" if accel_ramp
+                             else "banked cpu-rescue") + " measurement")
+        else:
+            take(full, None)
+
+    if ramp is None and full is None and banked is None:
+        rescue = run_rescue()
         if rescue is not None:
             take(rescue, "cpu-rescue")
 

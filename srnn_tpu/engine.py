@@ -143,19 +143,36 @@ def run_training(
     lr: float = DEFAULT_LR,
     train_mode: str = "sequential",
     record: bool = False,
+    shuffle_key: Optional[jax.Array] = None,
 ) -> TrainingRunResult:
     """Pure self-training, vectorized over trials
     (``training-fixpoints.py:52-56``: N trials x ``epochs`` train calls, no
     self-attacks, then classify).  Each epoch recomputes the samples from
     the current weights — the reference's moving-target regression toward
-    being a fixpoint (``network.py:613-618``)."""
+    being a fixpoint (``network.py:613-618``).
 
-    def epoch(w, _):
-        new_w, loss = jax.vmap(lambda wi: train_step(topo, wi, lr, train_mode))(w)
+    ``shuffle_key`` emulates keras ``fit``'s default per-epoch sample-order
+    shuffle, which the golden replay of the 2019 artifacts proved the
+    reference runs actually used (RESULTS.md round-5): each epoch each
+    particle takes its sequential batch-1 steps in an independent random
+    order.  Only the weightwise variant has multi-sample epochs, so this
+    is a bitwise no-op for aggregating/recurrent (asserted in tests);
+    ``None`` keeps the deterministic enumeration order."""
+
+    def epoch(w, e_idx):
+        if shuffle_key is None:
+            new_w, loss = jax.vmap(
+                lambda wi: train_step(topo, wi, lr, train_mode))(w)
+        else:
+            ks = jax.random.split(jax.random.fold_in(shuffle_key, e_idx),
+                                  w.shape[0])
+            new_w, loss = jax.vmap(
+                lambda wi, ki: train_step(topo, wi, lr, train_mode, key=ki)
+            )(w, ks)
         out = (loss, new_w if record else None)
         return new_w, out
 
-    w, (losses, traj) = jax.lax.scan(epoch, pop, None, length=epochs)
+    w, (losses, traj) = jax.lax.scan(epoch, pop, jnp.arange(epochs))
     classes = classify_batch(topo, w, epsilon)
     trajectory = jnp.concatenate([pop[None], traj], axis=0) if record else None
     return TrainingRunResult(w, losses, classes, count_classes(classes), trajectory)

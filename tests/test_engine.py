@@ -97,3 +97,39 @@ def test_classify_batch_matches_scalar_classify():
     pop = jnp.stack([jnp.asarray(identity_fixpoint_flat()), jnp.zeros(14)])
     ids = classify_batch(WW, pop)
     assert ids.tolist() == [CLS_FIX_OTHER, CLS_FIX_ZERO]
+
+
+def test_run_training_shuffle_key():
+    """run_training(shuffle_key=...) emulates keras fit's default per-epoch
+    sample shuffle (established by the golden replay of the 2019
+    artifacts): it must CHANGE the weightwise outcome per-step (14 samples
+    per epoch, order matters for sequential SGD), be a bitwise NO-OP for
+    the recurrent variant (single-sequence sample set), and leave the
+    weightwise training attractor class distribution intact."""
+    from srnn_tpu.engine import run_training
+
+    pop_ww = init_population(WW, jax.random.key(11), 16)
+    plain = run_training(WW, pop_ww, epochs=60, epsilon=1e-4)
+    shuf = run_training(WW, pop_ww, epochs=60, epsilon=1e-4,
+                        shuffle_key=jax.random.key(0))
+    assert not np.array_equal(np.asarray(plain.weights),
+                              np.asarray(shuf.weights))
+    # the science outcome survives the order change: training drives WW
+    # toward fixpoints either way (training-fixpoints.py headline)
+    assert int(shuf.counts[CLS_DIVERGENT]) == 0
+    assert shuf.counts.tolist() == plain.counts.tolist()
+
+    # single-sample epochs (the whole sequence for RNN, the aggregate
+    # vector for AGG): permuting one sample is the identity -> bitwise
+    pop_rnn = init_population(RNN, jax.random.key(12), 4) * 0.2
+    plain_r = run_training(RNN, pop_rnn, epochs=5, epsilon=1e-4)
+    shuf_r = run_training(RNN, pop_rnn, epochs=5, epsilon=1e-4,
+                          shuffle_key=jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(plain_r.weights),
+                                  np.asarray(shuf_r.weights))
+    pop_agg = init_population(AGG, jax.random.key(13), 4)
+    plain_a = run_training(AGG, pop_agg, epochs=5, epsilon=1e-4)
+    shuf_a = run_training(AGG, pop_agg, epochs=5, epsilon=1e-4,
+                          shuffle_key=jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(plain_a.weights),
+                                  np.asarray(shuf_a.weights))
