@@ -142,6 +142,8 @@ ROWS = {
     "soup_full": [
         (_soup_cmd("full", layout="popmajor", train_impl="xla"), None),
         (_soup_cmd("full", layout="popmajor", train_impl="pallas"), None),
+        (_soup_cmd("full", layout="popmajor", train_impl="pallas",
+                   attack_impl="compact", learn_from_impl="compact"), None),
     ],
     "soup_mixed": [
         (_soup_cmd("mixed", layout="rowmajor"), None),

@@ -47,7 +47,8 @@ def bench_size(preset: str, n: int, generations: int = 50,
                repeats: int = 3, layout: str = "rowmajor",
                train_mode: str = "sequential", sharded: bool = False,
                respawn_draws: str = "perparticle",
-               train_impl: str = "xla", attack_impl: str = "full") -> dict:
+               train_impl: str = "xla", attack_impl: str = "full",
+               learn_from_impl: str = "full") -> dict:
     dyn = _dynamics(preset, train_mode)
     dyn["respawn_draws"] = respawn_draws
     dyn["train_impl"] = train_impl
@@ -55,6 +56,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
         # the heterogeneous config has no attack_impl knob (per-type
         # cross-attack gathers are structural); homogeneous soups do
         dyn["attack_impl"] = attack_impl
+        dyn["learn_from_impl"] = learn_from_impl
     if preset == "mixed":
         third = n // 3
         cfg = MultiSoupConfig(
@@ -115,6 +117,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
         "respawn_draws": respawn_draws,
         "train_impl": train_impl,
         "attack_impl": attack_impl if preset != "mixed" else "n/a",
+        "learn_from_impl": learn_from_impl if preset != "mixed" else "n/a",
         "sharded_devices": jax.device_count() if sharded else 0,
         "particles": n,
         "generations": generations,
@@ -160,6 +163,10 @@ def main():
                    help="'compact': transform only the attacked lanes "
                         "(fixed-capacity compaction + scatter; popmajor, "
                         "non-mixed presets)")
+    p.add_argument("--learn-from-impl", choices=("full", "compact"),
+                   default="full",
+                   help="'compact': imitation-SGD on learner lanes only "
+                        "(same mechanics as --attack-impl)")
     args = p.parse_args()
     # the tunneled TPU backend flakes at init (sometimes raising, sometimes
     # wedging): probe with retries AND bound each phase with a watchdog that
@@ -193,7 +200,7 @@ def main():
                          args.repeats, args.layout,
                          args.train_mode, args.sharded,
                          args.respawn_draws, args.train_impl,
-                         args.attack_impl)
+                         args.attack_impl, args.learn_from_impl)
         row["platform"] = platform
         print(json.dumps(row))
     cancel()

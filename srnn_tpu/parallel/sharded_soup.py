@@ -245,11 +245,20 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
         if config.learn_from_severity > 0:
             post_attack = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
-            learned, _ = learn_epochs_popmajor(
-                topo, wT_loc, post_attack[:, learn_tgt_loc],
-                config.learn_from_severity, config.lr, config.train_mode,
-                config.train_impl)
-            wT_loc = jnp.where(learn_gate_loc[None, :], learned, wT_loc)
+            if config.learn_from_impl == "compact":
+                from ..soup import (_attack_capacity,
+                                    _learn_popmajor_compact)
+
+                wT_loc = _learn_popmajor_compact(
+                    config, wT_loc, learn_gate_loc, learn_tgt_loc,
+                    _attack_capacity(n_loc, config.learn_from_rate),
+                    source=post_attack)
+            else:
+                learned, _ = learn_epochs_popmajor(
+                    topo, wT_loc, post_attack[:, learn_tgt_loc],
+                    config.learn_from_severity, config.lr,
+                    config.train_mode, config.train_impl)
+                wT_loc = jnp.where(learn_gate_loc[None, :], learned, wT_loc)
     else:
         learn_gate_loc = jnp.zeros(n_loc, bool)
         learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
@@ -312,10 +321,10 @@ def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
         _check_popmajor(config)
         body = functools.partial(_local_popmajor_step, config, axes=axes)
     elif config.layout == "rowmajor":
-        if config.attack_impl != "full":
+        if config.attack_impl != "full" or config.learn_from_impl != "full":
             raise ValueError(
-                "attack_impl='compact' compacts lanes of the popmajor "
-                "layout; layout='rowmajor' needs attack_impl='full'")
+                "attack_impl/learn_from_impl='compact' compact lanes of "
+                "the popmajor layout; layout='rowmajor' needs 'full'")
         body = functools.partial(_local_evolve, config, axes=axes)
     else:
         raise ValueError(f"unknown soup layout {config.layout!r}")

@@ -101,6 +101,12 @@ class SoupConfig(NamedTuple):
     # overflow branch (mean + 8 sd bound, P < 1e-14) falls back to the
     # full path via lax.cond, so semantics never depend on the bound.
     attack_impl: str = "full"           # 'full' | 'compact'
+    # Same compaction for the learn_from phase (popmajor only): at
+    # learn_from_rate=0.1 only ~10% of lanes run the severity-epoch
+    # imitation-SGD chain, yet the full path computes it for every lane
+    # and selects.  Learner count is exactly Binomial(n, rate), same
+    # capacity bound and overflow fallback as the attack phase.
+    learn_from_impl: str = "full"       # 'full' | 'compact'
 
 
 class SoupState(NamedTuple):
@@ -269,19 +275,44 @@ def _attack_capacity(n: int, rate: float) -> int:
     return min(n, ((cap + 127) // 128) * 128)
 
 
+def _compact_gated_lanes(wT: jnp.ndarray, gate: jnp.ndarray, cap: int,
+                         block_fn) -> jnp.ndarray:
+    """Shared core of the sparse-phase compactions: run ``block_fn`` on the
+    gated lanes only and scatter the results back.
+
+    ``block_fn(cols)`` must return the transformed columns ``wT[:, cols]``
+    — per-lane math only, so computing it on a gathered subset is
+    value-preserving up to FMA contraction (the compiler may fuse a
+    multiply-add chain differently at the narrower width — observed <=1
+    ulp on XLA:CPU); ungated lanes are bitwise untouched.  ``cap`` lanes
+    are processed; overflow (more gated lanes than ``cap``) falls back to
+    the full-width computation via ``lax.cond``, so semantics never
+    depend on the capacity bound.
+    """
+    n = wT.shape[1]
+
+    def compact(_):
+        lanes = jnp.nonzero(gate, size=cap, fill_value=n)[0]
+        safe = jnp.where(lanes < n, lanes, 0)  # gather-safe clone slot
+        # scatter through the UNclipped indices: the fill slots are out of
+        # bounds and mode='drop' discards them — a clipped fill index would
+        # race a stale write against lane 0's real update
+        return wT.at[:, lanes].set(block_fn(safe), mode="drop")
+
+    def full(_):
+        return jnp.where(gate[None, :], block_fn(jnp.arange(n)), wT)
+
+    if cap >= n:
+        return full(None)
+    return jax.lax.cond(gate.sum(dtype=jnp.int32) > cap, full, compact, None)
+
+
 def _attack_popmajor_compact(topo: Topology, wT: jnp.ndarray,
                              att_idx: jnp.ndarray, has_attacker: jnp.ndarray,
                              cap: int, source: Optional[jnp.ndarray] = None
                              ) -> jnp.ndarray:
-    """Attack phase over compacted attacked-victim lanes only.
-
-    The per-lane transform is elementwise in the lane dimension, so
-    computing it on a gathered subset and scattering back is
-    value-preserving up to FMA contraction (the compiler may fuse the
-    multiply-add chain differently at the narrower width — observed <=1
-    ulp on XLA:CPU); unattacked lanes are bitwise untouched.  ``cap``
-    lanes are processed; overflow (more attacked victims than ``cap``)
-    returns the full-width computation via ``lax.cond`` instead.
+    """Attack phase over compacted attacked-victim lanes only
+    (:func:`_compact_gated_lanes` with the self-application transform).
 
     ``source`` is the matrix attacker columns are drawn from — ``wT``
     itself on one device; the all-gathered global population under
@@ -290,27 +321,38 @@ def _attack_popmajor_compact(topo: Topology, wT: jnp.ndarray,
     """
     from .ops.popmajor import apply_popmajor
 
-    n = wT.shape[1]
     src = wT if source is None else source
 
-    def compact(_):
-        victims = jnp.nonzero(has_attacker, size=cap, fill_value=n)[0]
-        safe = jnp.where(victims < n, victims, 0)  # gather-safe clone slot
-        attacker_w = src[:, jnp.clip(att_idx, 0)[safe]]
-        new = apply_popmajor(topo, attacker_w, wT[:, safe])
-        # scatter through the UNclipped indices: the fill slots are out of
-        # bounds and mode='drop' discards them — a clipped fill index would
-        # race a stale write against lane 0's real update
-        return wT.at[:, victims].set(new, mode="drop")
+    def block(cols):
+        return apply_popmajor(topo, src[:, jnp.clip(att_idx, 0)[cols]],
+                              wT[:, cols])
 
-    def full(_):
-        attacked = apply_popmajor(topo, src[:, jnp.clip(att_idx, 0)], wT)
-        return jnp.where(has_attacker[None, :], attacked, wT)
+    return _compact_gated_lanes(wT, has_attacker, cap, block)
 
-    if cap >= n:
-        return full(None)
-    overflow = has_attacker.sum(dtype=jnp.int32) > cap
-    return jax.lax.cond(overflow, full, compact, None)
+
+def _learn_popmajor_compact(config: SoupConfig, wT: jnp.ndarray,
+                            learn_gate: jnp.ndarray, learn_tgt: jnp.ndarray,
+                            cap: int, source: Optional[jnp.ndarray] = None
+                            ) -> jnp.ndarray:
+    """learn_from phase over compacted learner lanes only (the imitation
+    SGD chain, reference ``network.py:620-626``, runs on ~rate x N lanes
+    instead of all N).  Same value guarantees and overflow fallback as
+    ``_attack_popmajor_compact``; ``source`` is where counterpart columns
+    come from (the all-gathered post-attack population under sharding,
+    with ``learn_tgt`` holding global indices)."""
+    from .ops.popmajor import learn_epochs_popmajor
+
+    topo = config.topo
+    src = wT if source is None else source
+
+    def block(cols):
+        learned, _ = learn_epochs_popmajor(
+            topo, wT[:, cols], src[:, learn_tgt[cols]],
+            config.learn_from_severity, config.lr, config.train_mode,
+            config.train_impl)
+        return learned
+
+    return _compact_gated_lanes(wT, learn_gate, cap, block)
 
 
 def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
@@ -356,10 +398,15 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
         learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
         if config.learn_from_severity > 0:
-            learned, _ = learn_epochs_popmajor(
-                topo, wT, wT[:, learn_tgt], config.learn_from_severity,
-                config.lr, config.train_mode, config.train_impl)
-            wT = jnp.where(learn_gate[None, :], learned, wT)
+            if config.learn_from_impl == "compact":
+                wT = _learn_popmajor_compact(
+                    config, wT, learn_gate, learn_tgt,
+                    _attack_capacity(n, config.learn_from_rate))
+            else:
+                learned, _ = learn_epochs_popmajor(
+                    topo, wT, wT[:, learn_tgt], config.learn_from_severity,
+                    config.lr, config.train_mode, config.train_impl)
+                wT = jnp.where(learn_gate[None, :], learned, wT)
     else:
         learn_gate = jnp.zeros(n, bool)
         learn_tgt = jnp.zeros(n, jnp.int32)
@@ -411,6 +458,9 @@ def _check_popmajor(config: SoupConfig) -> None:
         raise ValueError(f"unknown train_impl {config.train_impl!r}")
     if config.attack_impl not in ("full", "compact"):
         raise ValueError(f"unknown attack_impl {config.attack_impl!r}")
+    if config.learn_from_impl not in ("full", "compact"):
+        raise ValueError(
+            f"unknown learn_from_impl {config.learn_from_impl!r}")
     if config.train_impl == "pallas" and (
             config.topo.variant != "weightwise"
             or config.train_mode != "sequential"
@@ -502,10 +552,11 @@ def evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEv
         raise ValueError(
             "train_impl='pallas' is the popmajor lane kernel; "
             "layout='rowmajor' needs train_impl='xla'")
-    if config.attack_impl != "full" and config.layout != "popmajor":
+    if (config.attack_impl != "full" or config.learn_from_impl != "full") \
+            and config.layout != "popmajor":
         raise ValueError(
-            "attack_impl='compact' compacts lanes of the popmajor layout; "
-            "layout='rowmajor' needs attack_impl='full'")
+            "attack_impl/learn_from_impl='compact' compact lanes of the "
+            "popmajor layout; layout='rowmajor' needs 'full'")
     if config.layout == "popmajor":
         _check_popmajor(config)
         new_state, events, wT = _evolve_parallel_popmajor(config, state,

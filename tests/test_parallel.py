@@ -109,14 +109,16 @@ def test_sharded_popmajor_compact_attack_matches_unsharded(mesh):
 
     n_dev = mesh.devices.size
     cfg = SoupConfig(topo=WW, size=512 * n_dev, attacking_rate=0.05,
+                     learn_from_rate=0.05, learn_from_severity=1,
                      train=1, remove_divergent=True, remove_zero=True,
                      layout="popmajor", respawn_draws="fused",
-                     attack_impl="compact")
+                     attack_impl="compact", learn_from_impl="compact")
     assert _attack_capacity(512, cfg.attacking_rate) < 512
     s0 = seed(cfg, jax.random.key(9))
     # one generation: the only difference is FMA contraction inside the
     # compact attack block -> ulp-tight
-    ref1 = evolve(cfg._replace(attack_impl="full"), s0, generations=1)
+    ref1 = evolve(cfg._replace(attack_impl="full", learn_from_impl="full"),
+                  s0, generations=1)
     sh1 = sharded_evolve(cfg, mesh,
                          make_sharded_state(cfg, mesh, jax.random.key(9)),
                          generations=1)
@@ -126,7 +128,8 @@ def test_sharded_popmajor_compact_attack_matches_unsharded(mesh):
                                rtol=1e-4, atol=1e-6)
     # four generations: ulp seeds amplify through the train-phase dynamics
     # (sensitive directions grow); uids stay exact, weights stay close
-    ref = evolve(cfg._replace(attack_impl="full"), s0, generations=4)
+    ref = evolve(cfg._replace(attack_impl="full", learn_from_impl="full"),
+                 s0, generations=4)
     sh = sharded_evolve(cfg, mesh,
                         make_sharded_state(cfg, mesh, jax.random.key(9)),
                         generations=4)

@@ -299,6 +299,36 @@ def test_attack_compact_rejects_rowmajor():
                     seed(mkconfig(), jax.random.key(0)))
 
 
+def test_learn_from_impl_compact_matches_full():
+    """learn_from_impl='compact' runs the imitation-SGD chain on the
+    learner lanes only; same uid/gate exactness and FMA-level weight
+    agreement as the attack compaction, across generations that mix all
+    phases.  Sized so the capacity is genuinely below N."""
+    from srnn_tpu.soup import _attack_capacity
+
+    cfg_full = mkconfig(size=512, attacking_rate=0.05, learn_from_rate=0.05,
+                        learn_from_severity=2, train=1,
+                        remove_divergent=True, remove_zero=True,
+                        layout="popmajor", respawn_draws="fused")
+    assert _attack_capacity(512, 0.05) < 512
+    cfg_c = cfg_full._replace(learn_from_impl="compact",
+                              attack_impl="compact")
+    st = seed(cfg_full, jax.random.key(21))
+    full = evolve(cfg_full, st, generations=5)
+    comp = evolve(cfg_c, st, generations=5)
+    np.testing.assert_array_equal(np.asarray(full.uids),
+                                  np.asarray(comp.uids))
+    f, c = np.asarray(full.weights), np.asarray(comp.weights)
+    finite = np.isfinite(f).all(axis=1) & np.isfinite(c).all(axis=1)
+    np.testing.assert_allclose(c[finite], f[finite], rtol=5e-3, atol=1e-6)
+
+
+def test_learn_compact_rejects_rowmajor():
+    with pytest.raises(ValueError, match="learn_from_impl"):
+        evolve_step(mkconfig(learn_from_impl="compact", learn_from_rate=0.5),
+                    seed(mkconfig(), jax.random.key(0)))
+
+
 def test_popmajor_rejects_unsupported_configs():
     with pytest.raises(ValueError):
         evolve_step(mkconfig(layout="popmajor", mode="sequential"),
