@@ -49,6 +49,13 @@ def build_parser():
                         "--checkpoint-every")
     p.add_argument("--resume", default=None, metavar="RUN_DIR",
                    help="continue a previous run from its latest checkpoint")
+    p.add_argument("--attack-impl", choices=("full", "compact"),
+                   default="full",
+                   help="'compact': transform only attacked lanes "
+                        "(popmajor; see SoupConfig.attack_impl)")
+    p.add_argument("--learn-from-impl", choices=("full", "compact"),
+                   default="full",
+                   help="'compact': imitation-SGD on learner lanes only")
     p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
                    default="fused",
                    help="respawn replacement draws: 'fused' (default here — "
@@ -65,7 +72,8 @@ def build_parser():
 
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
                   "train_mode", "layout", "epsilon", "capture_every",
-                  "sharded", "respawn_draws")
+                  "sharded", "respawn_draws", "attack_impl",
+                  "learn_from_impl")
 
 
 def run(args):
@@ -212,6 +220,8 @@ def _make_config(args) -> SoupConfig:
         epsilon=args.epsilon,
         layout=args.layout,
         respawn_draws=args.respawn_draws,
+        attack_impl=args.attack_impl,
+        learn_from_impl=args.learn_from_impl,
     )
 
 

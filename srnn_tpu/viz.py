@@ -53,7 +53,12 @@ CLASS_COLORS = ("#d62728", "#1f77b4", "#2ca02c", "#9467bd", "#7f7f7f")
 # ---------------------------------------------------------------------------
 
 
-def particle_trajectories(artifact: Dict[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
+MAX_RENDER_PARTICLES = 2048
+
+
+def particle_trajectories(artifact: Dict[str, np.ndarray],
+                          max_particles: Optional[int] = None,
+                          ) -> List[Dict[str, np.ndarray]]:
     """Artifact -> list of {'trajectory': (T, P), 'time': (T,), 'uid': int}.
 
     Accepts both artifact shapes the setups write:
@@ -62,6 +67,11 @@ def particle_trajectories(artifact: Dict[str, np.ndarray]) -> List[Dict[str, np.
       * soup histories: ``{"weights": (G, N, P), "uids": (G, N)}`` — slots
         are split wherever the uid changes (respawn), mirroring
         ``build_from_soup_or_exp`` (``visualization.py:27-40``).
+
+    ``max_particles`` caps the rendered slots by a deterministic even
+    stride over the columns — a mega-soup capture holds 1M slots, and a
+    plot of 1M lines is neither readable nor computable; ``None`` keeps
+    every column (the paper-scale artifacts).
     """
     w = np.asarray(artifact["weights"])
     if w.ndim != 3:
@@ -69,8 +79,11 @@ def particle_trajectories(artifact: Dict[str, np.ndarray]) -> List[Dict[str, np.
     t_len, n, _ = w.shape
     uids = np.asarray(artifact["uids"]) if "uids" in artifact else \
         np.broadcast_to(np.arange(n, dtype=np.int64), (t_len, n))
+    cols = range(n)
+    if max_particles is not None and n > max_particles:
+        cols = np.unique(np.linspace(0, n - 1, max_particles).astype(int))
     out = []
-    for col in range(n):
+    for col in cols:
         col_uids = uids[:, col]
         # contiguous segments of constant uid = one particle lifetime
         breaks = np.flatnonzero(np.diff(col_uids) != 0) + 1
@@ -103,10 +116,12 @@ def pca2_fit(stacked: np.ndarray):
 # ---------------------------------------------------------------------------
 
 
-def extract_pca(artifact):
+def extract_pca(artifact, max_particles: Optional[int] = MAX_RENDER_PARTICLES):
     """Shared per-artifact preprocessing for the 3-D trajectory views:
-    -> (trajs, mean, (P, 2) components).  Compute once, render many."""
-    trajs = particle_trajectories(artifact)
+    -> (trajs, mean, (P, 2) components).  Compute once, render many.
+    Renders cap at ``MAX_RENDER_PARTICLES`` deterministically-strided
+    slots so mega-scale captures stay plottable."""
+    trajs = particle_trajectories(artifact, max_particles=max_particles)
     if not trajs:
         raise ValueError("no finite trajectories to plot")
     mean, comps = pca2_fit(np.vstack([t["trajectory"] for t in trajs]))
@@ -143,7 +158,13 @@ def plot_latent_trajectories(artifact, out_path: str, title: str = "",
     (``plot_latent_trajectories``, ``visualization.py:43-93``)."""
     from sklearn.manifold import TSNE
 
-    trajs = particle_trajectories(artifact)
+    # t-SNE is ~quadratic in POINTS (= particles x frames), so cap the
+    # particle count so the stacked rows stay bounded — a mega-scale
+    # capture would otherwise hang the embedding even after the generic
+    # MAX_RENDER_PARTICLES cap
+    t_len = np.asarray(artifact["weights"]).shape[0]
+    cap = min(MAX_RENDER_PARTICLES, max(8, 20_000 // max(1, t_len)))
+    trajs = particle_trajectories(artifact, max_particles=cap)
     stacked = np.vstack([t["trajectory"] for t in trajs])
     perplexity = min(perplexity, max(2.0, (len(stacked) - 1) / 3))
     emb = TSNE(n_components=2, perplexity=perplexity,

@@ -128,3 +128,20 @@ def test_mega_curve_rendered_by_walker(tmp_path):
     outs = viz.search_and_apply(str(tmp_path))
     assert os.path.join(d, "mega_curve.png") in outs
     assert viz.search_and_apply(str(tmp_path)) == []  # idempotent
+
+
+def test_particle_trajectories_subsampling_cap():
+    """Mega-scale artifacts render a deterministic strided subset; small
+    artifacts keep every column; the stride includes both ends."""
+    from srnn_tpu.viz import particle_trajectories
+
+    t_len, n, p = 3, 1000, 4
+    art = {"weights": np.random.default_rng(0).normal(size=(t_len, n, p))}
+    full = particle_trajectories(art)
+    assert len(full) == n
+    capped = particle_trajectories(art, max_particles=64)
+    assert len(capped) == 64
+    uids = [t["uid"] for t in capped]
+    assert uids[0] == 0 and uids[-1] == n - 1
+    again = particle_trajectories(art, max_particles=64)
+    assert [t["uid"] for t in again] == uids  # deterministic stride
