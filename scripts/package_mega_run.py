@@ -1,0 +1,99 @@
+"""Package a completed (or checkpointed) mega_soup run for results_tpu/.
+
+The live run dir holds artifacts at two scales: small evidence files
+(config/meta/log/events, the class-count curve) and bulk state (the
+full-population ``soup.traj`` frames at ~56 MB each, orbax checkpoints).
+This packager commits the evidence and a DETERMINISTIC 2048-particle
+sample of the trajectory frames (same even stride the render cap uses),
+leaving the bulk on disk:
+
+    python scripts/package_mega_run.py <run_dir> <out_dir>
+
+Outputs in <out_dir>:
+    config.json meta.json log.txt events.jsonl   (copied verbatim)
+    mega_curve.png                               (class counts/generation)
+    soup_trajectories_3d.png/.html               (sampled 3-D PCA views)
+    trajectories_sample.npz                      (weights/uids/generations
+                                                  for the sampled slots)
+    PACKAGE.json                                 (what was sampled, from
+                                                  what, when; final counts)
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+
+
+def main(run_dir: str, out_dir: str) -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # packaging is pure host work; never let the (possibly wedged) tunnel
+    # backend initialize under the srnn_tpu import chain
+    from srnn_tpu.utils.backend import force_cpu
+    force_cpu()
+    from srnn_tpu import viz
+
+    os.makedirs(out_dir, exist_ok=True)
+    for name in ("config.json", "meta.json", "log.txt", "events.jsonl"):
+        src = os.path.join(run_dir, name)
+        if os.path.exists(src):
+            shutil.copy2(src, os.path.join(out_dir, name))
+
+    # class-count curve + trajectory views (render caps keep this bounded
+    # at mega scale); renders land in out_dir, inputs read from run_dir
+    outputs = viz.search_and_apply(run_dir, redo=True, out_dir=out_dir)
+
+    package = {"run_dir": os.path.abspath(run_dir),
+               "packaged_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+               "renders": [os.path.basename(o) for o in outputs]}
+
+    traj = os.path.join(run_dir, "soup.traj")
+    if os.path.exists(traj):
+        from srnn_tpu.utils.trajstore import read_store_sampled, store_shape
+
+        # the SAME deterministic stride the renders use, sampled at read
+        # time (streaming windows — a long mega capture's full frames
+        # would not fit in host RAM)
+        n, p = store_shape(traj)
+        cols = viz.render_columns(n)
+        store = read_store_sampled(traj, cols)
+        np.savez_compressed(
+            os.path.join(out_dir, "trajectories_sample.npz"),
+            weights=store["weights"].astype(np.float32),
+            uids=store["uids"],
+            generations=store["generations"],
+            sampled_columns=cols)
+        package["trajectory_sample"] = {
+            "frames": int(len(store["generations"])), "population": int(n),
+            "sampled_slots": int(len(cols)), "weights_per_particle": int(p)}
+
+    events = os.path.join(run_dir, "events.jsonl")
+    if os.path.exists(events):
+        last = None
+        with open(events) as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if "counts" in ev:
+                    last = ev
+        if last is not None:
+            package["final"] = {"generation": last.get("generation"),
+                                "counts": last.get("counts")}
+
+    with open(os.path.join(out_dir, "PACKAGE.json"), "w") as fh:
+        json.dump(package, fh, indent=1)
+    print(json.dumps(package))
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__)
+    sys.exit(main(sys.argv[1], sys.argv[2]))

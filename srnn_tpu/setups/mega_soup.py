@@ -88,12 +88,18 @@ def run(args):
     # a bad invocation can never leave a run dir without meta.json
     ckpt = None
     if args.resume:
-        # original dynamics win over CLI; legacy configs written before
-        # respawn_draws existed ran per-particle draws — the new 'fused'
-        # CLI default must not silently change a resumed run's stream
+        # original dynamics win over CLI; legacy configs written before a
+        # field existed must resume with the behavior they actually ran
+        # (per-particle draws, full-width phases) — never a newer CLI value
         load_run_config(args.resume, args, _CONFIG_FIELDS,
-                        legacy_defaults={"respawn_draws": "perparticle"})
+                        legacy_defaults={"respawn_draws": "perparticle",
+                                         "attack_impl": "full",
+                                         "learn_from_impl": "full"})
         ckpt = latest_checkpoint(args.resume)
+    if (args.attack_impl != "full" or args.learn_from_impl != "full") \
+            and args.layout != "popmajor":
+        raise SystemExit("--attack-impl/--learn-from-impl compact need "
+                         "--layout popmajor")
     if args.capture_every < 0:
         raise SystemExit("--capture-every must be >= 0")
     if args.capture_every and args.checkpoint_every % args.capture_every:

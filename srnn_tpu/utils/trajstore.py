@@ -21,7 +21,7 @@ import os
 import struct
 import subprocess
 import zlib
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -315,12 +315,53 @@ def truncate_frames(path: str, keep: int) -> int:
     return keep
 
 
-def read_store_artifact(path: str) -> Dict[str, np.ndarray]:
-    """Read a whole store in the soup-artifact shape ``srnn_tpu.viz``
-    consumes (weights/uids/action/counterpart/loss keys).  Accepts both a
+def read_store_sampled(path: str, columns: np.ndarray,
+                       chunk_frames: int = 4) -> Dict[str, np.ndarray]:
+    """Read a store keeping only the given particle ``columns``, streaming
+    ``chunk_frames`` frames at a time so peak memory is bounded by the
+    WINDOW, not the store (a 1M-particle capture is ~56 MB/frame — a
+    whole-store read of a long run OOMs exactly at the scale the sampling
+    exists for).  Returns the full dict including ``generations``."""
+    columns = np.asarray(columns)
+    # one-frame peek fixes the shapes/keys without loading the store
+    peek = read_sharded_store(path, 0, min(1, _total_frames(path)))
+    total = _total_frames(path)
+    parts = {k: [] for k in peek if k != "generations"}
+    gens = []
+    for start in range(0, total, chunk_frames):
+        win = read_sharded_store(path, start,
+                                 min(chunk_frames, total - start))
+        gens.append(win.pop("generations"))
+        for k, v in win.items():
+            parts[k].append(v[:, columns] if v.ndim >= 2 else v)
+    out = {k: np.concatenate(v, axis=0) if v else peek[k][:0]
+           for k, v in parts.items()}
+    out["generations"] = np.concatenate(gens) if gens else \
+        peek["generations"][:0]
+    return out
+
+
+def _total_frames(path: str) -> int:
+    """Complete merged frame count for a plain store or a shard set."""
+    shards = _find_shards(path)
+    if not shards:
+        return store_frame_count(path)
+    return min(store_frame_count(p) for _, _, p in shards)
+
+
+def read_store_artifact(path: str,
+                        columns: Optional[np.ndarray] = None
+                        ) -> Dict[str, np.ndarray]:
+    """Read a store in the soup-artifact shape ``srnn_tpu.viz`` consumes
+    (weights/uids/action/counterpart/loss keys).  Accepts both a
     single-process store and the base path of a per-process shard set
-    (merged via :func:`read_sharded_store`)."""
-    out = read_sharded_store(path)
+    (merged via :func:`read_sharded_store`).  ``columns`` restricts to a
+    particle subset via the memory-bounded streaming reader — pass it for
+    mega-scale stores."""
+    if columns is not None:
+        out = read_store_sampled(path, columns)
+    else:
+        out = read_sharded_store(path)
     out.pop("generations")
     return out
 
@@ -376,6 +417,20 @@ def store_frame_count(path: str) -> int:
         n, p = _parse_header(f, path)
         f.seek(0, os.SEEK_END)
         return (f.tell() - _HEADER.size) // _frame_bytes(n, p)
+
+
+def store_shape(path: str) -> "Tuple[int, int]":
+    """(total particles, weights per particle) from headers alone — the
+    merged particle count for a shard set, no frame data read."""
+    shards = _find_shards(path)
+    paths = [p for _, _, p in shards] if shards else [path]
+    n_total, p_dim = 0, None
+    for sp in paths:
+        with open(sp, "rb") as f:
+            n, p = _parse_header(f, sp)
+        n_total += n
+        p_dim = p
+    return n_total, p_dim
 
 
 def read_sharded_store(base: str, start: int = 0,

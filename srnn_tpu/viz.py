@@ -56,6 +56,17 @@ CLASS_COLORS = ("#d62728", "#1f77b4", "#2ca02c", "#9467bd", "#7f7f7f")
 MAX_RENDER_PARTICLES = 2048
 
 
+def render_columns(n: int, max_particles: int = MAX_RENDER_PARTICLES
+                   ) -> np.ndarray:
+    """THE deterministic even-stride column subset used everywhere a
+    mega-scale population is sampled (renders, packaged trajectory
+    samples) — one definition so plots and committed samples can never
+    silently diverge."""
+    if n <= max_particles:
+        return np.arange(n)
+    return np.unique(np.linspace(0, n - 1, max_particles).astype(int))
+
+
 def particle_trajectories(artifact: Dict[str, np.ndarray],
                           max_particles: Optional[int] = None,
                           ) -> List[Dict[str, np.ndarray]]:
@@ -79,9 +90,8 @@ def particle_trajectories(artifact: Dict[str, np.ndarray],
     t_len, n, _ = w.shape
     uids = np.asarray(artifact["uids"]) if "uids" in artifact else \
         np.broadcast_to(np.arange(n, dtype=np.int64), (t_len, n))
-    cols = range(n)
-    if max_particles is not None and n > max_particles:
-        cols = np.unique(np.linspace(0, n - 1, max_particles).astype(int))
+    cols = range(n) if max_particles is None else \
+        render_columns(n, max_particles)
     out = []
     for col in cols:
         col_uids = uids[:, col]
@@ -470,11 +480,17 @@ def search_and_apply(directory: str, redo: bool = False,
             if done and not redo:
                 continue
             from .utils import read_store_artifact
+            from .utils.trajstore import store_shape
             try:
                 os.makedirs(render_dir, exist_ok=True)
+                # sample columns at READ time: a mega store's full frames
+                # would exhaust host RAM long before the render cap runs
+                n_slots = store_shape(os.path.join(root, f))[0]
+                cols = render_columns(n_slots) \
+                    if n_slots > MAX_RENDER_PARTICLES else None
                 outputs += _render_traj_views(
-                    read_store_artifact(os.path.join(root, f)), render_dir,
-                    stem)
+                    read_store_artifact(os.path.join(root, f),
+                                        columns=cols), render_dir, stem)
             except Exception as e:
                 print(f"viz: skipping {f} in {root}: {e!r}")
         basenames = {f.rsplit(".", 1)[0] for f in files

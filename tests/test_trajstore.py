@@ -340,3 +340,24 @@ def test_sharded_artifact_renders_in_viz(tmp_path, mesh):
     # the run-dir walker discovers shard sets too (no plain .traj exists)
     outputs = viz.search_and_apply(str(tmp_path))
     assert any("soup_trajectories_3d" in o for o in outputs)
+
+
+def test_sampled_read_matches_full_read(tmp_path):
+    """read_store_sampled streams frame windows and keeps only the given
+    columns; result must equal slicing the full read, including
+    generations, and store_shape must report the merged shape without
+    reading frames."""
+    from srnn_tpu.utils.trajstore import (read_store_sampled, store_shape)
+
+    n, p, g = 20, 14, 7
+    frames = _frames(n, p, g)
+    path = tmp_path / "big.traj"
+    _write(path, frames, n, p, native=False)
+    assert store_shape(str(path)) == (n, p)
+    cols = np.array([0, 3, 11, 19])
+    full = read_store(str(path))
+    sampled = read_store_sampled(str(path), cols, chunk_frames=3)
+    np.testing.assert_array_equal(sampled["generations"],
+                                  full["generations"])
+    for key in ("weights", "uids", "action", "counterpart", "loss"):
+        np.testing.assert_array_equal(sampled[key], full[key][:, cols])
