@@ -18,11 +18,15 @@ Prints one JSON line per row.
 """
 
 import argparse
+import os
+import sys
 import json
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from srnn_tpu import Topology
 from srnn_tpu.engine import run_training

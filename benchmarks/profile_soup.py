@@ -26,12 +26,16 @@ Run: ``python benchmarks/profile_soup.py [--n 1000000] [--gens 20]
 """
 
 import argparse
+import os
+import sys
 import functools
 import json
 import time
 
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from srnn_tpu import Topology, init_population
 from srnn_tpu.ops.popmajor import ww_forward_popmajor

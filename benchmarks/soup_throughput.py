@@ -25,9 +25,12 @@ Prints one JSON line per size.
 import argparse
 import json
 import os
+import sys
 import time
 
 import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from srnn_tpu import Topology
 from srnn_tpu.multisoup import MultiSoupConfig, evolve_multi, seed_multi

@@ -23,9 +23,13 @@ non-goal") reads straight off the ``x_vs_ww_pallas`` field.
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from srnn_tpu import Topology
 from srnn_tpu.soup import SoupConfig, evolve, seed

@@ -25,6 +25,13 @@ sample snapshot refreshes at each epoch top (self-training) or stays fixed
 returned loss is the last epoch's mean PRE-update loss (keras history
 semantics).  Parity with the XLA path is tested to float tolerance
 (reassociation differs).
+
+Mosaic notes (learned compiling on a real v5e, round 5): the epoch loop is
+a ``lax.fori_loop`` — Mosaic's loop lowering pattern-matches fori_loop and
+rejects a raw ``lax.scan`` ("not a fori_loop index"); and the normalized
+duplex coordinates are NOT a kernel operand — they are trace-time
+constants of the topology, baked in as Python floats (the previous (P, 3)
+VMEM table needed scalar loads Mosaic has no clean lowering for).
 """
 
 import functools
@@ -39,29 +46,26 @@ from ..topology import Topology, normalized_weight_coords
 LANE_BLOCK = 2048  # particles per grid step (matches pallas_ww)
 
 
-def _sgd_chain(topo: Topology, w, snap_source, epochs: int, lr: float,
-               coords_ref, refresh: bool):
-    """The flattened epochs x samples batch-1 SGD chain on one (P, B) lane
-    block.  ``snap_source`` supplies the fixed imitation target when
-    ``refresh`` is False; ignored otherwise.  Returns (w, last_loss (B,))."""
+def _sgd_chain(topo: Topology, rows0, snap_rows, epochs: int, lr: float,
+               refresh: bool):
+    """The flattened epochs x samples batch-1 SGD chain on one lane block.
+
+    ``rows0`` is a length-P tuple of (B,) lane vectors (one per weight);
+    ``snap_rows`` supplies the fixed imitation target when ``refresh`` is
+    False, ignored otherwise.  Returns (rows tuple, last_loss (B,))."""
     p = topo.num_weights
     shapes = topo.layer_shapes
     offs = topo.offsets
+    coords = normalized_weight_coords(topo)  # (P, 3) trace-time constants
 
-    # carry the population as a TUPLE of row vectors: per-sample updates
-    # touch rows in place with no (P, B) re-stack per step (a per-sample
-    # stack+index pattern is quadratic in P for both tracing and the
-    # interpreter)
-    rows0 = tuple(w[r] for r in range(p))
-    snap_rows = None if refresh else tuple(snap_source[r] for r in range(p))
-
-    def epoch(rows, _):
+    def epoch(e, carry):
+        rows, _ = carry
         snap = rows if refresh else snap_rows
         loss_acc = jnp.zeros_like(rows[0])
         rows = list(rows)
         for s in range(p):
             x = snap[s]
-            feats = [x] + [coords_ref[s, k] + jnp.zeros_like(x)
+            feats = [x] + [jnp.full_like(x, float(coords[s, k]))
                            for k in range(3)]
             # forward, keeping every layer's activations for the backward
             acts = [feats]
@@ -97,24 +101,26 @@ def _sgd_chain(topo: Topology, w, snap_source, epochs: int, lr: float,
                 rows[r] = rows[r] - lr * grads[r]
         return tuple(rows), loss_acc / p
 
-    (rows, last_loss), _ = jax.lax.scan(
-        lambda c, _: (epoch(c[0], None), None),
-        (rows0, jnp.zeros_like(w[0])), None, length=epochs)
-    return jnp.stack(rows), last_loss
+    return jax.lax.fori_loop(0, epochs, epoch,
+                             (rows0, jnp.zeros_like(rows0[0])))
 
 
-def _train_kernel(coords_ref, w_ref, out_ref, loss_ref, *, topo, epochs, lr):
-    w, loss = _sgd_chain(topo, w_ref[:, :], None, epochs, lr, coords_ref,
-                         refresh=True)
-    out_ref[:, :] = w
+def _train_kernel(w_ref, out_ref, loss_ref, *, topo, epochs, lr):
+    p = topo.num_weights
+    rows0 = tuple(w_ref[r, :] for r in range(p))
+    rows, loss = _sgd_chain(topo, rows0, None, epochs, lr, refresh=True)
+    for r in range(p):
+        out_ref[r, :] = rows[r]
     loss_ref[0, :] = loss
 
 
-def _learn_kernel(coords_ref, w_ref, other_ref, out_ref, loss_ref, *,
-                  topo, epochs, lr):
-    w, loss = _sgd_chain(topo, w_ref[:, :], other_ref[:, :], epochs, lr,
-                         coords_ref, refresh=False)
-    out_ref[:, :] = w
+def _learn_kernel(w_ref, other_ref, out_ref, loss_ref, *, topo, epochs, lr):
+    p = topo.num_weights
+    rows0 = tuple(w_ref[r, :] for r in range(p))
+    snap = tuple(other_ref[r, :] for r in range(p))
+    rows, loss = _sgd_chain(topo, rows0, snap, epochs, lr, refresh=False)
+    for r in range(p):
+        out_ref[r, :] = rows[r]
     loss_ref[0, :] = loss
 
 
@@ -141,7 +147,6 @@ def ww_train_epochs_pallas(topo: Topology, wT: jnp.ndarray, epochs: int,
     if pad:
         wT = jnp.pad(wT, ((0, 0), (0, pad)))
     padded = n + pad
-    coords = jnp.asarray(normalized_weight_coords(topo), wT.dtype)
     out, loss = pl.pallas_call(
         functools.partial(_train_kernel, topo=topo, epochs=epochs,
                           lr=float(lr)),
@@ -149,7 +154,6 @@ def ww_train_epochs_pallas(topo: Topology, wT: jnp.ndarray, epochs: int,
                    jax.ShapeDtypeStruct((1, padded), wT.dtype)),
         grid=(padded // block,),
         in_specs=[
-            pl.BlockSpec((p, 3), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((p, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
@@ -158,7 +162,7 @@ def ww_train_epochs_pallas(topo: Topology, wT: jnp.ndarray, epochs: int,
                    pl.BlockSpec((1, block), lambda i: (0, i),
                                 memory_space=pltpu.VMEM)),
         interpret=interpret,
-    )(coords, wT)
+    )(wT)
     return (out[:, :n], loss[0, :n]) if pad else (out, loss[0])
 
 
@@ -178,7 +182,6 @@ def ww_learn_epochs_pallas(topo: Topology, wT: jnp.ndarray,
         wT = jnp.pad(wT, ((0, 0), (0, pad)))
         otherT = jnp.pad(otherT, ((0, 0), (0, pad)))
     padded = n + pad
-    coords = jnp.asarray(normalized_weight_coords(topo), wT.dtype)
     out, loss = pl.pallas_call(
         functools.partial(_learn_kernel, topo=topo, epochs=severity,
                           lr=float(lr)),
@@ -186,7 +189,6 @@ def ww_learn_epochs_pallas(topo: Topology, wT: jnp.ndarray,
                    jax.ShapeDtypeStruct((1, padded), wT.dtype)),
         grid=(padded // block,),
         in_specs=[
-            pl.BlockSpec((p, 3), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((p, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((p, block), lambda i: (0, i),
@@ -197,5 +199,5 @@ def ww_learn_epochs_pallas(topo: Topology, wT: jnp.ndarray,
                    pl.BlockSpec((1, block), lambda i: (0, i),
                                 memory_space=pltpu.VMEM)),
         interpret=interpret,
-    )(coords, wT, otherT)
+    )(wT, otherT)
     return (out[:, :n], loss[0, :n]) if pad else (out, loss[0])
