@@ -66,11 +66,25 @@ sys.exit(0 if ok or not int(os.environ.get("SRNN_REQUIRE_TPU", "0")) else 3)
 """
 
 
+# The axon PJRT plugin registers via a sitecustomize on this path.  Children
+# need it on PYTHONPATH to reach the TPU; the PARENT should be started
+# WITHOUT it (``PYTHONPATH= python benchmarks/opportunistic.py``), because
+# that sitecustomize dials the relay at interpreter startup and a wedged
+# tunnel then blocks the parent in recvfrom() before main() ever runs
+# (observed round 5).  _spawn composes the child PYTHONPATH explicitly —
+# repo root first (children import srnn_tpu; ~10 rows were lost in the
+# round-5 capture window to a missing repo root) — so it does not matter
+# what the parent was started with.
+_AXON_SITE = "/root/.axon_site"
+
+
 def _spawn(cmd, timeout_s, extra_env=None):
     """Run one child; return (status, seconds, stdout_lines, stderr_tail)."""
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let the axon plugin register
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_opportunistic_cache")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + ([_AXON_SITE] if os.path.isdir(_AXON_SITE) else []))
     if extra_env:
         env.update(extra_env)
     t0 = time.time()

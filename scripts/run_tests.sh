@@ -21,6 +21,15 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# The suite is CPU-only (tests/conftest.py pins jax_platforms=cpu), but the
+# axon TPU-tunnel sitecustomize on PYTHONPATH dials the relay at EVERY
+# python startup — and when the tunnel is wedged that handshake blocks in
+# recvfrom() before pytest even begins (observed round 5: interpreter hung
+# 12+ min at startup, 0% CPU).  Strip it: tests/conftest.py puts the repo
+# root on sys.path itself, so nothing else is lost.
+export PYTHONPATH=
+export JAX_PLATFORMS=cpu
+
 pass=0; fail=0; failed_groups=()
 summary=""
 

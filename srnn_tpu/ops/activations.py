@@ -30,3 +30,31 @@ def resolve_activation(name):
         raise ValueError(
             f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}"
         ) from None
+
+
+# Activations whose derivative is expressible from the OUTPUT alone —
+# what the hand-derived Pallas backward passes need, since they store
+# post-activation values (not pre-activations) in VMEM.  relu's gradient
+# at exactly 0 is 0, matching jax.nn.relu's VJP.
+_OUTPUT_GRADS = {
+    "linear": None,                    # multiplier 1 — callers skip the mul
+    "sigmoid": lambda h: h * (1.0 - h),
+    "tanh": lambda h: 1.0 - h * h,
+    "relu": lambda h: (h > 0.0).astype(h.dtype),
+}
+
+
+def output_grad_activations():
+    """Activation names the fused Pallas SGD kernels can differentiate."""
+    return tuple(sorted(_OUTPUT_GRADS))
+
+
+def resolve_output_grad(name):
+    """act'(z) as a function of h = act(z); returns None for 'linear'
+    (identity multiplier)."""
+    try:
+        return _OUTPUT_GRADS[name]
+    except KeyError:
+        raise ValueError(
+            f"activation {name!r} has no output-expressible derivative; "
+            f"the fused kernels support {sorted(_OUTPUT_GRADS)}") from None

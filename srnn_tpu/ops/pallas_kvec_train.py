@@ -1,0 +1,201 @@
+"""Fused Pallas TPU kernel for k-vector-variant batch-1 SGD
+(aggregating / fft).
+
+Round-5 TPU train-phase decomposition (RESULTS.md): the aggregating and
+fft variants' XLA train paths run at 2.4x / 2.9x the fused weightwise
+kernel's per-particle cost — each scan(epochs) step round-trips the (P, N)
+population through HBM for a gradient whose arithmetic is a few dozen
+lane-elementwise FMAs.  This kernel fuses the whole multi-epoch chain in
+VMEM per lane block, like its weightwise and recurrent siblings.
+
+Semantics mirror ``ops/popmajor_kvec`` (reference ``network.py:414-417`` /
+``:518-521``): ONE sample per epoch (x = y = the particle's k-aggregate /
+DFT-coefficient vector), so each reference batch-1 epoch is a single
+full-batch gradient step; self-training re-reduces x from the current
+weights at each epoch top, imitation keeps x fixed at the counterpart's
+reduction.  Gradients do not flow through the reduction (the XLA path
+stop-gradients the sample — keras regenerates x outside the graph).
+
+The reductions become trace-time-constant lane arithmetic:
+
+  * aggregating 'average': per-segment add chains scaled by 1/count
+    (reference ``collect_weights`` leftover rule, ``network.py:388-403``);
+    'max' / 'max_buggy' are the same comparison chains as the popmajor
+    path (including the falsy-max quirk, ``network.py:303-308``);
+  * fft: the truncated real-part DFT is a (k, P) cosine-basis constant
+    matrix applied as per-row multiply-add chains — the same
+    real-arithmetic decomposition ``parallel/sharded_apply.py`` uses (the
+    imaginary parts are discarded by the reference's float cast, so only
+    the cos basis survives; ``network.py:444-448``).
+
+The MLP backward is the hand-derived chain shared with the weightwise
+kernel (act' from stored post-activations,
+``activations.resolve_output_grad``); the epoch loop is a
+``lax.fori_loop`` (Mosaic's loop-lowering requirement).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..topology import Topology, aggregation_segments
+from .activations import resolve_activation, resolve_output_grad
+from .pallas_sgd_common import lane_call, make_learn_kernel, make_train_kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _dft_cos_rows(topo: Topology):
+    """(k, P) real cosine-basis rows of the variant's truncated DFT, as a
+    tuple-of-tuples of Python floats (trace-time constants).
+
+    fft_mode='fft': ``fft(x, n=k)`` crops/pads x to length k, so bin j
+    reads only the first min(k, P) weights with basis cos(2*pi*j*m/k).
+    fft_mode='rfft': bins are the first k of the full-length real FFT,
+    basis cos(2*pi*j*m/P); bins beyond P//2+1 are zero (the popmajor
+    path's explicit pad)."""
+    assert topo.variant == "fft"
+    p, k = topo.num_weights, topo.aggregates
+    rows = np.zeros((k, p), dtype=np.float64)
+    if topo.fft_mode == "fft":
+        for j in range(k):
+            for m in range(min(k, p)):
+                rows[j, m] = np.cos(2.0 * np.pi * j * m / k)
+    else:
+        n_bins = p // 2 + 1
+        for j in range(min(k, n_bins)):
+            for m in range(p):
+                rows[j, m] = np.cos(2.0 * np.pi * j * m / p)
+    return tuple(tuple(float(v) for v in r) for r in rows)
+
+
+def _reduce_rows(topo: Topology, rows):
+    """P lane-vector rows -> k lane-vector aggregates (the kernel-side twin
+    of ``popmajor_kvec.kvec_reduce_popmajor``)."""
+    if topo.variant == "fft":
+        out = []
+        for coeffs in _dft_cos_rows(topo):
+            acc = None
+            for m, c in enumerate(coeffs):
+                if c == 0.0:
+                    continue
+                term = rows[m] if c == 1.0 else rows[m] * c
+                acc = term if acc is None else acc + term
+            out.append(acc if acc is not None
+                       else jnp.zeros_like(rows[0]))
+        return out
+    assert topo.variant == "aggregating"
+    from .popmajor_kvec import _segment_bounds
+
+    _, counts = aggregation_segments(topo)
+    starts, ends = _segment_bounds(topo)
+    out = []
+    for s, e, c in zip(starts, ends, counts):
+        s, e = int(s), int(e)
+        if topo.aggregator == "average":
+            acc = rows[s]
+            for r in range(s + 1, e):
+                acc = acc + rows[r]
+            out.append(acc * (1.0 / float(c)))
+        elif topo.aggregator == "max":
+            acc = rows[s]
+            for r in range(s + 1, e):
+                acc = jnp.maximum(acc, rows[r])
+            out.append(acc)
+        else:  # max_buggy: bit-faithful falsy-max (network.py:303-308)
+            acc = rows[s]
+            for r in range(s + 1, e):
+                w = rows[r]
+                acc = jnp.where((w > acc) & (w != 0.0), w, acc)
+            out.append(acc)
+    return out
+
+
+def _sgd_epochs(topo: Topology, rows0, snap_xk, epochs: int, lr: float,
+                refresh: bool):
+    """``epochs`` full-batch MSE-SGD steps on the k-vector sample."""
+    p = topo.num_weights
+    k = topo.aggregates
+    shapes = topo.layer_shapes
+    offs = topo.offsets
+    act = resolve_activation(topo.activation)
+    act_grad = resolve_output_grad(topo.activation)
+
+    def epoch(e, carry):
+        rows, _ = carry
+        xk = _reduce_rows(topo, rows) if refresh else snap_xk
+        # forward, storing post-activations for the backward
+        acts = [xk]
+        h = xk
+        for (a, b), o in zip(shapes, offs):
+            nxt = []
+            for j in range(b):
+                acc = h[0] * rows[o + j]
+                for i in range(1, a):
+                    acc = acc + h[i] * rows[o + i * b + j]
+                nxt.append(act(acc))
+            acts.append(nxt)
+            h = nxt
+        err = [h[j] - xk[j] for j in range(k)]
+        loss = err[0] * err[0]
+        for j in range(1, k):
+            loss = loss + err[j] * err[j]
+        loss = loss / k
+        # backward
+        dh = [err[j] * (2.0 / k) for j in range(k)]
+        grads = [None] * p
+        for li in range(len(shapes) - 1, -1, -1):
+            a, b = shapes[li]
+            o = offs[li]
+            prev = acts[li]
+            if act_grad is not None:
+                dh = [dh[j] * act_grad(acts[li + 1][j]) for j in range(b)]
+            dprev = []
+            for i in range(a):
+                acc = dh[0] * rows[o + i * b + 0]
+                for j in range(1, b):
+                    acc = acc + dh[j] * rows[o + i * b + j]
+                dprev.append(acc)
+                for j in range(b):
+                    grads[o + i * b + j] = dh[j] * prev[i]
+            dh = dprev
+        new_rows = tuple(rows[r] - lr * grads[r] for r in range(p))
+        return new_rows, loss
+
+    return jax.lax.fori_loop(0, epochs, epoch,
+                             (rows0, jnp.zeros_like(rows0[0])))
+
+
+_train_kernel = make_train_kernel(_sgd_epochs)
+_learn_kernel = make_learn_kernel(_sgd_epochs, snap_fn=_reduce_rows)
+
+
+def _supported(topo: Topology) -> None:
+    assert topo.variant in ("aggregating", "fft")
+    resolve_output_grad(topo.activation)  # raises for unsupported
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("topo", "epochs", "lr", "interpret"))
+def kvec_train_epochs_pallas(topo: Topology, wT: jnp.ndarray, epochs: int,
+                             lr: float = 0.01, interpret: bool = False):
+    """``epochs`` of self-training SGD, the entire chain fused in VMEM per
+    lane block.  Same semantics as
+    ``ops.popmajor_kvec.kvec_train_epochs_popmajor``.
+    Returns (new_wT, last epoch per-particle loss (N,))."""
+    _supported(topo)
+    return lane_call(_train_kernel, topo, [wT], epochs, lr, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("topo", "severity", "lr", "interpret"))
+def kvec_learn_epochs_pallas(topo: Topology, wT: jnp.ndarray,
+                             otherT: jnp.ndarray, severity: int,
+                             lr: float = 0.01, interpret: bool = False):
+    """``severity`` imitation epochs toward the counterparts' (fixed)
+    k-vector sample, fused in VMEM.  Same semantics as
+    ``ops.popmajor_kvec.kvec_learn_epochs_popmajor``."""
+    _supported(topo)
+    return lane_call(_learn_kernel, topo, [wT, otherT], severity, lr,
+                     interpret)

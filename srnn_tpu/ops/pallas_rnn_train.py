@@ -1,0 +1,186 @@
+"""Fused Pallas TPU kernel for recurrent-variant batch-1 SGD (BPTT).
+
+The round-5 TPU train-phase decomposition (`benchmarks/train_generality.py`,
+RESULTS.md) measured the recurrent variant's XLA train path at **118x** the
+fused weightwise kernel's per-particle cost — by far the worst row, and the
+reason the heterogeneous multisoup is stuck at ~2.5 gens/s (its generation
+is dominated by the recurrent member).  The XLA path pays scan(epochs) x
+{forward scan(T) + reverse BPTT scan(T)} with the (P, N) population and
+(units, N) hidden state round-tripping HBM at every step.
+
+This kernel runs the ENTIRE multi-epoch BPTT chain in VMEM per lane block:
+one HBM read + one write of the population per train/learn phase, exactly
+like `pallas_ww_train.py` does for the weightwise chain.
+
+Semantics mirror `ops/popmajor_rnn` (reference `network.py:544-574`
+semantics): the training sample is ONE sequence x = y = the flat weight
+vector (T = P timesteps, feature dim 1), so each reference batch-1 epoch IS
+a single full-batch gradient step; self-training re-snapshots x from the
+current weights at each epoch top, imitation (`learn_from`) keeps x fixed
+at the counterpart's weights.  The returned loss is the last epoch's
+per-particle PRE-update loss (keras history semantics).
+
+The backward is hand-derived backprop-through-time over the stacked
+SimpleRNN law h_t = act(x_t @ K + h_{t-1} @ R) (keras kernel order:
+K[i, u] at flat `ko + i*units + u`, R[v, u] at `ro + v*units + u`):
+
+    dh_t[u]   = dOut_t[u] + sum_u' dz_{t+1}[u'] * R[u, u']
+    dz_t[u]   = dh_t[u] * act'(h_t[u])
+    dK[i, u] += x_t[i] * dz_t[u]
+    dR[v, u] += h_{t-1}[v] * dz_t[u]
+    dX_t[i]   = sum_u dz_t[u] * K[i, u]      (the layer-below dOut)
+
+with act' taken from the stored post-activations
+(`activations.resolve_output_grad` — linear/sigmoid/tanh/relu).  All of it
+is elementwise over the lane axis; the T-step time loop and the layer stack
+unroll at trace time (T = P <= 64 by the dispatch fence), and the epoch
+loop is a `lax.fori_loop` (Mosaic's loop lowering requirement, learned on a
+real v5e in round 5).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..topology import Topology
+from .activations import resolve_activation, resolve_output_grad
+from .pallas_sgd_common import lane_call, make_learn_kernel, make_train_kernel
+
+
+def _bptt_epoch(topo: Topology, rows, x_rows):
+    """One full-batch MSE-SGD gradient on one lane block.
+
+    ``rows`` / ``x_rows`` are length-P tuples of (B,) lane vectors (current
+    parameters / the sequence sample).  Returns (grads list, per-particle
+    pre-update loss (B,))."""
+    act = resolve_activation(topo.activation)
+    act_grad = resolve_output_grad(topo.activation)
+    p = topo.num_weights
+    t_len = p  # the sequence IS the flat weight vector
+
+    # ---- forward, storing every layer's full output sequence ------------
+    seqs = [[[x_rows[t]] for t in range(t_len)]]  # layer 0 input: (T, 1)
+    for layer, (ind, units) in enumerate(topo.rnn_layer_dims):
+        ko = topo.offsets[2 * layer]
+        ro = topo.offsets[2 * layer + 1]
+        inp = seqs[-1]
+        out = []
+        # h_{-1} = 0, kept as explicit zero terms so NaN/Inf propagation
+        # (0 * inf = nan) matches the XLA scan path bit-for-bit
+        h = [jnp.zeros_like(rows[0])] * units
+        for t in range(t_len):
+            nxt = []
+            for u in range(units):
+                acc = inp[t][0] * rows[ko + u]
+                for i in range(1, ind):
+                    acc = acc + inp[t][i] * rows[ko + i * units + u]
+                for v in range(units):
+                    acc = acc + h[v] * rows[ro + v * units + u]
+                nxt.append(act(acc))
+            out.append(nxt)
+            h = nxt
+        seqs.append(out)
+
+    pred = [seqs[-1][t][0] for t in range(t_len)]
+    err = [pred[t] - x_rows[t] for t in range(t_len)]
+    loss = err[0] * err[0]
+    for t in range(1, t_len):
+        loss = loss + err[t] * err[t]
+    loss = loss / t_len
+
+    # ---- backward through layers (top-down) and time (reverse) ----------
+    grads = [jnp.zeros_like(rows[0]) for _ in range(p)]
+    scale = 2.0 / t_len
+    d_out = [[err[t] * scale] for t in range(t_len)]  # dL/d pred_t
+    for layer in range(len(topo.rnn_layer_dims) - 1, -1, -1):
+        ind, units = topo.rnn_layer_dims[layer]
+        ko = topo.offsets[2 * layer]
+        ro = topo.offsets[2 * layer + 1]
+        inp = seqs[layer]
+        out = seqs[layer + 1]
+        zero = jnp.zeros_like(rows[0])
+        d_inp = [None] * t_len
+        dcarry = None  # gradient flowing into h_t from step t+1
+        for t in range(t_len - 1, -1, -1):
+            dz = []
+            for u in range(units):
+                dh = d_out[t][u]
+                if dcarry is not None:
+                    dh = dh + dcarry[u]
+                if act_grad is not None:
+                    dh = dh * act_grad(out[t][u])
+                dz.append(dh)
+            for u in range(units):
+                for i in range(ind):
+                    gi = ko + i * units + u
+                    grads[gi] = grads[gi] + inp[t][i] * dz[u]
+                for v in range(units):
+                    gr = ro + v * units + u
+                    prev = out[t - 1][v] if t > 0 else zero
+                    grads[gr] = grads[gr] + prev * dz[u]
+            d_inp[t] = [
+                functools.reduce(
+                    lambda a, b: a + b,
+                    [dz[u] * rows[ko + i * units + u] for u in range(units)])
+                for i in range(ind)
+            ]
+            dcarry = [
+                functools.reduce(
+                    lambda a, b: a + b,
+                    [dz[u] * rows[ro + v * units + u] for u in range(units)])
+                for v in range(units)
+            ]
+        d_out = d_inp  # becomes the layer below's upstream gradient
+    return grads, loss
+
+
+def _sgd_epochs(topo: Topology, rows0, snap_rows, epochs: int, lr: float,
+                refresh: bool):
+    """``epochs`` full-batch BPTT-SGD steps; the sample re-snapshots from
+    the current rows (self-training) or stays fixed (imitation)."""
+    p = topo.num_weights
+
+    def epoch(e, carry):
+        rows, _ = carry
+        x_rows = rows if refresh else snap_rows
+        grads, loss = _bptt_epoch(topo, rows, x_rows)
+        new_rows = tuple(rows[r] - lr * grads[r] for r in range(p))
+        return new_rows, loss
+
+    return jax.lax.fori_loop(0, epochs, epoch,
+                             (rows0, jnp.zeros_like(rows0[0])))
+
+
+_train_kernel = make_train_kernel(_sgd_epochs)
+_learn_kernel = make_learn_kernel(_sgd_epochs)
+
+
+def _supported(topo: Topology) -> None:
+    assert topo.variant == "recurrent"
+    resolve_output_grad(topo.activation)  # raises for unsupported
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("topo", "epochs", "lr", "interpret"))
+def rnn_train_epochs_pallas(topo: Topology, wT: jnp.ndarray, epochs: int,
+                            lr: float = 0.01, interpret: bool = False):
+    """``epochs`` of self-training BPTT-SGD, the entire chain fused in VMEM
+    per lane block.  Same semantics as
+    ``ops.popmajor_rnn.rnn_train_epochs_popmajor``.
+    Returns (new_wT, last epoch per-particle loss (N,))."""
+    _supported(topo)
+    return lane_call(_train_kernel, topo, [wT], epochs, lr, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("topo", "severity", "lr", "interpret"))
+def rnn_learn_epochs_pallas(topo: Topology, wT: jnp.ndarray,
+                            otherT: jnp.ndarray, severity: int,
+                            lr: float = 0.01, interpret: bool = False):
+    """``severity`` imitation epochs toward the counterparts' (fixed)
+    sequence, fused in VMEM.  Same semantics as
+    ``ops.popmajor_rnn.rnn_learn_epochs_popmajor``."""
+    _supported(topo)
+    return lane_call(_learn_kernel, topo, [wT, otherT], severity, lr,
+                     interpret)

@@ -1,0 +1,81 @@
+"""Shared plumbing for the fused Pallas SGD kernels
+(``pallas_ww_train`` / ``pallas_rnn_train`` / ``pallas_kvec_train``).
+
+All three kernel families have the same shape: a lane-blocked (P, N)
+population in VMEM, an SGD *chain* function
+``chain(topo, rows0, snap, epochs, lr, refresh) -> (rows, last_loss)``
+over length-P tuples of (B,) lane vectors, and train/learn entry points
+that differ only in whether the sample snapshot refreshes from the current
+rows (self-training) or is derived once from a counterpart operand
+(imitation).  This module owns the pallas_call grid/BlockSpec/pad
+boilerplate and the kernel-body adapters so a fix to blocking or padding
+lands in exactly one place.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_ww import LANE_BLOCK  # one block size for every lane kernel
+
+
+def make_train_kernel(chain):
+    """Kernel body for self-training: the snapshot refreshes from the
+    current rows at each epoch top (``refresh=True``)."""
+
+    def kernel(w_ref, out_ref, loss_ref, *, topo, epochs, lr):
+        p = topo.num_weights
+        rows0 = tuple(w_ref[r, :] for r in range(p))
+        rows, loss = chain(topo, rows0, None, epochs, lr, True)
+        for r in range(p):
+            out_ref[r, :] = rows[r]
+        loss_ref[0, :] = loss
+
+    return kernel
+
+
+def make_learn_kernel(chain, snap_fn=None):
+    """Kernel body for imitation: the snapshot derives ONCE from the
+    counterpart rows — via ``snap_fn`` (e.g. the k-vector reduction) or
+    identity — and stays fixed across epochs (``refresh=False``)."""
+
+    def kernel(w_ref, other_ref, out_ref, loss_ref, *, topo, epochs, lr):
+        p = topo.num_weights
+        rows0 = tuple(w_ref[r, :] for r in range(p))
+        other = tuple(other_ref[r, :] for r in range(p))
+        snap = snap_fn(topo, other) if snap_fn is not None else other
+        rows, loss = chain(topo, rows0, snap, epochs, lr, False)
+        for r in range(p):
+            out_ref[r, :] = rows[r]
+        loss_ref[0, :] = loss
+
+    return kernel
+
+
+def lane_call(kernel, topo, arrays, epochs, lr, interpret):
+    """Blocked pallas_call over the lane axis: pad N to a multiple of the
+    lane block, run the kernel per (P, block) tile, strip the pad.
+    Returns (new (P, N) population, (N,) last-epoch loss)."""
+    p, n = arrays[0].shape
+    block = min(LANE_BLOCK, n)
+    pad = (-n) % block
+    if pad:
+        arrays = [jnp.pad(a, ((0, 0), (0, pad))) for a in arrays]
+    padded = n + pad
+    out, loss = pl.pallas_call(
+        functools.partial(kernel, topo=topo, epochs=epochs, lr=float(lr)),
+        out_shape=(jax.ShapeDtypeStruct((p, padded), arrays[0].dtype),
+                   jax.ShapeDtypeStruct((1, padded), arrays[0].dtype)),
+        grid=(padded // block,),
+        in_specs=[pl.BlockSpec((p, block), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)] * len(arrays),
+        out_specs=(pl.BlockSpec((p, block), lambda i: (0, i),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, block), lambda i: (0, i),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(*arrays)
+    return (out[:, :n], loss[0, :n]) if pad else (out, loss[0])

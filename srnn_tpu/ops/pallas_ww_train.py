@@ -9,17 +9,19 @@ epoch*sample chain inside VMEM per lane block: one HBM read + one write of
 the population per ``train()`` phase, like ``pallas_ww.py`` does for
 chained self-application.
 
-The backward pass is hand-derived for the LINEAR activation (the science
-default every reference experiment effectively ran — SURVEY quirk
-§2.4.11): with h_{l+1}[j] = sum_i h_l[i] * W_l[i, j], the per-sample
-gradients are
+The backward pass is hand-derived: with h_{l+1}[j] = act(z[j]),
+z[j] = sum_i h_l[i] * W_l[i, j], the per-sample gradients are
 
     dL/dpred         = 2 (pred - y)
-    dL/dW_l[i, j]    = dh_{l+1}[j] * h_l[i]
-    dh_l[i]          = sum_j dh_{l+1}[j] * W_l[i, j]
+    dz[j]            = dh_{l+1}[j] * act'(h_{l+1}[j])
+    dL/dW_l[i, j]    = dz[j] * h_l[i]
+    dh_l[i]          = sum_j dz[j] * W_l[i, j]
 
 all elementwise over the lane axis (per-particle parameters are per-lane
-scalars).  Per-step math mirrors ``ops/popmajor._ww_seq_sgd_flat``: the
+scalars).  act' comes from the stored post-activations
+(`activations.resolve_output_grad`), so the kernel covers
+linear/sigmoid/tanh/relu; 'linear' (the science default every reference
+experiment effectively ran — SURVEY quirk §2.4.11) skips the multiplier.  Per-step math mirrors ``ops/popmajor._ww_seq_sgd_flat``: the
 sample snapshot refreshes at each epoch top (self-training) or stays fixed
 (imitation / learn_from), updates run in enumeration order, and the
 returned loss is the last epoch's mean PRE-update loss (keras history
@@ -38,12 +40,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from ..topology import Topology, normalized_weight_coords
-
-LANE_BLOCK = 2048  # particles per grid step (matches pallas_ww)
+from .activations import resolve_activation, resolve_output_grad
+from .pallas_sgd_common import lane_call, make_learn_kernel, make_train_kernel
 
 
 def _sgd_chain(topo: Topology, rows0, snap_rows, epochs: int, lr: float,
@@ -57,6 +57,8 @@ def _sgd_chain(topo: Topology, rows0, snap_rows, epochs: int, lr: float,
     shapes = topo.layer_shapes
     offs = topo.offsets
     coords = normalized_weight_coords(topo)  # (P, 3) trace-time constants
+    act = resolve_activation(topo.activation)
+    act_grad = resolve_output_grad(topo.activation)
 
     def epoch(e, carry):
         rows, _ = carry
@@ -76,18 +78,22 @@ def _sgd_chain(topo: Topology, rows0, snap_rows, epochs: int, lr: float,
                     acc = h[0] * rows[o + j]
                     for i in range(1, a):
                         acc = acc + h[i] * rows[o + i * b + j]
-                    nxt.append(acc)
+                    nxt.append(act(acc))
                 acts.append(nxt)
                 h = nxt
             pred = h[0]
             loss_acc = loss_acc + (pred - x) * (pred - x)
-            # backward (linear layers), building per-row weight updates
+            # backward, building per-row weight updates; dh holds the
+            # gradient w.r.t. each layer's POST-activation output
             dh = [2.0 * (pred - x)]
             grads = [None] * p
             for li in range(len(shapes) - 1, -1, -1):
                 a, b = shapes[li]
                 o = offs[li]
                 prev = acts[li]
+                if act_grad is not None:
+                    dh = [dh[j] * act_grad(acts[li + 1][j])
+                          for j in range(b)]
                 dprev = []
                 for i in range(a):
                     acc = dh[0] * rows[o + i * b + 0]
@@ -105,31 +111,13 @@ def _sgd_chain(topo: Topology, rows0, snap_rows, epochs: int, lr: float,
                              (rows0, jnp.zeros_like(rows0[0])))
 
 
-def _train_kernel(w_ref, out_ref, loss_ref, *, topo, epochs, lr):
-    p = topo.num_weights
-    rows0 = tuple(w_ref[r, :] for r in range(p))
-    rows, loss = _sgd_chain(topo, rows0, None, epochs, lr, refresh=True)
-    for r in range(p):
-        out_ref[r, :] = rows[r]
-    loss_ref[0, :] = loss
-
-
-def _learn_kernel(w_ref, other_ref, out_ref, loss_ref, *, topo, epochs, lr):
-    p = topo.num_weights
-    rows0 = tuple(w_ref[r, :] for r in range(p))
-    snap = tuple(other_ref[r, :] for r in range(p))
-    rows, loss = _sgd_chain(topo, rows0, snap, epochs, lr, refresh=False)
-    for r in range(p):
-        out_ref[r, :] = rows[r]
-    loss_ref[0, :] = loss
+_train_kernel = make_train_kernel(_sgd_chain)
+_learn_kernel = make_learn_kernel(_sgd_chain)
 
 
 def _supported(topo: Topology) -> None:
     assert topo.variant == "weightwise"
-    if topo.activation != "linear":
-        raise ValueError(
-            "the fused Pallas SGD kernel hand-derives the linear backward; "
-            f"activation={topo.activation!r} uses the XLA path")
+    resolve_output_grad(topo.activation)  # raises for unsupported
 
 
 @functools.partial(jax.jit,
@@ -141,29 +129,7 @@ def ww_train_epochs_pallas(topo: Topology, wT: jnp.ndarray, epochs: int,
     ``ops.popmajor.ww_train_epochs_popmajor(mode='sequential')``.
     Returns (new_wT, last epoch per-particle loss (N,))."""
     _supported(topo)
-    p, n = wT.shape
-    block = min(LANE_BLOCK, n)
-    pad = (-n) % block
-    if pad:
-        wT = jnp.pad(wT, ((0, 0), (0, pad)))
-    padded = n + pad
-    out, loss = pl.pallas_call(
-        functools.partial(_train_kernel, topo=topo, epochs=epochs,
-                          lr=float(lr)),
-        out_shape=(jax.ShapeDtypeStruct((p, padded), wT.dtype),
-                   jax.ShapeDtypeStruct((1, padded), wT.dtype)),
-        grid=(padded // block,),
-        in_specs=[
-            pl.BlockSpec((p, block), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=(pl.BlockSpec((p, block), lambda i: (0, i),
-                                memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, block), lambda i: (0, i),
-                                memory_space=pltpu.VMEM)),
-        interpret=interpret,
-    )(wT)
-    return (out[:, :n], loss[0, :n]) if pad else (out, loss[0])
+    return lane_call(_train_kernel, topo, [wT], epochs, lr, interpret)
 
 
 @functools.partial(jax.jit,
@@ -175,29 +141,5 @@ def ww_learn_epochs_pallas(topo: Topology, wT: jnp.ndarray,
     samples, fused in VMEM.  Same semantics as
     ``ops.popmajor.ww_learn_epochs_popmajor(mode='sequential')``."""
     _supported(topo)
-    p, n = wT.shape
-    block = min(LANE_BLOCK, n)
-    pad = (-n) % block
-    if pad:
-        wT = jnp.pad(wT, ((0, 0), (0, pad)))
-        otherT = jnp.pad(otherT, ((0, 0), (0, pad)))
-    padded = n + pad
-    out, loss = pl.pallas_call(
-        functools.partial(_learn_kernel, topo=topo, epochs=severity,
-                          lr=float(lr)),
-        out_shape=(jax.ShapeDtypeStruct((p, padded), wT.dtype),
-                   jax.ShapeDtypeStruct((1, padded), wT.dtype)),
-        grid=(padded // block,),
-        in_specs=[
-            pl.BlockSpec((p, block), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((p, block), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=(pl.BlockSpec((p, block), lambda i: (0, i),
-                                memory_space=pltpu.VMEM),
-                   pl.BlockSpec((1, block), lambda i: (0, i),
-                                memory_space=pltpu.VMEM)),
-        interpret=interpret,
-    )(wT, otherT)
-    return (out[:, :n], loss[0, :n]) if pad else (out, loss[0])
+    return lane_call(_learn_kernel, topo, [wT, otherT], severity, lr,
+                     interpret)

@@ -261,23 +261,31 @@ def apply_popmajor(topo: Topology, selfT: jnp.ndarray,
 
 
 def _use_pallas_sgd(topo: Topology, mode: str, impl: str) -> bool:
-    """Route to the fused Pallas SGD chain?  Applies to the weightwise
-    variant's batch-1 sequential mode with the linear activation
-    (hand-derived backward).  Other variants/modes fall back silently — the
-    heterogeneous multisoup dispatches per type by design — but a
-    weightwise config that CANNOT take the kernel raises rather than
-    silently executing the XLA path under a 'pallas' label."""
+    """Route to a fused Pallas SGD chain?  Round-5 coverage: EVERY variant
+    (pallas_ww_train / pallas_rnn_train / pallas_kvec_train), activations
+    with output-expressible derivatives (linear/sigmoid/tanh/relu).  The
+    weightwise kernel additionally requires the sequential (batch-1) mode —
+    its fused chain IS the per-sample update order; the other variants have
+    ONE sample per epoch, so sequential and full_batch coincide and both
+    take the kernel.  Any unsupported combination — activation, mode, or a
+    particle beyond 64 weights (unrolled-chain length grows ~P^2 per epoch
+    for ww / ~P*T for rnn; compile cost dwarfs the fusion win) — falls back
+    silently: the heterogeneous multisoup dispatches per type by design,
+    and ``resolved_train_impl`` surfaces what actually runs.  The
+    homogeneous-soup entry points reject unsupported configs UPFRONT with
+    a message (``soup._check_popmajor``), so this dispatch never needs to
+    raise — raising here would make the multisoup's reported per-type
+    resolution disagree with its execution."""
     if impl != "pallas":
         return False
-    if (topo.variant != "weightwise" or mode != "sequential"
-            or topo.activation != "linear"):
-        return False  # per-type / per-mode fallback (multisoup dispatch)
+    from .activations import output_grad_activations
+
+    if topo.activation not in output_grad_activations():
+        return False
+    if topo.variant == "weightwise" and mode != "sequential":
+        return False  # full_batch is a genuinely different program
     if topo.num_weights > 64:
-        # unrolled-chain length grows ~P^2 per epoch; beyond small science
-        # topologies the compile cost dwarfs the fusion win
-        raise ValueError(
-            f"train_impl='pallas' supports weightwise particles up to 64 "
-            f"weights (got {topo.num_weights}); use train_impl='xla'")
+        return False
     return True
 
 
@@ -289,13 +297,7 @@ def resolved_train_impl(topo: Topology, mode: str, impl: str) -> str:
     (``_use_pallas_sgd``); run headers should surface the resolution so a
     ``train_impl='pallas'`` run states which types took the kernel rather
     than leaving it to be inferred from the fence rules."""
-    try:
-        return "pallas" if _use_pallas_sgd(topo, mode, impl) else "xla"
-    except ValueError:
-        # homogeneous-soup entry points re-raise via _check_popmajor;
-        # for reporting purposes the effective impl is still the kernel's
-        # refusal -> XLA
-        return "xla"
+    return "pallas" if _use_pallas_sgd(topo, mode, impl) else "xla"
 
 
 def _pallas_interpret(n: int) -> bool:
@@ -314,14 +316,34 @@ def _pallas_interpret(n: int) -> bool:
         "use train_impl='xla' on this platform")
 
 
+def _check_train_mode(mode: str) -> None:
+    # validated here for every impl: the pallas route treats the two modes
+    # as coinciding for single-sample variants and would otherwise accept
+    # any string the XLA twins reject
+    if mode not in ("sequential", "full_batch"):
+        raise ValueError(f"unknown train mode {mode!r}")
+
+
 def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
                           lr: float = DEFAULT_LR, mode: str = "sequential",
                           impl: str = "xla"):
+    _check_train_mode(mode)
     if _use_pallas_sgd(topo, mode, impl):
-        from .pallas_ww_train import ww_train_epochs_pallas
+        interpret = _pallas_interpret(wT.shape[1])
+        if topo.variant == "weightwise":
+            from .pallas_ww_train import ww_train_epochs_pallas
 
-        return ww_train_epochs_pallas(
-            topo, wT, epochs, lr, interpret=_pallas_interpret(wT.shape[1]))
+            return ww_train_epochs_pallas(topo, wT, epochs, lr,
+                                          interpret=interpret)
+        if topo.variant == "recurrent":
+            from .pallas_rnn_train import rnn_train_epochs_pallas
+
+            return rnn_train_epochs_pallas(topo, wT, epochs, lr,
+                                           interpret=interpret)
+        from .pallas_kvec_train import kvec_train_epochs_pallas
+
+        return kvec_train_epochs_pallas(topo, wT, epochs, lr,
+                                        interpret=interpret)
     if topo.variant == "weightwise":
         return ww_train_epochs_popmajor(topo, wT, epochs, lr, mode)
     if topo.variant == "recurrent":
@@ -336,12 +358,23 @@ def train_epochs_popmajor(topo: Topology, wT: jnp.ndarray, epochs: int,
 def learn_epochs_popmajor(topo: Topology, wT: jnp.ndarray, otherT: jnp.ndarray,
                           severity: int, lr: float = DEFAULT_LR,
                           mode: str = "sequential", impl: str = "xla"):
+    _check_train_mode(mode)
     if _use_pallas_sgd(topo, mode, impl):
-        from .pallas_ww_train import ww_learn_epochs_pallas
+        interpret = _pallas_interpret(wT.shape[1])
+        if topo.variant == "weightwise":
+            from .pallas_ww_train import ww_learn_epochs_pallas
 
-        return ww_learn_epochs_pallas(
-            topo, wT, otherT, severity, lr,
-            interpret=_pallas_interpret(wT.shape[1]))
+            return ww_learn_epochs_pallas(topo, wT, otherT, severity, lr,
+                                          interpret=interpret)
+        if topo.variant == "recurrent":
+            from .pallas_rnn_train import rnn_learn_epochs_pallas
+
+            return rnn_learn_epochs_pallas(topo, wT, otherT, severity, lr,
+                                           interpret=interpret)
+        from .pallas_kvec_train import kvec_learn_epochs_pallas
+
+        return kvec_learn_epochs_pallas(topo, wT, otherT, severity, lr,
+                                        interpret=interpret)
     if topo.variant == "weightwise":
         return ww_learn_epochs_popmajor(topo, wT, otherT, severity, lr, mode)
     if topo.variant == "recurrent":

@@ -82,11 +82,14 @@ class SoupConfig(NamedTuple):
     # (benchmarks/profile_soup.py), the fused path is one threefry call.
     # The recurrent variant (orthogonal kernels) always draws per-particle.
     respawn_draws: str = "perparticle"  # 'perparticle' | 'fused'
-    # 'pallas' fuses the ENTIRE batch-1 sequential SGD chain (train and
-    # learn_from phases) in VMEM per lane block — one HBM round trip per
-    # phase instead of one per sample step (~140 at train=10).  Weightwise
-    # + popmajor + sequential + linear activation only (hand-derived
-    # backward, ops/pallas_ww_train.py); parity-tested vs the XLA path.
+    # 'pallas' fuses the ENTIRE batch-1 SGD chain (train and learn_from
+    # phases) in VMEM per lane block — one HBM round trip per phase instead
+    # of one per gradient step (~140 at train=10 for weightwise; fwd+BPTT
+    # scans per epoch for recurrent).  Popmajor only; covers every variant
+    # (ops/pallas_{ww,rnn,kvec}_train.py) with hand-derived backwards for
+    # activations whose derivative is output-expressible (linear, sigmoid,
+    # tanh, relu) and particles up to 64 weights; parity-tested vs the XLA
+    # path (weights bitwise on CPU interpret, float-noise on TPU).
     train_impl: str = "xla"             # 'xla' | 'pallas'
     # Attack-phase execution (popmajor only).  'full' transforms all N
     # lanes and selects (one gather + one forward over the whole
@@ -461,19 +464,24 @@ def _check_popmajor(config: SoupConfig) -> None:
     if config.learn_from_impl not in ("full", "compact"):
         raise ValueError(
             f"unknown learn_from_impl {config.learn_from_impl!r}")
-    if config.train_impl == "pallas" and (
-            config.topo.variant != "weightwise"
-            or config.train_mode != "sequential"
-            or config.topo.activation != "linear"
-            or config.topo.num_weights > 64):
-        raise ValueError(
-            "train_impl='pallas' fuses the weightwise batch-1 sequential "
-            "SGD chain with a hand-derived LINEAR backward for particles "
-            "up to 64 weights; this config "
-            f"(variant={config.topo.variant!r}, "
-            f"train_mode={config.train_mode!r}, "
-            f"activation={config.topo.activation!r}, "
-            f"P={config.topo.num_weights}) needs train_impl='xla'")
+    if config.train_impl == "pallas":
+        from .ops.activations import output_grad_activations
+
+        if (config.topo.activation not in output_grad_activations()
+                or config.topo.num_weights > 64
+                or (config.topo.variant == "weightwise"
+                    and config.train_mode != "sequential")):
+            raise ValueError(
+                "train_impl='pallas' fuses the batch-1 SGD chain with a "
+                "hand-derived backward: any variant, activation in "
+                f"{sorted(output_grad_activations())}, particles up to 64 "
+                "weights (the weightwise kernel additionally needs "
+                "train_mode='sequential' — its chain IS the per-sample "
+                "order); this config "
+                f"(variant={config.topo.variant!r}, "
+                f"train_mode={config.train_mode!r}, "
+                f"activation={config.topo.activation!r}, "
+                f"P={config.topo.num_weights}) needs train_impl='xla'")
 
 
 def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
