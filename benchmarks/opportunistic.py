@@ -30,11 +30,11 @@ The row set covers every round-4/5 perf lever that lacks TPU evidence
   soup_apply      apply-only gens/s, rowmajor vs popmajor
   soup_fused      apply-only popmajor, respawn_draws fused vs perparticle
   soup_full       full dynamics popmajor, train_impl xla vs pallas
-  soup_mixed      heterogeneous multisoup, rowmajor vs popmajor
-  train_generality popmajor train phase timings for the cases the pallas
-                  kernel fences out (aggregating/fft/sigmoid) vs the fenced
-                  weightwise-linear case — the data VERDICT r4 item 6 asks
-                  for (reference train semantics: ``network.py:613-617``)
+  soup_mixed      heterogeneous multisoup: rowmajor, popmajor, and
+                  popmajor + per-type fused SGD kernels (round 5)
+  train_generality popmajor train phase per variant, fused Pallas kernel
+                  vs XLA scan (reference train semantics:
+                  ``network.py:613-617``)
 """
 
 import argparse
@@ -162,6 +162,9 @@ ROWS = {
     "soup_mixed": [
         (_soup_cmd("mixed", layout="rowmajor"), None),
         (_soup_cmd("mixed", layout="popmajor"), None),
+        # round 5: per-type fused SGD kernels (incl. the recurrent member
+        # whose serial train scan dominated the 2.48 gens/s plateau)
+        (_soup_cmd("mixed", layout="popmajor", train_impl="pallas"), None),
     ],
     "train_generality": [
         ([sys.executable, "benchmarks/train_generality.py"], None),

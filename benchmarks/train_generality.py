@@ -1,24 +1,18 @@
-"""Measure the popmajor TRAIN phase for the configs the Pallas SGD kernel
-fences out, against the fenced weightwise-linear case.
+"""Measure the popmajor TRAIN phase per variant: fused Pallas kernel vs
+the XLA scan path.
 
-VERDICT r4 item 6: ``train_impl='pallas'`` is fenced to weightwise /
-linear / sequential / P<=64 (``soup.py:324-349``).  Is that fence leaving
->2x on the table anywhere?  This harness times a train-only soup
-generation (attack/learn_from off, train=10 — isolating the batch-1
-sequential SGD chain plus respawn, reference ``network.py:613-617``
-semantics) at the mega-soup scale for:
+History: VERDICT r4 item 6 asked whether the then weightwise-linear-only
+kernel fence left >2x on the table; the first round-5 TPU campaign
+answered yes everywhere (recurrent 118x, ww-sigmoid 11.5x, fft 2.9x,
+aggregating 2.4x), so the kernels now cover every variant
+(``ops/pallas_{ww,rnn,kvec}_train.py``) and this harness times BOTH impls
+for each.  Workload: a train-only soup generation (attack/learn_from off,
+train=10 — isolating the batch-1 SGD chain plus respawn, reference
+``network.py:613-617`` semantics) at the mega-soup scale.
 
-  ww-linear/pallas     the fused VMEM kernel (the yardstick)
-  ww-linear/xla        same math under the XLA scan
-  ww-sigmoid/xla       fenced out: nonlinear backward
-  aggregating/xla      fenced out: k-vector forward (popmajor_kvec path)
-  fft/xla              fenced out: FFT round trip per epoch
-  recurrent/xla        fenced out: sequential-in-P scan (popmajor_rnn path)
-
-Output: one JSON line per config with per-particle-generation cost; the
-decision rule from the VERDICT ("extend the kernel if any fenced-out case
-is >2x off the weightwise-pallas per-particle cost, else document the
-non-goal") reads straight off the ``x_vs_ww_pallas`` field.
+Output: one JSON line per config with per-particle-generation cost;
+``x_vs_ww_pallas`` is each row's per-particle cost relative to the
+weightwise-linear kernel yardstick.
 """
 
 import argparse
@@ -34,14 +28,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from srnn_tpu import Topology
 from srnn_tpu.soup import SoupConfig, evolve, seed
 
+_WW = Topology("weightwise", width=2, depth=2)
+_WWSIG = Topology("weightwise", width=2, depth=2, activation="sigmoid")
+_AGG = Topology("aggregating", width=2, depth=2)
+_FFT = Topology("fft", width=2, depth=2)
+_RNN = Topology("recurrent", width=2, depth=2)
+
 CONFIGS = (
-    ("ww-linear/pallas", Topology("weightwise", width=2, depth=2), "pallas"),
-    ("ww-linear/xla", Topology("weightwise", width=2, depth=2), "xla"),
-    ("ww-sigmoid/xla",
-     Topology("weightwise", width=2, depth=2, activation="sigmoid"), "xla"),
-    ("aggregating/xla", Topology("aggregating", width=2, depth=2), "xla"),
-    ("fft/xla", Topology("fft", width=2, depth=2), "xla"),
-    ("recurrent/xla", Topology("recurrent", width=2, depth=2), "xla"),
+    ("ww-linear/pallas", _WW, "pallas"),
+    ("ww-linear/xla", _WW, "xla"),
+    ("ww-sigmoid/pallas", _WWSIG, "pallas"),
+    ("ww-sigmoid/xla", _WWSIG, "xla"),
+    ("aggregating/pallas", _AGG, "pallas"),
+    ("aggregating/xla", _AGG, "xla"),
+    ("fft/pallas", _FFT, "pallas"),
+    ("fft/xla", _FFT, "xla"),
+    ("recurrent/pallas", _RNN, "pallas"),
+    ("recurrent/xla", _RNN, "xla"),
 )
 
 

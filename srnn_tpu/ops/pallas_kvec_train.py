@@ -88,15 +88,19 @@ def _reduce_rows(topo: Topology, rows):
     assert topo.variant == "aggregating"
     from .popmajor_kvec import _segment_bounds
 
-    _, counts = aggregation_segments(topo)
+    seg, counts = aggregation_segments(topo)
     starts, ends = _segment_bounds(topo)
     out = []
-    for s, e, c in zip(starts, ends, counts):
+    for j, (s, e, c) in enumerate(zip(starts, ends, counts)):
         s, e = int(s), int(e)
         if topo.aggregator == "average":
-            acc = rows[s]
-            for r in range(s + 1, e):
-                acc = acc + rows[r]
+            # matmul-equivalent: keep the 0.0-weighted out-of-segment
+            # terms so 0*Inf/NaN propagation matches the XLA path's
+            # one-hot matmul (kvec_reduce_popmajor) — a non-finite weight
+            # anywhere poisons EVERY aggregate of that particle there
+            acc = rows[0] * (1.0 if int(seg[0]) == j else 0.0)
+            for r in range(1, len(rows)):
+                acc = acc + rows[r] * (1.0 if int(seg[r]) == j else 0.0)
             out.append(acc * (1.0 / float(c)))
         elif topo.aggregator == "max":
             acc = rows[s]

@@ -21,8 +21,10 @@ all elementwise over the lane axis (per-particle parameters are per-lane
 scalars).  act' comes from the stored post-activations
 (`activations.resolve_output_grad`), so the kernel covers
 linear/sigmoid/tanh/relu; 'linear' (the science default every reference
-experiment effectively ran — SURVEY quirk §2.4.11) skips the multiplier.  Per-step math mirrors ``ops/popmajor._ww_seq_sgd_flat``: the
-sample snapshot refreshes at each epoch top (self-training) or stays fixed
+experiment effectively ran — SURVEY quirk §2.4.11) skips the multiplier.
+
+Per-step math mirrors ``ops/popmajor._ww_seq_sgd_flat``: the sample
+snapshot refreshes at each epoch top (self-training) or stays fixed
 (imitation / learn_from), updates run in enumeration order, and the
 returned loss is the last epoch's mean PRE-update loss (keras history
 semantics).  Parity with the XLA path is tested to float tolerance

@@ -29,7 +29,7 @@ def _pop(topo, seed, n=24):
 # ------------------------------------------------------------- recurrent
 
 
-@pytest.mark.parametrize("activation", ["linear", "tanh"])
+@pytest.mark.parametrize("activation", ["linear", "tanh", "relu"])
 def test_rnn_kernel_matches_xla_bptt(activation):
     """The hand-derived BPTT reproduces jax.grad through the time scan —
     weights have matched BITWISE on CPU; the assert keeps float headroom."""
@@ -61,9 +61,10 @@ def test_rnn_kernel_learn_matches_xla():
     Topology("aggregating"),
     Topology("aggregating", aggregator="max_buggy"),
     Topology("aggregating", activation="sigmoid"),
+    Topology("aggregating", activation="relu"),
     Topology("fft"),
     Topology("fft", fft_mode="rfft"),
-], ids=["agg-avg", "agg-maxbuggy", "agg-sigmoid", "fft", "rfft"])
+], ids=["agg-avg", "agg-maxbuggy", "agg-sigmoid", "agg-relu", "fft", "rfft"])
 def test_kvec_kernel_matches_xla(topo):
     wT = _pop(topo, 0)
     ref_w, ref_l = kvec_train_epochs_popmajor(topo, wT, 3)
@@ -73,6 +74,26 @@ def test_kvec_kernel_matches_xla(topo):
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref_l),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_kvec_kernel_nonfinite_propagation_matches_xla():
+    """A non-finite weight must poison EVERY aggregate of that particle in
+    the kernel exactly as in the XLA path's one-hot matmul (whose
+    0*Inf=NaN spreads it) — a per-segment add chain would confine it
+    (round-5 review finding)."""
+    topo = Topology("aggregating")
+    wT = _pop(topo, 0, n=8)
+    wT = wT.at[3, 2].set(jnp.inf)  # one Inf weight in lane 2
+    ref_w, ref_l = kvec_train_epochs_popmajor(topo, wT, 2)
+    got_w, got_l = kvec_train_epochs_pallas(topo, wT, 2, interpret=True)
+    np.testing.assert_array_equal(np.isnan(np.asarray(ref_w)),
+                                  np.isnan(np.asarray(got_w)))
+    np.testing.assert_array_equal(np.isnan(np.asarray(ref_l)),
+                                  np.isnan(np.asarray(got_l)))
+    assert np.isnan(np.asarray(got_w))[:, 2].all()  # the whole lane poisoned
+    fin = np.isfinite(np.asarray(ref_w))
+    np.testing.assert_allclose(np.asarray(got_w)[fin],
+                               np.asarray(ref_w)[fin], rtol=1e-5, atol=1e-6)
 
 
 def test_kvec_kernel_learn_matches_xla():
@@ -90,11 +111,12 @@ def test_kvec_kernel_learn_matches_xla():
 # ------------------------------------------- nonlinear weightwise (round 5)
 
 
-def test_ww_kernel_sigmoid_matches_xla():
+@pytest.mark.parametrize("activation", ["sigmoid", "relu"])
+def test_ww_kernel_nonlinear_matches_xla(activation):
     from srnn_tpu.ops.pallas_ww_train import ww_train_epochs_pallas
     from srnn_tpu.ops.popmajor import ww_train_epochs_popmajor
 
-    topo = Topology("weightwise", activation="sigmoid")
+    topo = Topology("weightwise", activation=activation)
     wT = _pop(topo, 0)
     ref_w, ref_l = ww_train_epochs_popmajor(topo, wT, 3)
     got_w, got_l = ww_train_epochs_pallas(topo, wT, 3, interpret=True)
