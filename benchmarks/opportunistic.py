@@ -30,8 +30,11 @@ The row set covers every round-4/5 perf lever that lacks TPU evidence
   soup_apply      apply-only gens/s, rowmajor vs popmajor
   soup_fused      apply-only popmajor, respawn_draws fused vs perparticle
   soup_full       full dynamics popmajor, train_impl xla vs pallas
-  soup_mixed      heterogeneous multisoup: rowmajor, popmajor, and
-                  popmajor + per-type fused SGD kernels (round 5)
+  soup_mixed      heterogeneous multisoup: rowmajor, popmajor, popmajor +
+                  per-type fused SGD kernels, + fused recurrent-attacker
+                  forward (round 5)
+  soup_rnn_apply  recurrent apply-only soup: XLA serial scan vs the fused
+                  VMEM forward (round 5)
   train_generality popmajor train phase per variant, fused Pallas kernel
                   vs XLA scan (reference train semantics:
                   ``network.py:613-617``)
@@ -163,8 +166,18 @@ ROWS = {
         (_soup_cmd("mixed", layout="rowmajor"), None),
         (_soup_cmd("mixed", layout="popmajor"), None),
         # round 5: per-type fused SGD kernels (incl. the recurrent member
-        # whose serial train scan dominated the 2.48 gens/s plateau)
+        # whose serial train scan dominated the 2.48 gens/s plateau),
+        # then + the fused recurrent-attacker forward on top
         (_soup_cmd("mixed", layout="popmajor", train_impl="pallas"), None),
+        (_soup_cmd("mixed", layout="popmajor", train_impl="pallas",
+                   apply_impl="pallas"), None),
+    ],
+    "soup_rnn_apply": [
+        # round 5: the recurrent apply-only soup, XLA serial scan vs the
+        # fused VMEM forward (ops/pallas_rnn_apply.py)
+        (_soup_cmd("apply", layout="popmajor", topo="recurrent"), None),
+        (_soup_cmd("apply", layout="popmajor", topo="recurrent",
+                   apply_impl="pallas"), None),
     ],
     "train_generality": [
         ([sys.executable, "benchmarks/train_generality.py"], None),

@@ -51,10 +51,12 @@ def bench_size(preset: str, n: int, generations: int = 50,
                train_mode: str = "sequential", sharded: bool = False,
                respawn_draws: str = "perparticle",
                train_impl: str = "xla", attack_impl: str = "full",
-               learn_from_impl: str = "full") -> dict:
+               learn_from_impl: str = "full", apply_impl: str = "xla",
+               topo_variant: str = "weightwise") -> dict:
     dyn = _dynamics(preset, train_mode)
     dyn["respawn_draws"] = respawn_draws
     dyn["train_impl"] = train_impl
+    dyn["apply_impl"] = apply_impl
     if preset != "mixed":
         # the heterogeneous config has no attack_impl knob (per-type
         # cross-attack gathers are structural); homogeneous soups do
@@ -88,7 +90,7 @@ def bench_size(preset: str, n: int, generations: int = 50,
             return float(out.weights[0].sum())
     else:
         cfg = SoupConfig(
-            topo=Topology("weightwise", width=2, depth=2), size=n,
+            topo=Topology(topo_variant, width=2, depth=2), size=n,
             remove_divergent=True, remove_zero=True, layout=layout, **dyn)
         if sharded:
             from srnn_tpu.parallel import (make_sharded_state, sharded_evolve,
@@ -117,8 +119,10 @@ def bench_size(preset: str, n: int, generations: int = 50,
     return {
         "metric": f"soup-generations/sec[{preset}]",
         "layout": layout,
+        "topo": topo_variant if preset != "mixed" else "mixed",
         "respawn_draws": respawn_draws,
         "train_impl": train_impl,
+        "apply_impl": apply_impl,
         "attack_impl": attack_impl if preset != "mixed" else "n/a",
         "learn_from_impl": learn_from_impl if preset != "mixed" else "n/a",
         "sharded_devices": jax.device_count() if sharded else 0,
@@ -170,6 +174,16 @@ def main():
                    default="full",
                    help="'compact': imitation-SGD on learner lanes only "
                         "(same mechanics as --attack-impl)")
+    p.add_argument("--apply-impl", choices=("xla", "pallas"),
+                   default="xla",
+                   help="'pallas': fused VMEM forward for the recurrent "
+                        "attack transform (ops/pallas_rnn_apply.py; "
+                        "recurrent topos / mixed preset)")
+    p.add_argument("--topo", choices=("weightwise", "aggregating", "fft",
+                                      "recurrent"),
+                   default="weightwise",
+                   help="homogeneous-preset particle variant (the 'mixed' "
+                        "preset keeps its fixed ww/agg/rnn blend)")
     args = p.parse_args()
     # the tunneled TPU backend flakes at init (sometimes raising, sometimes
     # wedging): probe with retries AND bound each phase with a watchdog that
@@ -203,7 +217,8 @@ def main():
                          args.repeats, args.layout,
                          args.train_mode, args.sharded,
                          args.respawn_draws, args.train_impl,
-                         args.attack_impl, args.learn_from_impl)
+                         args.attack_impl, args.learn_from_impl,
+                         args.apply_impl, args.topo)
         row["platform"] = platform
         print(json.dumps(row))
     cancel()

@@ -63,6 +63,9 @@ class MultiSoupConfig(NamedTuple):
     respawn_draws: str = "perparticle"
     # see SoupConfig.train_impl; applies per type where supported
     train_impl: str = "xla"
+    # see SoupConfig.apply_impl; routes the cross-type attack transform
+    # per ATTACKER type where a kernel exists (recurrent attackers)
+    apply_impl: str = "xla"
 
     @property
     def total(self) -> int:
@@ -144,6 +147,8 @@ def _attack_phase(config: MultiSoupConfig, weights, k_gate, k_tgt):
 
 
 def _check_popmajor_multi(config: MultiSoupConfig) -> None:
+    if config.apply_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown apply_impl {config.apply_impl!r}")
     for topo in config.topos:
         if topo.shuffler == "random":
             raise ValueError(
@@ -183,7 +188,8 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
                 mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
                 selfT = wTs[a][:, jnp.clip(att_b - offs[a], 0,
                                            config.sizes[a] - 1)]
-                attacked = cross_apply_popmajor(atk, selfT, vic, wTs[b])
+                attacked = cross_apply_popmajor(atk, selfT, vic, wTs[b],
+                                                impl=config.apply_impl)
                 out = jnp.where(mask[None, :], attacked, out)
             new_wTs.append(out)
         wTs = tuple(new_wTs)
@@ -276,6 +282,10 @@ def evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
         raise ValueError(
             "train_impl='pallas' is the popmajor lane kernel; the "
             "row-major multisoup needs train_impl='xla'")
+    if config.apply_impl == "pallas":
+        raise ValueError(
+            "apply_impl='pallas' is the popmajor lane kernel; the "
+            "row-major multisoup needs apply_impl='xla'")
     n = config.total
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)

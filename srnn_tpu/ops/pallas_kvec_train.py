@@ -88,20 +88,29 @@ def _reduce_rows(topo: Topology, rows):
     assert topo.variant == "aggregating"
     from .popmajor_kvec import _segment_bounds
 
-    seg, counts = aggregation_segments(topo)
+    _, counts = aggregation_segments(topo)
     starts, ends = _segment_bounds(topo)
     out = []
-    for j, (s, e, c) in enumerate(zip(starts, ends, counts)):
+    # matmul-equivalence for 'average': the XLA path's one-hot matmul
+    # (kvec_reduce_popmajor) carries a 0.0-weighted term for every
+    # out-of-segment row, so a non-finite weight anywhere poisons EVERY
+    # aggregate of that particle (0*Inf = NaN).  One shared poison term
+    # (all rows times 0.0) reproduces that propagation at O(P) instead of
+    # unrolling the full O(P*k) coefficient chain: adding +/-0.0 to a
+    # finite segment sum is a no-op, and any non-finite row turns the
+    # poison — hence every aggregate — into NaN.
+    poison = None
+    if topo.aggregator == "average":
+        poison = rows[0] * 0.0
+        for r in range(1, len(rows)):
+            poison = poison + rows[r] * 0.0
+    for s, e, c in zip(starts, ends, counts):
         s, e = int(s), int(e)
         if topo.aggregator == "average":
-            # matmul-equivalent: keep the 0.0-weighted out-of-segment
-            # terms so 0*Inf/NaN propagation matches the XLA path's
-            # one-hot matmul (kvec_reduce_popmajor) — a non-finite weight
-            # anywhere poisons EVERY aggregate of that particle there
-            acc = rows[0] * (1.0 if int(seg[0]) == j else 0.0)
-            for r in range(1, len(rows)):
-                acc = acc + rows[r] * (1.0 if int(seg[r]) == j else 0.0)
-            out.append(acc * (1.0 / float(c)))
+            acc = rows[s]
+            for r in range(s + 1, e):
+                acc = acc + rows[r]
+            out.append((acc + poison) * (1.0 / float(c)))
         elif topo.aggregator == "max":
             acc = rows[s]
             for r in range(s + 1, e):

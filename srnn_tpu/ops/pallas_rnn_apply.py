@@ -1,0 +1,76 @@
+"""Fused Pallas TPU kernel for the recurrent variant's APPLY transform
+(attack / self-application forward).
+
+The recurrent transform is a serial scan over the length-T weight sequence
+(reference ``network.py:544-564``); under XLA every one of the T steps
+reads the (P, N) parameter matrix from HBM and round-trips the (units, N)
+hidden state — the same memory-bound structure that made the recurrent
+TRAIN path 118x off the fused kernels' per-particle cost (RESULTS.md
+round-5 campaign).  This kernel holds the attacker parameters and the
+victim sequence in VMEM per lane block and unrolls the T timesteps, so an
+attack phase costs one HBM read of each operand and one write of the
+result.
+
+The forward definition is shared with the BPTT kernel
+(``pallas_rnn_train.rnn_forward_rows``), including the explicit zero
+h_{-1} terms that keep NaN/Inf propagation identical to the XLA scan.
+Cross-architecture ready: the sequence length is the TARGET's weight
+count, independent of the attacker's parameter count.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..topology import Topology
+from .activations import resolve_output_grad
+from .pallas_rnn_train import rnn_forward_rows
+from .pallas_sgd_common import LANE_BLOCK
+
+
+def _apply_kernel(self_ref, target_ref, out_ref, *, topo):
+    rows = tuple(self_ref[r, :] for r in range(self_ref.shape[0]))
+    x_rows = tuple(target_ref[r, :] for r in range(target_ref.shape[0]))
+    seqs = rnn_forward_rows(topo, rows, x_rows)
+    for r in range(len(x_rows)):
+        out_ref[r, :] = seqs[-1][r][0]
+
+
+def _supported(topo: Topology) -> None:
+    assert topo.variant == "recurrent"
+    # same activation envelope as the SGD kernels (forward needs only the
+    # activation itself, but keeping one envelope keeps the fences simple)
+    resolve_output_grad(topo.activation)
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "interpret"))
+def rnn_apply_pallas(topo: Topology, selfT: jnp.ndarray,
+                     targetT: jnp.ndarray, interpret: bool = False):
+    """Population-major attack: particle n's transform (parameters
+    ``selfT[:, n]``) rewrites ``targetT[:, n]``.  Same semantics as
+    ``ops.popmajor_rnn.rnn_forward_popmajor`` with per-lane parameters."""
+    _supported(topo)
+    p_self, n = selfT.shape
+    p_tgt = targetT.shape[0]
+    block = min(LANE_BLOCK, n)
+    pad = (-n) % block
+    if pad:
+        selfT = jnp.pad(selfT, ((0, 0), (0, pad)))
+        targetT = jnp.pad(targetT, ((0, 0), (0, pad)))
+    padded = n + pad
+    out = pl.pallas_call(
+        functools.partial(_apply_kernel, topo=topo),
+        out_shape=jax.ShapeDtypeStruct((p_tgt, padded), targetT.dtype),
+        grid=(padded // block,),
+        in_specs=[pl.BlockSpec((p_self, block), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((p_tgt, block), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((p_tgt, block), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(selfT, targetT)
+    return out[:, :n] if pad else out

@@ -48,18 +48,16 @@ from .activations import resolve_activation, resolve_output_grad
 from .pallas_sgd_common import lane_call, make_learn_kernel, make_train_kernel
 
 
-def _bptt_epoch(topo: Topology, rows, x_rows):
-    """One full-batch MSE-SGD gradient on one lane block.
-
-    ``rows`` / ``x_rows`` are length-P tuples of (B,) lane vectors (current
-    parameters / the sequence sample).  Returns (grads list, per-particle
-    pre-update loss (B,))."""
+def rnn_forward_rows(topo: Topology, rows, x_rows):
+    """Unrolled stacked-SimpleRNN forward on one lane block: ``rows`` the
+    attacker's length-P parameter rows, ``x_rows`` the length-T input
+    sequence (T = the TARGET's weight count — cross-architecture attacks
+    feed another topology's sequence length).  Returns every layer's full
+    output sequence (``seqs[0]`` is the input, ``seqs[-1][t][0]`` the
+    prediction at step t) so the BPTT backward and the forward-only apply
+    kernel (``pallas_rnn_apply``) share one definition."""
     act = resolve_activation(topo.activation)
-    act_grad = resolve_output_grad(topo.activation)
-    p = topo.num_weights
-    t_len = p  # the sequence IS the flat weight vector
-
-    # ---- forward, storing every layer's full output sequence ------------
+    t_len = len(x_rows)
     seqs = [[[x_rows[t]] for t in range(t_len)]]  # layer 0 input: (T, 1)
     for layer, (ind, units) in enumerate(topo.rnn_layer_dims):
         ko = topo.offsets[2 * layer]
@@ -81,7 +79,20 @@ def _bptt_epoch(topo: Topology, rows, x_rows):
             out.append(nxt)
             h = nxt
         seqs.append(out)
+    return seqs
 
+
+def _bptt_epoch(topo: Topology, rows, x_rows):
+    """One full-batch MSE-SGD gradient on one lane block.
+
+    ``rows`` / ``x_rows`` are length-P tuples of (B,) lane vectors (current
+    parameters / the sequence sample).  Returns (grads list, per-particle
+    pre-update loss (B,))."""
+    act_grad = resolve_output_grad(topo.activation)
+    p = topo.num_weights
+    t_len = p  # the sequence IS the flat weight vector
+
+    seqs = rnn_forward_rows(topo, rows, x_rows)
     pred = [seqs[-1][t][0] for t in range(t_len)]
     err = [pred[t] - x_rows[t] for t in range(t_len)]
     loss = err[0] * err[0]

@@ -242,13 +242,39 @@ def ww_learn_epochs_popmajor(
 # ---------------------------------------------------------------------------
 
 
+def _use_pallas_apply(topo: Topology, impl: str,
+                      target_p: int = None) -> bool:
+    """Route the apply transform to a fused kernel?  Only the recurrent
+    variant has one (``pallas_rnn_apply``) — its serial T-step scan is the
+    only memory-bound apply; the other variants' dense lane programs are
+    already single XLA fusions.  Unsupported combinations fall back
+    silently (mirrors ``_use_pallas_sgd``).  ``target_p`` is the VICTIM's
+    weight count — the kernel unrolls T = target_p timesteps, so the
+    compile-size fence must bound it too (cross-type attacks can pair a
+    small recurrent attacker with an arbitrarily large victim)."""
+    if impl != "pallas":
+        return False
+    from .activations import output_grad_activations
+
+    return (topo.variant == "recurrent"
+            and topo.activation in output_grad_activations()
+            and topo.num_weights <= 64
+            and (target_p is None or target_p <= 64))
+
+
 def apply_popmajor(topo: Topology, selfT: jnp.ndarray,
-                   targetT: jnp.ndarray) -> jnp.ndarray:
+                   targetT: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
     """Population-major self-application / attack for any variant: particle
     n's transform (parameters ``selfT[:, n]``) rewrites ``targetT[:, n]``.
     The recurrent variant runs the serial time scan (lanes parallelize the
     population; the associative decomposition only matters for the
-    weight-axis-sharded path, ``parallel/sharded_apply.py``)."""
+    weight-axis-sharded path, ``parallel/sharded_apply.py``) — or, with
+    ``impl='pallas'``, the unrolled VMEM kernel."""
+    if _use_pallas_apply(topo, impl, target_p=targetT.shape[0]):
+        from .pallas_rnn_apply import rnn_apply_pallas
+
+        return rnn_apply_pallas(topo, selfT, targetT,
+                                interpret=_pallas_interpret(selfT.shape[1]))
     if topo.variant == "weightwise":
         return ww_forward_popmajor(topo, selfT, targetT)
     if topo.variant == "recurrent":
@@ -311,9 +337,9 @@ def _pallas_interpret(n: int) -> bool:
     if n <= 4096:
         return True
     raise ValueError(
-        "train_impl='pallas' needs a native Mosaic backend at this "
+        "the fused Pallas kernels need a native Mosaic backend at this "
         "population size (the interpreter would be pathologically slow); "
-        "use train_impl='xla' on this platform")
+        "use train_impl='xla' / apply_impl='xla' on this platform")
 
 
 def _check_train_mode(mode: str) -> None:

@@ -98,10 +98,16 @@ def _fft_cross(att: Topology, selfT: jnp.ndarray,
 
 
 def cross_apply_popmajor(att: Topology, selfT: jnp.ndarray, vic: Topology,
-                         targetT: jnp.ndarray) -> jnp.ndarray:
+                         targetT: jnp.ndarray,
+                         impl: str = "xla") -> jnp.ndarray:
     """Lane-layout ``cross_apply``: attacker n (parameters ``selfT[:, n]``,
     shape (P_att, N)) rewrites victim n (``targetT[:, n]``, shape
-    (P_vic, N)).  Returns the victims' new (P_vic, N) weights."""
+    (P_vic, N)).  Returns the victims' new (P_vic, N) weights.
+
+    ``impl='pallas'`` routes a recurrent ATTACKER's serial forward to the
+    fused VMEM kernel (cross-shape capable — the sequence length is the
+    victim's weight count); other attacker variants fall back to the XLA
+    lane programs, mirroring the per-type train dispatch."""
     _check_lane_capable(att)
     if att.variant == "weightwise":
         return _ww_cross(att, selfT, vic, targetT)
@@ -110,5 +116,13 @@ def cross_apply_popmajor(att: Topology, selfT: jnp.ndarray, vic: Topology,
     if att.variant == "fft":
         return _fft_cross(att, selfT, targetT)
     if att.variant == "recurrent":
+        from .popmajor import _pallas_interpret, _use_pallas_apply
+
+        if _use_pallas_apply(att, impl, target_p=targetT.shape[0]):
+            from .pallas_rnn_apply import rnn_apply_pallas
+
+            return rnn_apply_pallas(
+                att, selfT, targetT,
+                interpret=_pallas_interpret(selfT.shape[1]))
         return rnn_forward_popmajor(att, selfT, targetT)
     raise ValueError(f"unknown variant {att.variant!r}")

@@ -256,7 +256,8 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
                 selfT = all_wT[a][:, jnp.clip(att_b - offs[a], 0,
                                               config.sizes[a] - 1)]
                 attacked = cross_apply_popmajor(attacker_topo, selfT, topo,
-                                                wT_t)
+                                                wT_t,
+                                                impl=config.apply_impl)
                 out = jnp.where(mask[None, :], attacked, out)
             wT_t = out
 

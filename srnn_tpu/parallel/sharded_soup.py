@@ -229,7 +229,8 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
                 source=all_wT)
         else:
             attacked = apply_popmajor(
-                topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc)
+                topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc,
+                impl=config.apply_impl)
             wT_loc = jnp.where(has_attacker[None, :], attacked, wT_loc)
         attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
         attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)

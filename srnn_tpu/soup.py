@@ -110,6 +110,13 @@ class SoupConfig(NamedTuple):
     # and selects.  Learner count is exactly Binomial(n, rate), same
     # capacity bound and overflow fallback as the attack phase.
     learn_from_impl: str = "full"       # 'full' | 'compact'
+    # Attack-phase TRANSFORM execution (popmajor only; orthogonal to
+    # attack_impl, which picks WHICH lanes are transformed).  'pallas'
+    # fuses the recurrent variant's serial T-step forward in VMEM
+    # (ops/pallas_rnn_apply.py) — one HBM round trip per attack phase
+    # instead of T; the other variants' dense lane programs are already
+    # single XLA fusions, so only recurrent configs accept it.
+    apply_impl: str = "xla"             # 'xla' | 'pallas'
 
 
 class SoupState(NamedTuple):
@@ -390,7 +397,8 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
                 topo, wT, att_idx, has_attacker,
                 _attack_capacity(n, config.attacking_rate))
         else:
-            attacked = apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT)
+            attacked = apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT,
+                                      impl=config.apply_impl)
             wT = jnp.where(has_attacker[None, :], attacked, wT)
     else:
         attack_gate = jnp.zeros(n, bool)
@@ -464,6 +472,25 @@ def _check_popmajor(config: SoupConfig) -> None:
     if config.learn_from_impl not in ("full", "compact"):
         raise ValueError(
             f"unknown learn_from_impl {config.learn_from_impl!r}")
+    if config.apply_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown apply_impl {config.apply_impl!r}")
+    if config.apply_impl == "pallas":
+        from .ops.popmajor import _use_pallas_apply
+
+        if not _use_pallas_apply(config.topo, "pallas"):
+            raise ValueError(
+                "apply_impl='pallas' fuses the RECURRENT variant's serial "
+                "forward (activation with an output-expressible "
+                "derivative, particles up to 64 weights); this config "
+                f"(variant={config.topo.variant!r}, "
+                f"activation={config.topo.activation!r}, "
+                f"P={config.topo.num_weights}) needs apply_impl='xla'")
+        if config.attack_impl == "compact":
+            raise ValueError(
+                "apply_impl='pallas' and attack_impl='compact' are "
+                "mutually exclusive (the compact path's narrow block "
+                "defeats the kernel's lane blocking; compact is a "
+                "measured TPU loss anyway — use attack_impl='full')")
     if config.train_impl == "pallas":
         from .ops.activations import output_grad_activations
 
@@ -560,6 +587,10 @@ def evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEv
         raise ValueError(
             "train_impl='pallas' is the popmajor lane kernel; "
             "layout='rowmajor' needs train_impl='xla'")
+    if config.apply_impl == "pallas" and config.layout != "popmajor":
+        raise ValueError(
+            "apply_impl='pallas' is the popmajor lane kernel; "
+            "layout='rowmajor' needs apply_impl='xla'")
     if (config.attack_impl != "full" or config.learn_from_impl != "full") \
             and config.layout != "popmajor":
         raise ValueError(

@@ -126,6 +126,81 @@ def test_ww_kernel_nonlinear_matches_xla(activation):
                                rtol=1e-5, atol=1e-6)
 
 
+# ------------------------------------------------- fused recurrent APPLY
+
+
+@pytest.mark.parametrize("activation", ["linear", "tanh"])
+def test_rnn_apply_kernel_matches_xla(activation):
+    from srnn_tpu.ops.pallas_rnn_apply import rnn_apply_pallas
+    from srnn_tpu.ops.popmajor_rnn import rnn_forward_popmajor
+
+    topo = Topology("recurrent", activation=activation)
+    selfT, targetT = _pop(topo, 0), _pop(topo, 1)
+    ref = rnn_forward_popmajor(topo, selfT, targetT)
+    got = rnn_apply_pallas(topo, selfT, targetT, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_rnn_apply_kernel_cross_shape():
+    """Cross-architecture attack: a recurrent attacker consumes a victim
+    sequence of a DIFFERENT length (the victim topology's weight count)."""
+    from srnn_tpu.ops.pallas_rnn_apply import rnn_apply_pallas
+    from srnn_tpu.ops.popmajor_cross import cross_apply_popmajor
+
+    atk = Topology("recurrent")
+    vic = Topology("weightwise", width=3)  # P=24 != atk's 17
+    selfT = _pop(atk, 0)
+    targetT = _pop(vic, 1)
+    ref = cross_apply_popmajor(atk, selfT, vic, targetT)
+    got = cross_apply_popmajor(atk, selfT, vic, targetT, impl="pallas")
+    assert got.shape == targetT.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_rnn_apply_big_victim_falls_back():
+    """Cross-type pallas apply must bound the VICTIM's weight count too:
+    the kernel unrolls T = P_victim timesteps, so a big victim silently
+    takes the XLA scan instead of compiling forever (round-5 review
+    finding)."""
+    from srnn_tpu.ops.popmajor import _use_pallas_apply
+
+    atk = Topology("recurrent")
+    assert _use_pallas_apply(atk, "pallas", target_p=17)
+    assert not _use_pallas_apply(atk, "pallas", target_p=104)
+
+
+def test_rnn_apply_soup_parity_and_fences():
+    from srnn_tpu.soup import SoupConfig, evolve, evolve_step, seed
+
+    topo = Topology("recurrent")
+    cfg_x = SoupConfig(topo=topo, size=12, attacking_rate=0.5,
+                       remove_divergent=True, remove_zero=True,
+                       layout="popmajor")
+    cfg_p = cfg_x._replace(apply_impl="pallas")
+    st = seed(cfg_x, jax.random.key(4))
+    ref = evolve(cfg_x, st, generations=4)
+    got = evolve(cfg_p, st, generations=4)
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(got.uids))
+    ref_w, got_w = np.asarray(ref.weights), np.asarray(got.weights)
+    fin = np.isfinite(ref_w)
+    assert (fin == np.isfinite(got_w)).all()
+    np.testing.assert_allclose(got_w[fin], ref_w[fin], rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="apply_impl"):  # non-recurrent
+        ww = Topology("weightwise")
+        cfg = SoupConfig(topo=ww, size=8, layout="popmajor",
+                         apply_impl="pallas")
+        evolve_step(cfg, seed(cfg._replace(apply_impl="xla"),
+                              jax.random.key(0)))
+    with pytest.raises(ValueError, match="rowmajor"):
+        cfg = cfg_p._replace(layout="rowmajor")
+        evolve_step(cfg, st)
+    with pytest.raises(ValueError, match="compact"):
+        evolve_step(cfg_p._replace(attack_impl="compact"), st)
+
+
 # ------------------------------------------------- soup-level integration
 
 
