@@ -93,24 +93,32 @@ def _reduce_rows(topo: Topology, rows):
     out = []
     # matmul-equivalence for 'average': the XLA path's one-hot matmul
     # (kvec_reduce_popmajor) carries a 0.0-weighted term for every
-    # out-of-segment row, so a non-finite weight anywhere poisons EVERY
-    # aggregate of that particle (0*Inf = NaN).  One shared poison term
-    # (all rows times 0.0) reproduces that propagation at O(P) instead of
-    # unrolling the full O(P*k) coefficient chain: adding +/-0.0 to a
-    # finite segment sum is a no-op, and any non-finite row turns the
-    # poison — hence every aggregate — into NaN.
-    poison = None
+    # OUT-of-segment row, so a non-finite weight elsewhere poisons an
+    # aggregate (0*Inf = NaN) while a non-finite weight in its OWN
+    # segment enters at full value (Inf stays Inf).  Segments are
+    # contiguous, so the exact exclusion sums come from prefix/suffix
+    # chains of the 0.0-weighted rows at O(P) total — NOT one shared
+    # all-rows poison term, which would wrongly NaN the home segment of
+    # an Inf weight (round-5 review repro: XLA [inf, nan, nan, nan] vs
+    # shared-poison [nan, nan, nan, nan]).
+    zpre = zsuf = None
     if topo.aggregator == "average":
-        poison = rows[0] * 0.0
-        for r in range(1, len(rows)):
-            poison = poison + rows[r] * 0.0
+        p_rows = len(rows)
+        zero = jnp.zeros_like(rows[0])
+        zpre = [zero]
+        for r in range(p_rows):
+            zpre.append(zpre[-1] + rows[r] * 0.0)
+        zsuf = [zero]
+        for r in range(p_rows - 1, -1, -1):
+            zsuf.append(zsuf[-1] + rows[r] * 0.0)
+        zsuf = zsuf[::-1]  # zsuf[i] = sum of 0*rows[i:]
     for s, e, c in zip(starts, ends, counts):
         s, e = int(s), int(e)
         if topo.aggregator == "average":
             acc = rows[s]
             for r in range(s + 1, e):
                 acc = acc + rows[r]
-            out.append((acc + poison) * (1.0 / float(c)))
+            out.append((acc + zpre[s] + zsuf[e]) * (1.0 / float(c)))
         elif topo.aggregator == "max":
             acc = rows[s]
             for r in range(s + 1, e):

@@ -31,7 +31,6 @@ from ..topology import Topology, normalized_weight_coords, segments_for
 from .activations import resolve_activation
 from .linalg import matmul
 from .popmajor_kvec import _mlp_forward_lanes
-from .popmajor_rnn import rnn_forward_popmajor
 
 
 def _check_lane_capable(att: Topology) -> None:
@@ -116,13 +115,9 @@ def cross_apply_popmajor(att: Topology, selfT: jnp.ndarray, vic: Topology,
     if att.variant == "fft":
         return _fft_cross(att, selfT, targetT)
     if att.variant == "recurrent":
-        from .popmajor import _pallas_interpret, _use_pallas_apply
+        # one dispatch for homogeneous and cross attacks (the recurrent
+        # transform is shape-generic: T = the victim's weight count)
+        from .popmajor import apply_popmajor
 
-        if _use_pallas_apply(att, impl, target_p=targetT.shape[0]):
-            from .pallas_rnn_apply import rnn_apply_pallas
-
-            return rnn_apply_pallas(
-                att, selfT, targetT,
-                interpret=_pallas_interpret(selfT.shape[1]))
-        return rnn_forward_popmajor(att, selfT, targetT)
+        return apply_popmajor(att, selfT, targetT, impl=impl)
     raise ValueError(f"unknown variant {att.variant!r}")

@@ -77,20 +77,31 @@ def test_kvec_kernel_matches_xla(topo):
 
 
 def test_kvec_kernel_nonfinite_propagation_matches_xla():
-    """A non-finite weight must poison EVERY aggregate of that particle in
-    the kernel exactly as in the XLA path's one-hot matmul (whose
-    0*Inf=NaN spreads it) — a per-segment add chain would confine it
-    (round-5 review finding)."""
+    """Non-finite weights must propagate through the kernel's reduction
+    exactly as through the XLA path's one-hot matmul: an Inf weight
+    poisons every OTHER aggregate with NaN (0*Inf) but enters its OWN
+    segment's sum at full value (Inf stays Inf) — neither a per-segment
+    add chain (confines it) nor one shared poison term (NaNs the home
+    segment too) reproduces both halves (round-5 review repros)."""
+    from srnn_tpu.ops.pallas_kvec_train import _reduce_rows
+    from srnn_tpu.ops.popmajor_kvec import kvec_reduce_popmajor
+
     topo = Topology("aggregating")
     wT = _pop(topo, 0, n=8)
-    wT = wT.at[3, 2].set(jnp.inf)  # one Inf weight in lane 2
+    wT = wT.at[3, 2].set(jnp.inf)  # row 3 is INSIDE segment 0 (P=14, k=4)
+    ref_k = np.asarray(kvec_reduce_popmajor(topo, wT))
+    got_k = np.asarray(jnp.stack(
+        _reduce_rows(topo, tuple(wT[r] for r in range(wT.shape[0])))))
+    assert np.isinf(ref_k[0, 2]) and np.isnan(ref_k[1:, 2]).all()
+    np.testing.assert_array_equal(np.isinf(ref_k), np.isinf(got_k))
+    np.testing.assert_array_equal(np.isnan(ref_k), np.isnan(got_k))
+
     ref_w, ref_l = kvec_train_epochs_popmajor(topo, wT, 2)
     got_w, got_l = kvec_train_epochs_pallas(topo, wT, 2, interpret=True)
     np.testing.assert_array_equal(np.isnan(np.asarray(ref_w)),
                                   np.isnan(np.asarray(got_w)))
     np.testing.assert_array_equal(np.isnan(np.asarray(ref_l)),
                                   np.isnan(np.asarray(got_l)))
-    assert np.isnan(np.asarray(got_w))[:, 2].all()  # the whole lane poisoned
     fin = np.isfinite(np.asarray(ref_w))
     np.testing.assert_allclose(np.asarray(got_w)[fin],
                                np.asarray(ref_w)[fin], rtol=1e-5, atol=1e-6)
