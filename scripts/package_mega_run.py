@@ -1,4 +1,5 @@
-"""Package a completed (or checkpointed) mega_soup run for results_tpu/.
+"""Package a completed (or checkpointed) mega_soup / mega_multisoup run
+for results_tpu/.
 
 The live run dir holds artifacts at two scales: small evidence files
 (config/meta/log/events, the class-count curve) and bulk state (the
@@ -52,8 +53,24 @@ def main(run_dir: str, out_dir: str) -> int:
                                             time.gmtime()),
                "renders": [os.path.basename(o) for o in outputs]}
 
-    traj = os.path.join(run_dir, "soup.traj")
-    if os.path.exists(traj):
+    # homogeneous runs capture one soup.traj; heterogeneous mega_multisoup
+    # runs capture one soup.tN.traj per type — sample whichever exist
+    # (glob, not a sequential probe, so a missing/corrupt t0 cannot
+    # silently skip the later types)
+    import glob as _glob
+    import re as _re
+
+    stores = [("soup.traj", "trajectories_sample.npz", None)]
+    for path in sorted(_glob.glob(os.path.join(run_dir, "soup.t*.traj"))):
+        m = _re.fullmatch(r"soup\.t(\d+)\.traj", os.path.basename(path))
+        if m:
+            t = int(m.group(1))
+            stores.append((f"soup.t{t}.traj",
+                           f"trajectories_sample.t{t}.npz", t))
+    for base, out_name, type_idx in stores:
+        traj = os.path.join(run_dir, base)
+        if not os.path.exists(traj):
+            continue
         from srnn_tpu.utils.trajstore import read_store_sampled, store_shape
 
         # the SAME deterministic stride the renders use, sampled at read
@@ -63,14 +80,19 @@ def main(run_dir: str, out_dir: str) -> int:
         cols = viz.render_columns(n)
         store = read_store_sampled(traj, cols)
         np.savez_compressed(
-            os.path.join(out_dir, "trajectories_sample.npz"),
+            os.path.join(out_dir, out_name),
             weights=store["weights"].astype(np.float32),
             uids=store["uids"],
             generations=store["generations"],
             sampled_columns=cols)
-        package["trajectory_sample"] = {
+        sample = {
             "frames": int(len(store["generations"])), "population": int(n),
             "sampled_slots": int(len(cols)), "weights_per_particle": int(p)}
+        if type_idx is None:
+            package["trajectory_sample"] = sample
+        else:
+            package.setdefault("trajectory_samples_per_type", {})[
+                f"t{type_idx}"] = sample
 
     events = os.path.join(run_dir, "events.jsonl")
     if os.path.exists(events):

@@ -53,6 +53,10 @@ def build_parser():
     p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
                    default="fused")
     p.add_argument("--train-impl", choices=("xla", "pallas"), default="xla")
+    p.add_argument("--apply-impl", choices=("xla", "pallas"), default="xla",
+                   help="'pallas': fused VMEM forward for recurrent "
+                        "attackers in the cross-type attack phase "
+                        "(ops/pallas_rnn_apply.py)")
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--capture-every", type=int, default=0, metavar="K",
                    help="stream every K-th generation's per-type frames to "
@@ -69,7 +73,7 @@ def build_parser():
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate",
                   "learn_from_severity", "train", "train_mode", "layout",
                   "epsilon", "sharded", "respawn_draws", "train_impl",
-                  "capture_every")
+                  "apply_impl", "capture_every")
 
 
 def _make_config(args, n_dev: int = 1) -> MultiSoupConfig:
@@ -95,6 +99,7 @@ def _make_config(args, n_dev: int = 1) -> MultiSoupConfig:
         layout=args.layout,
         respawn_draws=args.respawn_draws,
         train_impl=args.train_impl,
+        apply_impl=args.apply_impl,
     )
 
 

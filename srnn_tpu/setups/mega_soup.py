@@ -106,6 +106,9 @@ def run(args):
             and args.layout != "popmajor":
         raise SystemExit("--attack-impl/--learn-from-impl compact need "
                          "--layout popmajor")
+    if args.train_impl == "pallas" and args.layout != "popmajor":
+        raise SystemExit("--train-impl pallas is the popmajor lane kernel; "
+                         "--layout rowmajor needs --train-impl xla")
     if args.capture_every < 0:
         raise SystemExit("--capture-every must be >= 0")
     if args.capture_every and args.checkpoint_every % args.capture_every:

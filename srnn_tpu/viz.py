@@ -371,15 +371,18 @@ def _render_variation(run_dir: str, path: str) -> List[str]:
 
 
 def _render_mega_curve(run_dir: str, path: str) -> List[str]:
-    """Class-count trajectory of a mega-soup run, from the structured event
-    log (``config.json`` marks a mega_soup run dir; events carry per-chunk
-    ``generation`` + ``counts``)."""
+    """Class-count trajectory of a mega run, from the structured event log
+    (``config.json`` marks a mega run dir; events carry per-chunk
+    ``generation`` + ``counts``).  Homogeneous ``mega_soup`` events hold one
+    name->count dict; heterogeneous ``mega_multisoup`` events hold a list of
+    per-type 5-class count arrays (ww/agg/rnn — the entry point's fixed
+    blend), rendered one panel per type."""
     import json as _json
 
     events_path = os.path.join(os.path.dirname(path), "events.jsonl")
     if not os.path.exists(events_path):
         return []
-    gens, series = [], {name: [] for name in CLASS_NAMES}
+    gens, rows = [], []
     with open(events_path) as f:
         for line in f:
             try:
@@ -389,21 +392,39 @@ def _render_mega_curve(run_dir: str, path: str) -> List[str]:
             if "generation" not in ev or "counts" not in ev:
                 continue
             gens.append(ev["generation"])
-            for name in CLASS_NAMES:
-                series[name].append(ev["counts"].get(name, 0))
+            rows.append(ev["counts"])
+    multi = bool(rows) and isinstance(rows[0], list)
     # always write the marker PNG — even with no counts yet — so the walk
     # stays idempotent; staleness vs the growing events.jsonl is handled by
     # the mtime rule in search_and_apply
-    fig, ax = plt.subplots(figsize=(9, 5))
-    for i, name in enumerate(CLASS_NAMES):
-        ax.plot(gens, series[name], color=CLASS_COLORS[i], label=name)
-    ax.set_xlabel("generation")
-    ax.set_ylabel("particles")
-    if gens:
-        ax.legend(fontsize=8)
+    if multi:
+        n_types = len(rows[0])
+        type_names = ("weightwise", "aggregating", "recurrent")
+        fig, axes = plt.subplots(1, n_types, figsize=(6 * n_types, 5),
+                                 sharex=True)
+        axes = [axes] if n_types == 1 else list(axes)
+        for t, ax in enumerate(axes):
+            for i, name in enumerate(CLASS_NAMES):
+                ax.plot(gens, [r[t][i] for r in rows],
+                        color=CLASS_COLORS[i], label=name)
+            ax.set_title(type_names[t] if t < len(type_names)
+                         else f"type {t}")
+            ax.set_xlabel("generation")
+            ax.grid(alpha=0.3)
+        axes[0].set_ylabel("particles")
+        axes[0].legend(fontsize=8)
     else:
-        ax.set_title("no generation counts logged yet")
-    ax.grid(alpha=0.3)
+        fig, ax = plt.subplots(figsize=(9, 5))
+        for i, name in enumerate(CLASS_NAMES):
+            ax.plot(gens, [r.get(name, 0) for r in rows],
+                    color=CLASS_COLORS[i], label=name)
+        ax.set_xlabel("generation")
+        ax.set_ylabel("particles")
+        if gens:
+            ax.legend(fontsize=8)
+        else:
+            ax.set_title("no generation counts logged yet")
+        ax.grid(alpha=0.3)
     out = os.path.join(run_dir, "mega_curve.png")
     fig.savefig(out, dpi=110, bbox_inches="tight")
     plt.close(fig)
