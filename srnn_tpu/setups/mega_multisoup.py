@@ -126,6 +126,10 @@ def run(args):
     if args.resume:
         load_run_config(args.resume, args, _CONFIG_FIELDS)
         ckpt = latest_checkpoint(args.resume)
+    if (args.train_impl == "pallas" or args.apply_impl == "pallas") \
+            and args.layout != "popmajor":
+        raise SystemExit("--train-impl/--apply-impl pallas are popmajor "
+                         "lane kernels; --layout rowmajor needs 'xla'")
     if args.capture_every < 0:
         raise SystemExit("--capture-every must be >= 0")
     if args.capture_every and args.checkpoint_every % args.capture_every:
