@@ -338,10 +338,14 @@ def _orchestrate(result):
     # stdout is discarded to preserve this script's one-JSON-line
     # contract).  Bounded by the remaining budget; a timeout keeps the
     # rows already captured.
+    # SRNN_REQUIRE_TPU marks a child spawned BY the opportunistic harness
+    # (its kernel row runs this script) — piggybacking there would recurse
+    # and run every lever twice inside the same window
     if (result["value"] > 0 and "cpu" not in result.get("backend", "cpu")
-            and remaining() > 150):
+            and remaining() > 150
+            and os.environ.get("SRNN_REQUIRE_TPU", "0") in ("", "0")):
         lever_rows = ["train_generality", "soup_rnn_apply", "soup_full",
-                      "soup_mixed"]
+                      "soup_mixed", "profile"]
         budget = max(remaining() - 30, 60)
         # the opportunistic PARENT must start without the axon
         # sitecustomize on PYTHONPATH (a tunnel wedge would otherwise

@@ -38,6 +38,8 @@ The row set covers every round-4/5 perf lever that lacks TPU evidence
   train_generality popmajor train phase per variant, fused Pallas kernel
                   vs XLA scan (reference train semantics:
                   ``network.py:613-617``)
+  profile         TPU phase attribution of the apply-only and
+                  full-dynamics generations (``profile_soup.py``)
 """
 
 import argparse
@@ -181,6 +183,15 @@ ROWS = {
     ],
     "train_generality": [
         ([sys.executable, "benchmarks/train_generality.py"], None),
+    ],
+    "profile": [
+        # TPU phase attribution of the apply-only generation (the CPU
+        # profile that motivated the round-5 compact phases mis-transferred
+        # — next-round levers need the TPU-side decomposition)
+        ([sys.executable, "benchmarks/profile_soup.py", "--preset", "apply"],
+         None),
+        ([sys.executable, "benchmarks/profile_soup.py", "--preset", "full"],
+         None),
     ],
 }
 

@@ -185,9 +185,17 @@ def main():
     cancel = watchdog(1800.0, on_fire=lambda: print(json.dumps(
         {"phase": "profile_soup", "error": "watchdog: wedged > 1800s"}),
         flush=True))
-    ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=False)
+    platform, _ = ensure_backend(retries=5, sleep_s=15.0, fallback_cpu=False)
+    if platform == "cpu" and os.environ.get(
+            "SRNN_REQUIRE_TPU", "0") not in ("", "0"):
+        # same honesty gate as the other benchmarks: a silent axon->cpu
+        # fallback must not masquerade as an accelerator profile
+        print(json.dumps({"error": f"SRNN_REQUIRE_TPU: live platform is "
+                                   f"{platform!r}"}), flush=True)
+        raise SystemExit(3)
     rows = phase_breakdown(args.n, args.gens, args.preset)
     for r in rows:
+        r["platform"] = platform
         print(json.dumps(r), flush=True)
     if args.trace:
         cfg = _gen_cfg(args.n, args.preset)
