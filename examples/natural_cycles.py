@@ -1,0 +1,153 @@
+"""Why natural period-2 cycles exist (at ~1e-5) and natural fixpoints
+don't (< 3e-8): the closed-form law behind the 100M-sample density run
+(`results_tpu/exp-fixpoint_density-_1785484013.956405-0`, RESULTS.md).
+
+With the linear activation every reference experiment effectively ran
+(SURVEY quirk 2.4.11), the weightwise transform is AFFINE in its target:
+each output weight is the 4-feature MLP applied to [v_p, coords_p], so
+
+    f_w(v) = a(w) * v + g(w),
+
+where a(w) is the composed linear coefficient of the weight-value input —
+for the 4->2->2->1 net, the path sum W1[0, :] @ W2 @ W3 — and g(w)_p is
+the affine contribution of the coordinate features.  Iterating,
+
+    f_w(f_w(v)) = a^2 v + (a + 1) g .
+
+Consequences, verified here against the recorded density-run PRNG stream:
+
+  * a(w) = -1  =>  f_w is an involution: EVERY target is a 2-cycle
+    (except the single point v* = g/2, which is the fixpoint).  A random
+    net is a natural fix_sec exactly when its scalar gain lands within
+    the epsilon-tolerance window of -1 — a codimension-1 event, rate
+    ~ p_a(-1) x window.  The 100M-run rate (9.5e-6) is reproduced from
+    the measured gain density and window below.
+  * a(w) = +1 AND w = g/(1 - a) is what a natural degree-1 fixpoint
+    would need — a measure-zero intersection of a codim-1 event with a
+    codim-P coincidence, hence 0 in 100M.
+  * The aggregating variant's transform maps into the rank-k
+    replicate(MLP(segment-avg)) subspace, so f^2(w) = w additionally
+    requires the net's own 20-dim weight vector to lie in a 4-dim
+    subspace — codim 16 on top of the eigenvalue condition; hence
+    neither class occurs in 100M samples.
+
+Run headless:  python examples/natural_cycles.py [--samples 5000000]
+Writes figures/natural_cycles.png and prints the verification numbers.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from srnn_tpu import Topology, init_population
+from srnn_tpu.engine import classify_batch
+from srnn_tpu.nets import apply_to_weights
+
+FIG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "figures")
+
+
+def input_gain(w: np.ndarray) -> float:
+    """a(w): composed coefficient of the weight-value input feature through
+    the linear 4->2->2->1 MLP (keras flat layout, `topology.py`)."""
+    W1 = w[0:8].reshape(4, 2)
+    W2 = w[8:12].reshape(2, 2)
+    W3 = w[12:14].reshape(2, 1)
+    return float((W1[0:1] @ W2 @ W3)[0, 0])
+
+
+# The committed 100M density run's batching: its PRNG stream keys each
+# batch on the cumulative sample count (`fixpoint_density.py`:
+# fold_in(fold_in(key, arch), done) with done stepping by --batch), so
+# rescanning the SAME stream requires the SAME batch size — 500,000, the
+# value the committed run was invoked with (its log records batches of
+# 500k; this is deliberately NOT a CLI flag here).
+RUN_BATCH = 500_000
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=5_000_000,
+                    help="how much of the density run's stream to rescan "
+                         f"(rounded up to the run's {RUN_BATCH:,} batch)")
+    args = ap.parse_args(argv)
+
+    from srnn_tpu.ops.predicates import CLS_FIX_SEC
+
+    topo = Topology("weightwise")
+    key = jax.random.key(0)  # the committed 100M run's seed stream
+
+    # -- collect natural fix_sec nets from the SAME stream ---------------
+    hits, done = [], 0
+    while done < args.samples:
+        pop = init_population(
+            topo, jax.random.fold_in(jax.random.fold_in(key, 0), done),
+            RUN_BATCH)
+        cls = np.asarray(classify_batch(topo, pop, 1e-4))
+        hits += [np.asarray(pop[j])
+                 for j in np.nonzero(cls == CLS_FIX_SEC)[0]]
+        done += RUN_BATCH
+    print(f"natural fix_sec nets: {len(hits)} in {done:,} samples "
+          f"(rate {len(hits) / done:.2e})")
+    if not hits:
+        print(f"no hits at this sample size (expect ~1 per 105k samples); "
+              f"re-run with a larger --samples")
+        return
+
+    gains = np.array([input_gain(w) for w in hits])
+    print(f"a(w) over the cycle nets: mean {gains.mean():+.7f}, "
+          f"max |a+1| = {np.abs(gains + 1).max():.2e}")
+
+    # -- the gain distribution over ORDINARY random nets -----------------
+    ref = np.asarray(init_population(topo, jax.random.key(123), 20_000))
+    allg = np.array([input_gain(w) for w in ref])
+    h = 0.05
+    p_minus1 = (np.abs(allg + 1) < h).sum() / len(allg) / (2 * h)
+    window = 2 * np.abs(gains + 1).max()
+    print(f"gain density near -1: {p_minus1:.3f}/unit; tolerance window "
+          f"~{window:.1e}  =>  predicted rate {p_minus1 * window:.1e} "
+          f"(measured {len(hits) / done:.1e})")
+
+    # -- involution check: f_w is period-2 on arbitrary targets ----------
+    w = jnp.asarray(hits[0])
+    v = jax.random.normal(jax.random.key(7), w.shape)
+    v2 = apply_to_weights(topo, w, v)
+    v4 = apply_to_weights(topo, w, v2)
+    err = float(jnp.max(jnp.abs(v4 - v)))
+    print(f"involution on a random target: max |f(f(v)) - v| = {err:.1e}")
+
+    # -- figure ----------------------------------------------------------
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4.2))
+    ax1.hist(allg, bins=120, range=(-3, 3), color="#888", alpha=0.8)
+    ax1.axvline(-1.0, color="tab:red", lw=1.5,
+                label="a = -1 (involution)")
+    ax1.axvline(1.0, color="tab:blue", lw=1.5, ls="--",
+                label="a = +1 (fixpoint gain)")
+    ax1.set_xlabel("input gain a(w) over random nets")
+    ax1.set_ylabel("count (20k sample)")
+    ax1.legend(fontsize=8)
+    ax1.grid(alpha=0.3)
+    ax2.scatter(range(len(gains)), gains + 1.0, s=12, color="tab:red")
+    ax2.axhline(0.0, color="k", lw=0.8)
+    ax2.set_xlabel("natural fix_sec net #")
+    ax2.set_ylabel("a(w) + 1")
+    ax2.set_title(f"all {len(gains)} natural 2-cycles sit on a = -1")
+    ax2.grid(alpha=0.3)
+    os.makedirs(FIG_DIR, exist_ok=True)
+    out = os.path.join(FIG_DIR, "natural_cycles.png")
+    fig.tight_layout()
+    fig.savefig(out, dpi=110)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,7 @@ from srnn_tpu import Topology, apply_to_weights, classify, is_diverged, is_fixpo
 from srnn_tpu.ops.predicates import (
     CLS_DIVERGENT,
     CLS_FIX_OTHER,
+    CLS_FIX_SEC,
     CLS_FIX_ZERO,
     CLS_OTHER,
     count_classes,
@@ -64,6 +65,23 @@ def test_classify_basic_classes():
     rng = np.random.default_rng(0)
     w = jnp.asarray(rng.normal(size=14).astype(np.float32))
     assert int(classify(self_apply(WW, w), w, eps)) in (CLS_OTHER, CLS_DIVERGENT)
+
+
+def test_gain_minus_one_nets_are_universal_two_cycles():
+    """The closed-form law behind the 100M-sample density result
+    (RESULTS.md / examples/natural_cycles.py): the linear weightwise
+    transform is affine in its target, f_w(v) = a(w) v + g(w), so any net
+    whose composed input gain a(w) = W1[0,:] @ W2 @ W3 equals -1 is an
+    involution — classify must call it fix_sec (a 2-cycle, never a
+    degree-1 fixpoint)."""
+    rng = np.random.default_rng(5)
+    for _ in range(5):
+        w = rng.normal(size=14, scale=0.6)
+        W1, W2 = w[0:8].reshape(4, 2), w[8:12].reshape(2, 2)
+        c = W1[0:1] @ W2  # (1, 2) partial path sum
+        w[12:14] = (-c / (c @ c.T)).ravel()  # solve c @ W3 = -1 exactly
+        flat = jnp.asarray(w.astype(np.float32))
+        assert int(classify(self_apply(WW, flat), flat, 1e-4)) == CLS_FIX_SEC
 
 
 def test_classify_vmapped_and_counts():
