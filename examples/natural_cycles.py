@@ -52,21 +52,24 @@ from srnn_tpu.nets import apply_to_weights
 FIG_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "figures")
 
 
-def input_gain(w: np.ndarray) -> float:
-    """a(w): composed coefficient of the weight-value input feature through
-    the linear 4->2->2->1 MLP (keras flat layout, `topology.py`)."""
-    W1 = w[0:8].reshape(4, 2)
-    W2 = w[8:12].reshape(2, 2)
-    W3 = w[12:14].reshape(2, 1)
-    return float((W1[0:1] @ W2 @ W3)[0, 0])
+def input_gain(w: np.ndarray, topo: Topology) -> float:
+    """a(w): composed coefficient of the weight-value input feature (path
+    sum W1[0, :] @ W2 @ ... through the linear MLP; kernel layout from
+    ``ops.flatten.unflatten`` so the layer shapes stay in one place)."""
+    from srnn_tpu.ops.flatten import unflatten
+
+    mats = unflatten(topo, jnp.asarray(w))
+    acc = np.asarray(mats[0])[0:1]
+    for m in mats[1:]:
+        acc = acc @ np.asarray(m)
+    return float(acc[0, 0])
 
 
 # The committed 100M density run's batching: its PRNG stream keys each
 # batch on the cumulative sample count (`fixpoint_density.py`:
 # fold_in(fold_in(key, arch), done) with done stepping by --batch), so
-# rescanning the SAME stream requires the SAME batch size — 500,000, the
-# value the committed run was invoked with (its log records batches of
-# 500k; this is deliberately NOT a CLI flag here).
+# rescanning the SAME stream requires the SAME batch size — 500,000, per
+# the run dir's config.json (this is deliberately NOT a CLI flag here).
 RUN_BATCH = 500_000
 
 
@@ -77,7 +80,7 @@ def main(argv=None):
                          f"(rounded up to the run's {RUN_BATCH:,} batch)")
     args = ap.parse_args(argv)
 
-    from srnn_tpu.ops.predicates import CLS_FIX_SEC
+    from srnn_tpu.ops.predicates import CLS_DIVERGENT, CLS_FIX_SEC
 
     topo = Topology("weightwise")
     key = jax.random.key(0)  # the committed 100M run's seed stream
@@ -99,13 +102,13 @@ def main(argv=None):
               f"re-run with a larger --samples")
         return
 
-    gains = np.array([input_gain(w) for w in hits])
+    gains = np.array([input_gain(w, topo) for w in hits])
     print(f"a(w) over the cycle nets: mean {gains.mean():+.7f}, "
           f"max |a+1| = {np.abs(gains + 1).max():.2e}")
 
     # -- the gain distribution over ORDINARY random nets -----------------
     ref = np.asarray(init_population(topo, jax.random.key(123), 20_000))
-    allg = np.array([input_gain(w) for w in ref])
+    allg = np.array([input_gain(w, topo) for w in ref])
     h = 0.05
     p_minus1 = (np.abs(allg + 1) < h).sum() / len(allg) / (2 * h)
     window = 2 * np.abs(gains + 1).max()
@@ -121,12 +124,29 @@ def main(argv=None):
     err = float(jnp.max(jnp.abs(v4 - v)))
     print(f"involution on a random target: max |f(f(v)) - v| = {err:.1e}")
 
+    # -- the gain also organizes the SELF-APPLICATION dynamics -----------
+    # w_{t+1} = a(w_t) w_t + g(w_t) with a(w) CUBIC in w: growth inflates
+    # the gain, so divergence is self-reinforcing — a basin, not a
+    # threshold.  |a_0| > 1 is near-sufficient for divergence; below 1
+    # the affine offset can still pump |w| across the basin boundary.
+    from srnn_tpu.engine import run_fixpoint
+
+    pop_j = init_population(topo, jax.random.key(11), 4000)
+    res = run_fixpoint(topo, pop_j, step_limit=100, epsilon=1e-4)
+    cls = np.asarray(res.classes)
+    a0 = np.array([input_gain(w, topo) for w in np.asarray(pop_j)])
+    div = cls == CLS_DIVERGENT
+    print(f"self-application outcomes vs initial gain "
+          f"(4000 trials: {div.mean():.1%} divergent): "
+          f"P(div | |a0|>1) = {div[np.abs(a0) > 1].mean():.2f}, "
+          f"P(div | |a0|<1) = {div[np.abs(a0) < 1].mean():.2f}")
+
     # -- figure ----------------------------------------------------------
     import matplotlib
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4.2))
+    fig, (ax1, ax2, ax3) = plt.subplots(1, 3, figsize=(16, 4.2))
     ax1.hist(allg, bins=120, range=(-3, 3), color="#888", alpha=0.8)
     ax1.axvline(-1.0, color="tab:red", lw=1.5,
                 label="a = -1 (involution)")
@@ -142,6 +162,19 @@ def main(argv=None):
     ax2.set_ylabel("a(w) + 1")
     ax2.set_title(f"all {len(gains)} natural 2-cycles sit on a = -1")
     ax2.grid(alpha=0.3)
+    bins = np.linspace(0, 2.5, 26)
+    centers = 0.5 * (bins[:-1] + bins[1:])
+    p_div = [div[(np.abs(a0) >= lo) & (np.abs(a0) < hi)].mean()
+             if ((np.abs(a0) >= lo) & (np.abs(a0) < hi)).any() else np.nan
+             for lo, hi in zip(bins[:-1], bins[1:])]
+    ax3.plot(centers, p_div, marker="o", ms=3, color="tab:red")
+    ax3.axvline(1.0, color="k", lw=0.8, ls="--", label="|a| = 1")
+    ax3.set_xlabel("initial gain |a(w0)|")
+    ax3.set_ylabel("P(divergent)")
+    ax3.set_title("divergence basin of self-application\n"
+                  "(gain is cubic in w: runaway is self-reinforcing)")
+    ax3.legend(fontsize=8)
+    ax3.grid(alpha=0.3)
     os.makedirs(FIG_DIR, exist_ok=True)
     out = os.path.join(FIG_DIR, "natural_cycles.png")
     fig.tight_layout()

@@ -14,7 +14,8 @@ import jax
 from ..engine import fixpoint_density
 from ..experiment import Experiment
 from ..init import init_population
-from .common import STANDARD_VARIANTS, base_parser, log_counters, register
+from .common import (STANDARD_VARIANTS, base_parser, log_counters, register,
+                     save_run_config)
 
 
 def build_parser():
@@ -31,6 +32,10 @@ def run(args):
     key = jax.random.key(args.seed)
     variants = STANDARD_VARIANTS[:2]  # WW + Agg, like the reference (:42-43)
     with Experiment("fixpoint_density", root=args.root, seed=args.seed) as exp:
+        # the PRNG stream is keyed per batch on the cumulative sample count,
+        # so reproducing/rescanning a run needs trials AND batch — record
+        # the invocation (examples/natural_cycles.py reads this)
+        save_run_config(exp.dir, args, ("trials", "batch", "epsilon"))
         all_counters, all_names = [], []
         for i, (name, topo) in enumerate(variants):
             total = jax.numpy.zeros(5, jax.numpy.int32)

@@ -74,12 +74,17 @@ def test_gain_minus_one_nets_are_universal_two_cycles():
     whose composed input gain a(w) = W1[0,:] @ W2 @ W3 equals -1 is an
     involution — classify must call it fix_sec (a 2-cycle, never a
     degree-1 fixpoint)."""
+    from srnn_tpu.ops.flatten import unflatten
+
     rng = np.random.default_rng(5)
     for _ in range(5):
-        w = rng.normal(size=14, scale=0.6)
-        W1, W2 = w[0:8].reshape(4, 2), w[8:12].reshape(2, 2)
-        c = W1[0:1] @ W2  # (1, 2) partial path sum
-        w[12:14] = (-c / (c @ c.T)).ravel()  # solve c @ W3 = -1 exactly
+        w = rng.normal(size=WW.num_weights, scale=0.6)
+        mats = [np.asarray(m) for m in unflatten(WW, jnp.asarray(w))]
+        c = mats[0][0:1]
+        for m in mats[1:-1]:
+            c = c @ m  # partial path sum up to the last kernel
+        # solve c @ W_last = -1 exactly for the last (w, 1) kernel
+        w[WW.offsets[-2]:] = (-c / (c @ c.T)).ravel()
         flat = jnp.asarray(w.astype(np.float32))
         assert int(classify(self_apply(WW, flat), flat, 1e-4)) == CLS_FIX_SEC
 
