@@ -79,6 +79,28 @@ def test_sharded_popmajor_step_bitwise_matches_unsharded(mesh):
                                   np.asarray(ev_got.counterpart))
 
 
+def test_sharded_pallas_kernels_bitwise_match_unsharded(mesh):
+    """The round-5 fused kernels inside the sharded body (per-shard
+    pallas_call under shard_map) are bitwise vs the single-device kernels:
+    the chains are per-lane elementwise, so the shard's narrower lane
+    block cannot reassociate anything.  Recurrent soup takes BOTH kernel
+    families (train BPTT + apply forward) in one step."""
+    cfg = SoupConfig(topo=Topology("recurrent", width=2, depth=2), size=16,
+                     attacking_rate=0.5, learn_from_rate=0.3,
+                     learn_from_severity=1, train=2, remove_divergent=True,
+                     remove_zero=True, layout="popmajor",
+                     train_impl="pallas", apply_impl="pallas")
+    s0 = seed(cfg, jax.random.key(9))
+    ref, _ = evolve_step(cfg, s0)
+    got, _ = sharded_evolve_step(cfg, mesh,
+                                 make_sharded_state(cfg, mesh,
+                                                    jax.random.key(9)))
+    np.testing.assert_array_equal(np.asarray(ref.weights),
+                                  np.asarray(got.weights))
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(got.uids))
+    assert int(ref.next_uid) == int(got.next_uid)
+
+
 def test_sharded_popmajor_multigeneration_bitwise(mesh):
     """10 full-dynamics generations through the transposed-carry scan path
     equal the single-device popmajor evolve bit-for-bit."""
