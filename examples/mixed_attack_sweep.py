@@ -42,6 +42,9 @@ def main(argv=None):
     ap.add_argument("--per-type", type=int, default=2000)
     ap.add_argument("--generations", type=int, default=200)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--no-figure", action="store_true",
+                    help="counts only (smoke tests must not overwrite the "
+                         "committed full-scale figure)")
     args = ap.parse_args(argv)
 
     results = []
@@ -63,6 +66,8 @@ def main(argv=None):
         print(json.dumps(row), flush=True)
 
     # figure: per-type fixpoint fraction (fix_other + fix_sec) vs rate
+    if args.no_figure:
+        return results
     import matplotlib
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
@@ -86,6 +91,7 @@ def main(argv=None):
     fig.tight_layout()
     fig.savefig(out, dpi=110)
     print(f"wrote {out}")
+    return results
 
 
 if __name__ == "__main__":

@@ -78,6 +78,11 @@ def main(argv=None):
     ap.add_argument("--samples", type=int, default=5_000_000,
                     help="how much of the density run's stream to rescan "
                          f"(rounded up to the run's {RUN_BATCH:,} batch)")
+    ap.add_argument("--no-figure", action="store_true",
+                    help="verification numbers only (smoke tests must not "
+                         "overwrite the committed full-sample figure)")
+    ap.add_argument("--basin-trials", type=int, default=4000,
+                    help="trials for the self-application basin panel")
     args = ap.parse_args(argv)
 
     from srnn_tpu.ops.predicates import CLS_DIVERGENT, CLS_FIX_SEC
@@ -100,7 +105,7 @@ def main(argv=None):
     if not hits:
         print(f"no hits at this sample size (expect ~1 per 105k samples); "
               f"re-run with a larger --samples")
-        return
+        return 0
 
     gains = np.array([input_gain(w, topo) for w in hits])
     print(f"a(w) over the cycle nets: mean {gains.mean():+.7f}, "
@@ -131,17 +136,19 @@ def main(argv=None):
     # the affine offset can still pump |w| across the basin boundary.
     from srnn_tpu.engine import run_fixpoint
 
-    pop_j = init_population(topo, jax.random.key(11), 4000)
+    pop_j = init_population(topo, jax.random.key(11), args.basin_trials)
     res = run_fixpoint(topo, pop_j, step_limit=100, epsilon=1e-4)
     cls = np.asarray(res.classes)
     a0 = np.array([input_gain(w, topo) for w in np.asarray(pop_j)])
     div = cls == CLS_DIVERGENT
     print(f"self-application outcomes vs initial gain "
-          f"(4000 trials: {div.mean():.1%} divergent): "
+          f"({args.basin_trials} trials: {div.mean():.1%} divergent): "
           f"P(div | |a0|>1) = {div[np.abs(a0) > 1].mean():.2f}, "
           f"P(div | |a0|<1) = {div[np.abs(a0) < 1].mean():.2f}")
 
     # -- figure ----------------------------------------------------------
+    if args.no_figure:
+        return len(hits)
     import matplotlib
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
@@ -180,6 +187,7 @@ def main(argv=None):
     fig.tight_layout()
     fig.savefig(out, dpi=110)
     print(f"wrote {out}")
+    return len(hits)
 
 
 if __name__ == "__main__":

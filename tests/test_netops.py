@@ -66,9 +66,33 @@ def test_multislice_mesh_axes():
         multislice_soup_mesh(3)
 
 
+_EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def test_round5_examples_smoke():
+    """The analysis examples run headless at smoke scale (figures skipped —
+    the committed PNGs are full-sample renders)."""
+    sys.path.insert(0, _EXAMPLES_DIR)
+    import mixed_attack_sweep
+    import natural_cycles
+
+    # tiny stream prefix: exercises both the hit path (RUN_BATCH finds a
+    # handful) and all verification arithmetic; a broken stream rescan
+    # would surface as zero hits
+    hits = natural_cycles.main(["--samples", "500000", "--no-figure",
+                                "--basin-trials", "200"])
+    assert hits and hits > 0
+    rows = mixed_attack_sweep.main(
+        ["--per-type", "24", "--generations", "3", "--no-figure"])
+    assert len(rows) == len(mixed_attack_sweep.RATES)
+    for r in rows:
+        for name in mixed_attack_sweep.TYPE_NAMES:
+            assert sum(r["counts"][name]) == 24
+
+
 def test_attractor_examples_run():
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "examples"))
+    sys.path.insert(0, _EXAMPLES_DIR)
     import attractors
 
     assert attractors.single_point_training(steps=200) < 1e-3
