@@ -454,6 +454,41 @@ def test_sharded_multisoup_popmajor_matches_unsharded(mesh):
                                       np.asarray(sh8.uids[t]))
 
 
+def test_sharded_multisoup_pallas_kernels_match_unsharded(mesh):
+    """The heterogeneous sharded step with the round-5 per-type fused
+    kernels (train BPTT / SGD per type + the recurrent attacker's fused
+    forward in the cross-type attack) matches the single-device multisoup
+    with the same impls — the per-type dispatch resolves identically on
+    both paths.  Weights to reduction tolerance (the aggregating
+    attacker's lane matmul retiles with the shard width — same reason
+    the XLA sibling test is not bitwise), integer state exact."""
+    from srnn_tpu import Topology
+    from srnn_tpu.multisoup import (MultiSoupConfig, evolve_multi_step,
+                                    seed_multi)
+    from srnn_tpu.parallel import (make_sharded_multi_state,
+                                   sharded_evolve_multi_step)
+
+    cfg = MultiSoupConfig(
+        topos=(Topology("weightwise", width=2, depth=2),
+               Topology("aggregating", width=2, depth=2),
+               Topology("recurrent", width=2, depth=2)),
+        sizes=(16, 8, 8),
+        attacking_rate=0.5, learn_from_rate=0.3, learn_from_severity=1,
+        train=1, remove_divergent=True, remove_zero=True,
+        layout="popmajor", train_impl="pallas", apply_impl="pallas")
+    s0 = seed_multi(cfg, jax.random.key(22))
+    ref, _ = evolve_multi_step(cfg, s0)
+    got, _ = sharded_evolve_multi_step(
+        cfg, mesh, make_sharded_multi_state(cfg, mesh, jax.random.key(22)))
+    for t in range(3):
+        np.testing.assert_allclose(np.asarray(ref.weights[t]),
+                                   np.asarray(got.weights[t]),
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_array_equal(np.asarray(ref.uids[t]),
+                                      np.asarray(got.uids[t]))
+    assert int(ref.next_uid) == int(got.next_uid)
+
+
 def test_multislice_mesh_soup_bitwise_matches_single_device():
     """DCN tier (SURVEY §2.5 collective row): the SAME sharded-soup body
     runs on a (slices, particles) multislice mesh — the particle dim
