@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Unattended tunnel watch: probe every INTERVAL seconds; on the FIRST
+# healthy probe, run the full TPU-window capture (scripts/tpu_window.sh)
+# exactly once, then keep watching (a later window gets another capture
+# only if the previous one failed before its rows completed).
+#
+# Start detached:  PYTHONPATH= nohup bash scripts/tpu_watch.sh &
+# Log:             /tmp/tpu_watch.log (or $TPU_WATCH_LOG)
+# The parent MUST run with PYTHONPATH stripped (see tpu_window.sh) so a
+# startup-level tunnel wedge cannot hang the watch loop itself.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${TPU_WATCH_INTERVAL_S:-600}"
+LOG="${TPU_WATCH_LOG:-/tmp/tpu_watch.log}"
+
+echo "$(date -u +%FT%TZ) tpu_watch: probing every ${INTERVAL}s" >> "$LOG"
+while true; do
+    if PYTHONPATH= timeout 280 python benchmarks/opportunistic.py \
+            --probe-only >> "$LOG" 2>&1; then
+        echo "$(date -u +%FT%TZ) tpu_watch: HEALTHY — running window capture" >> "$LOG"
+        if PYTHONPATH= bash scripts/tpu_window.sh >> "$LOG" 2>&1; then
+            echo "$(date -u +%FT%TZ) tpu_watch: window capture complete" >> "$LOG"
+            exit 0
+        fi
+        echo "$(date -u +%FT%TZ) tpu_watch: capture failed; resuming watch" >> "$LOG"
+    fi
+    sleep "$INTERVAL"
+done
