@@ -4,7 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from srnn_tpu import Topology, apply_to_weights, classify, is_diverged, is_fixpoint, is_zero
+from srnn_tpu import (Topology, apply_to_weights, classify, init_population,
+                      is_diverged, is_fixpoint, is_zero)
 from srnn_tpu.ops.predicates import (
     CLS_DIVERGENT,
     CLS_FIX_OTHER,
@@ -87,6 +88,43 @@ def test_gain_minus_one_nets_are_universal_two_cycles():
         w[WW.offsets[-2]:] = (-c / (c @ c.T)).ravel()
         flat = jnp.asarray(w.astype(np.float32))
         assert int(classify(self_apply(WW, flat), flat, 1e-4)) == CLS_FIX_SEC
+
+
+def test_transform_target_jacobian_structure():
+    """Structural linear algebra of every transform's TARGET dependence
+    (linear activation), the facts the round-5 density/cycle analysis
+    rests on (RESULTS.md):
+
+      * weightwise: J = a(w)·I — one scalar gain times identity;
+      * aggregating: rank <= min(aggregates, width) (the MLP bottleneck
+        caps the replicate∘MLP∘segment-avg map);
+      * fft (reference quirk, fft_use_target=False): J = 0 — the
+        transform ignores its target entirely (network.py:494-499);
+      * fft_use_target=True: same bottleneck bound as aggregating;
+      * recurrent: lower-triangular (causal — output t depends only on
+        inputs <= t).
+    """
+    key = jax.random.key(3)
+    for topo, check in [
+        (Topology("weightwise"), "aI"),
+        (Topology("aggregating"), "rank"),
+        (Topology("fft"), "zero"),
+        (Topology("fft", fft_use_target=True), "rank"),
+        (Topology("recurrent"), "tril"),
+    ]:
+        w = init_population(topo, key, 1)[0] * 0.5
+        p = topo.num_weights
+        J = np.asarray(jax.jacfwd(
+            lambda v: apply_to_weights(topo, w, v))(jnp.zeros(p)))
+        if check == "aI":
+            np.testing.assert_allclose(J, J[0, 0] * np.eye(p), atol=1e-7)
+        elif check == "zero":
+            np.testing.assert_allclose(J, 0.0, atol=1e-9)
+        elif check == "rank":
+            bound = min(topo.aggregates, topo.width)
+            assert np.linalg.matrix_rank(J, tol=1e-6) <= bound
+        else:  # tril
+            np.testing.assert_allclose(J, np.tril(J), atol=1e-7)
 
 
 def test_classify_vmapped_and_counts():
