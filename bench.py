@@ -60,6 +60,15 @@ FULL_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_FULL_TIMEOUT_S", "650"))
 # states instead of hammering the same wedge back-to-back
 RAMP_RETRY_TIMEOUT_S = float(
     os.environ.get("SRNN_BENCH_RAMP_RETRY_TIMEOUT_S", "240"))
+# compile-only warmer before any measurement: fills the persistent
+# executable cache so the ramp/full children's timed window pays execution
+# only.  Best-effort — a failure or timeout costs budget but never blocks
+# the measurement stages.
+PRECOMPILE_TIMEOUT_S = float(
+    os.environ.get("SRNN_BENCH_PRECOMPILE_TIMEOUT_S", "180"))
+# skip the warmer when the pre-reserve budget is this thin (the
+# measurement stages need whatever is left more than a warm cache)
+PRECOMPILE_MIN_BUDGET_S = 45.0
 RETRY_SPACING_S = float(os.environ.get("SRNN_BENCH_RETRY_SPACING_S", "150"))
 # spacing only makes sense at production proportions; test-scale timeouts
 # (seconds) must not inherit multi-minute sleeps
@@ -79,18 +88,15 @@ _SENTINEL = "@@BENCH_RESULT "
 # child side: one stage per process
 # --------------------------------------------------------------------------
 
-def _measure(topo, n, steps, calls):
-    """Ramped measurement unit: returns applications/sec for (n, steps)."""
+def _bench_fn(topo, steps):
+    """The measured program: ``steps`` chained self-applications over the
+    whole (P, N) population.  One definition shared by the measurement and
+    precompile stages, so the AOT-compiled executable and the measured
+    dispatch hit the SAME persistent-cache entry."""
     import jax
 
-    from srnn_tpu import init_population
-    from srnn_tpu.ops.pallas_ww import ww_apply_population
-
-    # damped init keeps the iteration numerically tame for the whole run;
-    # throughput is magnitude-independent
-    wT = (init_population(topo, jax.random.key(0), n) * 0.05).T
-
-    from srnn_tpu.ops.pallas_ww import native_mosaic_backend
+    from srnn_tpu.ops.pallas_ww import (native_mosaic_backend,
+                                        ww_apply_population)
 
     use_pallas = native_mosaic_backend()
 
@@ -106,12 +112,46 @@ def _measure(topo, n, steps, calls):
             out = jax.lax.scan(step, wT, None, length=steps)[0]
         return out, out.sum()
 
-    _ = float(run(wT)[1])  # compile + warm
+    return run
+
+
+def _measure(topo, n, steps, calls):
+    """Ramped measurement unit: returns applications/sec for (n, steps)."""
+    import jax
+
+    from srnn_tpu import init_population
+
+    # damped init keeps the iteration numerically tame for the whole run;
+    # throughput is magnitude-independent
+    wT = (init_population(topo, jax.random.key(0), n) * 0.05).T
+    run = _bench_fn(topo, steps)
+
+    _ = float(run(wT)[1])  # compile (persistent-cache served) + warm
     t0 = time.perf_counter()
     for _ in range(calls):
         _ = float(run(wT)[1])  # scalar readback forces completion
     dt = time.perf_counter() - t0
     return n * steps * calls / dt
+
+
+def _precompile(topo, shapes):
+    """AOT-lower + compile the bench program for each (n, steps) WITHOUT
+    executing anything, filling the shared persistent executable cache so
+    the ramp/full children's timed region pays execution only."""
+    import jax
+    import jax.numpy as jnp
+
+    from srnn_tpu.utils.aot import aot_compile
+
+    rows = []
+    for n, steps in shapes:
+        run = _bench_fn(topo, steps)
+        wT = jax.ShapeDtypeStruct((topo.num_weights, n), jnp.float32)
+        e = aot_compile(f"bench.run.{n}x{steps}", run, (wT,))
+        rows.append({"n": n, "steps": steps,
+                     "lower_s": round(e.lower_s, 3),
+                     "compile_s": round(e.compile_s, 3)})
+    return rows
 
 
 def _child_stage(stage: str) -> None:
@@ -134,9 +174,26 @@ def _child_stage(stage: str) -> None:
     import jax
 
     from srnn_tpu import Topology
+    from srnn_tpu.utils.aot import ensure_compilation_cache
+
+    # persistent executable cache (min-compile-time floor dropped so even
+    # the ramp program is cached): the parent exports the dir, this call
+    # turns the machinery on for this child
+    ensure_compilation_cache()
 
     topo = Topology("weightwise", width=2, depth=2)  # science-default f32
     on_cpu = platform == "cpu"  # fallback OR a genuinely CPU-default host
+    if stage == "precompile":
+        # compile-only stage: exactly the shapes the measurement stages
+        # will dispatch (the degraded CPU shape on a CPU host)
+        shapes = [(RAMP_N, RAMP_STEPS),
+                  (100_000, 20) if on_cpu else (N, STEPS_PER_CALL)]
+        rows = _precompile(topo, shapes)
+        out = {"precompile": rows, "device_count": jax.device_count(),
+               "backend": platform}
+        print(_SENTINEL + json.dumps(out), flush=True)
+        sys.stdout.flush()
+        os._exit(0)
     if stage == "ramp":
         # tiny shapes — proves compile + execute end-to-end and leaves a
         # nonzero fail-soft number if the full run dies
@@ -288,6 +345,18 @@ def _orchestrate(result):
         # never dials it, so the simulated wedge does not apply
         cpu_env.pop("SRNN_BENCH_TEST_HANG", None)
         return run_stage("full", 1, 300.0, stage_env=cpu_env)
+
+    # compile-only warm-up: one bounded child fills the shared persistent
+    # cache (ramp + full shapes), so the measurement children below
+    # deserialize executables instead of compiling inside their timed
+    # window.  Skipped when the budget is already thin; a timeout here is
+    # recorded but never blocks the stages that actually measure.
+    if remaining() - RESCUE_RESERVE_S > PRECOMPILE_MIN_BUDGET_S:
+        pre = run_stage("precompile", 1,
+                        min(PRECOMPILE_TIMEOUT_S,
+                            remaining() - RESCUE_RESERVE_S - 15))
+        if pre is not None and "precompile" in pre:
+            result["precompile"] = pre["precompile"]
 
     ramp = run_stage("ramp", RAMP_ATTEMPTS, RAMP_TIMEOUT_S,
                      reserve=RESCUE_RESERVE_S,

@@ -1,0 +1,214 @@
+"""Micro-benchmark: compile-time, dispatch-overhead, and peak-memory rows
+for the soup hot path, before/after the AOT + donation subsystem.
+
+Three rows, one JSON line:
+
+  * ``compile``: wall time of the soup hot path's BACKEND COMPILE (the
+    generation step + the 100-generation chunk run, full dynamics) in a
+    fresh process, cold persistent cache vs warm (``srnn_tpu.utils.aot``'s
+    on-disk executable cache).  ``speedup`` is cold/warm — the factor a
+    bench child or restarted mega-run no longer pays.
+  * ``dispatch``: per-call overhead of dispatching the already-compiled
+    step through the jit front end vs calling the AOT ``Compiled`` object
+    directly (tiny population, so the delta is dominated by dispatch, not
+    math).
+  * ``memory``: ``memory_analysis()`` of the 1M-particle weightwise
+    generation step, donated vs not.  With donation the population input
+    aliases the output (``alias ≈ args``), i.e. generation N+1 rewrites
+    generation N's buffers in place and no second population-sized output
+    buffer exists; without donation the output is a fresh allocation on
+    top of the argument.
+
+Usage:  python benchmarks/micro_dispatch.py [--mega-size N] [--json-only]
+The child stages re-exec this file (``--stage compile``).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.abspath(__file__)
+REPO = os.path.dirname(os.path.dirname(HERE))
+if REPO not in sys.path:  # runnable as `python benchmarks/micro_dispatch.py`
+    sys.path.insert(0, REPO)
+_SENTINEL = "@@MICRO "
+
+# the compile-row config: mega-soup dynamics at a compile-representative
+# (shape-independent) population size
+COMPILE_N = 8192
+DISPATCH_N = 256
+DISPATCH_CALLS = 200
+
+
+def _config(n, train=0):
+    from srnn_tpu.soup import SoupConfig
+    from srnn_tpu.topology import Topology
+
+    return SoupConfig(
+        topo=Topology("weightwise", width=2, depth=2), size=n,
+        attacking_rate=0.1, train=train, remove_divergent=True,
+        remove_zero=True, layout="popmajor", respawn_draws="fused")
+
+
+# ---------------------------------------------------------------------------
+# child: one timed compile in a fresh process (the only way to measure the
+# persistent cache — in-process recompiles hit jax's live jit cache)
+# ---------------------------------------------------------------------------
+
+
+def _child_compile() -> None:
+    from srnn_tpu.soup import evolve_donated, evolve_step_donated
+    from srnn_tpu.utils import aot
+
+    aot.ensure_compilation_cache()  # dir comes from the parent's env
+    # full dynamics (train=10) over the two entry points a mega-run chunk
+    # actually dispatches: the programs whose compile time ate the
+    # accelerator bench windows.  Summing both entries also smooths
+    # machine-load variance out of the cold/warm ratio.
+    cfg = _config(COMPILE_N, train=10)
+    st = aot.abstract_soup_state(cfg)
+    e1 = aot.aot_compile("micro.evolve_step.donated", evolve_step_donated,
+                         (cfg, st))
+    e2 = aot.aot_compile("micro.evolve.donated", evolve_donated, (cfg, st),
+                         {"generations": 100})
+    print(_SENTINEL + json.dumps(
+        {"lower_s": e1.lower_s + e2.lower_s,
+         "compile_s": e1.compile_s + e2.compile_s}), flush=True)
+
+
+def _run_child(cache_dir: str, timeout: float = 600.0):
+    env = dict(os.environ)
+    env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")  # measurement tool: stay off
+    # flaky tunnels unless the operator overrides explicitly
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, HERE, "--stage", "compile"],
+                          stdout=subprocess.PIPE, timeout=timeout, env=env)
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        if line.startswith(_SENTINEL):
+            return json.loads(line[len(_SENTINEL):])
+    raise RuntimeError(
+        f"compile child produced no result (rc={proc.returncode})")
+
+
+# ---------------------------------------------------------------------------
+# parent rows
+# ---------------------------------------------------------------------------
+
+
+def row_compile() -> dict:
+    """Cold vs warm second-process compile of the soup step."""
+    with tempfile.TemporaryDirectory(prefix="srnn_micro_cache_") as d:
+        cold = _run_child(d)
+        warm = _run_child(d)
+    return {
+        "row": "compile",
+        "n": COMPILE_N,
+        "cold_compile_s": round(cold["compile_s"], 4),
+        "warm_compile_s": round(warm["compile_s"], 4),
+        "lower_s": round(warm["lower_s"], 4),  # tracing is never cached
+        "speedup": round(cold["compile_s"] / max(warm["compile_s"], 1e-9), 1),
+    }
+
+
+def row_dispatch() -> dict:
+    """jit-front-end dispatch vs direct AOT-executable call, per step."""
+    import jax
+
+    from srnn_tpu.soup import evolve_step_donated, seed
+    from srnn_tpu.utils import aot
+
+    cfg = _config(DISPATCH_N)
+    entry = aot.aot_compile("micro.dispatch.evolve_step",
+                            evolve_step_donated,
+                            (cfg, aot.abstract_soup_state(cfg)))
+
+    def bench(invoke):
+        # the donated step CONSUMES its input, so the warm-up call gets its
+        # own throwaway state and the timed chain always rebinds
+        invoke(seed(cfg, jax.random.key(1)))
+        st = seed(cfg, jax.random.key(0))
+        t0 = time.perf_counter()
+        for _ in range(DISPATCH_CALLS):
+            st, _ev = invoke(st)
+        jax.block_until_ready(st.weights)
+        return (time.perf_counter() - t0) / DISPATCH_CALLS
+
+    jit_s = bench(lambda st: evolve_step_donated(cfg, st))
+    aot_s = bench(entry.compiled)
+    return {
+        "row": "dispatch",
+        "n": DISPATCH_N,
+        "calls": DISPATCH_CALLS,
+        "jit_us_per_call": round(jit_s * 1e6, 1),
+        "aot_us_per_call": round(aot_s * 1e6, 1),
+    }
+
+
+def row_memory(mega_size: int) -> dict:
+    """Static memory analysis of the mega-scale step, donated vs not —
+    donation must leave NO second population-sized output buffer."""
+    from srnn_tpu.soup import evolve_step, evolve_step_donated
+    from srnn_tpu.utils import aot
+
+    cfg = _config(mega_size)
+    pop_bytes = mega_size * cfg.topo.num_weights * 4
+    out = {"row": "memory", "n": mega_size, "population_bytes": pop_bytes}
+    for tag, fn in (("plain", evolve_step), ("donated", evolve_step_donated)):
+        # persistent=False: cache-deserialized executables report empty
+        # memory stats, which would fake alias_bytes=0 on a warm machine
+        ma = aot.aot_compile(f"micro.memory.{tag}", fn,
+                             (cfg, aot.abstract_soup_state(cfg)),
+                             persistent=False).compiled.memory_analysis()
+        out[tag] = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+        }
+    out["donated_population_aliased"] = \
+        out["donated"]["alias_bytes"] >= pop_bytes
+    out["plain_extra_output_bytes"] = \
+        out["plain"]["output_bytes"] - out["plain"]["alias_bytes"]
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--stage", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--mega-size", type=int, default=1_000_000,
+                   help="population size of the memory row")
+    p.add_argument("--json-only", action="store_true",
+                   help="suppress the human-readable summary")
+    args = p.parse_args(argv)
+
+    if args.stage == "compile":
+        _child_compile()
+        return 0
+
+    rows = [row_compile(), row_dispatch(), row_memory(args.mega_size)]
+    doc = {"bench": "micro_dispatch", "rows": rows}
+    print(json.dumps(doc), flush=True)
+    if not args.json_only:
+        c, d, m = rows
+        print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
+              f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
+              "persistent cache)", file=sys.stderr)
+        print(f"# dispatch(N={d['n']}): jit {d['jit_us_per_call']:.0f}us "
+              f"vs aot {d['aot_us_per_call']:.0f}us per call",
+              file=sys.stderr)
+        print(f"# memory(N={m['n']}): donated aliases "
+              f"{m['donated']['alias_bytes']} B of args "
+              f"(population={m['population_bytes']} B, aliased="
+              f"{m['donated_population_aliased']}); plain allocates "
+              f"{m['plain_extra_output_bytes']} B of fresh outputs",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

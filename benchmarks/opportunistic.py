@@ -71,16 +71,18 @@ sys.exit(0 if ok or not int(os.environ.get("SRNN_REQUIRE_TPU", "0")) else 3)
 """
 
 
-# The axon PJRT plugin registers via a sitecustomize on this path.  Children
-# need it on PYTHONPATH to reach the TPU; the PARENT should be started
-# WITHOUT it (``PYTHONPATH= python benchmarks/opportunistic.py``), because
-# that sitecustomize dials the relay at interpreter startup and a wedged
+# The axon PJRT plugin registers via a sitecustomize on this path
+# (``SRNN_AXON_SITE`` overrides the conventional default for hosts that
+# mount the tunnel elsewhere).  Children need it on PYTHONPATH to reach
+# the TPU; the PARENT should be started WITHOUT it
+# (``PYTHONPATH= python benchmarks/opportunistic.py``), because that
+# sitecustomize dials the relay at interpreter startup and a wedged
 # tunnel then blocks the parent in recvfrom() before main() ever runs
 # (observed round 5).  _spawn composes the child PYTHONPATH explicitly —
 # repo root first (children import srnn_tpu; ~10 rows were lost in the
 # round-5 capture window to a missing repo root) — so it does not matter
 # what the parent was started with.
-_AXON_SITE = "/root/.axon_site"
+_AXON_SITE = os.environ.get("SRNN_AXON_SITE", "/root/.axon_site")
 
 
 def _spawn(cmd, timeout_s, extra_env=None):

@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # Unattended tunnel watch: probe every INTERVAL seconds; on the FIRST
 # healthy probe, run the full TPU-window capture (scripts/tpu_window.sh)
-# exactly once, then keep watching (a later window gets another capture
-# only if the previous one failed before its rows completed).
+# and EXIT on success.  Only a failed capture resumes the watch loop (so
+# a later window can retry); a completed capture ends the watch.
 #
 # Start detached:  PYTHONPATH= nohup bash scripts/tpu_watch.sh &
 # Log:             /tmp/tpu_watch.log (or $TPU_WATCH_LOG)

@@ -33,10 +33,12 @@ PYTHONPATH= python benchmarks/opportunistic.py
 
 echo "== north-star mega-soup on TPU =="
 # The stripped parent PYTHONPATH must NOT leak into this step: without
-# /root/.axon_site the axon plugin never registers and the flagship run
-# would silently execute on CPU while claiming a TPU window.  Re-add the
-# site explicitly and hard-gate on a live accelerator first.
-AXON_PP="$PWD:/root/.axon_site"
+# the axon sitecustomize dir the plugin never registers and the flagship
+# run would silently execute on CPU while claiming a TPU window.  Re-add
+# the site explicitly (SRNN_AXON_SITE overrides the conventional default,
+# same knob benchmarks/opportunistic.py honors) and hard-gate on a live
+# accelerator first.
+AXON_PP="$PWD:${SRNN_AXON_SITE:-/root/.axon_site}"
 if ! PYTHONPATH="$AXON_PP" timeout 300 python -c "
 from srnn_tpu.utils.backend import ensure_backend
 p, _ = ensure_backend(retries=2, sleep_s=5.0, fallback_cpu=False)

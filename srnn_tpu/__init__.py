@@ -29,12 +29,16 @@ from .engine import (
     classify_batch,
     fixpoint_density,
     run_fixpoint,
+    run_fixpoint_donated,
     run_known_fixpoint_variation,
     run_mixed_fixpoint,
+    run_mixed_fixpoint_donated,
     run_training,
+    run_training_donated,
 )
 from .train import fit_epoch, learn_from, train_step
-from .soup import SoupConfig, SoupState, count, evolve, evolve_step, seed
+from .soup import (SoupConfig, SoupState, count, evolve, evolve_donated,
+                   evolve_step, evolve_step_donated, seed)
 from .experiment import (
     Experiment,
     load_artifact,
@@ -62,9 +66,12 @@ __all__ = [
     "classify_batch",
     "fixpoint_density",
     "run_fixpoint",
+    "run_fixpoint_donated",
     "run_known_fixpoint_variation",
     "run_mixed_fixpoint",
+    "run_mixed_fixpoint_donated",
     "run_training",
+    "run_training_donated",
     "fit_epoch",
     "learn_from",
     "train_step",
@@ -72,7 +79,9 @@ __all__ = [
     "SoupState",
     "count",
     "evolve",
+    "evolve_donated",
     "evolve_step",
+    "evolve_step_donated",
     "seed",
     "Experiment",
     "load_artifact",

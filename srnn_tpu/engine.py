@@ -51,8 +51,7 @@ def classify_batch(topo: Topology, w: jnp.ndarray, epsilon: float = DEFAULT_EPSI
     )(w)
 
 
-@functools.partial(jax.jit, static_argnames=("topo", "step_limit", "record"))
-def run_fixpoint(
+def _run_fixpoint(
     topo: Topology,
     pop: jnp.ndarray,
     step_limit: int = 100,
@@ -81,11 +80,17 @@ def run_fixpoint(
     return FixpointRunResult(w, steps, classes, count_classes(classes), trajectory)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("topo", "trains_per_application", "step_limit", "train_mode", "record"),
-)
-def run_mixed_fixpoint(
+#: jitted fixpoint engine; the ``_donated`` twin donates ``pop`` so the
+#: final weights reuse the trial population's buffer in place (input dead
+#: after the call — see ``soup.evolve_step_donated`` for the contract).
+run_fixpoint = jax.jit(_run_fixpoint,
+                       static_argnames=("topo", "step_limit", "record"))
+run_fixpoint_donated = jax.jit(
+    _run_fixpoint, static_argnames=("topo", "step_limit", "record"),
+    donate_argnums=(1,))
+
+
+def _run_mixed_fixpoint(
     topo: Topology,
     pop: jnp.ndarray,
     trains_per_application: int = 100,
@@ -126,6 +131,13 @@ def run_mixed_fixpoint(
     return FixpointRunResult(w, steps, classes, count_classes(classes), trajectory)
 
 
+_MIXED_STATICS = ("topo", "trains_per_application", "step_limit",
+                  "train_mode", "record")
+run_mixed_fixpoint = jax.jit(_run_mixed_fixpoint, static_argnames=_MIXED_STATICS)
+run_mixed_fixpoint_donated = jax.jit(
+    _run_mixed_fixpoint, static_argnames=_MIXED_STATICS, donate_argnums=(1,))
+
+
 class TrainingRunResult(NamedTuple):
     weights: jnp.ndarray      # (N, P) final weights
     losses: jnp.ndarray       # (E, N) per-epoch training loss
@@ -134,8 +146,7 @@ class TrainingRunResult(NamedTuple):
     trajectory: Optional[jnp.ndarray]  # (E+1, N, P) weight history or None
 
 
-@functools.partial(jax.jit, static_argnames=("topo", "epochs", "train_mode", "record"))
-def run_training(
+def _run_training(
     topo: Topology,
     pop: jnp.ndarray,
     epochs: int = 1000,
@@ -176,6 +187,13 @@ def run_training(
     classes = classify_batch(topo, w, epsilon)
     trajectory = jnp.concatenate([pop[None], traj], axis=0) if record else None
     return TrainingRunResult(w, losses, classes, count_classes(classes), trajectory)
+
+
+run_training = jax.jit(_run_training,
+                       static_argnames=("topo", "epochs", "train_mode", "record"))
+run_training_donated = jax.jit(
+    _run_training, static_argnames=("topo", "epochs", "train_mode", "record"),
+    donate_argnums=(1,))
 
 
 class VariationResult(NamedTuple):

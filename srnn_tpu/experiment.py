@@ -65,7 +65,9 @@ def save_artifact(path: str, value: Any) -> str:
         if isinstance(v, jax.Array) and jax.dtypes.issubdtype(v.dtype, jax.dtypes.prng_key)
         else v,
         value)
-    leaves, treedef = jax.tree.flatten_with_path(value)
+    # tree_util spelling: ``jax.tree.flatten_with_path`` only exists on
+    # newer jax (same version-compat story as ``parallel.compat.shard_map``)
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(value)
     # npz only when every leaf is an actual array: plain-python structures
     # (sweep dicts of lists, name lists) keep their shape better as JSON
     if leaves and all(_is_arraylike(v) for _, v in leaves):

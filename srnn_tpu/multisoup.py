@@ -149,6 +149,8 @@ def _attack_phase(config: MultiSoupConfig, weights, k_gate, k_tgt):
 def _check_popmajor_multi(config: MultiSoupConfig) -> None:
     if config.apply_impl not in ("xla", "pallas"):
         raise ValueError(f"unknown apply_impl {config.apply_impl!r}")
+    if config.train_impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown train_impl {config.train_impl!r}")
     for topo in config.topos:
         if topo.shuffler == "random":
             raise ValueError(
@@ -267,9 +269,8 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
     return new_state, events, tuple(out_wTs)
 
 
-@functools.partial(jax.jit, static_argnames=("config",))
-def evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
-                      ) -> Tuple[MultiSoupState, MultiSoupEvents]:
+def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
+                       ) -> Tuple[MultiSoupState, MultiSoupEvents]:
     """One mixed-soup generation (phase order of ``soup.py:51-87``)."""
     if config.layout == "popmajor":
         _check_popmajor_multi(config)
@@ -353,9 +354,18 @@ def evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
                                       tuple(losses))
 
 
-@functools.partial(jax.jit, static_argnames=("config", "generations"))
-def evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
-                 generations: int = 1) -> MultiSoupState:
+#: jitted single-generation mixed-soup step; the ``_donated`` twin donates
+#: the state pytree so every per-type population is rewritten in place
+#: (see ``soup.evolve_step_donated`` — same contract: input dead after the
+#: call, rebinding callers only).
+evolve_multi_step = jax.jit(_evolve_multi_step, static_argnames=("config",))
+evolve_multi_step_donated = jax.jit(_evolve_multi_step,
+                                    static_argnames=("config",),
+                                    donate_argnums=(1,))
+
+
+def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
+                  generations: int = 1) -> MultiSoupState:
     if config.layout == "popmajor":
         # keep every per-type carry transposed across the whole run: one
         # transpose per type at entry/exit instead of two per generation
@@ -379,6 +389,14 @@ def evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
 
     final, _ = jax.lax.scan(body, state, None, length=generations)
     return final
+
+
+#: jitted multi-generation mixed-soup run + its buffer-donating twin
+#: (mega-run hot loops; state rebound chunk over chunk).
+evolve_multi = jax.jit(_evolve_multi, static_argnames=("config", "generations"))
+evolve_multi_donated = jax.jit(_evolve_multi,
+                               static_argnames=("config", "generations"),
+                               donate_argnums=(1,))
 
 
 @functools.partial(jax.jit, static_argnames=("config",))

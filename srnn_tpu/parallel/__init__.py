@@ -3,14 +3,18 @@ from .sharded_soup import (
     make_sharded_state,
     place_sharded_state,
     sharded_evolve,
+    sharded_evolve_donated,
     sharded_evolve_step,
+    sharded_evolve_step_donated,
     sharded_count,
 )
 from .sharded_multisoup import (
     make_sharded_multi_state,
     place_sharded_multi_state,
     sharded_evolve_multi,
+    sharded_evolve_multi_donated,
     sharded_evolve_multi_step,
+    sharded_evolve_multi_step_donated,
     sharded_count_multi,
 )
 from .ring_rnn import ring_rnn_apply
@@ -33,12 +37,16 @@ __all__ = [
     "make_sharded_state",
     "place_sharded_state",
     "sharded_evolve_step",
+    "sharded_evolve_step_donated",
     "sharded_evolve",
+    "sharded_evolve_donated",
     "sharded_count",
     "make_sharded_multi_state",
     "place_sharded_multi_state",
     "sharded_evolve_multi_step",
+    "sharded_evolve_multi_step_donated",
     "sharded_evolve_multi",
+    "sharded_evolve_multi_donated",
     "sharded_count_multi",
     "ring_rnn_apply",
     "rnn_associative_apply",

@@ -1,0 +1,33 @@
+"""JAX version-compat shims for the parallel layer.
+
+``shard_map`` has moved twice across the JAX versions this package must
+run on: newest releases export it as ``jax.shard_map`` (keyword-only
+``mesh=``/``in_specs=``/``out_specs=`` and a ``check_vma`` flag), while
+older ones only ship ``jax.experimental.shard_map.shard_map`` (positional
+mesh/specs allowed and the same flag spelled ``check_rep``).  Every module
+here imports :func:`shard_map` from THIS shim so the rest of the parallel
+layer can write the modern spelling (``check_vma=...``) and run on either.
+"""
+
+import inspect
+
+try:  # jax >= 0.6: public top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_ACCEPTED = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the replication-check flag translated to
+    whatever the installed JAX spells it (``check_vma`` <-> ``check_rep``).
+
+    Callers use keyword arguments only (mesh=, in_specs=, out_specs=,
+    check_vma=) — both upstream signatures accept those.
+    """
+    if "check_vma" in kwargs and "check_vma" not in _ACCEPTED:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _ACCEPTED:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(f, **kwargs)

@@ -25,7 +25,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.activations import resolve_activation
@@ -33,6 +32,7 @@ from ..ops.flatten import unflatten
 from ..ops.linalg import matmul
 from ..topology import Topology
 from .mesh import SOUP_AXIS
+from .compat import shard_map
 
 
 def _local_forward(topo: Topology, n_dev: int, self_flat, seq_loc):

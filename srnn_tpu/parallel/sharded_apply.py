@@ -39,7 +39,6 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.linalg import matmul
@@ -47,6 +46,7 @@ from ..ops.mlp import mlp_forward
 from ..ops.flatten import unflatten
 from ..topology import Topology, normalized_weight_coords, segments_for
 from .mesh import SOUP_AXIS
+from .compat import shard_map
 from .ring_rnn import ring_rnn_apply
 
 

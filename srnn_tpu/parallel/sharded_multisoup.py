@@ -35,7 +35,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..init import fresh_lanes, fresh_rows
@@ -59,6 +58,7 @@ from ..soup import (
     _train_epochs,
 )
 from .mesh import SOUP_AXIS
+from .compat import shard_map
 
 
 def _mstate_specs(t: int) -> MultiSoupState:
@@ -338,9 +338,8 @@ def _local_multi_popmajor_step(config: MultiSoupConfig,
     return new_state._replace(weights=tuple(wT.T for wT in wTs)), events
 
 
-@functools.partial(jax.jit, static_argnames=("config", "mesh"))
-def sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
-                              state: MultiSoupState):
+def _sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
+                               state: MultiSoupState):
     """One mixed-soup generation with every type's particle axis sharded."""
     if config.layout == "popmajor":
         _check_popmajor_multi(config)
@@ -359,10 +358,18 @@ def sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
     return fn(state)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "mesh", "generations"))
-def sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
-                         state: MultiSoupState, generations: int = 1
-                         ) -> MultiSoupState:
+#: jitted sharded mixed-soup step + buffer-donating twin (state dead after
+#: the call; rebinding callers only — see ``soup.evolve_step_donated``).
+sharded_evolve_multi_step = jax.jit(_sharded_evolve_multi_step,
+                                    static_argnames=("config", "mesh"))
+sharded_evolve_multi_step_donated = jax.jit(
+    _sharded_evolve_multi_step, static_argnames=("config", "mesh"),
+    donate_argnums=(2,))
+
+
+def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
+                          state: MultiSoupState, generations: int = 1
+                          ) -> MultiSoupState:
     """Scan ``generations`` sharded mixed-soup steps inside ONE shard_map
     (collectives stay inside the scan).  The popmajor layout keeps every
     per-type local shard transposed (P_t, N_t/D) across generations."""
@@ -410,6 +417,13 @@ def sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         check_vma=False,
     )
     return fn(state)
+
+
+sharded_evolve_multi = jax.jit(
+    _sharded_evolve_multi, static_argnames=("config", "mesh", "generations"))
+sharded_evolve_multi_donated = jax.jit(
+    _sharded_evolve_multi, static_argnames=("config", "mesh", "generations"),
+    donate_argnums=(2,))
 
 
 @functools.partial(jax.jit, static_argnames=("config", "mesh"))

@@ -41,7 +41,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..init import fresh_lanes
@@ -63,6 +62,7 @@ from ..soup import (
 )
 from ..engine import classify_batch
 from .mesh import SOUP_AXIS
+from .compat import shard_map
 
 
 def _soup_axes(mesh: Mesh):
@@ -314,8 +314,7 @@ def _local_popmajor_step(config: SoupConfig, state: SoupState,
     return new_state._replace(weights=wT.T), events
 
 
-@functools.partial(jax.jit, static_argnames=("config", "mesh"))
-def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
+def _sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
     """One generation with the particle axis sharded over ``mesh``."""
     axes = _soup_axes(mesh)
     if config.layout == "popmajor":
@@ -339,8 +338,17 @@ def sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
     return fn(state)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "mesh", "generations"))
-def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations: int = 1):
+#: jitted sharded step + its buffer-donating twin: the donated spelling
+#: rewrites every device's population shard in place (state dead after the
+#: call; rebinding callers only — see ``soup.evolve_step_donated``).
+sharded_evolve_step = jax.jit(_sharded_evolve_step,
+                              static_argnames=("config", "mesh"))
+sharded_evolve_step_donated = jax.jit(_sharded_evolve_step,
+                                      static_argnames=("config", "mesh"),
+                                      donate_argnums=(2,))
+
+
+def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations: int = 1):
     """Scan ``generations`` sharded steps (collectives stay inside the scan —
     one compiled program for the whole evolution).
 
@@ -380,6 +388,14 @@ def sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations
 
     final, _ = jax.lax.scan(body, state, None, length=generations)
     return final
+
+
+sharded_evolve = jax.jit(_sharded_evolve,
+                         static_argnames=("config", "mesh", "generations"))
+sharded_evolve_donated = jax.jit(_sharded_evolve,
+                                 static_argnames=("config", "mesh",
+                                                  "generations"),
+                                 donate_argnums=(2,))
 
 
 @functools.partial(jax.jit, static_argnames=("config", "mesh"))

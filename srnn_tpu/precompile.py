@@ -1,0 +1,167 @@
+"""Fill the persistent executable cache for the soup hot path ahead of a run.
+
+    python -m srnn_tpu.precompile --size 1000000 --generations 100
+    python -m srnn_tpu.precompile --multi --engine --json
+
+AOT-lowers and compiles the hot entry points (``srnn_tpu.utils.aot``) for
+the given (topology, config, shapes) on the current backend, writing the
+executables into jax's persistent on-disk cache
+(``JAX_COMPILATION_CACHE_DIR`` / ``SRNN_COMPILE_CACHE_DIR``, see
+``aot.default_cache_dir``).  A later process — a bench child, a mega-run,
+a CI shard — that compiles the same program deserializes it instead of
+re-paying XLA, so its measurement (or production) window spends its time
+executing.  Safe to run on a login CPU for the CPU cache, or inside an
+accelerator allocation for the device cache; a cache-dir problem degrades
+to plain compilation, never an error.
+
+Config knobs mirror ``python -m srnn_tpu.setups mega_soup`` so the default
+invocation warms exactly the flagship configuration.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+from .soup import SoupConfig
+from .topology import Topology
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--variant", default="weightwise",
+                   choices=("weightwise", "aggregating", "fft", "recurrent"))
+    p.add_argument("--width", type=int, default=2)
+    p.add_argument("--depth", type=int, default=2)
+    p.add_argument("--size", type=int, default=1_000_000)
+    p.add_argument("--generations", type=int, default=100,
+                   help="scan length of the evolve executable (the mega "
+                        "runs' per-chunk generation count)")
+    p.add_argument("--attacking-rate", type=float, default=0.1)
+    p.add_argument("--learn-from-rate", type=float, default=-1.0)
+    p.add_argument("--train", type=int, default=0)
+    p.add_argument("--train-mode", default="sequential",
+                   choices=("sequential", "full_batch"))
+    p.add_argument("--layout", default="popmajor",
+                   choices=("rowmajor", "popmajor"))
+    p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
+                   default="fused")
+    p.add_argument("--train-impl", choices=("xla", "pallas"), default="xla")
+    p.add_argument("--attack-impl", choices=("full", "compact"),
+                   default="full")
+    p.add_argument("--learn-from-impl", choices=("full", "compact"),
+                   default="full")
+    p.add_argument("--epsilon", type=float, default=1e-4)
+    p.add_argument("--multi", action="store_true",
+                   help="also warm the heterogeneous (ww+agg+rnn) "
+                        "multisoup twins at ~size/3 per type")
+    p.add_argument("--engine", action="store_true",
+                   help="also warm run_fixpoint / run_mixed_fixpoint / "
+                        "run_training for the config's topology+size")
+    p.add_argument("--sharded", action="store_true",
+                   help="also warm the sharded steps over all visible "
+                        "devices")
+    p.add_argument("--no-donate", action="store_true",
+                   help="warm the value-preserving spellings instead of "
+                        "the buffer-donating production ones")
+    p.add_argument("--both", action="store_true",
+                   help="warm donated AND non-donated spellings")
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent executable cache location (default: "
+                        "$JAX_COMPILATION_CACHE_DIR / "
+                        "$SRNN_COMPILE_CACHE_DIR / ~/.cache/srnn_tpu/xla)")
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable JSON line instead of "
+                        "the human summary")
+    return p
+
+
+def _make_config(args) -> SoupConfig:
+    return SoupConfig(
+        topo=Topology(args.variant, width=args.width, depth=args.depth),
+        size=args.size,
+        attacking_rate=args.attacking_rate,
+        learn_from_rate=args.learn_from_rate,
+        train=args.train,
+        train_mode=args.train_mode,
+        remove_divergent=True,
+        remove_zero=True,
+        epsilon=args.epsilon,
+        layout=args.layout,
+        respawn_draws=args.respawn_draws,
+        attack_impl=args.attack_impl,
+        learn_from_impl=args.learn_from_impl,
+        train_impl=args.train_impl,
+    )
+
+
+def _make_multi(args):
+    from .multisoup import MultiSoupConfig
+
+    third = args.size // 3
+    return MultiSoupConfig(
+        topos=(Topology("weightwise", width=2, depth=2),
+               Topology("aggregating", width=2, depth=2),
+               Topology("recurrent", width=2, depth=2)),
+        sizes=(args.size - 2 * third, third, third),
+        attacking_rate=args.attacking_rate,
+        learn_from_rate=args.learn_from_rate,
+        train=args.train,
+        train_mode=args.train_mode,
+        remove_divergent=True,
+        remove_zero=True,
+        epsilon=args.epsilon,
+        layout=args.layout,
+        respawn_draws=args.respawn_draws,
+        train_impl=args.train_impl,
+    )
+
+
+def run(args) -> dict:
+    from .utils import aot
+
+    cache = aot.ensure_compilation_cache(args.cache_dir)
+    import jax  # after the cache config so nothing compiles uncached
+
+    mesh = None
+    if args.sharded:
+        from .parallel import soup_mesh
+        mesh = soup_mesh()
+
+    cfg = _make_config(args)
+    multi = _make_multi(args) if args.multi else None
+    donate_modes = [True, False] if args.both \
+        else [not args.no_donate]
+    t0 = time.perf_counter()
+    rows = []
+    for donate in donate_modes:
+        rows += aot.warmup(cfg, multi=multi, mesh=mesh,
+                           generations=args.generations, donate=donate,
+                           engine=args.engine, verbose=not args.json)
+    return {
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "cache_dir": cache,
+        "entries": len(rows),
+        "total_s": round(time.perf_counter() - t0, 3),
+        "rows": rows,
+    }
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    out = run(args)
+    if args.json:
+        print(json.dumps(out), flush=True)
+    else:
+        print(f"precompiled {out['entries']} entries on "
+              f"{out['backend']} x{out['device_count']} in "
+              f"{out['total_s']:.1f}s"
+              + (f"; persistent cache: {out['cache_dir']}"
+                 if out["cache_dir"] else "; persistent cache DISABLED"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

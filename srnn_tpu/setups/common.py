@@ -103,11 +103,15 @@ def latest_checkpoint(run_dir: str) -> str:
     return ckpts[-1]
 
 
-def save_run_config(run_dir: str, args, fields) -> None:
+def save_run_config(run_dir: str, args, fields, extra=None) -> None:
+    """Persist the run's dynamics knobs (and optional ``extra`` derived
+    metadata, e.g. per-type names for the viz layer) as config.json."""
     import json as _json
 
+    doc = {k: getattr(args, k) for k in fields}
+    doc.update(extra or {})
     with open(os.path.join(run_dir, "config.json"), "w") as f:
-        _json.dump({k: getattr(args, k) for k in fields}, f, indent=1)
+        _json.dump(doc, f, indent=1)
 
 
 def load_run_config(run_dir: str, args, fields, legacy_defaults=None) -> None:

@@ -29,7 +29,9 @@ import numpy as np
 
 from ..experiment import (Experiment, restore_multi_checkpoint,
                           save_multi_checkpoint)
-from ..multisoup import MultiSoupConfig, count_multi, evolve_multi, seed_multi
+from ..multisoup import (MultiSoupConfig, count_multi, evolve_multi,
+                         evolve_multi_donated, seed_multi)
+from ..utils.aot import ensure_compilation_cache
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
 from .common import (base_parser, latest_checkpoint,
@@ -157,6 +159,7 @@ def run(args):
                 f"--sharded needs --size >= 3x the {n_dev} visible devices "
                 "so every type keeps at least one shard per device")
     cfg = _make_config(args, n_dev)
+    ensure_compilation_cache()  # warm-start executables across processes
 
     if args.resume:
         exp = Experiment.attach(args.resume)
@@ -173,12 +176,19 @@ def run(args):
         if mesh is not None:
             from ..parallel import place_sharded_multi_state
             state = place_sharded_multi_state(mesh, state)
+        else:
+            # restored arrays may be zero-copy host views; the all-donated
+            # chunk loop requires jax-owned buffers
+            from ..utils.aot import own_pytree
+            state = own_pytree(state)
         exp.log(f"resumed from {os.path.basename(ckpt)} "
                 f"at generation {int(state.time)}")
     else:
         exp = Experiment("mega-multisoup", root=args.root,
                          seed=args.seed).__enter__()
-        save_run_config(exp.dir, args, _CONFIG_FIELDS)
+        save_run_config(exp.dir, args, _CONFIG_FIELDS,
+                        extra={"type_names": [t.variant
+                                              for t in cfg.topos]})
         if mesh is not None:
             from ..parallel import make_sharded_multi_state
             state = make_sharded_multi_state(cfg, mesh, jax.random.key(args.seed))
@@ -200,11 +210,19 @@ def run(args):
             return np.asarray(sharded_count_multi(cfg, mesh, s))
         return np.asarray(count_multi(cfg, s))
 
-    def _evolve(s, gens):
+    # Donation discipline (see mega_soup): unsharded chunks are
+    # ALL-donated (states entering the loop are jax-owned — seeds are jit
+    # outputs, restores own_pytree-copied — and one executable for every
+    # chunk keeps resume bitwise); the sharded path donates only states
+    # this loop itself produced (first chunk plain).
+    def _evolve(s, gens, owned):
         if mesh is not None:
-            from ..parallel import sharded_evolve_multi
-            return sharded_evolve_multi(cfg, mesh, s, generations=gens)
-        return evolve_multi(cfg, s, generations=gens)
+            from ..parallel import (sharded_evolve_multi,
+                                    sharded_evolve_multi_donated)
+            run = sharded_evolve_multi_donated if owned \
+                else sharded_evolve_multi
+            return run(cfg, mesh, s, generations=gens)
+        return evolve_multi_donated(cfg, s, generations=gens)
 
     stores = None
     import time as _time
@@ -237,16 +255,21 @@ def run(args):
             exp.log(f"capturing every {args.capture_every} generations to "
                     f"{len(stores)} per-type stores")
         counts = _count(state)
+        owned = False
         while int(state.time) < args.generations:
             chunk = min(args.checkpoint_every,
                         args.generations - int(state.time))
             t0 = _time.perf_counter()
             if stores is not None:
                 from ..utils import evolve_multi_captured
+                # owned=True: state is jax-owned (seed/own_pytree) and
+                # rebound every chunk — skip capture's defensive copy
                 state = evolve_multi_captured(cfg, state, chunk, stores,
-                                              every=args.capture_every)
+                                              every=args.capture_every,
+                                              owned=True)
             else:
-                state = _evolve(state, chunk)
+                state = _evolve(state, chunk, owned)
+            owned = True
             counts = _count(state)
             dt = _time.perf_counter() - t0
             gen = int(state.time)

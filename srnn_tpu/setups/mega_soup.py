@@ -24,7 +24,8 @@ import numpy as np
 
 from ..experiment import (Experiment, counters_dict, format_counters,
                           restore_checkpoint, save_checkpoint)
-from ..soup import SoupConfig, count, evolve, seed
+from ..soup import SoupConfig, count, evolve, evolve_donated, seed
+from ..utils.aot import ensure_compilation_cache
 from ..topology import Topology
 from .common import (base_parser, latest_checkpoint,
                      load_run_config, register, save_run_config)
@@ -118,6 +119,10 @@ def run(args):
         # fails evolve_captured's divisibility check hours into the run
         raise SystemExit("--capture-every must divide --generations")
     cfg = _make_config(args)
+    # persistent executable cache: a restarted/resumed run (or one warmed by
+    # `python -m srnn_tpu.precompile`) deserializes the chunk executable
+    # instead of re-paying XLA inside the first timed chunk
+    ensure_compilation_cache()
 
     mesh = None
     if args.sharded:
@@ -130,6 +135,12 @@ def run(args):
         if mesh is not None:
             from ..parallel import place_sharded_state
             state = place_sharded_state(mesh, state)
+        else:
+            # restored arrays may be zero-copy views of host memory; the
+            # donated chunk loop below must only ever donate jax-owned
+            # buffers, so materialize a device-owned copy first
+            from ..utils.aot import own_pytree
+            state = own_pytree(state)
         exp.log(f"resumed from {os.path.basename(ckpt)} "
                 f"at generation {int(state.time)}")
     else:
@@ -183,6 +194,15 @@ def run(args):
                     + (f" ({jax.process_count()} process shards)"
                        if mesh is not None and jax.process_count() > 1 else ""))
         counts = _count(state)
+        # Donation discipline.  Unsharded chunks are ALL-donated — every
+        # state entering the loop is jax-owned (seed is a jit output, a
+        # restore is own_pytree-copied above), and using ONE executable for
+        # every chunk keeps runs bitwise chunking-invariant (the donated
+        # and plain programs may differ by fusion ulps, so mixing them
+        # would break bit-exact resume).  The sharded path donates only
+        # states this loop itself produced (first chunk plain): a
+        # device_put-placed restore has no such ownership guarantee.
+        sh_owned = False
         while int(state.time) < args.generations:
             chunk = min(args.checkpoint_every, args.generations - int(state.time))
             t0 = _time.perf_counter()
@@ -192,13 +212,20 @@ def run(args):
                                                 every=args.capture_every)
             elif store is not None:
                 from ..utils import evolve_captured
+                # owned=True: this loop's state is always jax-owned (seed
+                # is a jit output, a restore is own_pytree-copied above)
+                # and rebound, so capture skips its defensive copy
                 state = evolve_captured(cfg, state, chunk, store,
-                                        every=args.capture_every)
+                                        every=args.capture_every,
+                                        owned=True)
             elif mesh is not None:
-                from ..parallel import sharded_evolve
-                state = sharded_evolve(cfg, mesh, state, generations=chunk)
+                from ..parallel import (sharded_evolve,
+                                        sharded_evolve_donated)
+                run = sharded_evolve_donated if sh_owned else sharded_evolve
+                state = run(cfg, mesh, state, generations=chunk)
+                sh_owned = True
             else:
-                state = evolve(cfg, state, generations=chunk)
+                state = evolve_donated(cfg, state, generations=chunk)
             counts = _count(state)
             dt = _time.perf_counter() - t0
             gen = int(state.time)

@@ -6,6 +6,8 @@ from .capture import (evolve_captured, evolve_multi_captured,
 from .profiling import phase, timed, trace
 from .debug import checked_apply_to_weights, divergence_onset
 from .printing import PrintingObject
+from .aot import (aot_compile, clear_executable_cache, default_cache_dir,
+                  ensure_compilation_cache, warmup)
 
 __all__ = [
     "TrajStore", "read_store", "read_store_artifact", "truncate_frames",
@@ -15,4 +17,6 @@ __all__ = [
     "phase", "timed", "trace",
     "checked_apply_to_weights", "divergence_onset",
     "PrintingObject",
+    "aot_compile", "clear_executable_cache", "default_cache_dir",
+    "ensure_compilation_cache", "warmup",
 ]

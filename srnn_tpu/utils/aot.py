@@ -1,0 +1,429 @@
+"""AOT compile + persistent-executable subsystem for the soup hot path.
+
+Two rounds of bench evidence (BENCH_r04/r05) showed the accelerator window
+being eaten by COMPILATION, not execution: every ramp/full attempt paid
+XLA compile time inside its measurement timeout.  This module moves that
+cost out of the measured (and production) window:
+
+  * :func:`ensure_compilation_cache` turns on jax's persistent executable
+    cache for the whole package/process (the ``JAX_COMPILATION_CACHE_DIR``
+    machinery ``bench.py`` already used for its children, generalized:
+    any entry point compiled once on a machine is deserialized — not
+    recompiled — by every later process).
+  * :func:`aot_compile` AOT-lowers and compiles ONE jitted entry point
+    against abstract (shape/dtype-only) arguments, memoized in-process by
+    ``(entry, statics, arg-shape signature, backend, device_count)`` — the
+    executable for a given (topology, config, shapes, backend) key is
+    built exactly once and reused.
+  * :func:`warmup` sweeps the hot entry points — the soup step/run, their
+    heterogeneous (multisoup) twins, the fixpoint/training engines, and
+    the sharded steps when a mesh is given — so a production run or bench
+    child starts from warm executables end to end.
+  * ``python -m srnn_tpu.precompile`` (see :mod:`srnn_tpu.precompile`)
+    exposes the same sweep as a CLI for filling the on-disk cache ahead
+    of a run.
+
+Donation rides the same subsystem: ``donate=True`` (default) warms the
+``*_donated`` spellings — the production hot loops' entry points, where
+generation N+1 rewrites generation N's population buffers in place
+(roughly halving peak HBM for the population at 1M-particle scale).
+"""
+
+import os
+import time
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+
+#: env var consulted first for the on-disk executable cache location
+CACHE_DIR_ENV = "JAX_COMPILATION_CACHE_DIR"
+#: package-specific override consulted second
+SRNN_CACHE_DIR_ENV = "SRNN_COMPILE_CACHE_DIR"
+#: set to "1" to disable the persistent cache entirely
+DISABLE_ENV = "SRNN_NO_COMPILE_CACHE"
+
+_cache_dir_enabled: Optional[str] = None
+
+
+def default_cache_dir() -> str:
+    """Resolve the on-disk executable cache directory: env overrides first
+    (the same ``JAX_COMPILATION_CACHE_DIR`` bench.py exports to its
+    children), then a stable per-user location."""
+    return (os.environ.get(CACHE_DIR_ENV)
+            or os.environ.get(SRNN_CACHE_DIR_ENV)
+            or os.path.join(os.path.expanduser("~"), ".cache", "srnn_tpu",
+                            "xla"))
+
+
+def ensure_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Idempotently enable jax's persistent compilation cache for this
+    process (package-wide: every jitted entry point benefits, not just the
+    bench children that historically set the env var).
+
+    Returns the live cache dir, or ``None`` when disabled
+    (``SRNN_NO_COMPILE_CACHE=1``) or the dir cannot be created — cache
+    trouble must never break a run, it just compiles uncached.
+    """
+    global _cache_dir_enabled
+    if os.environ.get(DISABLE_ENV, "0") not in ("", "0"):
+        return None
+    if path is None:
+        path = default_cache_dir()
+    if _cache_dir_enabled == path:
+        return path
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every entry: the defaults skip sub-second compiles, which
+        # is exactly the regime of the small parity/test configs whose
+        # repeat compiles dominate CI time
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (OSError, AttributeError):
+        return None
+    _cache_dir_enabled = path
+    return path
+
+
+def own_pytree(tree):
+    """Deep-copy every array leaf of ``tree`` into jax-owned device memory.
+
+    Checkpoint-restored (or otherwise host-constructed) arrays can share
+    their buffer with numpy zero-copy on CPU; DONATING such a buffer lets
+    XLA reuse memory jax does not own (observed as corrupted scalars after
+    a donated dispatch on a restored state).  Donation-using loops pass any
+    externally-produced state through this first — jit outputs are already
+    device-owned and never need it.
+    """
+    import jax.numpy as jnp
+
+    def leaf(x):
+        if not hasattr(x, "dtype"):
+            return x
+        if jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return jax.random.wrap_key_data(
+                jnp.array(jax.random.key_data(x)))
+        return jnp.array(x)
+
+    return jax.tree.map(leaf, tree)
+
+
+def _reset_jax_cache_singleton() -> None:
+    """Drop jax's in-process compilation-cache instance so the NEXT compile
+    re-reads ``jax_compilation_cache_dir`` (cache-dir config changes are
+    otherwise ignored once the singleton exists)."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+class CompiledEntry(NamedTuple):
+    """One AOT-compiled executable plus its build provenance."""
+    name: str
+    compiled: Any          # jax.stages.Compiled — call with the non-static args
+    key: Tuple             # full memo key (statics + shapes + backend)
+    lower_s: float         # trace+lower seconds (0.0 on a memo hit)
+    compile_s: float       # backend compile seconds (0.0 on a memo hit)
+    cached: bool           # True when served from the in-process memo
+
+
+_EXECUTABLES: Dict[Tuple, CompiledEntry] = {}
+
+
+def clear_executable_cache() -> None:
+    """Drop the in-process executable memo (tests; the on-disk cache is
+    jax's own and survives)."""
+    _EXECUTABLES.clear()
+
+
+def _is_arraylike(x) -> bool:
+    # .shape alone is not enough: jax.sharding.Mesh has a .shape too
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _abstract(tree):
+    """Shape/dtype skeleton of a pytree of arrays (lower() input)."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if _is_arraylike(x) else x, tree)
+
+
+def _signature(tree) -> Tuple:
+    leaves = jax.tree.leaves(tree)
+    return tuple(
+        (tuple(l.shape), str(l.dtype)) if _is_arraylike(l) else repr(l)
+        for l in leaves)
+
+
+def _key_array_struct() -> jax.ShapeDtypeStruct:
+    """Abstract stand-in for a scalar PRNG key array (typed key dtype)."""
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def _with_shardings(state, specs, mesh):
+    """Attach ``NamedSharding(mesh, spec)`` to every ShapeDtypeStruct leaf:
+    lowering against unsharded skeletons produces a DIFFERENT program (no
+    ``mhlo.sharding`` parameter attributes) than the real sharded dispatch,
+    so the persistent-cache entry would never be reused."""
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda l, spec: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, spec)),
+        state, specs)
+
+
+def abstract_soup_state(config, mesh=None) -> "Any":
+    """``SoupState`` skeleton for ``config`` — what :func:`aot_compile`
+    lowers against, no population allocation needed.  With ``mesh`` the
+    leaves carry the sharded-soup placement (particle axis sharded,
+    scalars/key replicated), matching ``make_sharded_state``."""
+    import jax.numpy as jnp
+
+    from ..soup import SoupState
+
+    st = SoupState(
+        weights=jax.ShapeDtypeStruct(
+            (config.size, config.topo.num_weights), jnp.float32),
+        uids=jax.ShapeDtypeStruct((config.size,), jnp.int32),
+        next_uid=jax.ShapeDtypeStruct((), jnp.int32),
+        time=jax.ShapeDtypeStruct((), jnp.int32),
+        key=_key_array_struct(),
+    )
+    if mesh is None:
+        return st
+    from ..parallel.sharded_soup import _soup_axes, _state_specs
+
+    return _with_shardings(st, _state_specs(_soup_axes(mesh)), mesh)
+
+
+def abstract_multi_state(config, mesh=None) -> "Any":
+    """``MultiSoupState`` skeleton for a ``MultiSoupConfig`` (with ``mesh``:
+    per-type particle axes sharded, matching ``make_sharded_multi_state``)."""
+    import jax.numpy as jnp
+
+    from ..multisoup import MultiSoupState
+
+    st = MultiSoupState(
+        weights=tuple(
+            jax.ShapeDtypeStruct((n, t.num_weights), jnp.float32)
+            for t, n in zip(config.topos, config.sizes)),
+        uids=tuple(jax.ShapeDtypeStruct((n,), jnp.int32)
+                   for n in config.sizes),
+        next_uid=jax.ShapeDtypeStruct((), jnp.int32),
+        time=jax.ShapeDtypeStruct((), jnp.int32),
+        key=_key_array_struct(),
+    )
+    if mesh is None:
+        return st
+    from ..parallel.sharded_multisoup import _mstate_specs
+
+    return _with_shardings(st, _mstate_specs(len(config.topos)), mesh)
+
+
+def aot_compile(name: str, jitted, args: Tuple, kwargs: Optional[dict] = None,
+                persistent: bool = True) -> CompiledEntry:
+    """Lower + compile ``jitted`` against ``args``/``kwargs`` ahead of time.
+
+    Array(-like) arguments may be concrete or ``ShapeDtypeStruct``s — only
+    shapes/dtypes matter; hashable non-array arguments (configs,
+    topologies, meshes, ints) are statics and become part of the memo key.
+    Returns the memoized :class:`CompiledEntry` for
+    ``(name, statics, shapes, backend, device_count)``; a second call with
+    the same key is a cache hit and does no work.  The backend compile
+    additionally goes through jax's persistent on-disk cache (see
+    :func:`ensure_compilation_cache`), so even the first in-process call
+    is a fast deserialization when any earlier process built the same
+    program.
+
+    ``persistent=False`` compiles FRESH with the on-disk cache bypassed:
+    an executable deserialized from the cache reports an empty
+    ``memory_analysis()`` (stats are not serialized), so donation-aliasing
+    and peak-memory inspection must use this spelling.
+    """
+    kwargs = dict(kwargs or {})
+    abstract_args = tuple(_abstract(a) for a in args)
+    backend = jax.default_backend()
+    key = (name, _signature(abstract_args),
+           tuple(sorted((k, repr(v)) for k, v in kwargs.items())),
+           backend, jax.device_count())
+    hit = _EXECUTABLES.get(key)
+    if hit is not None:
+        return hit._replace(cached=True, lower_s=0.0, compile_s=0.0)
+    prev_dir = None
+    if persistent:
+        if _cache_dir_enabled is None:
+            # respect a dir an earlier ensure_compilation_cache(path) call
+            # picked — re-resolving defaults here would silently re-point
+            # the cache away from an operator's --cache-dir
+            ensure_compilation_cache()
+    else:
+        # snapshot the LIVE config value (it may come from the env default
+        # without any ensure_compilation_cache call), so the restore below
+        # never leaves the process permanently uncached
+        prev_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except AttributeError:
+            pass
+        # the dir change alone is not enough: once jax's cache singleton is
+        # initialized (any earlier compile this process), it keeps serving
+        # the old dir — drop it so this compile really bypasses the cache
+        _reset_jax_cache_singleton()
+    try:
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*abstract_args, **kwargs)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+    finally:
+        if not persistent:
+            # re-point the singleton at whatever dir was live before
+            _reset_jax_cache_singleton()
+            if prev_dir is not None:
+                try:
+                    jax.config.update("jax_compilation_cache_dir", prev_dir)
+                except AttributeError:
+                    pass
+    entry = CompiledEntry(name=name, compiled=compiled, key=key,
+                          lower_s=t1 - t0, compile_s=t2 - t1, cached=False)
+    _EXECUTABLES[key] = entry
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# warmup sweep over the hot entry points
+# ---------------------------------------------------------------------------
+
+
+def _soup_entries(config, generations: int, donate: bool):
+    from .. import soup
+
+    st = abstract_soup_state(config)
+    step = soup.evolve_step_donated if donate else soup.evolve_step
+    run = soup.evolve_donated if donate else soup.evolve
+    tag = ".donated" if donate else ""
+    yield (f"soup.evolve_step{tag}", step, (config, st), {})
+    yield (f"soup.evolve{tag}", run, (config, st),
+           {"generations": generations})
+
+
+def _multi_entries(config, generations: int, donate: bool):
+    from .. import multisoup
+
+    st = abstract_multi_state(config)
+    step = multisoup.evolve_multi_step_donated if donate \
+        else multisoup.evolve_multi_step
+    run = multisoup.evolve_multi_donated if donate \
+        else multisoup.evolve_multi
+    tag = ".donated" if donate else ""
+    yield (f"multisoup.evolve_multi_step{tag}", step, (config, st), {})
+    yield (f"multisoup.evolve_multi{tag}", run, (config, st),
+           {"generations": generations})
+
+
+def _engine_entries(topo, size: int, donate: bool, step_limit: int,
+                    epochs: int, train_mode: str):
+    import jax.numpy as jnp
+
+    from .. import engine
+
+    pop = jax.ShapeDtypeStruct((size, topo.num_weights), jnp.float32)
+    tag = ".donated" if donate else ""
+    fix = engine.run_fixpoint_donated if donate else engine.run_fixpoint
+    mixed = engine.run_mixed_fixpoint_donated if donate \
+        else engine.run_mixed_fixpoint
+    train = engine.run_training_donated if donate else engine.run_training
+    yield (f"engine.run_fixpoint{tag}", fix, (topo, pop),
+           {"step_limit": step_limit})
+    yield (f"engine.run_mixed_fixpoint{tag}", mixed, (topo, pop),
+           {"step_limit": step_limit, "train_mode": train_mode})
+    yield (f"engine.run_training{tag}", train, (topo, pop),
+           {"epochs": epochs, "train_mode": train_mode})
+
+
+def _sharded_entries(config, mesh, generations: int, donate: bool):
+    from ..parallel import sharded_soup
+
+    st = abstract_soup_state(config, mesh=mesh)
+    step = sharded_soup.sharded_evolve_step_donated if donate \
+        else sharded_soup.sharded_evolve_step
+    run = sharded_soup.sharded_evolve_donated if donate \
+        else sharded_soup.sharded_evolve
+    tag = ".donated" if donate else ""
+    yield (f"parallel.sharded_evolve_step{tag}", step, (config, mesh, st), {})
+    yield (f"parallel.sharded_evolve{tag}", run, (config, mesh, st),
+           {"generations": generations})
+
+
+def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
+    from ..parallel import sharded_multisoup as sm
+
+    st = abstract_multi_state(config, mesh=mesh)
+    step = sm.sharded_evolve_multi_step_donated if donate \
+        else sm.sharded_evolve_multi_step
+    run = sm.sharded_evolve_multi_donated if donate \
+        else sm.sharded_evolve_multi
+    tag = ".donated" if donate else ""
+    yield (f"parallel.sharded_evolve_multi_step{tag}", step,
+           (config, mesh, st), {})
+    yield (f"parallel.sharded_evolve_multi{tag}", run, (config, mesh, st),
+           {"generations": generations})
+
+
+def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,
+           donate: bool = True, engine: bool = False, step_limit: int = 100,
+           epochs: int = 100, verbose: bool = False) -> "list[dict]":
+    """AOT-compile the hot entry points so later dispatches only execute.
+
+    ``config`` (a ``SoupConfig``) warms the homogeneous soup step/run;
+    ``multi`` (a ``MultiSoupConfig``) the heterogeneous twins; ``mesh``
+    additionally warms the sharded steps for whichever of the two configs
+    are given; ``engine=True`` adds the fixpoint/training engines sized
+    from ``config`` (or ``multi``'s per-type topos).  ``donate`` picks the
+    buffer-donating production spellings (default) — pass ``False`` to warm
+    the value-preserving ones used by parity tooling.
+
+    Returns one row per entry: ``{"entry", "cached", "lower_s",
+    "compile_s", "backend"}`` — ``cached`` meaning served from the
+    in-process memo (an on-disk persistent-cache hit still shows as a
+    fresh compile, just a fast one).
+    """
+    jobs = []
+    if config is not None:
+        jobs += list(_soup_entries(config, generations, donate))
+        if mesh is not None:
+            jobs += list(_sharded_entries(config, mesh, generations, donate))
+    if multi is not None:
+        jobs += list(_multi_entries(multi, generations, donate))
+        if mesh is not None:
+            jobs += list(_sharded_multi_entries(multi, mesh, generations,
+                                                donate))
+    if engine:
+        # each topo keeps ITS config's train_mode — it is a static arg, so
+        # warming the wrong mode would compile a dead executable
+        topos = [(config.topo, config.size, config.train_mode)] \
+            if config is not None else []
+        if multi is not None:
+            topos += [(t, n, multi.train_mode)
+                      for t, n in zip(multi.topos, multi.sizes)]
+        for topo, size, train_mode in topos:
+            jobs += list(_engine_entries(topo, size, donate, step_limit,
+                                         epochs, train_mode))
+    rows = []
+    for name, jitted, args, kwargs in jobs:
+        entry = aot_compile(name, jitted, args, kwargs)
+        row = {"entry": name, "cached": entry.cached,
+               "lower_s": round(entry.lower_s, 4),
+               "compile_s": round(entry.compile_s, 4),
+               "backend": jax.default_backend()}
+        rows.append(row)
+        if verbose:
+            print(f"warmup: {name}: "
+                  + ("memo hit" if entry.cached else
+                     f"lower {entry.lower_s:.2f}s compile "
+                     f"{entry.compile_s:.2f}s"), flush=True)
+    return rows

@@ -17,7 +17,9 @@ from typing import Optional, Tuple
 import jax
 import numpy as np
 
-from ..soup import SoupConfig, SoupState, evolve, evolve_step
+from ..soup import (SoupConfig, SoupState, evolve_donated,
+                    evolve_step_donated)
+from .aot import own_pytree
 from .trajstore import TrajStore, shard_path
 
 
@@ -27,6 +29,7 @@ def evolve_captured(
     generations: int,
     store: TrajStore,
     every: int = 1,
+    owned: bool = False,
 ) -> SoupState:
     """Evolve ``generations`` steps, appending one frame per ``every``
     generations to ``store``.  Returns the final state.
@@ -34,13 +37,28 @@ def evolve_captured(
     Frames carry the true per-generation event record (action/counterpart/
     loss of the captured generation), so the event-log semantics match the
     unsampled run at the captured points.
+
+    ``owned=True`` asserts the caller hands over ``state``: it must be a
+    jax-owned buffer (a jit output, or ``aot.own_pytree`` of a restore)
+    that the caller never touches again — the mega-run loops, which rebind
+    every chunk, pass this to skip the defensive copy below.
     """
     if generations % every != 0:
         raise ValueError(f"generations={generations} not divisible by every={every}")
+    # ALL-donated internal stream: every generation executes the donated
+    # executable, so the captured stream is bitwise chunking-invariant (the
+    # donated and plain programs may differ by fusion ulps on some XLA
+    # versions — mixing them would make resume/stride choices visible in
+    # the bits).  By default the caller's state is never consumed: it is
+    # first copied into jax-owned buffers (own_pytree) and only the copy
+    # is donated; ``owned=True`` skips the copy (one population of peak
+    # memory saved) for callers that hand the state over.
+    if not owned:
+        state = own_pytree(state)
     for _ in range(generations // every):
         if every > 1:
-            state = evolve(config, state, generations=every - 1)
-        state, events = evolve_step(config, state)
+            state = evolve_donated(config, state, generations=every - 1)
+        state, events = evolve_step_donated(config, state)
         # one host transfer per captured frame; everything else stays on device
         frame = jax.device_get(
             (state.time, state.weights, state.uids,
@@ -57,12 +75,14 @@ def evolve_multi_captured(
     generations: int,
     stores,
     every: int = 1,
+    owned: bool = False,
 ):
     """Heterogeneous-soup twin of :func:`evolve_captured`: one
     :class:`TrajStore` per TYPE (``stores[t]`` holds type t's (N_t, P_t)
     frames), so the mixed mega-soup's history survives at scale the same
     way the homogeneous one's does.  Returns the final state."""
-    from ..multisoup import evolve_multi, evolve_multi_step
+    from ..multisoup import evolve_multi_donated, evolve_multi_step_donated
+    from .aot import own_pytree
 
     if generations % every != 0:
         raise ValueError(
@@ -70,10 +90,15 @@ def evolve_multi_captured(
     if len(stores) != len(config.topos):
         raise ValueError(f"need one store per type "
                          f"({len(config.topos)}), got {len(stores)}")
+    # copy-then-donate unless the caller hands the state over: see
+    # evolve_captured (chunking-invariant stream; ``owned=True`` skips the
+    # defensive copy for rebinding callers)
+    if not owned:
+        state = own_pytree(state)
     for _ in range(generations // every):
         if every > 1:
-            state = evolve_multi(config, state, generations=every - 1)
-        state, events = evolve_multi_step(config, state)
+            state = evolve_multi_donated(config, state, generations=every - 1)
+        state, events = evolve_multi_step_donated(config, state)
         frame = jax.device_get(
             (state.time, state.weights, state.uids,
              events.action, events.counterpart, events.loss))
@@ -162,7 +187,9 @@ def sharded_evolve_captured(
     shards into global frames offline.  Scales the reference's
     never-lose-history registry (``soup.py:37-43``) to multihost.
     """
-    from ..parallel import sharded_evolve, sharded_evolve_step
+    from ..parallel import (sharded_evolve, sharded_evolve_donated,
+                            sharded_evolve_step,
+                            sharded_evolve_step_donated)
 
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if num_processes is None else num_processes
@@ -184,10 +211,16 @@ def sharded_evolve_captured(
     if generations % every != 0:
         raise ValueError(f"generations={generations} not divisible by every={every}")
 
+    owned = False  # donate internal states only, never the caller's input
     for _ in range(generations // every):
         if every > 1:
-            state = sharded_evolve(config, mesh, state, generations=every - 1)
-        state, events = sharded_evolve_step(config, mesh, state)
+            run = sharded_evolve_donated if owned else sharded_evolve
+            state = run(config, mesh, state, generations=every - 1)
+            owned = True
+        step = sharded_evolve_step_donated if owned \
+            else sharded_evolve_step
+        state, events = step(config, mesh, state)
+        owned = True
         t = int(jax.device_get(state.time))
         store.append(
             t,

@@ -399,7 +399,16 @@ def _render_mega_curve(run_dir: str, path: str) -> List[str]:
     # the mtime rule in search_and_apply
     if multi:
         n_types = len(rows[0])
+        # per-type panel titles come from the run's own config.json when the
+        # entry point recorded them (mega_multisoup writes "type_names");
+        # legacy run dirs fall back to the historical fixed blend
         type_names = ("weightwise", "aggregating", "recurrent")
+        try:
+            recorded = load_artifact(path).get("type_names")
+            if recorded:
+                type_names = tuple(str(n) for n in recorded)
+        except Exception:
+            pass
         fig, axes = plt.subplots(1, n_types, figsize=(6 * n_types, 5),
                                  sharex=True)
         axes = [axes] if n_types == 1 else list(axes)

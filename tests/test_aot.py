@@ -1,0 +1,202 @@
+"""AOT precompile + buffer-donation subsystem (``srnn_tpu.utils.aot``).
+
+Donation must be a pure memory optimization — same bits out of the donated
+and non-donated spellings — and the AOT executable memo must hit on a
+repeated (topology, config, shapes, backend) key and miss when the
+topology changes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import engine, multisoup, soup
+from srnn_tpu.soup import SoupConfig, seed
+from srnn_tpu.topology import Topology
+from srnn_tpu.utils import aot
+
+WW = Topology("weightwise", width=2, depth=2)
+AGG = Topology("aggregating", width=2, depth=2)
+RNN = Topology("recurrent", width=2, depth=2)
+
+
+def _full_dynamics(topo, **over):
+    kw = dict(topo=topo, size=16, attacking_rate=0.3, learn_from_rate=0.3,
+              train=1, remove_divergent=True, remove_zero=True)
+    kw.update(over)
+    return SoupConfig(**kw)
+
+
+def _assert_states_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.weights), np.asarray(b.weights))
+    np.testing.assert_array_equal(np.asarray(a.uids), np.asarray(b.uids))
+    assert int(a.next_uid) == int(b.next_uid)
+    assert int(a.time) == int(b.time)
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(a.key)),
+                                  np.asarray(jax.random.key_data(b.key)))
+
+
+@pytest.mark.parametrize("topo", [WW, AGG, RNN],
+                         ids=lambda t: t.variant)
+def test_donated_step_bitwise_parity(topo):
+    """The donated step is the SAME program: bitwise-equal states over 3
+    full-dynamics generations for every variant."""
+    cfg = _full_dynamics(topo)
+    ref = seed(cfg, jax.random.key(3))
+    don = jax.tree.map(jnp.copy, ref)
+    for _ in range(3):
+        ref, ev_ref = soup.evolve_step(cfg, ref)
+        don, ev_don = soup.evolve_step_donated(cfg, don)
+        np.testing.assert_array_equal(np.asarray(ev_ref.action),
+                                      np.asarray(ev_don.action))
+    _assert_states_equal(ref, don)
+
+
+def test_donated_evolve_popmajor_parity():
+    """Popmajor mega-config: donated vs plain multi-generation run.  XLA
+    may fuse the aliased program differently (same class of <=1-ulp
+    reassociation the compact paths document), so the weights tolerance is
+    ulp-scale rather than bitwise; uids/counters stay exact."""
+    cfg = _full_dynamics(WW, layout="popmajor", respawn_draws="fused")
+    st = seed(cfg, jax.random.key(5))
+    ref = soup.evolve(cfg, st, generations=3)
+    don = soup.evolve_donated(cfg, jax.tree.map(jnp.copy, st), generations=3)
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(don.uids))
+    assert int(ref.next_uid) == int(don.next_uid)
+    np.testing.assert_allclose(np.asarray(ref.weights),
+                               np.asarray(don.weights), rtol=2e-6, atol=1e-7)
+
+
+def test_donated_input_is_consumed():
+    """Contract check: the donated step really donates — the input state's
+    buffers are dead afterwards (this is what frees the second
+    population-sized buffer at mega scale)."""
+    cfg = _full_dynamics(WW, learn_from_rate=-1.0, train=0)
+    st = seed(cfg, jax.random.key(0))
+    _ = soup.evolve_step_donated(cfg, st)
+    with pytest.raises((RuntimeError, ValueError)):
+        np.asarray(st.weights)  # donated buffer must be unusable
+
+
+def test_donated_multisoup_step_parity():
+    mcfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(8, 8), attacking_rate=0.4,
+        learn_from_rate=0.3, train=1, remove_divergent=True,
+        remove_zero=True)
+    ref = multisoup.seed_multi(mcfg, jax.random.key(2))
+    don = jax.tree.map(jnp.copy, ref)
+    for _ in range(3):
+        ref, _ev = multisoup.evolve_multi_step(mcfg, ref)
+        don, _ev2 = multisoup.evolve_multi_step_donated(mcfg, don)
+    for t in range(2):
+        np.testing.assert_array_equal(np.asarray(ref.weights[t]),
+                                      np.asarray(don.weights[t]))
+        np.testing.assert_array_equal(np.asarray(ref.uids[t]),
+                                      np.asarray(don.uids[t]))
+
+
+def test_donated_engine_parity():
+    from srnn_tpu.init import init_population
+
+    pop = init_population(WW, jax.random.key(1), 12)
+    ref = engine.run_fixpoint(WW, pop, step_limit=4)
+    don = engine.run_fixpoint_donated(WW, jnp.copy(pop), step_limit=4)
+    np.testing.assert_array_equal(np.asarray(ref.weights),
+                                  np.asarray(don.weights))
+    np.testing.assert_array_equal(np.asarray(ref.steps), np.asarray(don.steps))
+
+    ref = engine.run_training(WW, pop, epochs=3)
+    don = engine.run_training_donated(WW, jnp.copy(pop), epochs=3)
+    np.testing.assert_array_equal(np.asarray(ref.weights),
+                                  np.asarray(don.weights))
+    np.testing.assert_array_equal(np.asarray(ref.losses), np.asarray(don.losses))
+
+
+# --------------------------------------------------------------- AOT memo
+
+
+def test_aot_cache_hit_same_key_and_miss_on_topology_change():
+    aot.clear_executable_cache()
+    cfg = SoupConfig(topo=WW, size=8, attacking_rate=0.2,
+                     remove_divergent=True, remove_zero=True)
+    rows = aot.warmup(cfg, generations=2)
+    assert rows and not any(r["cached"] for r in rows)
+    again = aot.warmup(cfg, generations=2)
+    assert [r["entry"] for r in again] == [r["entry"] for r in rows]
+    assert all(r["cached"] for r in again)
+    assert all(r["compile_s"] == 0.0 for r in again)
+
+    # same shapes, different topology -> different key -> fresh compiles
+    miss = aot.warmup(cfg._replace(topo=AGG), generations=2)
+    assert not any(r["cached"] for r in miss)
+    # a config change that alters the compiled program also misses
+    miss2 = aot.warmup(cfg._replace(attacking_rate=0.5), generations=2)
+    assert not any(r["cached"] for r in miss2)
+
+
+def test_aot_compiled_executable_runs_and_matches_jit():
+    aot.clear_executable_cache()
+    cfg = SoupConfig(topo=WW, size=8, attacking_rate=0.3,
+                     remove_divergent=True, remove_zero=True)
+    entry = aot.aot_compile("test.evolve_step", soup.evolve_step,
+                            (cfg, aot.abstract_soup_state(cfg)))
+    st = seed(cfg, jax.random.key(7))
+    ref, _ = soup.evolve_step(cfg, st)
+    got, _ = entry.compiled(st)
+    _assert_states_equal(ref, got)
+
+
+def test_donation_aliases_population_buffer():
+    """``memory_analysis`` proof that the donated step emits no second
+    population-sized output buffer: the whole argument block (population
+    included) aliases the outputs, while the plain step aliases nothing."""
+    cfg = SoupConfig(topo=WW, size=4096, attacking_rate=0.1,
+                     remove_divergent=True, remove_zero=True,
+                     layout="popmajor", respawn_draws="fused")
+    pop_bytes = cfg.size * cfg.topo.num_weights * 4
+    st = aot.abstract_soup_state(cfg)
+    # persistent=False: a cache-deserialized executable reports empty
+    # memory stats, so the aliasing proof must compile fresh
+    don = aot.aot_compile("test.mem.donated", soup.evolve_step_donated,
+                          (cfg, st),
+                          persistent=False).compiled.memory_analysis()
+    plain = aot.aot_compile("test.mem.plain", soup.evolve_step,
+                            (cfg, st),
+                            persistent=False).compiled.memory_analysis()
+    assert don.alias_size_in_bytes >= pop_bytes
+    assert plain.alias_size_in_bytes < pop_bytes
+
+
+def test_engine_and_multi_warmup_entries():
+    aot.clear_executable_cache()
+    cfg = SoupConfig(topo=WW, size=8, attacking_rate=0.2,
+                     remove_divergent=True, remove_zero=True)
+    mcfg = multisoup.MultiSoupConfig(topos=(WW, AGG), sizes=(8, 8),
+                                     attacking_rate=0.2, learn_from_rate=-1.0,
+                                     remove_divergent=True, remove_zero=True)
+    rows = aot.warmup(cfg, multi=mcfg, generations=2, engine=True,
+                      step_limit=2, epochs=2)
+    entries = {r["entry"] for r in rows}
+    assert "soup.evolve_step.donated" in entries
+    assert "multisoup.evolve_multi.donated" in entries
+    assert "engine.run_fixpoint.donated" in entries
+    assert "engine.run_training.donated" in entries
+    # non-donating sweep compiles the value-preserving spellings separately
+    plain = aot.warmup(cfg, generations=2, donate=False)
+    assert {r["entry"] for r in plain} == {"soup.evolve_step", "soup.evolve"}
+    assert not any(r["cached"] for r in plain)
+
+
+def test_warmup_sharded_entries_accept_mesh():
+    """A Mesh argument has .shape but no .dtype — the abstraction step
+    must pass it through as a static, not explode on it."""
+    from srnn_tpu.parallel import soup_mesh
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve_step_donated
+
+    mesh = soup_mesh()
+    cfg = SoupConfig(topo=WW, size=mesh.devices.size * 2, attacking_rate=0.2,
+                     remove_divergent=True, remove_zero=True)
+    entry = aot.aot_compile("test.sharded.step", sharded_evolve_step_donated,
+                            (cfg, mesh, aot.abstract_soup_state(cfg)))
+    assert entry.compiled is not None

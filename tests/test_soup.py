@@ -445,8 +445,12 @@ def test_fused_respawn_layouts_agree_and_law_is_bounded():
     row = evolve(cfg_row, st, generations=12)
     pop = evolve(cfg_pop, st, generations=12)
     np.testing.assert_array_equal(np.asarray(row.uids), np.asarray(pop.uids))
+    # the layouts reassociate the attack chain differently; 12 generations
+    # at rate 0.5 compound that on diverged (1e18-magnitude) survivors, so
+    # the tolerance is loose — the respawn-stream agreement this test is
+    # about is pinned bitwise by the uid check above
     np.testing.assert_allclose(np.asarray(row.weights), np.asarray(pop.weights),
-                               rtol=1e-3, atol=1e-5)
+                               rtol=5e-3, atol=1e-5)
     assert int(row.next_uid) > 24  # respawns actually happened
 
     lim = _glorot_limit_rows(WW)
