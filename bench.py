@@ -82,11 +82,22 @@ FULL_ATTEMPTS = 2
 RESCUE_RESERVE_S = 330.0
 
 _SENTINEL = "@@BENCH_RESULT "
+#: child-side heartbeat lines: milestone rows on the piped stdout, so a
+#: TIMED-OUT child's partial output still names the last step it finished
+#: (backend init / compile / call k of n) instead of just "timeout"
+_HB_SENTINEL = "@@BENCH_HB "
 
 
 # --------------------------------------------------------------------------
 # child side: one stage per process
 # --------------------------------------------------------------------------
+
+def _hb(stage, step, **extra):
+    """Emit one child heartbeat row (parent salvages the last one from a
+    killed child's partial stdout and records it in the stage log)."""
+    row = {"stage": stage, "step": step, "t": round(time.time(), 3)}
+    row.update(extra)
+    print(_HB_SENTINEL + json.dumps(row), flush=True)
 
 def _bench_fn(topo, steps):
     """The measured program: ``steps`` chained self-applications over the
@@ -115,7 +126,7 @@ def _bench_fn(topo, steps):
     return run
 
 
-def _measure(topo, n, steps, calls):
+def _measure(topo, n, steps, calls, stage=None):
     """Ramped measurement unit: returns applications/sec for (n, steps)."""
     import jax
 
@@ -125,12 +136,21 @@ def _measure(topo, n, steps, calls):
     # throughput is magnitude-independent
     wT = (init_population(topo, jax.random.key(0), n) * 0.05).T
     run = _bench_fn(topo, steps)
+    if stage:
+        _hb(stage, "init", n=n, steps=steps)
 
     _ = float(run(wT)[1])  # compile (persistent-cache served) + warm
-    t0 = time.perf_counter()
-    for _ in range(calls):
+    if stage:
+        _hb(stage, "compiled+warm")
+    # time each dispatch individually so the liveness heartbeat between
+    # calls never contaminates the measured window
+    dt = 0.0
+    for i in range(calls):
+        t0 = time.perf_counter()
         _ = float(run(wT)[1])  # scalar readback forces completion
-    dt = time.perf_counter() - t0
+        dt += time.perf_counter() - t0
+        if stage:
+            _hb(stage, "call", call=i + 1, calls=calls)
     return n * steps * calls / dt
 
 
@@ -151,6 +171,8 @@ def _precompile(topo, shapes):
         rows.append({"n": n, "steps": steps,
                      "lower_s": round(e.lower_s, 3),
                      "compile_s": round(e.compile_s, 3)})
+        _hb("precompile", "compiled", n=n, steps=steps,
+            compile_s=round(e.compile_s, 3))
     return rows
 
 
@@ -171,6 +193,7 @@ def _child_stage(stage: str) -> None:
     else:
         platform, fell_back = ensure_backend(retries=3, sleep_s=10.0,
                                              fallback_cpu=True)
+    _hb(stage, "backend", platform=platform)
     import jax
 
     from srnn_tpu import Topology
@@ -197,13 +220,13 @@ def _child_stage(stage: str) -> None:
     if stage == "ramp":
         # tiny shapes — proves compile + execute end-to-end and leaves a
         # nonzero fail-soft number if the full run dies
-        apps = _measure(topo, RAMP_N, RAMP_STEPS, 1)
+        apps = _measure(topo, RAMP_N, RAMP_STEPS, 1, stage=stage)
     elif on_cpu:
         # degraded run: the full 1M x 2000-step workload would take hours
         # on host CPU; report a reduced honest measurement
-        apps = _measure(topo, 100_000, 20, 1)
+        apps = _measure(topo, 100_000, 20, 1, stage=stage)
     else:
-        apps = _measure(topo, N, STEPS_PER_CALL, CALLS)
+        apps = _measure(topo, N, STEPS_PER_CALL, CALLS, stage=stage)
     out = {
         "apps_per_chip": apps / jax.device_count(),
         "device_count": jax.device_count(),
@@ -223,9 +246,10 @@ def _child_stage(stage: str) -> None:
 
 def _run_child(stage: str, timeout: float, env: dict):
     """Spawn one stage as a fresh process.  Returns (result_dict | None,
-    error_str | None).  On timeout the child is killed — a wedged backend
-    dies with its process, which an in-process retry provably cannot do
-    (BENCH_r03)."""
+    error_str | None, last_heartbeat | None).  On timeout the child is
+    killed — a wedged backend dies with its process, which an in-process
+    retry provably cannot do (BENCH_r03); its partial stdout still yields
+    the last heartbeat it printed, attributing WHERE the budget went."""
     cmd = [sys.executable, os.path.abspath(__file__), "--stage", stage]
     try:
         proc = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=None,
@@ -237,23 +261,35 @@ def _run_child(stage: str, timeout: float, env: dict):
         # rather than discarding a completed run
         out, rc = e.stdout, None
     parsed = _parse_result(out)
+    hb = _parse_last_heartbeat(out)
     if parsed is not None:
-        return parsed, None
+        return parsed, None, hb
     if rc is None:
-        return None, f"timeout>{timeout:.0f}s"
-    return None, f"rc={rc}, no result line"
+        return None, f"timeout>{timeout:.0f}s", hb
+    return None, f"rc={rc}, no result line", hb
 
 
-def _parse_result(stdout_bytes):
+def _scan_sentinel(stdout_bytes, sentinel):
     if not stdout_bytes:
         return None
     for line in reversed(stdout_bytes.decode(errors="replace").splitlines()):
-        if line.startswith(_SENTINEL):
+        if line.startswith(sentinel):
             try:
-                return json.loads(line[len(_SENTINEL):])
+                return json.loads(line[len(sentinel):])
             except json.JSONDecodeError:
-                return None
+                # a killed child's LAST line may be torn or interleaved
+                # with C++ runtime noise — keep scanning for an earlier
+                # intact row rather than discarding the whole trail
+                continue
     return None
+
+
+def _parse_result(stdout_bytes):
+    return _scan_sentinel(stdout_bytes, _SENTINEL)
+
+
+def _parse_last_heartbeat(stdout_bytes):
+    return _scan_sentinel(stdout_bytes, _HB_SENTINEL)
 
 
 def main():
@@ -277,6 +313,11 @@ def main():
 def _orchestrate(result):
     t_start = time.monotonic()
     errors = []
+    # per-attempt heartbeat trail in the emitted JSON: every child attempt
+    # gets a start/end/outcome row (+ the child's last milestone heartbeat
+    # when it timed out), so a bad round's BENCH_*.json names which stage
+    # and which step ate the deadline instead of just "deadline exhausted"
+    stage_log = result["stage_log"] = []
 
     env = dict(os.environ)
     # persistent compile cache: a retried stage skips the compile that
@@ -295,22 +336,34 @@ def _orchestrate(result):
         return DEADLINE_S - (time.monotonic() - t_start)
 
     def run_stage(stage, attempts, per_timeout, stage_env=None, reserve=0.0,
-                  retry_timeout=None):
+                  retry_timeout=None, tag=None):
         # retries never get a LONGER leash than the stage's own timeout
         # (an operator-lowered SRNN_BENCH_RAMP_TIMEOUT_S must win)
         retry_want = per_timeout if retry_timeout is None \
             else min(per_timeout, retry_timeout)
         for i in range(attempts):
             if remaining() - reserve <= 10:
-                errors.append(f"{stage}: deadline exhausted"
+                errors.append(f"{tag or stage}: deadline exhausted"
                               + (" (rescue slice reserved)" if reserve else ""))
+                stage_log.append({"stage": tag or stage, "attempt": i + 1,
+                                  "outcome": "skipped: deadline exhausted",
+                                  "t_start_s": round(time.monotonic()
+                                                     - t_start, 1)})
                 return None
             want = per_timeout if i == 0 else retry_want
             t = min(want, remaining() - reserve)
-            r, err = _run_child(stage, t, stage_env or env)
+            att = {"stage": tag or stage, "attempt": i + 1,
+                   "timeout_s": round(t, 1),
+                   "t_start_s": round(time.monotonic() - t_start, 1)}
+            r, err, hb = _run_child(stage, t, stage_env or env)
+            att["t_end_s"] = round(time.monotonic() - t_start, 1)
+            att["outcome"] = "ok" if r is not None else err
+            if hb is not None:
+                att["last_heartbeat"] = hb
+            stage_log.append(att)
             if r is not None:
                 return r
-            errors.append(f"{stage} attempt {i + 1}/{attempts}: {err}")
+            errors.append(f"{tag or stage} attempt {i + 1}/{attempts}: {err}")
             print(f"bench: {errors[-1]}; retrying in a fresh process"
                   if i + 1 < attempts else f"bench: {errors[-1]}",
                   file=sys.stderr, flush=True)
@@ -344,7 +397,8 @@ def _orchestrate(result):
         # the hang hook simulates a wedged TUNNEL; a CPU-pinned rescue child
         # never dials it, so the simulated wedge does not apply
         cpu_env.pop("SRNN_BENCH_TEST_HANG", None)
-        return run_stage("full", 1, 300.0, stage_env=cpu_env)
+        return run_stage("full", 1, 300.0, stage_env=cpu_env,
+                         tag="cpu-rescue")
 
     # compile-only warm-up: one bounded child fills the shared persistent
     # cache (ramp + full shapes), so the measurement children below

@@ -177,6 +177,58 @@ def row_memory(mega_size: int) -> dict:
     return out
 
 
+TELEMETRY_N = 2048
+TELEMETRY_GENS = 50
+
+
+def row_telemetry() -> dict:
+    """Walltime overhead of the in-scan telemetry metrics carry: the
+    metered chunk program (``evolve(..., metrics=True)``) vs the plain
+    one, same dynamics — the acceptance bound is <= ~2% overhead.
+
+    Plain/metered calls are INTERLEAVED and compared by median: on a
+    shared host, back-to-back blocks drift by more than the effect being
+    measured (observed ±10% block-to-block on idle-ish CPU)."""
+    import statistics
+
+    import jax
+
+    from srnn_tpu.soup import evolve, seed
+
+    cfg = _config(TELEMETRY_N)
+    st = seed(cfg, jax.random.key(0))
+    calls = 20
+
+    def plain():
+        s = evolve(cfg, st, generations=TELEMETRY_GENS)
+        return float(s.next_uid)  # scalar readback forces completion
+
+    def metered():
+        s, _m = evolve(cfg, st, generations=TELEMETRY_GENS, metrics=True)
+        return float(s.next_uid)
+
+    plain(), metered(), plain(), metered()  # compile + warm both
+    tp, tm = [], []
+    for _ in range(calls):
+        t0 = time.perf_counter()
+        plain()
+        tp.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        metered()
+        tm.append(time.perf_counter() - t0)
+    plain_s = statistics.median(tp)
+    metered_s = statistics.median(tm)
+    return {
+        "row": "telemetry",
+        "n": TELEMETRY_N,
+        "generations": TELEMETRY_GENS,
+        "calls": calls,
+        "plain_ms_per_chunk": round(plain_s * 1e3, 3),
+        "metered_ms_per_chunk": round(metered_s * 1e3, 3),
+        "overhead_pct": round(100 * (metered_s / plain_s - 1), 2),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--stage", default=None, help=argparse.SUPPRESS)
@@ -190,11 +242,12 @@ def main(argv=None) -> int:
         _child_compile()
         return 0
 
-    rows = [row_compile(), row_dispatch(), row_memory(args.mega_size)]
+    rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
+            row_telemetry()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m = rows
+        c, d, m, t = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -207,6 +260,10 @@ def main(argv=None) -> int:
               f"{m['donated_population_aliased']}); plain allocates "
               f"{m['plain_extra_output_bytes']} B of fresh outputs",
               file=sys.stderr)
+        print(f"# telemetry(N={t['n']}, G={t['generations']}): metered "
+              f"{t['metered_ms_per_chunk']:.1f}ms vs plain "
+              f"{t['plain_ms_per_chunk']:.1f}ms per chunk "
+              f"({t['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
     return 0
 
 

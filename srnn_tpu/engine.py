@@ -68,8 +68,10 @@ def _run_fixpoint(
 
     def step(carry, _):
         w, steps = carry
-        active = ~is_diverged(w) & ~_is_fixpoint_batch(topo, w, epsilon)
-        new_w = jnp.where(active[:, None], _apply_self_batch(topo, w), w)
+        with jax.named_scope("engine.classify"):
+            active = ~is_diverged(w) & ~_is_fixpoint_batch(topo, w, epsilon)
+        with jax.named_scope("engine.self_apply"):
+            new_w = jnp.where(active[:, None], _apply_self_batch(topo, w), w)
         out = new_w if record else None
         return (new_w, steps + active), out
 
@@ -116,9 +118,13 @@ def _run_mixed_fixpoint(
 
     def step(carry, _):
         w, steps, loss = carry
-        active = ~is_diverged(w) & ~_is_fixpoint_batch(topo, w, epsilon)
-        attacked = _apply_self_batch(topo, w)
-        trained, new_loss = train_n(attacked) if trains_per_application else (attacked, loss)
+        with jax.named_scope("engine.classify"):
+            active = ~is_diverged(w) & ~_is_fixpoint_batch(topo, w, epsilon)
+        with jax.named_scope("engine.self_apply"):
+            attacked = _apply_self_batch(topo, w)
+        with jax.named_scope("engine.train"):
+            trained, new_loss = train_n(attacked) if trains_per_application \
+                else (attacked, loss)
         new_w = jnp.where(active[:, None], trained, w)
         out = new_w if record else None
         return (new_w, steps + active, jnp.where(active, new_loss, loss)), out
@@ -170,6 +176,7 @@ def _run_training(
     is a bitwise no-op for aggregating/recurrent (asserted in tests);
     ``None`` keeps the deterministic enumeration order."""
 
+    @jax.named_scope("engine.train_epoch")
     def epoch(w, e_idx):
         if shuffle_key is None:
             new_w, loss = jax.vmap(

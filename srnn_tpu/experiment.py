@@ -251,11 +251,17 @@ class Experiment:
         if event_fields:
             self.event(message=str(message), **event_fields)
 
-    def event(self, **fields):
-        """Append one structured record to ``events.jsonl``."""
+    def event(self, _fsync: bool = False, **fields):
+        """Append one structured record to ``events.jsonl``.
+
+        Every write is flushed so a killed run keeps its structured tail;
+        ``_fsync=True`` (heartbeats — telemetry liveness rows) additionally
+        forces the record to disk past the OS cache."""
         fields.setdefault("t", time.time() - self._t0)
         self._events.write(json.dumps(_jsonify(fields), default=str) + "\n")
         self._events.flush()
+        if _fsync:
+            os.fsync(self._events.fileno())
 
     def save_log(self, log_name: str = "log"):
         with open(os.path.join(self.dir, f"{log_name}.txt"), "w") as f:

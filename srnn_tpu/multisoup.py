@@ -175,29 +175,30 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
 
     # --- attack (cross-type, last-attacker-wins) ------------------------
-    if config.attacking_rate > 0:
-        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
-        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
-        att_idx = jax.ops.segment_max(
-            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt,
-            num_segments=n)
-        new_wTs = []
-        for b, vic in enumerate(config.topos):
-            att_b = jax.lax.dynamic_slice_in_dim(att_idx, offs[b],
-                                                 config.sizes[b])
-            out = wTs[b]
-            for a, atk in enumerate(config.topos):
-                mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
-                selfT = wTs[a][:, jnp.clip(att_b - offs[a], 0,
-                                           config.sizes[a] - 1)]
-                attacked = cross_apply_popmajor(atk, selfT, vic, wTs[b],
-                                                impl=config.apply_impl)
-                out = jnp.where(mask[None, :], attacked, out)
-            new_wTs.append(out)
-        wTs = tuple(new_wTs)
-    else:
-        attack_gate = jnp.zeros(n, bool)
-        attack_tgt = jnp.zeros(n, jnp.int32)
+    with jax.named_scope("multisoup.attack"):
+        if config.attacking_rate > 0:
+            attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+            attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+            att_idx = jax.ops.segment_max(
+                jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt,
+                num_segments=n)
+            new_wTs = []
+            for b, vic in enumerate(config.topos):
+                att_b = jax.lax.dynamic_slice_in_dim(att_idx, offs[b],
+                                                     config.sizes[b])
+                out = wTs[b]
+                for a, atk in enumerate(config.topos):
+                    mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
+                    selfT = wTs[a][:, jnp.clip(att_b - offs[a], 0,
+                                               config.sizes[a] - 1)]
+                    attacked = cross_apply_popmajor(atk, selfT, vic, wTs[b],
+                                                    impl=config.apply_impl)
+                    out = jnp.where(mask[None, :], attacked, out)
+                new_wTs.append(out)
+            wTs = tuple(new_wTs)
+        else:
+            attack_gate = jnp.zeros(n, bool)
+            attack_tgt = jnp.zeros(n, jnp.int32)
 
     all_uids = jnp.concatenate(state.uids)
 
@@ -210,46 +211,49 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
         sl = lambda arr: jax.lax.dynamic_slice_in_dim(arr, offs[t], n_t)
 
         # --- learn_from (same-type teachers, post-attack weights) -------
-        if config.learn_from_rate > 0:
-            learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
-            learn_tgt = jax.random.randint(
-                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
-            if config.learn_from_severity > 0:
-                learned, _ = learn_epochs_popmajor(
-                    topo, wT_t, wT_t[:, learn_tgt],
-                    config.learn_from_severity, config.lr, config.train_mode,
-                    config.train_impl)
-                wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
-            learn_cp = state.uids[t][learn_tgt]
-        else:
-            learn_gate = jnp.zeros(n_t, bool)
-            learn_cp = jnp.zeros(n_t, jnp.int32)
+        with jax.named_scope("multisoup.learn_from"):
+            if config.learn_from_rate > 0:
+                learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
+                learn_tgt = jax.random.randint(
+                    jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+                if config.learn_from_severity > 0:
+                    learned, _ = learn_epochs_popmajor(
+                        topo, wT_t, wT_t[:, learn_tgt],
+                        config.learn_from_severity, config.lr, config.train_mode,
+                        config.train_impl)
+                    wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
+                learn_cp = state.uids[t][learn_tgt]
+            else:
+                learn_gate = jnp.zeros(n_t, bool)
+                learn_cp = jnp.zeros(n_t, jnp.int32)
 
         # --- train ------------------------------------------------------
-        if config.train > 0:
-            wT_t, loss_t = train_epochs_popmajor(
-                topo, wT_t, config.train, config.lr, config.train_mode,
-                config.train_impl)
-        else:
-            loss_t = jnp.zeros(n_t, wT_t.dtype)
+        with jax.named_scope("multisoup.train"):
+            if config.train > 0:
+                wT_t, loss_t = train_epochs_popmajor(
+                    topo, wT_t, config.train, config.lr, config.train_mode,
+                    config.train_impl)
+            else:
+                loss_t = jnp.zeros(n_t, wT_t.dtype)
 
         # --- respawn (same draws/uid blocks as the row-major _respawn) --
-        dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
-            else jnp.zeros(n_t, bool)
-        dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
-            if config.remove_zero else jnp.zeros(n_t, bool)
-        dead = dead_div | dead_zero
-        fresh = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
-        wT_t = jnp.where(dead[None, :], fresh, wT_t)
-        rank = jnp.cumsum(dead) - 1
-        base = state.next_uid + total_deaths
-        uids_t = jnp.where(dead, base + rank.astype(jnp.int32),
-                           state.uids[t])
-        total_deaths = total_deaths + dead.sum(dtype=jnp.int32)
-        death_action = jnp.full(n_t, ACT_NONE, jnp.int32)
-        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
-        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
-        death_cp = jnp.where(dead, uids_t, -1)
+        with jax.named_scope("multisoup.respawn"):
+            dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
+                else jnp.zeros(n_t, bool)
+            dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
+                if config.remove_zero else jnp.zeros(n_t, bool)
+            dead = dead_div | dead_zero
+            fresh = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
+            wT_t = jnp.where(dead[None, :], fresh, wT_t)
+            rank = jnp.cumsum(dead) - 1
+            base = state.next_uid + total_deaths
+            uids_t = jnp.where(dead, base + rank.astype(jnp.int32),
+                               state.uids[t])
+            total_deaths = total_deaths + dead.sum(dtype=jnp.int32)
+            death_action = jnp.full(n_t, ACT_NONE, jnp.int32)
+            death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+            death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+            death_cp = jnp.where(dead, uids_t, -1)
 
         action, counterpart = _event_record(
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -293,12 +297,13 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
     weights = state.weights
 
     # --- attack (cross-type) -------------------------------------------
-    if config.attacking_rate > 0:
-        weights, attack_gate, attack_tgt = _attack_phase(
-            config, weights, k_ag, k_at)
-    else:
-        attack_gate = jnp.zeros(n, bool)
-        attack_tgt = jnp.zeros(n, jnp.int32)
+    with jax.named_scope("multisoup.attack"):
+        if config.attacking_rate > 0:
+            weights, attack_gate, attack_tgt = _attack_phase(
+                config, weights, k_ag, k_at)
+        else:
+            attack_gate = jnp.zeros(n, bool)
+            attack_tgt = jnp.zeros(n, jnp.int32)
 
     # global uid lookup for counterpart logging
     all_uids = jnp.concatenate(state.uids)
@@ -313,29 +318,33 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
         sl = lambda arr: jax.lax.dynamic_slice_in_dim(arr, offs[t], n_t)
 
         # --- learn_from (same-type teachers) ---------------------------
-        if config.learn_from_rate > 0:
-            learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
-            learn_tgt = jax.random.randint(
-                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
-            if config.learn_from_severity > 0:
-                learned, _ = jax.vmap(
-                    lambda wi, ow: _learn_epochs(tc, wi, ow))(w_t, w_t[learn_tgt])
-                w_t = jnp.where(learn_gate[:, None], learned, w_t)
-            learn_cp = state.uids[t][learn_tgt]
-        else:
-            learn_gate = jnp.zeros(n_t, bool)
-            learn_cp = jnp.zeros(n_t, jnp.int32)
+        with jax.named_scope("multisoup.learn_from"):
+            if config.learn_from_rate > 0:
+                learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
+                learn_tgt = jax.random.randint(
+                    jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+                if config.learn_from_severity > 0:
+                    learned, _ = jax.vmap(
+                        lambda wi, ow: _learn_epochs(tc, wi, ow))(w_t, w_t[learn_tgt])
+                    w_t = jnp.where(learn_gate[:, None], learned, w_t)
+                learn_cp = state.uids[t][learn_tgt]
+            else:
+                learn_gate = jnp.zeros(n_t, bool)
+                learn_cp = jnp.zeros(n_t, jnp.int32)
 
         # --- train ------------------------------------------------------
-        if config.train > 0:
-            w_t, loss_t = jax.vmap(lambda wi: _train_epochs(tc, wi))(w_t)
-        else:
-            loss_t = jnp.zeros(n_t, w_t.dtype)
+        with jax.named_scope("multisoup.train"):
+            if config.train > 0:
+                w_t, loss_t = jax.vmap(lambda wi: _train_epochs(tc, wi))(w_t)
+            else:
+                loss_t = jnp.zeros(n_t, w_t.dtype)
 
         # --- respawn with per-type uid blocks ---------------------------
-        w_t, uids_t, deaths, death_action, death_cp = _respawn(
-            tc, w_t, state.uids[t], state.next_uid + total_deaths, re_keys[t])
-        total_deaths = total_deaths + deaths
+        with jax.named_scope("multisoup.respawn"):
+            w_t, uids_t, deaths, death_action, death_cp = _respawn(
+                tc, w_t, state.uids[t], state.next_uid + total_deaths,
+                re_keys[t])
+            total_deaths = total_deaths + deaths
 
         action, counterpart = _event_record(
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -365,37 +374,64 @@ evolve_multi_step_donated = jax.jit(_evolve_multi_step,
 
 
 def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
-                  generations: int = 1) -> MultiSoupState:
+                  generations: int = 1, metrics: bool = False):
+    """Evolve ``generations`` mixed-soup steps as one scan.
+
+    ``metrics=True`` additionally returns one
+    ``telemetry.device.SoupMetrics`` carry PER TYPE, accumulated inside
+    the scan from the per-type event records (zero extra host syncs; the
+    evolved state is bit-identical to the unmetered program)."""
+    if metrics:
+        from .telemetry.device import (accumulate_soup_metrics,
+                                       zero_soup_metrics)
+
+        def acc(ms, ev):
+            return tuple(accumulate_soup_metrics(m, a, l) for m, a, l
+                         in zip(ms, ev.action, ev.loss))
+
+        m0 = tuple(zero_soup_metrics() for _ in config.topos)
+    else:
+        m0 = None
+
     if config.layout == "popmajor":
         # keep every per-type carry transposed across the whole run: one
         # transpose per type at entry/exit instead of two per generation
         _check_popmajor_multi(config)
 
         def body_t(carry, _):
-            s, wTs = carry
-            new_s, _ev, new_wTs = _evolve_multi_popmajor(config, s, wTs)
-            return (new_s, new_wTs), None
+            s, wTs, ms = carry
+            new_s, ev, new_wTs = _evolve_multi_popmajor(config, s, wTs)
+            if metrics:
+                ms = acc(ms, ev)
+            return (new_s, new_wTs, ms), None
 
         light = state._replace(weights=tuple(
             jnp.zeros((0,), w.dtype) for w in state.weights))
-        (final, wTs), _ = jax.lax.scan(
-            body_t, (light, tuple(w.T for w in state.weights)), None,
+        (final, wTs, ms), _ = jax.lax.scan(
+            body_t, (light, tuple(w.T for w in state.weights), m0), None,
             length=generations)
-        return final._replace(weights=tuple(wT.T for wT in wTs))
+        final = final._replace(weights=tuple(wT.T for wT in wTs))
+        return (final, ms) if metrics else final
 
-    def body(s, _):
-        new_s, _ev = evolve_multi_step(config, s)
-        return new_s, None
+    def body(carry, _):
+        s, ms = carry
+        new_s, ev = evolve_multi_step(config, s)
+        if metrics:
+            ms = acc(ms, ev)
+        return (new_s, ms), None
 
-    final, _ = jax.lax.scan(body, state, None, length=generations)
-    return final
+    (final, ms), _ = jax.lax.scan(body, (state, m0), None,
+                                  length=generations)
+    return (final, ms) if metrics else final
 
 
 #: jitted multi-generation mixed-soup run + its buffer-donating twin
 #: (mega-run hot loops; state rebound chunk over chunk).
-evolve_multi = jax.jit(_evolve_multi, static_argnames=("config", "generations"))
+evolve_multi = jax.jit(_evolve_multi,
+                       static_argnames=("config", "generations", "metrics"))
 evolve_multi_donated = jax.jit(_evolve_multi,
-                               static_argnames=("config", "generations"),
+                               static_argnames=("config", "generations",
+                                                "metrics"),
                                donate_argnums=(1,))
 
 

@@ -123,65 +123,69 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
             return jax.lax.dynamic_slice_in_dim(arr, start, n_loc)
 
         # --- attack on local victims (T^2 masked cross-apply) -----------
-        if config.attacking_rate > 0:
-            att_b = sl(att_idx)
-            out = w_t
-            for a, attacker_topo in enumerate(config.topos):
-                mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
-                rows = all_w[a][jnp.clip(att_b - offs[a], 0,
-                                         config.sizes[a] - 1)]
-                attacked = jax.vmap(
-                    lambda s, v: cross_apply(attacker_topo, s, topo, v)
-                )(rows, w_t)
-                out = jnp.where(mask[:, None], attacked, out)
-            w_t = out
+        with jax.named_scope("multisoup.attack"):
+            if config.attacking_rate > 0:
+                att_b = sl(att_idx)
+                out = w_t
+                for a, attacker_topo in enumerate(config.topos):
+                    mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
+                    rows = all_w[a][jnp.clip(att_b - offs[a], 0,
+                                             config.sizes[a] - 1)]
+                    attacked = jax.vmap(
+                        lambda s, v: cross_apply(attacker_topo, s, topo, v)
+                    )(rows, w_t)
+                    out = jnp.where(mask[:, None], attacked, out)
+                w_t = out
 
         # --- learn_from (same-type teachers, POST-attack re-gather) -----
-        if config.learn_from_rate > 0:
-            learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
-                < config.learn_from_rate
-            learn_tgt_full = jax.random.randint(
-                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
-            learn_tgt = jax.lax.dynamic_slice_in_dim(
-                learn_tgt_full, d * n_loc, n_loc)
-            if config.learn_from_severity > 0:
-                post_attack = jax.lax.all_gather(w_t, SOUP_AXIS, tiled=True)
-                learned, _ = jax.vmap(
-                    lambda wi, ow: _learn_epochs(tc, wi, ow)
-                )(w_t, post_attack[learn_tgt])
-                w_t = jnp.where(learn_gate[:, None], learned, w_t)
-            learn_cp = all_uids_t[t][learn_tgt]
-        else:
-            learn_gate = jnp.zeros(n_loc, bool)
-            learn_cp = jnp.zeros(n_loc, jnp.int32)
+        with jax.named_scope("multisoup.learn_from"):
+            if config.learn_from_rate > 0:
+                learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
+                    < config.learn_from_rate
+                learn_tgt_full = jax.random.randint(
+                    jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+                learn_tgt = jax.lax.dynamic_slice_in_dim(
+                    learn_tgt_full, d * n_loc, n_loc)
+                if config.learn_from_severity > 0:
+                    post_attack = jax.lax.all_gather(w_t, SOUP_AXIS, tiled=True)
+                    learned, _ = jax.vmap(
+                        lambda wi, ow: _learn_epochs(tc, wi, ow)
+                    )(w_t, post_attack[learn_tgt])
+                    w_t = jnp.where(learn_gate[:, None], learned, w_t)
+                learn_cp = all_uids_t[t][learn_tgt]
+            else:
+                learn_gate = jnp.zeros(n_loc, bool)
+                learn_cp = jnp.zeros(n_loc, jnp.int32)
 
         # --- train ------------------------------------------------------
-        if config.train > 0:
-            w_t, loss_t = jax.vmap(lambda wi: _train_epochs(tc, wi))(w_t)
-        else:
-            loss_t = jnp.zeros(n_loc, w_t.dtype)
+        with jax.named_scope("multisoup.train"):
+            if config.train > 0:
+                w_t, loss_t = jax.vmap(lambda wi: _train_epochs(tc, wi))(w_t)
+            else:
+                loss_t = jnp.zeros(n_loc, w_t.dtype)
 
         # --- respawn: global per-type dead-rank, replicated fresh draws -
-        dead_div = is_diverged(w_t) if tc.remove_divergent \
-            else jnp.zeros(n_loc, bool)
-        dead_zero = (is_zero(w_t, tc.epsilon) & ~dead_div) \
-            if tc.remove_zero else jnp.zeros(n_loc, bool)
-        dead = dead_div | dead_zero
-        all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
-        rank = jnp.cumsum(all_dead) - 1
-        rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
-        fresh = fresh_rows(topo, re_keys[t], n_t, config.respawn_draws)
-        fresh_loc = jax.lax.dynamic_slice_in_dim(fresh, d * n_loc, n_loc,
-                                                 axis=0)
-        w_t = jnp.where(dead[:, None], fresh_loc, w_t)
-        uid_base = state.next_uid + total_deaths
-        uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
-                           state.uids[t])
-        total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
-        death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
-        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
-        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
-        death_cp = jnp.where(dead, uids_t, -1)
+        with jax.named_scope("multisoup.respawn"):
+            dead_div = is_diverged(w_t) if tc.remove_divergent \
+                else jnp.zeros(n_loc, bool)
+            dead_zero = (is_zero(w_t, tc.epsilon) & ~dead_div) \
+                if tc.remove_zero else jnp.zeros(n_loc, bool)
+            dead = dead_div | dead_zero
+            all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
+            rank = jnp.cumsum(all_dead) - 1
+            rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
+            fresh = fresh_rows(topo, re_keys[t], n_t, config.respawn_draws)
+            fresh_loc = jax.lax.dynamic_slice_in_dim(fresh, d * n_loc, n_loc,
+                                                     axis=0)
+            w_t = jnp.where(dead[:, None], fresh_loc, w_t)
+            uid_base = state.next_uid + total_deaths
+            uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
+                               state.uids[t])
+            total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
+            death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+            death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+            death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+            death_cp = jnp.where(dead, uids_t, -1)
 
         action, counterpart = _event_record(
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -248,69 +252,73 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
             return jax.lax.dynamic_slice_in_dim(arr, start, n_loc)
 
         # --- attack on local victims (T^2 masked lane cross-apply) ------
-        if config.attacking_rate > 0:
-            att_b = sl(att_idx)
-            out = wT_t
-            for a, attacker_topo in enumerate(config.topos):
-                mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
-                selfT = all_wT[a][:, jnp.clip(att_b - offs[a], 0,
-                                              config.sizes[a] - 1)]
-                attacked = cross_apply_popmajor(attacker_topo, selfT, topo,
-                                                wT_t,
-                                                impl=config.apply_impl)
-                out = jnp.where(mask[None, :], attacked, out)
-            wT_t = out
+        with jax.named_scope("multisoup.attack"):
+            if config.attacking_rate > 0:
+                att_b = sl(att_idx)
+                out = wT_t
+                for a, attacker_topo in enumerate(config.topos):
+                    mask = (att_b >= offs[a]) & (att_b < offs[a + 1])
+                    selfT = all_wT[a][:, jnp.clip(att_b - offs[a], 0,
+                                                  config.sizes[a] - 1)]
+                    attacked = cross_apply_popmajor(attacker_topo, selfT, topo,
+                                                    wT_t,
+                                                    impl=config.apply_impl)
+                    out = jnp.where(mask[None, :], attacked, out)
+                wT_t = out
 
         # --- learn_from (same-type teachers, POST-attack re-gather) -----
-        if config.learn_from_rate > 0:
-            learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
-                < config.learn_from_rate
-            learn_tgt_full = jax.random.randint(
-                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
-            learn_tgt = jax.lax.dynamic_slice_in_dim(
-                learn_tgt_full, d * n_loc, n_loc)
-            if config.learn_from_severity > 0:
-                post_attack = jax.lax.all_gather(wT_t, SOUP_AXIS, axis=1,
-                                                 tiled=True)
-                learned, _ = learn_epochs_popmajor(
-                    topo, wT_t, post_attack[:, learn_tgt],
-                    config.learn_from_severity, config.lr, config.train_mode,
-                    config.train_impl)
-                wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
-            learn_cp = all_uids_t[t][learn_tgt]
-        else:
-            learn_gate = jnp.zeros(n_loc, bool)
-            learn_cp = jnp.zeros(n_loc, jnp.int32)
+        with jax.named_scope("multisoup.learn_from"):
+            if config.learn_from_rate > 0:
+                learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
+                    < config.learn_from_rate
+                learn_tgt_full = jax.random.randint(
+                    jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+                learn_tgt = jax.lax.dynamic_slice_in_dim(
+                    learn_tgt_full, d * n_loc, n_loc)
+                if config.learn_from_severity > 0:
+                    post_attack = jax.lax.all_gather(wT_t, SOUP_AXIS, axis=1,
+                                                     tiled=True)
+                    learned, _ = learn_epochs_popmajor(
+                        topo, wT_t, post_attack[:, learn_tgt],
+                        config.learn_from_severity, config.lr, config.train_mode,
+                        config.train_impl)
+                    wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
+                learn_cp = all_uids_t[t][learn_tgt]
+            else:
+                learn_gate = jnp.zeros(n_loc, bool)
+                learn_cp = jnp.zeros(n_loc, jnp.int32)
 
         # --- train ------------------------------------------------------
-        if config.train > 0:
-            wT_t, loss_t = train_epochs_popmajor(
-                topo, wT_t, config.train, config.lr, config.train_mode,
-                config.train_impl)
-        else:
-            loss_t = jnp.zeros(n_loc, wT_t.dtype)
+        with jax.named_scope("multisoup.train"):
+            if config.train > 0:
+                wT_t, loss_t = train_epochs_popmajor(
+                    topo, wT_t, config.train, config.lr, config.train_mode,
+                    config.train_impl)
+            else:
+                loss_t = jnp.zeros(n_loc, wT_t.dtype)
 
         # --- respawn: global per-type dead-rank, replicated fresh draws -
-        dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
-            else jnp.zeros(n_loc, bool)
-        dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
-            if config.remove_zero else jnp.zeros(n_loc, bool)
-        dead = dead_div | dead_zero
-        all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
-        rank = jnp.cumsum(all_dead) - 1
-        rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
-        freshT = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
-        freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, d * n_loc, n_loc,
-                                                  axis=1)
-        wT_t = jnp.where(dead[None, :], freshT_loc, wT_t)
-        uid_base = state.next_uid + total_deaths
-        uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
-                           state.uids[t])
-        total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
-        death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
-        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
-        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
-        death_cp = jnp.where(dead, uids_t, -1)
+        with jax.named_scope("multisoup.respawn"):
+            dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
+                else jnp.zeros(n_loc, bool)
+            dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
+                if config.remove_zero else jnp.zeros(n_loc, bool)
+            dead = dead_div | dead_zero
+            all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
+            rank = jnp.cumsum(all_dead) - 1
+            rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
+            freshT = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
+            freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, d * n_loc, n_loc,
+                                                      axis=1)
+            wT_t = jnp.where(dead[None, :], freshT_loc, wT_t)
+            uid_base = state.next_uid + total_deaths
+            uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
+                               state.uids[t])
+            total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
+            death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+            death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+            death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+            death_cp = jnp.where(dead, uids_t, -1)
 
         action, counterpart = _event_record(
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -367,62 +375,104 @@ sharded_evolve_multi_step_donated = jax.jit(
     donate_argnums=(2,))
 
 
+def _multi_metrics_specs(t: int):
+    """Replicated placement of the per-type ``SoupMetrics`` carries
+    (global after the in-body psum)."""
+    from ..telemetry.device import SoupMetrics
+
+    return tuple(SoupMetrics(generations=P(), actions=P(), loss_sum=P())
+                 for _ in range(t))
+
+
 def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
-                          state: MultiSoupState, generations: int = 1
-                          ) -> MultiSoupState:
+                          state: MultiSoupState, generations: int = 1,
+                          metrics: bool = False):
     """Scan ``generations`` sharded mixed-soup steps inside ONE shard_map
     (collectives stay inside the scan).  The popmajor layout keeps every
-    per-type local shard transposed (P_t, N_t/D) across generations."""
+    per-type local shard transposed (P_t, N_t/D) across generations.
+
+    ``metrics=True`` additionally returns the GLOBAL per-type
+    ``telemetry.device.SoupMetrics`` carries (per-shard accumulation
+    inside the scan, one psum per type at the shard boundary)."""
     if config.layout not in ("rowmajor", "popmajor"):
         raise ValueError(f"unknown multisoup layout {config.layout!r}")
+    if metrics:
+        from ..telemetry.device import (accumulate_soup_metrics,
+                                        psum_soup_metrics,
+                                        zero_soup_metrics)
+
+        def acc(ms, ev):
+            return tuple(accumulate_soup_metrics(m, a, l) for m, a, l
+                         in zip(ms, ev.action, ev.loss))
+
+        def flush(ms):
+            return tuple(psum_soup_metrics(m, SOUP_AXIS) for m in ms)
+
+    def m0():
+        return tuple(zero_soup_metrics() for _ in config.topos) \
+            if metrics else None
+
+    nt = len(config.topos)
+    out_specs = (_mstate_specs(nt), _multi_metrics_specs(nt)) if metrics \
+        else _mstate_specs(nt)
     if config.layout == "popmajor":
         _check_popmajor_multi(config)
-        def local_run_t(st: MultiSoupState) -> MultiSoupState:
+
+        def local_run_t(st: MultiSoupState):
             light = st._replace(weights=tuple(
                 jnp.zeros((0,), w.dtype) for w in st.weights))
 
             def body(carry, _):
-                s, wTs = carry
-                new_s, _ev, new_wTs = _local_evolve_multi_popmajor(
+                s, wTs, ms = carry
+                new_s, ev, new_wTs = _local_evolve_multi_popmajor(
                     config, s, wTs)
-                return (new_s, new_wTs), None
+                if metrics:
+                    ms = acc(ms, ev)
+                return (new_s, new_wTs, ms), None
 
-            (final, wTs), _ = jax.lax.scan(
-                body, (light, tuple(w.T for w in st.weights)), None,
+            (final, wTs, ms), _ = jax.lax.scan(
+                body, (light, tuple(w.T for w in st.weights), m0()), None,
                 length=generations)
-            return final._replace(weights=tuple(wT.T for wT in wTs))
+            final = final._replace(weights=tuple(wT.T for wT in wTs))
+            return (final, flush(ms)) if metrics else final
 
         fn = shard_map(
             local_run_t,
             mesh=mesh,
-            in_specs=(_mstate_specs(len(config.topos)),),
-            out_specs=_mstate_specs(len(config.topos)),
+            in_specs=(_mstate_specs(nt),),
+            out_specs=out_specs,
             check_vma=False,
         )
         return fn(state)
 
-    def local_run(st: MultiSoupState) -> MultiSoupState:
-        def body(s, _):
-            new_s, _ev = _local_evolve_multi(config, s)
-            return new_s, None
+    def local_run(st: MultiSoupState):
+        def body(carry, _):
+            s, ms = carry
+            new_s, ev = _local_evolve_multi(config, s)
+            if metrics:
+                ms = acc(ms, ev)
+            return (new_s, ms), None
 
-        final, _ = jax.lax.scan(body, st, None, length=generations)
-        return final
+        (final, ms), _ = jax.lax.scan(body, (st, m0()), None,
+                                      length=generations)
+        return (final, flush(ms)) if metrics else final
 
     fn = shard_map(
         local_run,
         mesh=mesh,
-        in_specs=(_mstate_specs(len(config.topos)),),
-        out_specs=_mstate_specs(len(config.topos)),
+        in_specs=(_mstate_specs(nt),),
+        out_specs=out_specs,
         check_vma=False,
     )
     return fn(state)
 
 
 sharded_evolve_multi = jax.jit(
-    _sharded_evolve_multi, static_argnames=("config", "mesh", "generations"))
+    _sharded_evolve_multi,
+    static_argnames=("config", "mesh", "generations", "metrics"))
 sharded_evolve_multi_donated = jax.jit(
-    _sharded_evolve_multi, static_argnames=("config", "mesh", "generations"),
+    _sharded_evolve_multi,
+    static_argnames=("config", "mesh", "generations", "metrics"),
     donate_argnums=(2,))
 
 

@@ -109,60 +109,64 @@ def _local_evolve(config: SoupConfig, state: SoupState,
     all_w = jax.lax.all_gather(w_loc, axes, tiled=True)  # (N, P)
 
     # --- attack ---------------------------------------------------------
-    if config.attacking_rate > 0:
-        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
-        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
-        att_idx = jax.ops.segment_max(
-            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
-        att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
-        has_attacker = att_loc >= 0
-        attacker_w = all_w[jnp.clip(att_loc, 0)]
-        attacked = jax.vmap(lambda s, t: apply_to_weights(topo, s, t))(attacker_w, w_loc)
-        w_loc = jnp.where(has_attacker[:, None], attacked, w_loc)
-        attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
-        attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
-    else:
-        attack_gate_loc = jnp.zeros(n_loc, bool)
-        attack_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+    with jax.named_scope("soup.attack"):
+        if config.attacking_rate > 0:
+            attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+            attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+            att_idx = jax.ops.segment_max(
+                jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+            att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
+            has_attacker = att_loc >= 0
+            attacker_w = all_w[jnp.clip(att_loc, 0)]
+            attacked = jax.vmap(lambda s, t: apply_to_weights(topo, s, t))(attacker_w, w_loc)
+            w_loc = jnp.where(has_attacker[:, None], attacked, w_loc)
+            attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
+            attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
+        else:
+            attack_gate_loc = jnp.zeros(n_loc, bool)
+            attack_tgt_loc = jnp.zeros(n_loc, jnp.int32)
 
     # --- learn_from -----------------------------------------------------
     # imitation targets come from the start-of-generation gather; the
     # single-device path uses post-attack weights, an intra-generation
     # staleness difference only for the rare learn-from-an-attacked-victim
-    if config.learn_from_rate > 0:
-        learn_gate = jax.random.uniform(k_lg, (n,)) < config.learn_from_rate
-        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
-        learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start, n_loc)
-        learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
-        if config.learn_from_severity > 0:
-            learned, _ = jax.vmap(lambda wi, ow: _learn_epochs(config, wi, ow))(
-                w_loc, all_w[learn_tgt_loc])
-            w_loc = jnp.where(learn_gate_loc[:, None], learned, w_loc)
-    else:
-        learn_gate_loc = jnp.zeros(n_loc, bool)
-        learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+    with jax.named_scope("soup.learn_from"):
+        if config.learn_from_rate > 0:
+            learn_gate = jax.random.uniform(k_lg, (n,)) < config.learn_from_rate
+            learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+            learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start, n_loc)
+            learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
+            if config.learn_from_severity > 0:
+                learned, _ = jax.vmap(lambda wi, ow: _learn_epochs(config, wi, ow))(
+                    w_loc, all_w[learn_tgt_loc])
+                w_loc = jnp.where(learn_gate_loc[:, None], learned, w_loc)
+        else:
+            learn_gate_loc = jnp.zeros(n_loc, bool)
+            learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
 
     # --- train ----------------------------------------------------------
-    if config.train > 0:
-        w_loc, train_loss = jax.vmap(lambda wi: _train_epochs(config, wi))(w_loc)
-    else:
-        train_loss = jnp.zeros(n_loc, w_loc.dtype)
+    with jax.named_scope("soup.train"):
+        if config.train > 0:
+            w_loc, train_loss = jax.vmap(lambda wi: _train_epochs(config, wi))(w_loc)
+        else:
+            train_loss = jnp.zeros(n_loc, w_loc.dtype)
 
     # --- respawn with per-device uid blocks -----------------------------
     # pre-count deaths to carve a uid block for this device, then reuse the
     # single-device respawn with that block base — one semantic source
-    dead_now = jnp.zeros(n_loc, bool)
-    if config.remove_divergent:
-        dead_now = dead_now | is_diverged(w_loc)
-    if config.remove_zero:
-        dead_now = dead_now | is_zero(w_loc, config.epsilon)
-    local_deaths = dead_now.sum(dtype=jnp.int32)
-    deaths_by_dev = jax.lax.all_gather(local_deaths, axes)  # (D,)
-    my_uid_base = state.next_uid + jnp.sum(
-        jnp.where(jnp.arange(deaths_by_dev.shape[0]) < d, deaths_by_dev, 0))
-    new_w, new_uids, _, death_action, death_cp = _respawn(
-        config, w_loc, state.uids, my_uid_base, jax.random.fold_in(k_re, d))
-    next_uid = state.next_uid + deaths_by_dev.sum()
+    with jax.named_scope("soup.respawn"):
+        dead_now = jnp.zeros(n_loc, bool)
+        if config.remove_divergent:
+            dead_now = dead_now | is_diverged(w_loc)
+        if config.remove_zero:
+            dead_now = dead_now | is_zero(w_loc, config.epsilon)
+        local_deaths = dead_now.sum(dtype=jnp.int32)
+        deaths_by_dev = jax.lax.all_gather(local_deaths, axes)  # (D,)
+        my_uid_base = state.next_uid + jnp.sum(
+            jnp.where(jnp.arange(deaths_by_dev.shape[0]) < d, deaths_by_dev, 0))
+        new_w, new_uids, _, death_action, death_cp = _respawn(
+            config, w_loc, state.uids, my_uid_base, jax.random.fold_in(k_re, d))
+        next_uid = state.next_uid + deaths_by_dev.sum()
 
     # --- event record (last action wins, shared tail) -------------------
     # uid of a global index: gather from the uid table
@@ -210,90 +214,94 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
 
     # --- attack (soup.py:56-61); last-attacker-wins, same as single-device
-    if config.attacking_rate > 0:
-        all_wT = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
-        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
-        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
-        att_idx = jax.ops.segment_max(
-            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
-        att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
-        has_attacker = att_loc >= 0
-        if config.attack_impl == "compact":
-            from ..soup import _attack_capacity, _attack_popmajor_compact
+    with jax.named_scope("soup.attack"):
+        if config.attacking_rate > 0:
+            all_wT = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
+            attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+            attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+            att_idx = jax.ops.segment_max(
+                jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+            att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
+            has_attacker = att_loc >= 0
+            if config.attack_impl == "compact":
+                from ..soup import _attack_capacity, _attack_popmajor_compact
 
-            # per-shard capacity over the shard's own lane count; a shard
-            # that overflows falls back to full width for that step only
-            wT_loc = _attack_popmajor_compact(
-                topo, wT_loc, att_loc, has_attacker,
-                _attack_capacity(n_loc, config.attacking_rate),
-                source=all_wT)
+                # per-shard capacity over the shard's own lane count; a shard
+                # that overflows falls back to full width for that step only
+                wT_loc = _attack_popmajor_compact(
+                    topo, wT_loc, att_loc, has_attacker,
+                    _attack_capacity(n_loc, config.attacking_rate),
+                    source=all_wT)
+            else:
+                attacked = apply_popmajor(
+                    topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc,
+                    impl=config.apply_impl)
+                wT_loc = jnp.where(has_attacker[None, :], attacked, wT_loc)
+            attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
+            attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
         else:
-            attacked = apply_popmajor(
-                topo, all_wT[:, jnp.clip(att_loc, 0)], wT_loc,
-                impl=config.apply_impl)
-            wT_loc = jnp.where(has_attacker[None, :], attacked, wT_loc)
-        attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start, n_loc)
-        attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start, n_loc)
-    else:
-        attack_gate_loc = jnp.zeros(n_loc, bool)
-        attack_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+            attack_gate_loc = jnp.zeros(n_loc, bool)
+            attack_tgt_loc = jnp.zeros(n_loc, jnp.int32)
 
     # --- learn_from (soup.py:62-68): POST-attack re-gather for exact parity
-    if config.learn_from_rate > 0:
-        learn_gate = jax.random.uniform(k_lg, (n,)) < config.learn_from_rate
-        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
-        learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start, n_loc)
-        learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
-        if config.learn_from_severity > 0:
-            post_attack = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
-            if config.learn_from_impl == "compact":
-                from ..soup import (_attack_capacity,
-                                    _learn_popmajor_compact)
+    with jax.named_scope("soup.learn_from"):
+        if config.learn_from_rate > 0:
+            learn_gate = jax.random.uniform(k_lg, (n,)) < config.learn_from_rate
+            learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+            learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start, n_loc)
+            learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
+            if config.learn_from_severity > 0:
+                post_attack = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
+                if config.learn_from_impl == "compact":
+                    from ..soup import (_attack_capacity,
+                                        _learn_popmajor_compact)
 
-                wT_loc = _learn_popmajor_compact(
-                    config, wT_loc, learn_gate_loc, learn_tgt_loc,
-                    _attack_capacity(n_loc, config.learn_from_rate),
-                    source=post_attack)
-            else:
-                learned, _ = learn_epochs_popmajor(
-                    topo, wT_loc, post_attack[:, learn_tgt_loc],
-                    config.learn_from_severity, config.lr,
-                    config.train_mode, config.train_impl)
-                wT_loc = jnp.where(learn_gate_loc[None, :], learned, wT_loc)
-    else:
-        learn_gate_loc = jnp.zeros(n_loc, bool)
-        learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+                    wT_loc = _learn_popmajor_compact(
+                        config, wT_loc, learn_gate_loc, learn_tgt_loc,
+                        _attack_capacity(n_loc, config.learn_from_rate),
+                        source=post_attack)
+                else:
+                    learned, _ = learn_epochs_popmajor(
+                        topo, wT_loc, post_attack[:, learn_tgt_loc],
+                        config.learn_from_severity, config.lr,
+                        config.train_mode, config.train_impl)
+                    wT_loc = jnp.where(learn_gate_loc[None, :], learned, wT_loc)
+        else:
+            learn_gate_loc = jnp.zeros(n_loc, bool)
+            learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
 
     # --- train (soup.py:69-76) ------------------------------------------
-    if config.train > 0:
-        wT_loc, train_loss = train_epochs_popmajor(
-            topo, wT_loc, config.train, config.lr, config.train_mode,
-            config.train_impl)
-    else:
-        train_loss = jnp.zeros(n_loc, wT_loc.dtype)
+    with jax.named_scope("soup.train"):
+        if config.train > 0:
+            wT_loc, train_loss = train_epochs_popmajor(
+                topo, wT_loc, config.train, config.lr, config.train_mode,
+                config.train_impl)
+        else:
+            train_loss = jnp.zeros(n_loc, wT_loc.dtype)
 
     # --- respawn (soup.py:77-86): global-rank uids + replicated fresh draws
-    dead_div = is_diverged(wT_loc, axis=0) if config.remove_divergent \
-        else jnp.zeros(n_loc, bool)
-    dead_zero = (is_zero(wT_loc, config.epsilon, axis=0) & ~dead_div) \
-        if config.remove_zero else jnp.zeros(n_loc, bool)
-    dead = dead_div | dead_zero
-    all_dead = jax.lax.all_gather(dead, axes, tiled=True)  # (N,) device order
-    rank = jnp.cumsum(all_dead) - 1
-    rank_loc = jax.lax.dynamic_slice_in_dim(rank, start, n_loc)
-    # every device draws the same global fresh population and keeps its
-    # columns: bitwise-identical replacements to the single-device k_re
-    # stream (in either respawn_draws mode)
-    freshT = fresh_lanes(topo, k_re, n, config.respawn_draws)
-    freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, start, n_loc, axis=1)
-    wT_loc = jnp.where(dead[None, :], freshT_loc, wT_loc)
-    uids = jnp.where(dead, state.next_uid + rank_loc.astype(jnp.int32),
-                     state.uids)
-    next_uid = state.next_uid + all_dead.sum(dtype=jnp.int32)
-    death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
-    death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
-    death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
-    death_cp = jnp.where(dead, uids, -1)
+    with jax.named_scope("soup.respawn"):
+        dead_div = is_diverged(wT_loc, axis=0) if config.remove_divergent \
+            else jnp.zeros(n_loc, bool)
+        dead_zero = (is_zero(wT_loc, config.epsilon, axis=0) & ~dead_div) \
+            if config.remove_zero else jnp.zeros(n_loc, bool)
+        dead = dead_div | dead_zero
+        all_dead = jax.lax.all_gather(dead, axes, tiled=True)  # (N,) device order
+        rank = jnp.cumsum(all_dead) - 1
+        rank_loc = jax.lax.dynamic_slice_in_dim(rank, start, n_loc)
+        # every device draws the same global fresh population and keeps its
+        # columns: bitwise-identical replacements to the single-device k_re
+        # stream (in either respawn_draws mode)
+        freshT = fresh_lanes(topo, k_re, n, config.respawn_draws)
+        freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, start, n_loc, axis=1)
+        wT_loc = jnp.where(dead[None, :], freshT_loc, wT_loc)
+        uids = jnp.where(dead, state.next_uid + rank_loc.astype(jnp.int32),
+                         state.uids)
+        next_uid = state.next_uid + all_dead.sum(dtype=jnp.int32)
+        death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+        death_cp = jnp.where(dead, uids, -1)
 
     # --- event record (last action wins) --------------------------------
     all_uids = jax.lax.all_gather(state.uids, axes, tiled=True)
@@ -348,53 +356,86 @@ sharded_evolve_step_donated = jax.jit(_sharded_evolve_step,
                                       donate_argnums=(2,))
 
 
-def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState, generations: int = 1):
+def _metrics_specs():
+    """Replicated placement of a flushed ``SoupMetrics`` carry (global
+    after the in-body psum)."""
+    from ..telemetry.device import SoupMetrics
+
+    return SoupMetrics(generations=P(), actions=P(), loss_sum=P())
+
+
+def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
+                    generations: int = 1, metrics: bool = False):
     """Scan ``generations`` sharded steps (collectives stay inside the scan —
     one compiled program for the whole evolution).
 
     In the popmajor layout the whole scan runs inside ONE ``shard_map`` with
     the local shard kept transposed (P, N/D) across generations — one
     transpose at entry/exit instead of two per step, mirroring the
-    single-device ``soup.evolve`` fast path."""
+    single-device ``soup.evolve`` fast path.
+
+    ``metrics=True`` additionally returns the GLOBAL
+    ``telemetry.device.SoupMetrics`` carry: per-shard accumulation inside
+    the scan, one psum at the shard boundary — no per-generation host
+    syncs, state bit-identical to the unmetered program."""
     axes = _soup_axes(mesh)
+    if metrics:
+        from ..telemetry.device import (accumulate_soup_metrics,
+                                        psum_soup_metrics,
+                                        zero_soup_metrics)
     if config.layout == "popmajor":
         _check_popmajor(config)
 
-        def local_run(st: SoupState) -> SoupState:
+        def local_run(st: SoupState):
             light = st._replace(weights=jnp.zeros((0,), st.weights.dtype))
+            m0 = zero_soup_metrics() if metrics else None
 
             def body(carry, _):
-                s, wT = carry
-                new_s, _ev, new_wT = _local_evolve_popmajor(config, s, wT,
-                                                            axes)
-                return (new_s, new_wT), None
+                s, wT, m = carry
+                new_s, ev, new_wT = _local_evolve_popmajor(config, s, wT,
+                                                           axes)
+                if metrics:
+                    m = accumulate_soup_metrics(m, ev.action, ev.loss)
+                return (new_s, new_wT, m), None
 
-            (final, wT), _ = jax.lax.scan(
-                body, (light, st.weights.T), None, length=generations)
-            return final._replace(weights=wT.T)
+            (final, wT, m), _ = jax.lax.scan(
+                body, (light, st.weights.T, m0), None, length=generations)
+            final = final._replace(weights=wT.T)
+            if metrics:
+                return final, psum_soup_metrics(m, axes)
+            return final
 
         fn = shard_map(
             local_run,
             mesh=mesh,
             in_specs=(_state_specs(axes),),
-            out_specs=_state_specs(axes),
+            out_specs=(_state_specs(axes), _metrics_specs()) if metrics
+            else _state_specs(axes),
             check_vma=False,
         )
         return fn(state)
 
-    def body(fn_state, _):
-        new_state, _ev = sharded_evolve_step(config, mesh, fn_state)
-        return new_state, None
+    m0 = zero_soup_metrics() if metrics else None
 
-    final, _ = jax.lax.scan(body, state, None, length=generations)
-    return final
+    def body(carry, _):
+        fn_state, m = carry
+        new_state, ev = sharded_evolve_step(config, mesh, fn_state)
+        if metrics:
+            # events come back particle-sharded; the bincount reduction is
+            # GSPMD's to place (one small collective per generation)
+            m = accumulate_soup_metrics(m, ev.action, ev.loss)
+        return (new_state, m), None
+
+    (final, m), _ = jax.lax.scan(body, (state, m0), None, length=generations)
+    return (final, m) if metrics else final
 
 
 sharded_evolve = jax.jit(_sharded_evolve,
-                         static_argnames=("config", "mesh", "generations"))
+                         static_argnames=("config", "mesh", "generations",
+                                          "metrics"))
 sharded_evolve_donated = jax.jit(_sharded_evolve,
                                  static_argnames=("config", "mesh",
-                                                  "generations"),
+                                                  "generations", "metrics"),
                                  donate_argnums=(2,))
 
 

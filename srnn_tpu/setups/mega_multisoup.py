@@ -31,6 +31,9 @@ from ..experiment import (Experiment, restore_multi_checkpoint,
                           save_multi_checkpoint)
 from ..multisoup import (MultiSoupConfig, count_multi, evolve_multi,
                          evolve_multi_donated, seed_multi)
+from ..telemetry import Heartbeat, MetricsRegistry
+from ..telemetry.soup_metrics import (type_names, update_class_gauges,
+                                      update_multi_registry)
 from ..utils.aot import ensure_compilation_cache
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
@@ -221,8 +224,16 @@ def run(args):
                                     sharded_evolve_multi_donated)
             run = sharded_evolve_multi_donated if owned \
                 else sharded_evolve_multi
-            return run(cfg, mesh, s, generations=gens)
-        return evolve_multi_donated(cfg, s, generations=gens)
+            return run(cfg, mesh, s, generations=gens, metrics=True)
+        return evolve_multi_donated(cfg, s, generations=gens, metrics=True)
+
+    # telemetry: per-run registry (per-type science counters from the
+    # in-scan carries, class gauges per type) + fsync'd heartbeats; both
+    # flushed every chunk to events.jsonl and metrics.prom
+    registry = MetricsRegistry()
+    hb = Heartbeat(exp, stage="mega_multisoup",
+                   total_generations=args.generations, registry=registry)
+    hb.beat(generation=int(state.time))
 
     stores = None
     import time as _time
@@ -266,17 +277,26 @@ def run(args):
                 # rebound every chunk — skip capture's defensive copy
                 state = evolve_multi_captured(cfg, state, chunk, stores,
                                               every=args.capture_every,
-                                              owned=True)
+                                              owned=True, registry=registry)
             else:
-                state = _evolve(state, chunk, owned)
+                state, ms = _evolve(state, chunk, owned)
+                update_multi_registry(registry, ms, cfg)
             owned = True
-            counts = _count(state)
+            prev_counts, counts = counts, _count(state)
+            for t, tname in enumerate(type_names(cfg)):
+                update_class_gauges(registry, counts[t],
+                                    type_name=tname,
+                                    prev=prev_counts[t])
             dt = _time.perf_counter() - t0
             gen = int(state.time)
             exp.log(f"gen {gen}/{args.generations}  {chunk / dt:.2f} gens/s  "
                     f"{_format_type_counts(counts)}",
                     generation=gen, gens_per_sec=round(chunk / dt, 3),
                     counts=counts.tolist())
+            hb.beat(generation=gen, gens_per_sec=chunk / dt,
+                    chunk_seconds=round(dt, 3))
+            registry.flush_events(exp)
+            registry.write_textfile(os.path.join(exp.dir, "metrics.prom"))
             save_multi_checkpoint(os.path.join(exp.dir, f"ckpt-gen{gen:08d}"),
                                   state)
         exp.log(f"done: {_format_type_counts(counts)}")

@@ -25,6 +25,8 @@ import numpy as np
 from ..experiment import (Experiment, counters_dict, format_counters,
                           restore_checkpoint, save_checkpoint)
 from ..soup import SoupConfig, count, evolve, evolve_donated, seed
+from ..telemetry import Heartbeat, MetricsRegistry
+from ..telemetry.soup_metrics import update_class_gauges, update_registry
 from ..utils.aot import ensure_compilation_cache
 from ..topology import Topology
 from .common import (base_parser, latest_checkpoint,
@@ -162,6 +164,15 @@ def run(args):
             return np.asarray(sharded_count(cfg, mesh, s))
         return np.asarray(count(cfg, s))
 
+    # telemetry: per-run metrics registry (science counters from the
+    # in-scan device carry, class gauges from the chunk counts) flushed to
+    # events.jsonl + metrics.prom every chunk, and fsync'd heartbeat rows
+    # so a killed run names its last stage/generation/rate
+    registry = MetricsRegistry()
+    hb = Heartbeat(exp, stage="mega_soup",
+                   total_generations=args.generations, registry=registry)
+    hb.beat(generation=int(state.time))
+
     store = None
     import time as _time
     try:
@@ -209,7 +220,8 @@ def run(args):
             if store is not None and mesh is not None:
                 from ..utils import sharded_evolve_captured
                 state = sharded_evolve_captured(cfg, mesh, state, chunk, store,
-                                                every=args.capture_every)
+                                                every=args.capture_every,
+                                                registry=registry)
             elif store is not None:
                 from ..utils import evolve_captured
                 # owned=True: this loop's state is always jax-owned (seed
@@ -217,22 +229,31 @@ def run(args):
                 # and rebound, so capture skips its defensive copy
                 state = evolve_captured(cfg, state, chunk, store,
                                         every=args.capture_every,
-                                        owned=True)
+                                        owned=True, registry=registry)
             elif mesh is not None:
                 from ..parallel import (sharded_evolve,
                                         sharded_evolve_donated)
                 run = sharded_evolve_donated if sh_owned else sharded_evolve
-                state = run(cfg, mesh, state, generations=chunk)
+                state, m = run(cfg, mesh, state, generations=chunk,
+                               metrics=True)
+                update_registry(registry, m, n_particles=cfg.size)
                 sh_owned = True
             else:
-                state = evolve_donated(cfg, state, generations=chunk)
-            counts = _count(state)
+                state, m = evolve_donated(cfg, state, generations=chunk,
+                                          metrics=True)
+                update_registry(registry, m, n_particles=cfg.size)
+            prev_counts, counts = counts, _count(state)
+            update_class_gauges(registry, counts, prev=prev_counts)
             dt = _time.perf_counter() - t0
             gen = int(state.time)
             exp.log(f"gen {gen}/{args.generations}  {chunk / dt:.2f} gens/s  "
                     f"{format_counters(counts)}",
                     generation=gen, gens_per_sec=round(chunk / dt, 3),
                     counts=counters_dict(counts))
+            hb.beat(generation=gen, gens_per_sec=chunk / dt,
+                    chunk_seconds=round(dt, 3))
+            registry.flush_events(exp)
+            registry.write_textfile(os.path.join(exp.dir, "metrics.prom"))
             save_checkpoint(os.path.join(exp.dir, f"ckpt-gen{gen:08d}"), state)
         exp.log(f"done: {counters_dict(counts)}")
     finally:

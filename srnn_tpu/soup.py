@@ -218,49 +218,53 @@ def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, S
     w = state.weights
 
     # --- attack phase (soup.py:56-61) ---------------------------------
-    if config.attacking_rate > 0:
-        attack_gate = (jax.random.uniform(k_ag, (n,)) < config.attacking_rate)
-        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
-        # victim-side resolution: the highest-indexed attacker targeting v
-        # wins outright.  NOTE this is a documented deviation from the
-        # reference for multi-attacker collisions: there, attacks compose in
-        # index order (victim 7 hit by 2 then 5 ends as f_w5(f_w2(w7)),
-        # soup.py:56-61); here earlier attackers' effects are dropped
-        # (f_w5(w7_start)).  Collisions are rare at the paper's rates
-        # (Binomial(N, rate/N)); use mode='sequential' for exact composition.
-        att_idx = jax.ops.segment_max(
-            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
-        has_attacker = att_idx >= 0  # un-targeted victims get the int identity (min) or -1
-        attacker_w = w[jnp.clip(att_idx, 0)]
-        attacked = jax.vmap(lambda s, t: apply_to_weights(topo, s, t))(attacker_w, w)
-        w = jnp.where(has_attacker[:, None], attacked, w)
-    else:
-        attack_gate = jnp.zeros(n, bool)
-        attack_tgt = jnp.zeros(n, jnp.int32)
+    with jax.named_scope("soup.attack"):
+        if config.attacking_rate > 0:
+            attack_gate = (jax.random.uniform(k_ag, (n,)) < config.attacking_rate)
+            attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+            # victim-side resolution: the highest-indexed attacker targeting v
+            # wins outright.  NOTE this is a documented deviation from the
+            # reference for multi-attacker collisions: there, attacks compose in
+            # index order (victim 7 hit by 2 then 5 ends as f_w5(f_w2(w7)),
+            # soup.py:56-61); here earlier attackers' effects are dropped
+            # (f_w5(w7_start)).  Collisions are rare at the paper's rates
+            # (Binomial(N, rate/N)); use mode='sequential' for exact composition.
+            att_idx = jax.ops.segment_max(
+                jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+            has_attacker = att_idx >= 0  # un-targeted victims get the int identity (min) or -1
+            attacker_w = w[jnp.clip(att_idx, 0)]
+            attacked = jax.vmap(lambda s, t: apply_to_weights(topo, s, t))(attacker_w, w)
+            w = jnp.where(has_attacker[:, None], attacked, w)
+        else:
+            attack_gate = jnp.zeros(n, bool)
+            attack_tgt = jnp.zeros(n, jnp.int32)
 
     # --- learn_from phase (soup.py:62-68) ------------------------------
-    if config.learn_from_rate > 0:
-        # the gate (and its event-log entry) fires independently of severity,
-        # like the reference, where severity=0 still logs 'learn_from'
-        learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
-        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
-        if config.learn_from_severity > 0:
-            learned, _ = jax.vmap(lambda wi, ow: _learn_epochs(config, wi, ow))(w, w[learn_tgt])
-            w = jnp.where(learn_gate[:, None], learned, w)
-    else:
-        learn_gate = jnp.zeros(n, bool)
-        learn_tgt = jnp.zeros(n, jnp.int32)
+    with jax.named_scope("soup.learn_from"):
+        if config.learn_from_rate > 0:
+            # the gate (and its event-log entry) fires independently of severity,
+            # like the reference, where severity=0 still logs 'learn_from'
+            learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
+            learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+            if config.learn_from_severity > 0:
+                learned, _ = jax.vmap(lambda wi, ow: _learn_epochs(config, wi, ow))(w, w[learn_tgt])
+                w = jnp.where(learn_gate[:, None], learned, w)
+        else:
+            learn_gate = jnp.zeros(n, bool)
+            learn_tgt = jnp.zeros(n, jnp.int32)
 
     # --- train phase (soup.py:69-76) -----------------------------------
-    if config.train > 0:
-        w, train_loss = jax.vmap(lambda wi: _train_epochs(config, wi))(w)
-    else:
-        train_loss = jnp.zeros(n, w.dtype)
+    with jax.named_scope("soup.train"):
+        if config.train > 0:
+            w, train_loss = jax.vmap(lambda wi: _train_epochs(config, wi))(w)
+        else:
+            train_loss = jnp.zeros(n, w.dtype)
 
     # --- respawn (soup.py:77-86) ---------------------------------------
-    w, uids, deaths, death_action, death_cp = _respawn(
-        config, w, state.uids, state.next_uid, k_re)
-    next_uid = state.next_uid + deaths
+    with jax.named_scope("soup.respawn"):
+        w, uids, deaths, death_action, death_cp = _respawn(
+            config, w, state.uids, state.next_uid, k_re)
+        next_uid = state.next_uid + deaths
 
     # --- event record: last action wins (soup.py:55-87 quirk);
     # the reference logs 'attacking' on the ATTACKER; victims log nothing
@@ -386,65 +390,69 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
 
     # --- attack (soup.py:56-61); same last-attacker-wins resolution -----
-    if config.attacking_rate > 0:
-        attack_gate = (jax.random.uniform(k_ag, (n,)) < config.attacking_rate)
-        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
-        att_idx = jax.ops.segment_max(
-            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
-        has_attacker = att_idx >= 0
-        if config.attack_impl == "compact":
-            wT = _attack_popmajor_compact(
-                topo, wT, att_idx, has_attacker,
-                _attack_capacity(n, config.attacking_rate))
+    with jax.named_scope("soup.attack"):
+        if config.attacking_rate > 0:
+            attack_gate = (jax.random.uniform(k_ag, (n,)) < config.attacking_rate)
+            attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+            att_idx = jax.ops.segment_max(
+                jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt, num_segments=n)
+            has_attacker = att_idx >= 0
+            if config.attack_impl == "compact":
+                wT = _attack_popmajor_compact(
+                    topo, wT, att_idx, has_attacker,
+                    _attack_capacity(n, config.attacking_rate))
+            else:
+                attacked = apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT,
+                                          impl=config.apply_impl)
+                wT = jnp.where(has_attacker[None, :], attacked, wT)
         else:
-            attacked = apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT,
-                                      impl=config.apply_impl)
-            wT = jnp.where(has_attacker[None, :], attacked, wT)
-    else:
-        attack_gate = jnp.zeros(n, bool)
-        attack_tgt = jnp.zeros(n, jnp.int32)
+            attack_gate = jnp.zeros(n, bool)
+            attack_tgt = jnp.zeros(n, jnp.int32)
 
     # --- learn_from (soup.py:62-68) -------------------------------------
-    if config.learn_from_rate > 0:
-        learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
-        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
-        if config.learn_from_severity > 0:
-            if config.learn_from_impl == "compact":
-                wT = _learn_popmajor_compact(
-                    config, wT, learn_gate, learn_tgt,
-                    _attack_capacity(n, config.learn_from_rate))
-            else:
-                learned, _ = learn_epochs_popmajor(
-                    topo, wT, wT[:, learn_tgt], config.learn_from_severity,
-                    config.lr, config.train_mode, config.train_impl)
-                wT = jnp.where(learn_gate[None, :], learned, wT)
-    else:
-        learn_gate = jnp.zeros(n, bool)
-        learn_tgt = jnp.zeros(n, jnp.int32)
+    with jax.named_scope("soup.learn_from"):
+        if config.learn_from_rate > 0:
+            learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
+            learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+            if config.learn_from_severity > 0:
+                if config.learn_from_impl == "compact":
+                    wT = _learn_popmajor_compact(
+                        config, wT, learn_gate, learn_tgt,
+                        _attack_capacity(n, config.learn_from_rate))
+                else:
+                    learned, _ = learn_epochs_popmajor(
+                        topo, wT, wT[:, learn_tgt], config.learn_from_severity,
+                        config.lr, config.train_mode, config.train_impl)
+                    wT = jnp.where(learn_gate[None, :], learned, wT)
+        else:
+            learn_gate = jnp.zeros(n, bool)
+            learn_tgt = jnp.zeros(n, jnp.int32)
 
     # --- train (soup.py:69-76) ------------------------------------------
-    if config.train > 0:
-        wT, train_loss = train_epochs_popmajor(
-            topo, wT, config.train, config.lr, config.train_mode,
-            config.train_impl)
-    else:
-        train_loss = jnp.zeros(n, wT.dtype)
+    with jax.named_scope("soup.train"):
+        if config.train > 0:
+            wT, train_loss = train_epochs_popmajor(
+                topo, wT, config.train, config.lr, config.train_mode,
+                config.train_impl)
+        else:
+            train_loss = jnp.zeros(n, wT.dtype)
 
     # --- respawn (soup.py:77-86); per-lane masks ------------------------
-    action = jnp.full(n, ACT_NONE, jnp.int32)
-    dead_div = is_diverged(wT, axis=0) if config.remove_divergent \
-        else jnp.zeros(n, bool)
-    dead_zero = (is_zero(wT, config.epsilon, axis=0) & ~dead_div) \
-        if config.remove_zero else jnp.zeros(n, bool)
-    dead = dead_div | dead_zero
-    fresh = fresh_lanes(topo, k_re, n, config.respawn_draws)
-    wT = jnp.where(dead[None, :], fresh, wT)
-    rank = jnp.cumsum(dead) - 1
-    uids = jnp.where(dead, state.next_uid + rank.astype(jnp.int32), state.uids)
-    deaths = dead.sum(dtype=jnp.int32)
-    action = jnp.where(dead_div, ACT_DIV_DEAD, action)
-    action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
-    death_cp = jnp.where(dead, uids, -1)
+    with jax.named_scope("soup.respawn"):
+        action = jnp.full(n, ACT_NONE, jnp.int32)
+        dead_div = is_diverged(wT, axis=0) if config.remove_divergent \
+            else jnp.zeros(n, bool)
+        dead_zero = (is_zero(wT, config.epsilon, axis=0) & ~dead_div) \
+            if config.remove_zero else jnp.zeros(n, bool)
+        dead = dead_div | dead_zero
+        fresh = fresh_lanes(topo, k_re, n, config.respawn_draws)
+        wT = jnp.where(dead[None, :], fresh, wT)
+        rank = jnp.cumsum(dead) - 1
+        uids = jnp.where(dead, state.next_uid + rank.astype(jnp.int32), state.uids)
+        deaths = dead.sum(dtype=jnp.int32)
+        action = jnp.where(dead_div, ACT_DIV_DEAD, action)
+        action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
+        death_cp = jnp.where(dead, uids, -1)
 
     act, cp = _event_record(
         n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
@@ -628,6 +636,7 @@ def _evolve(
     state: SoupState,
     generations: int = 1,
     record: bool = False,
+    metrics: bool = False,
 ):
     """Evolve ``generations`` steps as one scan.
 
@@ -635,7 +644,20 @@ def _evolve(
     ``(SoupEvents, weights (G, N, P), uids (G, N))`` for trajectory analysis
     (the vectorized stand-in for ``ParticleDecorator.save_state`` histories,
     ``network.py:193-198``).
+
+    With ``metrics=True`` also returns a ``telemetry.device.SoupMetrics``
+    carry — the soup-science counters (action histogram, summed train
+    loss) accumulated INSIDE the scan, so a metered chunk costs one
+    bincount per generation on device and zero extra host round-trips.
+    The evolved state is bit-identical to the unmetered program (the
+    carry only reads the event record; tests assert parity).  Return
+    shape: ``final``, then ``recs`` if recording, then the metrics carry
+    if metering.
     """
+    if metrics:
+        from .telemetry.device import (accumulate_soup_metrics,
+                                       zero_soup_metrics)
+    m0 = zero_soup_metrics() if metrics else None
 
     if config.layout == "popmajor":
         # keep the carry transposed across the whole run: one transpose at
@@ -643,34 +665,47 @@ def _evolve(
         _check_popmajor(config)
 
         def step_t(carry, _):
-            s, wT = carry
+            s, wT, m = carry
             new_s, ev, new_wT = _evolve_parallel_popmajor(config, s, wT)
+            if metrics:
+                m = accumulate_soup_metrics(m, ev.action, ev.loss)
             out = (ev, new_wT.T, new_s.uids) if record else None
-            return (new_s, new_wT), out
+            return (new_s, new_wT, m), out
 
         # the transposed wT is the live weights carry; null the row-major
         # field so the scan doesn't drag a dead (N, P) buffer along
         light = state._replace(weights=jnp.zeros((0,), state.weights.dtype))
-        (final, wT), recs = jax.lax.scan(
-            step_t, (light, state.weights.T), None, length=generations)
+        (final, wT, m), recs = jax.lax.scan(
+            step_t, (light, state.weights.T, m0), None, length=generations)
         final = final._replace(weights=wT.T)
-        return (final, recs) if record else final
+    else:
+        def step(carry, _):
+            s, m = carry
+            new_s, ev = evolve_step(config, s)
+            if metrics:
+                m = accumulate_soup_metrics(m, ev.action, ev.loss)
+            out = (ev, new_s.weights, new_s.uids) if record else None
+            return (new_s, m), out
 
-    def step(s, _):
-        new_s, ev = evolve_step(config, s)
-        out = (ev, new_s.weights, new_s.uids) if record else None
-        return new_s, out
+        (final, m), recs = jax.lax.scan(step, (state, m0), None,
+                                        length=generations)
 
-    final, recs = jax.lax.scan(step, state, None, length=generations)
-    return (final, recs) if record else final
+    out = (final,)
+    if record:
+        out += (recs,)
+    if metrics:
+        out += (m,)
+    return out if len(out) > 1 else final
 
 
 #: jitted multi-generation run; ``evolve_donated`` is the in-place-buffer
 #: twin (see ``evolve_step_donated``) used by the mega-run hot loops, where
 #: the state is always rebound chunk over chunk.
-evolve = jax.jit(_evolve, static_argnames=("config", "generations", "record"))
+evolve = jax.jit(_evolve, static_argnames=("config", "generations", "record",
+                                           "metrics"))
 evolve_donated = jax.jit(_evolve,
-                         static_argnames=("config", "generations", "record"),
+                         static_argnames=("config", "generations", "record",
+                                          "metrics"),
                          donate_argnums=(1,))
 
 

@@ -1,0 +1,39 @@
+"""srnn_tpu.telemetry — metrics, span tracing, and run heartbeats.
+
+The observability triad for soup evolution at production scale:
+
+  * **Metrics** — soup-science counters accumulated INSIDE the jitted
+    generations scan as an extra carry (``device.SoupMetrics``; zero host
+    round-trips, flushed every K-generation chunk) plus a host-side typed
+    registry (``metrics.MetricsRegistry``) with two sinks: structured
+    ``events.jsonl`` rows through ``Experiment.event`` and a
+    Prometheus-textfile exposition for scraping long mega runs.  Runtime
+    metrics (AOT cache hits, compile seconds, span wall-clock) land on
+    the process-wide ``RUNTIME`` registry.
+  * **Tracing** — ``span()`` wall-clock blocks layered on
+    ``jax.named_scope`` + scalar-readback sync; ``annotate`` for
+    zero-cost phase names in profiler traces; ``trace`` re-exported for
+    full ``jax.profiler`` captures.
+  * **Heartbeats** — fsync'd liveness rows (stage, generation, gens/sec,
+    rss, device memory) so a killed run leaves an attributable trail,
+    and ``python -m srnn_tpu.telemetry.report <run_dir>`` to render it.
+"""
+
+from .device import (N_ACTIONS, SoupMetrics, accumulate_soup_metrics,
+                     count_events, merge_soup_metrics, psum_soup_metrics,
+                     zero_soup_metrics)
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, RUNTIME)
+from .tracing import Span, annotate, span, trace
+from .heartbeat import Heartbeat, device_memory_stats, rss_bytes
+from .soup_metrics import (EVENT_COUNTERS, update_class_gauges,
+                           update_multi_registry, update_registry)
+
+__all__ = [
+    "N_ACTIONS", "SoupMetrics", "accumulate_soup_metrics", "count_events",
+    "merge_soup_metrics", "psum_soup_metrics", "zero_soup_metrics",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "RUNTIME",
+    "Span", "annotate", "span", "trace",
+    "Heartbeat", "device_memory_stats", "rss_bytes",
+    "EVENT_COUNTERS", "update_class_gauges", "update_multi_registry",
+    "update_registry",
+]

@@ -1,0 +1,117 @@
+"""Run heartbeats: periodic liveness rows so a killed or timed-out run
+leaves an attributable trail.
+
+The bench trajectory motivating this (``BENCH_r05.json``) ends in
+``"full: deadline exhausted"`` after four opaque stage timeouts — nothing
+recorded which stage, generation range, or compile step consumed the
+budget.  A :class:`Heartbeat` row carries exactly that attribution:
+stage, generation (of total), generations/sec, host RSS, and device
+memory, written through ``Experiment.event`` with ``fsync`` so the tail
+survives a SIGKILL.
+
+Helpers are fail-soft: a platform without ``/proc`` or device memory
+stats yields rows without those fields, never an exception in the run
+loop.
+"""
+
+import os
+import resource
+import sys
+import time
+from typing import Dict, Optional
+
+
+def rss_bytes() -> Optional[int]:
+    """Current resident set size of this process, or ``None`` when the
+    platform offers no way to read it."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * resource.getpagesize()
+    except (OSError, IndexError, ValueError):
+        pass
+    try:
+        # portable fallback: PEAK rss — labeled the same, still
+        # monotone-useful for leak spotting.  ru_maxrss units differ by
+        # platform: KiB on linux, BYTES on macOS (the platform where this
+        # fallback is actually the taken path, /proc being absent)
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak if sys.platform == "darwin" else peak * 1024
+    except (OSError, ValueError):
+        return None
+
+
+def device_memory_stats() -> Optional[Dict[str, int]]:
+    """Allocator stats of the first local device (``bytes_in_use`` /
+    ``peak_bytes_in_use`` where the backend reports them — TPU and GPU
+    do, CPU returns ``None``).  Never raises."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {}
+    for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if k in stats:
+            out[k] = int(stats[k])
+    return out or None
+
+
+class Heartbeat:
+    """Emitter of ``{"kind": "heartbeat", ...}`` rows for one run stage.
+
+    >>> hb = Heartbeat(exp, stage="mega_soup", total_generations=1000)
+    >>> hb.beat(generation=100, gens_per_sec=28.5)
+
+    Rows are fsync'd (the whole point is surviving a kill); each row also
+    carries ``beat`` (a per-instance sequence number) and the seconds
+    since the previous beat, so a trail's cadence is self-describing.
+    """
+
+    def __init__(self, exp, stage: str, total_generations: Optional[int] = None,
+                 registry=None):
+        self.exp = exp
+        self.stage = stage
+        self.total_generations = total_generations
+        self.registry = registry
+        self.count = 0
+        self._last_t: Optional[float] = None
+
+    def beat(self, generation: Optional[int] = None,
+             gens_per_sec: Optional[float] = None, **extra) -> dict:
+        now = time.monotonic()
+        row = {"stage": self.stage, "beat": self.count}
+        if generation is not None:
+            row["generation"] = int(generation)
+        if self.total_generations is not None:
+            row["total_generations"] = int(self.total_generations)
+        if gens_per_sec is not None:
+            row["gens_per_sec"] = round(float(gens_per_sec), 3)
+        if self._last_t is not None:
+            row["since_last_s"] = round(now - self._last_t, 3)
+        rss = rss_bytes()
+        if rss is not None:
+            row["rss_mb"] = round(rss / 2 ** 20, 1)
+        dev = device_memory_stats()
+        if dev is not None:
+            row["device_memory"] = dev
+        row.update(extra)
+        self.exp.event(_fsync=True, kind="heartbeat", **row)
+        if self.registry is not None:
+            g = self.registry.gauge
+            if generation is not None:
+                g("heartbeat_generation",
+                  help="last heartbeat's generation").set(
+                      int(generation), stage=self.stage)
+            if gens_per_sec is not None:
+                g("gens_per_sec", help="generations per second",
+                  unit="1/s").set(round(float(gens_per_sec), 3),
+                                  stage=self.stage)
+            if rss is not None:
+                g("rss_bytes", help="host resident set size",
+                  unit="bytes").set(rss, stage=self.stage)
+        self.count += 1
+        self._last_t = now
+        return row

@@ -1,0 +1,182 @@
+"""Summarize a run directory's telemetry trail.
+
+    python -m srnn_tpu.telemetry.report <run_dir> [--json]
+
+Reads ``meta.json`` + ``events.jsonl`` (the ``Experiment`` channel the
+mega-run loops, heartbeats and metric flushes all write through) and
+renders what a post-mortem needs first: did the run finish, where was it
+last alive (stage / generation / gens-per-sec / memory), what do the
+final cumulative metrics say, and where did the wall time go (spans).
+Works on killed runs — heartbeat rows are fsync'd, and cumulative metric
+snapshots mean the last row is the whole story.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+from .metrics import quantile_from_times
+
+
+def load_events(run_dir: str) -> List[dict]:
+    path = os.path.join(run_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass  # torn tail of a killed run: keep what parses
+    return rows
+
+
+def _load_json(run_dir: str, name: str) -> dict:
+    path = os.path.join(run_dir, name)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def summarize(run_dir: str) -> dict:
+    """Machine-readable summary (the ``--json`` output; the text renderer
+    formats this)."""
+    events = load_events(run_dir)
+    meta = _load_json(run_dir, "meta.json")
+    config = _load_json(run_dir, "config.json")
+
+    by_kind: Dict[str, List[dict]] = {}
+    for e in events:
+        by_kind.setdefault(str(e.get("kind", "log")), []).append(e)
+
+    heartbeats: Dict[str, dict] = {}
+    for hb in by_kind.get("heartbeat", []):
+        stage = str(hb.get("stage", "?"))
+        s = heartbeats.setdefault(stage, {"beats": 0, "gens_per_sec": []})
+        s["beats"] += 1
+        s["last"] = {k: hb[k] for k in
+                     ("generation", "total_generations", "gens_per_sec",
+                      "rss_mb", "device_memory", "t") if k in hb}
+        if "gens_per_sec" in hb:
+            s["gens_per_sec"].append(float(hb["gens_per_sec"]))
+    for s in heartbeats.values():
+        gps = s.pop("gens_per_sec")
+        if gps:
+            s["gens_per_sec"] = {
+                "min": min(gps), "max": max(gps),
+                "p50": quantile_from_times(gps, 0.5),
+            }
+
+    spans: Dict[str, dict] = {}
+    for sp in by_kind.get("span", []):
+        name = str(sp.get("span", "?"))
+        s = spans.setdefault(name, {"count": 0, "total_s": 0.0})
+        s["count"] += 1
+        s["total_s"] += float(sp.get("seconds", 0.0))
+    for s in spans.values():
+        s["total_s"] = round(s["total_s"], 3)
+
+    metric_rows = by_kind.get("metrics", [])
+    final_metrics = dict(metric_rows[-1].get("metrics", {})) \
+        if metric_rows else {}
+
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "meta": meta,
+        "config": config,
+        "event_counts": {k: len(v) for k, v in sorted(by_kind.items())},
+        "heartbeats": heartbeats,
+        "spans": spans,
+        "metrics": final_metrics,
+        "metrics_flushes": len(metric_rows),
+        "has_prom_file": os.path.exists(
+            os.path.join(run_dir, "metrics.prom")),
+    }
+
+
+def _render(s: dict, out) -> None:
+    w = out.write
+    meta = s["meta"]
+    w(f"run: {s['run_dir']}\n")
+    if meta:
+        status = "FAILED: " + str(meta["error"]) if meta.get("error") \
+            else "completed"
+        w(f"  name={meta.get('name')} seed={meta.get('seed')} "
+          f"wall={meta.get('wall_seconds', 0):.1f}s  {status}\n")
+    elif not s["event_counts"]:
+        w("  (no meta.json and no events.jsonl — not a telemetry run dir)\n")
+    if s["config"]:
+        knobs = " ".join(f"{k}={v}" for k, v in sorted(s["config"].items())
+                         if not isinstance(v, (list, dict)))
+        w(f"  config: {knobs}\n")
+    if s["event_counts"]:
+        w("  events: " + "  ".join(f"{k}={n}" for k, n
+                                   in s["event_counts"].items()) + "\n")
+
+    if s["heartbeats"]:
+        w("heartbeats:\n")
+        for stage, hb in sorted(s["heartbeats"].items()):
+            last = hb.get("last", {})
+            gen = last.get("generation")
+            tot = last.get("total_generations")
+            where = f"gen {gen}/{tot}" if gen is not None and tot \
+                else (f"gen {gen}" if gen is not None else "")
+            gps = hb.get("gens_per_sec")
+            rate = (f"  gens/s p50={gps['p50']:.2f} "
+                    f"[{gps['min']:.2f}..{gps['max']:.2f}]") if gps else ""
+            mem = f"  rss={last['rss_mb']}MB" if "rss_mb" in last else ""
+            dev = last.get("device_memory") or {}
+            if "bytes_in_use" in dev:
+                mem += f"  dev={dev['bytes_in_use'] / 2**20:.0f}MB"
+            w(f"  {stage}: {hb['beats']} beats, last at {where}"
+              f"{rate}{mem}\n")
+    else:
+        w("heartbeats: none recorded\n")
+
+    if s["spans"]:
+        w("spans (wall seconds):\n")
+        for name, sp in sorted(s["spans"].items(),
+                               key=lambda kv: -kv[1]["total_s"]):
+            w(f"  {name}: {sp['total_s']}s over {sp['count']} blocks\n")
+
+    if s["metrics"]:
+        w(f"metrics (cumulative, {s['metrics_flushes']} flushes"
+          + (", metrics.prom present" if s["has_prom_file"] else "")
+          + "):\n")
+        for name, value in sorted(s["metrics"].items()):
+            w(f"  {name} = {value}\n")
+    else:
+        w("metrics: none recorded\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("run_dir", help="an Experiment run directory")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable summary instead of text")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"report: {args.run_dir}: not a directory", file=sys.stderr)
+        return 2
+    s = summarize(args.run_dir)
+    if args.json:
+        print(json.dumps(s, indent=1, default=str))
+    else:
+        _render(s, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Host-side interpretation of the device metrics carry.
+
+Maps the :class:`~srnn_tpu.telemetry.device.SoupMetrics` action histogram
+onto named registry counters (the soup-science metrics: attack /
+learn_from / train event counts, divergent / zero respawn counts) and
+maintains the class-histogram gauges + deltas the mega-run loops flush
+every chunk.  Rates are left to readers (the report CLI divides by
+``soup_particle_generations_total``), so everything stored is a plain
+monotone counter or a last-value gauge.
+"""
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops.predicates import CLASS_NAMES
+from ..soup import ACTION_NAMES
+from .device import N_ACTIONS, SoupMetrics
+from .metrics import MetricsRegistry
+
+assert len(ACTION_NAMES) == N_ACTIONS, (
+    "telemetry.device.N_ACTIONS fell out of sync with soup.ACTION_NAMES")
+
+#: action-code -> (counter name, help).  'none'/'init' are not events.
+EVENT_COUNTERS = {
+    "attacking": ("soup_attacks_total",
+                  "particles whose last action was attacking another"),
+    "learn_from": ("soup_learns_total",
+                   "particles whose last action was imitation SGD"),
+    "train_self": ("soup_train_events_total",
+                   "particles whose last action was self-training"),
+    "divergent_dead": ("soup_respawns_divergent_total",
+                       "particles respawned after diverging"),
+    "zweo_dead": ("soup_respawns_zero_total",
+                  "particles respawned after collapsing to zero"),
+}
+
+
+def update_registry(registry: MetricsRegistry, m: SoupMetrics,
+                    type_name: Optional[str] = None,
+                    n_particles: Optional[int] = None) -> None:
+    """Fold one flushed device carry into ``registry``'s counters.
+
+    ``type_name`` labels heterogeneous (multisoup) per-type carries;
+    ``n_particles`` additionally advances the particle-generations
+    denominator counter so readers can compute per-particle rates.
+    """
+    labels = {"type": type_name} if type_name else {}
+    actions = np.asarray(m.actions)
+    gens = int(m.generations)
+    registry.counter("soup_generations_total",
+                     help="soup generations evolved").inc(gens, **labels)
+    if n_particles is not None:
+        registry.counter(
+            "soup_particle_generations_total",
+            help="particles x generations (rate denominator)").inc(
+                gens * int(n_particles), **labels)
+    for code, action_name in enumerate(ACTION_NAMES):
+        named = EVENT_COUNTERS.get(action_name)
+        if named is None:
+            continue
+        name, help_ = named
+        registry.counter(name, help=help_).inc(int(actions[code]), **labels)
+    # a soup with diverging particles legitimately produces inf/nan train
+    # losses; a counter must stay finite and monotone, so count those
+    # windows separately instead of poisoning (or crashing) the total
+    loss = float(m.loss_sum)
+    if math.isfinite(loss) and loss >= 0:
+        registry.counter("soup_train_loss_sum",
+                         help="summed per-particle train losses").inc(
+                             loss, **labels)
+    else:
+        registry.counter(
+            "soup_train_loss_nonfinite_flushes_total",
+            help="flush windows whose loss sum was inf/nan (divergence)"
+        ).inc(1, **labels)
+
+
+def update_class_gauges(registry: MetricsRegistry, counts,
+                        type_name: Optional[str] = None,
+                        prev=None) -> None:
+    """Record a (5,) class histogram as gauges — current particle count
+    per class plus, when ``prev`` (the previous flush's histogram) is
+    given, the delta since then (the chunk-over-chunk drift the science
+    watches)."""
+    labels = {"type": type_name} if type_name else {}
+    counts = np.asarray(counts)
+    prev = None if prev is None else np.asarray(prev)
+    for i, cls in enumerate(CLASS_NAMES):
+        registry.gauge("soup_class_particles",
+                       help="particles per class").set(
+                           int(counts[i]), cls=cls, **labels)
+        if prev is not None:
+            registry.gauge(
+                "soup_class_delta",
+                help="particles-per-class change since last flush").set(
+                    int(counts[i]) - int(prev[i]), cls=cls, **labels)
+
+
+def type_names(config) -> list:
+    """Per-type label values for a ``MultiSoupConfig``: the variant name
+    when unique, disambiguated by type index otherwise — two same-variant
+    subpopulations (e.g. weightwise at two widths) must not silently merge
+    their counters under one label."""
+    names = [t.variant for t in config.topos]
+    if len(set(names)) == len(names):
+        return names
+    return [f"{v}[{i}]" for i, v in enumerate(names)]
+
+
+def update_multi_registry(registry: MetricsRegistry,
+                          ms: Sequence[SoupMetrics], config) -> None:
+    """Per-type carries of a ``MultiSoupConfig`` run, labeled by variant."""
+    labels = type_names(config)
+    for t, m in enumerate(ms):
+        update_registry(registry, m, type_name=labels[t],
+                        n_particles=config.sizes[t])

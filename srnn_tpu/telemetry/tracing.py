@@ -1,0 +1,95 @@
+"""Span tracing: wall-clock phase timing layered on ``jax.named_scope`` +
+``jax.profiler``.
+
+Three layers, cheapest first:
+
+  * :func:`annotate` (= ``jax.named_scope``) — zero-cost trace-time
+    annotation: phases show up as named scopes in HLO metadata and
+    profiler traces.  The soup/engine step functions annotate their
+    attack/learn/train/respawn phases with it directly.
+  * :func:`span` — host-side wall-clock timing of a code block, recorded
+    into a registry histogram (``srnn_span_seconds{span=...}``) and
+    optionally as an ``events.jsonl`` row.  Synchronization is by scalar
+    readback (``Span.sync``), not ``block_until_ready`` — on the tunneled
+    axon platform the latter does not actually wait (the caveat
+    documented in ``utils/profiling.py`` and ``bench.py``).
+  * ``trace`` (re-exported from ``utils.profiling``) — a full
+    ``jax.profiler`` device/host trace into a TensorBoard-loadable
+    directory, for when a span points at a phase worth opening up.
+"""
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.profiling import trace  # noqa: F401  (re-export)
+from .metrics import MetricsRegistry, RUNTIME
+
+#: zero-cost phase annotation (alias of ``jax.named_scope``): visible in
+#: profiler traces and HLO metadata, no runtime effect.
+annotate = jax.named_scope
+
+
+def _readback(value: Any) -> None:
+    """Force completion of ``value``'s computation via a scalar readback
+    (the axon-safe synchronization primitive)."""
+    leaves = jax.tree.leaves(value)
+    if leaves:
+        float(jnp.asarray(leaves[0]).ravel()[0])
+
+
+class Span:
+    """The in-flight record :func:`span` yields; ``seconds`` is set on
+    exit.  Call :meth:`sync` with any array/pytree whose computation the
+    span must wait for — it is read back (one scalar) at exit."""
+
+    __slots__ = ("name", "seconds", "_sync_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.seconds: Optional[float] = None
+        self._sync_value: Any = None
+
+    def sync(self, value):
+        """Register ``value`` for completion-sync at span exit; returns it
+        unchanged so call sites stay one-liners."""
+        self._sync_value = value
+        return value
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricsRegistry] = None,
+         exp=None, **labels):
+    """Time a block of host code (usually: one or more jitted dispatches).
+
+    >>> with span("soup.chunk", registry=reg, exp=exp) as s:
+    ...     state = s.sync(evolve_donated(cfg, state, generations=100))
+
+    Enters ``jax.named_scope(name)`` (so any tracing inside the block is
+    annotated), measures wall seconds with the work force-completed via
+    scalar readback when :meth:`Span.sync` was called, then records the
+    duration into ``registry``'s ``span_seconds`` histogram (label
+    ``span=name`` + any extra labels; default registry: the process
+    ``RUNTIME``) and, when ``exp`` is given, appends a
+    ``{"kind": "span", ...}`` row to its ``events.jsonl``.
+    """
+    reg = RUNTIME if registry is None else registry
+    s = Span(name)
+    with jax.named_scope(name):
+        t0 = time.perf_counter()
+        try:
+            yield s
+        finally:
+            if s._sync_value is not None:
+                _readback(s._sync_value)
+            s.seconds = time.perf_counter() - t0
+            reg.histogram(
+                "span_seconds",
+                help="wall-clock seconds of telemetry.span blocks",
+                unit="seconds").observe(s.seconds, span=name, **labels)
+            if exp is not None:
+                exp.event(kind="span", span=name,
+                          seconds=round(s.seconds, 6), **labels)

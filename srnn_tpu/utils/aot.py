@@ -30,6 +30,7 @@ generation N+1 rewrites generation N's population buffers in place
 """
 
 import os
+import sys
 import time
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
@@ -247,11 +248,16 @@ def aot_compile(name: str, jitted, args: Tuple, kwargs: Optional[dict] = None,
     kwargs = dict(kwargs or {})
     abstract_args = tuple(_abstract(a) for a in args)
     backend = jax.default_backend()
+    # ``persistent`` is part of the memo key: a persistent=False build must
+    # never be answered by a cache-deserialized executable memoized earlier
+    # under the same signature (its empty memory_analysis() would fake
+    # alias_bytes=0 in donation checks)
     key = (name, _signature(abstract_args),
            tuple(sorted((k, repr(v)) for k, v in kwargs.items())),
-           backend, jax.device_count())
+           backend, jax.device_count(), persistent)
     hit = _EXECUTABLES.get(key)
     if hit is not None:
+        _record_aot_metrics(name, hit=True)
         return hit._replace(cached=True, lower_s=0.0, compile_s=0.0)
     prev_dir = None
     if persistent:
@@ -291,7 +297,40 @@ def aot_compile(name: str, jitted, args: Tuple, kwargs: Optional[dict] = None,
     entry = CompiledEntry(name=name, compiled=compiled, key=key,
                           lower_s=t1 - t0, compile_s=t2 - t1, cached=False)
     _EXECUTABLES[key] = entry
+    _record_aot_metrics(name, hit=False, lower_s=entry.lower_s,
+                        compile_s=entry.compile_s)
     return entry
+
+
+def _record_aot_metrics(entry: str, hit: bool, lower_s: float = 0.0,
+                        compile_s: float = 0.0) -> None:
+    """Host-side runtime metrics on the process ``telemetry.RUNTIME``
+    registry: memo hit/miss counts and trace/compile seconds per entry
+    point.  (A fresh compile served fast from jax's on-disk persistent
+    cache still counts as a compile — its near-zero ``compile_s`` is the
+    cache's win showing up in the histogram.)  Fail-soft by construction:
+    telemetry must never break a compile path."""
+    try:
+        from ..telemetry.metrics import RUNTIME
+    except Exception:
+        return
+    if hit:
+        RUNTIME.counter("aot_memo_hits_total",
+                        help="aot_compile served from the in-process "
+                        "executable memo").inc(1, entry=entry)
+        return
+    RUNTIME.counter("aot_compiles_total",
+                    help="aot_compile lower+compile builds").inc(
+                        1, entry=entry)
+    RUNTIME.counter("aot_lower_seconds_total",
+                    help="seconds spent tracing/lowering",
+                    unit="seconds").inc(lower_s, entry=entry)
+    RUNTIME.counter("aot_compile_seconds_total",
+                    help="seconds spent in backend compile",
+                    unit="seconds").inc(compile_s, entry=entry)
+    RUNTIME.histogram("aot_compile_seconds",
+                      help="per-build backend compile seconds",
+                      unit="seconds").observe(compile_s, entry=entry)
 
 
 # ---------------------------------------------------------------------------
@@ -309,6 +348,12 @@ def _soup_entries(config, generations: int, donate: bool):
     yield (f"soup.evolve_step{tag}", step, (config, st), {})
     yield (f"soup.evolve{tag}", run, (config, st),
            {"generations": generations})
+    # the mega-run loops and capture helpers dispatch the chunk run with
+    # the telemetry carry (metrics=True, a STATIC arg — a different
+    # program); warm that spelling too or production's first chunk
+    # re-pays the compile this subsystem exists to remove
+    yield (f"soup.evolve{tag}.metered", run, (config, st),
+           {"generations": generations, "metrics": True})
 
 
 def _multi_entries(config, generations: int, donate: bool):
@@ -323,6 +368,8 @@ def _multi_entries(config, generations: int, donate: bool):
     yield (f"multisoup.evolve_multi_step{tag}", step, (config, st), {})
     yield (f"multisoup.evolve_multi{tag}", run, (config, st),
            {"generations": generations})
+    yield (f"multisoup.evolve_multi{tag}.metered", run, (config, st),
+           {"generations": generations, "metrics": True})
 
 
 def _engine_entries(topo, size: int, donate: bool, step_limit: int,
@@ -357,6 +404,8 @@ def _sharded_entries(config, mesh, generations: int, donate: bool):
     yield (f"parallel.sharded_evolve_step{tag}", step, (config, mesh, st), {})
     yield (f"parallel.sharded_evolve{tag}", run, (config, mesh, st),
            {"generations": generations})
+    yield (f"parallel.sharded_evolve{tag}.metered", run, (config, mesh, st),
+           {"generations": generations, "metrics": True})
 
 
 def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
@@ -372,6 +421,9 @@ def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
            (config, mesh, st), {})
     yield (f"parallel.sharded_evolve_multi{tag}", run, (config, mesh, st),
            {"generations": generations})
+    yield (f"parallel.sharded_evolve_multi{tag}.metered", run,
+           (config, mesh, st),
+           {"generations": generations, "metrics": True})
 
 
 def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,
@@ -425,5 +477,5 @@ def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,
             print(f"warmup: {name}: "
                   + ("memo hit" if entry.cached else
                      f"lower {entry.lower_s:.2f}s compile "
-                     f"{entry.compile_s:.2f}s"), flush=True)
+                     f"{entry.compile_s:.2f}s"), file=sys.stderr, flush=True)
     return rows

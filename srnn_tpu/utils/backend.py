@@ -13,6 +13,7 @@ JSON line instead of a stack trace.
 """
 
 import os
+import sys
 import time
 from typing import Optional
 
@@ -79,13 +80,15 @@ def ensure_backend(retries: int = 4, sleep_s: float = 10.0,
             _clear_backends()
             if attempt < retries - 1:  # no pointless sleep after the last try
                 print(f"ensure_backend: attempt {attempt + 1}/{retries} "
-                      f"failed ({e}); retrying in {sleep_s:.0f}s")
+                      f"failed ({e}); retrying in {sleep_s:.0f}s",
+                      file=sys.stderr)
                 time.sleep(sleep_s)
             else:
                 print(f"ensure_backend: attempt {attempt + 1}/{retries} "
-                      f"failed ({e})")
+                      f"failed ({e})", file=sys.stderr)
     if fallback_cpu:
-        print("ensure_backend: default backend unavailable, falling back to CPU")
+        print("ensure_backend: default backend unavailable, falling back "
+              "to CPU", file=sys.stderr)
         force_cpu()
         return jax.devices()[0].platform, True
     raise last  # type: ignore[misc]
@@ -105,7 +108,7 @@ def watchdog(seconds: float, on_fire=None, exit_code: int = 3):
                 on_fire()
         finally:
             print(f"watchdog: fired after {seconds:.0f}s — backend wedge or "
-                  f"compile stall", flush=True)
+                  f"compile stall", file=sys.stderr, flush=True)
             os._exit(exit_code)
 
     t = threading.Timer(seconds, fire)

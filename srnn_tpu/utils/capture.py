@@ -30,6 +30,7 @@ def evolve_captured(
     store: TrajStore,
     every: int = 1,
     owned: bool = False,
+    registry=None,
 ) -> SoupState:
     """Evolve ``generations`` steps, appending one frame per ``every``
     generations to ``store``.  Returns the final state.
@@ -42,9 +43,18 @@ def evolve_captured(
     jax-owned buffer (a jit output, or ``aot.own_pytree`` of a restore)
     that the caller never touches again — the mega-run loops, which rebind
     every chunk, pass this to skip the defensive copy below.
+
+    ``registry`` (a ``telemetry.MetricsRegistry``) meters the run: the
+    intermediate ``every - 1`` generations ride the in-scan metrics carry
+    and the captured step's events — already in hand — are counted with
+    one tiny extra dispatch, so the registry sees EVERY generation (not a
+    stride sample) at no additional host transfers beyond the frames.
     """
     if generations % every != 0:
         raise ValueError(f"generations={generations} not divisible by every={every}")
+    if registry is not None:
+        from ..telemetry.device import count_events
+        from ..telemetry.soup_metrics import update_registry
     # ALL-donated internal stream: every generation executes the donated
     # executable, so the captured stream is bitwise chunking-invariant (the
     # donated and plain programs may differ by fusion ulps on some XLA
@@ -57,8 +67,18 @@ def evolve_captured(
         state = own_pytree(state)
     for _ in range(generations // every):
         if every > 1:
-            state = evolve_donated(config, state, generations=every - 1)
+            if registry is not None:
+                state, m = evolve_donated(config, state,
+                                          generations=every - 1,
+                                          metrics=True)
+                update_registry(registry, m, n_particles=config.size)
+            else:
+                state = evolve_donated(config, state, generations=every - 1)
         state, events = evolve_step_donated(config, state)
+        if registry is not None:
+            update_registry(registry,
+                            count_events(events.action, events.loss),
+                            n_particles=config.size)
         # one host transfer per captured frame; everything else stays on device
         frame = jax.device_get(
             (state.time, state.weights, state.uids,
@@ -76,13 +96,16 @@ def evolve_multi_captured(
     stores,
     every: int = 1,
     owned: bool = False,
+    registry=None,
 ):
     """Heterogeneous-soup twin of :func:`evolve_captured`: one
     :class:`TrajStore` per TYPE (``stores[t]`` holds type t's (N_t, P_t)
     frames), so the mixed mega-soup's history survives at scale the same
-    way the homogeneous one's does.  Returns the final state."""
+    way the homogeneous one's does.  Returns the final state.
+
+    ``registry`` meters every generation exactly as in
+    :func:`evolve_captured`, with per-type labels (``type=<variant>``)."""
     from ..multisoup import evolve_multi_donated, evolve_multi_step_donated
-    from .aot import own_pytree
 
     if generations % every != 0:
         raise ValueError(
@@ -90,6 +113,13 @@ def evolve_multi_captured(
     if len(stores) != len(config.topos):
         raise ValueError(f"need one store per type "
                          f"({len(config.topos)}), got {len(stores)}")
+    if registry is not None:
+        from ..telemetry.device import count_events
+        from ..telemetry.soup_metrics import (type_names,
+                                              update_multi_registry,
+                                              update_registry)
+
+        tnames = type_names(config)
     # copy-then-donate unless the caller hands the state over: see
     # evolve_captured (chunking-invariant stream; ``owned=True`` skips the
     # defensive copy for rebinding callers)
@@ -97,8 +127,19 @@ def evolve_multi_captured(
         state = own_pytree(state)
     for _ in range(generations // every):
         if every > 1:
-            state = evolve_multi_donated(config, state, generations=every - 1)
+            if registry is not None:
+                state, ms = evolve_multi_donated(
+                    config, state, generations=every - 1, metrics=True)
+                update_multi_registry(registry, ms, config)
+            else:
+                state = evolve_multi_donated(config, state,
+                                             generations=every - 1)
         state, events = evolve_multi_step_donated(config, state)
+        if registry is not None:
+            for t, tname in enumerate(tnames):
+                update_registry(
+                    registry, count_events(events.action[t], events.loss[t]),
+                    type_name=tname, n_particles=config.sizes[t])
         frame = jax.device_get(
             (state.time, state.weights, state.uids,
              events.action, events.counterpart, events.loss))
@@ -178,6 +219,7 @@ def sharded_evolve_captured(
     every: int = 1,
     process_index: Optional[int] = None,
     num_processes: Optional[int] = None,
+    registry=None,
 ) -> SoupState:
     """Sharded-soup evolution with PER-PROCESS trajectory shards.
 
@@ -186,6 +228,11 @@ def sharded_evolve_captured(
     scale 1/processes, and ``trajstore.read_sharded_store`` merges the
     shards into global frames offline.  Scales the reference's
     never-lose-history registry (``soup.py:37-43``) to multihost.
+
+    ``registry`` meters every generation with GLOBAL counters (the
+    metered sharded scan psums at the shard boundary; the captured step's
+    sharded events reduce under GSPMD) — every process sees the same
+    totals, so a per-process sink stays consistent with its siblings.
     """
     from ..parallel import (sharded_evolve, sharded_evolve_donated,
                             sharded_evolve_step,
@@ -211,16 +258,29 @@ def sharded_evolve_captured(
     if generations % every != 0:
         raise ValueError(f"generations={generations} not divisible by every={every}")
 
+    if registry is not None:
+        from ..telemetry.device import count_events
+        from ..telemetry.soup_metrics import update_registry
+
     owned = False  # donate internal states only, never the caller's input
     for _ in range(generations // every):
         if every > 1:
             run = sharded_evolve_donated if owned else sharded_evolve
-            state = run(config, mesh, state, generations=every - 1)
+            if registry is not None:
+                state, m = run(config, mesh, state, generations=every - 1,
+                               metrics=True)
+                update_registry(registry, m, n_particles=config.size)
+            else:
+                state = run(config, mesh, state, generations=every - 1)
             owned = True
         step = sharded_evolve_step_donated if owned \
             else sharded_evolve_step
         state, events = step(config, mesh, state)
         owned = True
+        if registry is not None:
+            update_registry(registry,
+                            count_events(events.action, events.loss),
+                            n_particles=config.size)
         t = int(jax.device_get(state.time))
         store.append(
             t,

@@ -34,8 +34,13 @@ def _sync(value) -> None:
 def timed(fn: Callable, *args, iters: int = 10, warmup: int = 2,
           **kwargs) -> Dict[str, Any]:
     """Time ``fn(*args, **kwargs)`` over ``iters`` runs after ``warmup``
-    (compile) runs.  Returns mean/median/min/max seconds + per-run list."""
-    for _ in range(max(warmup, 1)):
+    (compile) runs.  Returns mean/median/min/max seconds + per-run list.
+
+    ``warmup=0`` runs NO warm-up call, so the first timed iteration pays
+    compile/cache-deserialize — the cold-start number the telemetry
+    compile-time metrics want (earlier versions silently forced one
+    warm-up run, skewing exactly that measurement)."""
+    for _ in range(max(warmup, 0)):
         _sync(fn(*args, **kwargs))
     times = []
     for _ in range(iters):

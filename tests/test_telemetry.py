@@ -1,0 +1,296 @@
+"""Telemetry subsystem: in-scan metrics carry (parity + recount), sinks
+round-trip (events.jsonl / Prometheus textfile / report CLI), heartbeats,
+spans, and the mega-run wiring (one dispatch per flush interval)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology
+from srnn_tpu.experiment import Experiment
+from srnn_tpu.soup import ACTION_NAMES, SoupConfig, evolve, seed
+from srnn_tpu import telemetry
+from srnn_tpu.telemetry import report
+
+
+def _full_cfg(layout):
+    return SoupConfig(topo=Topology("weightwise"), size=12,
+                      attacking_rate=0.3, learn_from_rate=0.2,
+                      learn_from_severity=1, train=1,
+                      remove_divergent=True, remove_zero=True, layout=layout)
+
+
+# ---------------------------------------------------------------------------
+# device-side metrics carry
+# ---------------------------------------------------------------------------
+
+
+def test_action_code_layout_in_sync():
+    assert len(ACTION_NAMES) == telemetry.N_ACTIONS
+
+
+@pytest.mark.parametrize("layout", ["rowmajor", "popmajor"])
+def test_metrics_carry_parity_and_recount(layout):
+    """Metered evolution is bit-identical to unmetered, and the carry's
+    counters match a NumPy recount of the recorded SoupEvents."""
+    cfg = _full_cfg(layout)
+    st = seed(cfg, jax.random.key(3))
+    plain = evolve(cfg, st, generations=4)
+    metered, m = evolve(cfg, st, generations=4, metrics=True)
+    np.testing.assert_array_equal(np.asarray(plain.weights),
+                                  np.asarray(metered.weights))
+    np.testing.assert_array_equal(np.asarray(plain.uids),
+                                  np.asarray(metered.uids))
+
+    _final, (ev, _w, _u) = evolve(cfg, st, generations=4, record=True)
+    recount = np.bincount(np.asarray(ev.action).reshape(-1),
+                          minlength=telemetry.N_ACTIONS)
+    np.testing.assert_array_equal(recount, np.asarray(m.actions))
+    assert int(m.generations) == 4
+    np.testing.assert_allclose(float(m.loss_sum),
+                               float(np.asarray(ev.loss).sum()), rtol=1e-5)
+    # record + metrics compose
+    _f, _recs, m2 = evolve(cfg, st, generations=4, record=True, metrics=True)
+    np.testing.assert_array_equal(np.asarray(m2.actions), np.asarray(m.actions))
+
+
+def test_multi_metrics_parity_and_recount():
+    from srnn_tpu.multisoup import (MultiSoupConfig, evolve_multi,
+                                    evolve_multi_step, seed_multi)
+
+    mc = MultiSoupConfig(
+        topos=(Topology("weightwise"), Topology("aggregating", aggregates=4)),
+        sizes=(6, 6), attacking_rate=0.4, learn_from_rate=0.3,
+        learn_from_severity=1, train=1, remove_divergent=True,
+        remove_zero=True)
+    st = seed_multi(mc, jax.random.key(0))
+    plain = evolve_multi(mc, st, generations=3)
+    metered, ms = evolve_multi(mc, st, generations=3, metrics=True)
+    for wa, wb in zip(plain.weights, metered.weights):
+        np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+    # recount from the step-by-step event stream (same PRNG path)
+    s, rec = st, [np.zeros(telemetry.N_ACTIONS, int) for _ in mc.topos]
+    for _ in range(3):
+        s, ev = evolve_multi_step(mc, s)
+        for t in range(len(mc.topos)):
+            rec[t] += np.bincount(np.asarray(ev.action[t]),
+                                  minlength=telemetry.N_ACTIONS)
+    for t in range(len(mc.topos)):
+        np.testing.assert_array_equal(rec[t], np.asarray(ms[t].actions))
+        assert int(ms[t].generations) == 3
+
+
+def test_sharded_metrics_match_unsharded(mesh):
+    """The metered sharded scan psums per-shard carries into the same
+    global counters the single-device carry produces; integer state stays
+    bitwise, weights to the suite's usual fusion tolerance."""
+    from srnn_tpu.parallel import make_sharded_state
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve
+
+    cfg = SoupConfig(topo=Topology("weightwise"), size=16,
+                     attacking_rate=0.4, remove_divergent=True,
+                     remove_zero=True, layout="popmajor")
+    sst = make_sharded_state(cfg, mesh, jax.random.key(1))
+    sh, m_sh = sharded_evolve(cfg, mesh, sst, generations=4, metrics=True)
+    un, m_un = evolve(cfg, seed(cfg, jax.random.key(1)), generations=4,
+                      metrics=True)
+    np.testing.assert_array_equal(np.asarray(m_un.actions),
+                                  np.asarray(m_sh.actions))
+    assert int(m_sh.generations) == int(m_un.generations) == 4
+    np.testing.assert_array_equal(np.asarray(un.uids), np.asarray(sh.uids))
+    np.testing.assert_allclose(np.asarray(un.weights),
+                               np.asarray(sh.weights), rtol=0, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# host-side registry + sinks
+# ---------------------------------------------------------------------------
+
+
+def test_registry_sinks_roundtrip(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    reg.counter("soup_attacks_total", help="attacks").inc(7)
+    reg.counter("soup_attacks_total").inc(3)
+    reg.gauge("gens_per_sec", unit="1/s").set(12.5, stage="test")
+    reg.histogram("span_seconds").observe(0.02, span="chunk")
+    rows = reg.rows()
+    assert rows["srnn_soup_attacks_total"] == 10
+    assert rows['srnn_gens_per_sec{stage="test"}'] == 12.5
+    assert rows['srnn_span_seconds_count{span="chunk"}'] == 1
+
+    # kind-mismatched re-registration is an error, not silent data loss
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("soup_attacks_total")
+
+    # Prometheus textfile exposition
+    prom = tmp_path / "metrics.prom"
+    reg.write_textfile(str(prom))
+    text = prom.read_text()
+    assert "# TYPE srnn_soup_attacks_total counter" in text
+    assert "srnn_soup_attacks_total 10" in text
+    assert 'srnn_span_seconds_bucket{span="chunk",le="+Inf"} 1' in text
+
+    # events.jsonl sink through the Experiment channel
+    with Experiment("telemetry", root=str(tmp_path)) as exp:
+        reg.flush_events(exp)
+        run_dir = exp.dir
+    recs = [json.loads(l) for l in
+            open(os.path.join(run_dir, "events.jsonl"))]
+    mrows = [r for r in recs if r.get("kind") == "metrics"]
+    assert mrows and mrows[-1]["metrics"]["srnn_soup_attacks_total"] == 10
+
+
+def test_heartbeat_rows_and_report(tmp_path, capsys):
+    reg = telemetry.MetricsRegistry()
+    with Experiment("hb", root=str(tmp_path)) as exp:
+        hb = telemetry.Heartbeat(exp, stage="unit",
+                                 total_generations=10, registry=reg)
+        hb.beat(generation=2, gens_per_sec=5.0)
+        hb.beat(generation=4, gens_per_sec=6.0, extra_field=1)
+        with telemetry.span("unit.block", registry=reg, exp=exp) as s:
+            s.sync(jnp.ones(4).sum())
+        reg.flush_events(exp)
+        run_dir = exp.dir
+    recs = [json.loads(l) for l in
+            open(os.path.join(run_dir, "events.jsonl"))]
+    beats = [r for r in recs if r.get("kind") == "heartbeat"]
+    assert [b["generation"] for b in beats] == [2, 4]
+    assert beats[1]["beat"] == 1 and beats[1]["since_last_s"] >= 0
+    assert beats[0]["total_generations"] == 10
+    assert "rss_mb" in beats[0]  # linux /proc is available in CI
+    spans = [r for r in recs if r.get("kind") == "span"]
+    assert spans and spans[0]["span"] == "unit.block" \
+        and spans[0]["seconds"] > 0
+    assert s.seconds is not None and s.seconds > 0
+    assert reg.histogram("span_seconds").count(span="unit.block") == 1
+
+    # the report CLI renders the trail
+    assert report.main([run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "unit: 2 beats, last at gen 4/10" in out
+    assert "unit.block" in out and "srnn_gens_per_sec" in out
+    # machine-readable summary agrees
+    s = report.summarize(run_dir)
+    assert s["heartbeats"]["unit"]["beats"] == 2
+    assert s["metrics_flushes"] == 1
+    assert report.main([str(tmp_path / "nope")]) == 2
+
+
+def test_annotate_is_trace_safe():
+    @telemetry.annotate("test.annotated")
+    def f(x):
+        return x * 2
+
+    assert int(jax.jit(f)(jnp.int32(4))) == 8
+
+
+# ---------------------------------------------------------------------------
+# capture + mega-run wiring
+# ---------------------------------------------------------------------------
+
+
+def test_capture_meters_every_generation(tmp_path):
+    from srnn_tpu.utils import TrajStore, evolve_captured
+
+    cfg = SoupConfig(topo=Topology("weightwise"), size=8, attacking_rate=0.5,
+                     remove_divergent=True, remove_zero=True)
+    st = seed(cfg, jax.random.key(2))
+    reg = telemetry.MetricsRegistry()
+    store = TrajStore(str(tmp_path / "s.traj"), n_particles=8,
+                      n_weights=cfg.topo.num_weights)
+    try:
+        evolve_captured(cfg, st, generations=4, store=store, every=2,
+                        registry=reg)
+    finally:
+        store.close()
+    rows = reg.rows()
+    # every generation counted, not just the captured stride
+    assert rows["srnn_soup_generations_total"] == 4
+    assert rows["srnn_soup_particle_generations_total"] == 32
+    # recount the same evolution's events for the attack total
+    _f, (ev, _w, _u) = evolve(cfg, st, generations=4, record=True)
+    attacks = int((np.asarray(ev.action)
+                   == ACTION_NAMES.index("attacking")).sum())
+    assert rows["srnn_soup_attacks_total"] == attacks
+
+
+def test_mega_soup_one_dispatch_per_flush(tmp_path, monkeypatch, capsys):
+    """The metered mega-run loop dispatches exactly ONE executable per
+    flush interval (checkpoint chunk) — metrics accumulate in-scan, not
+    via per-generation host syncs — and its run dir carries the full
+    telemetry trail (heartbeats + metrics rows + metrics.prom) that the
+    report CLI renders."""
+    import srnn_tpu.setups.mega_soup as ms
+
+    calls = []
+    orig = ms.evolve_donated
+
+    def counting(cfg, state, **kw):
+        calls.append(kw)
+        return orig(cfg, state, **kw)
+
+    monkeypatch.setattr(ms, "evolve_donated", counting)
+    run_dir = ms.run(ms.build_parser().parse_args(
+        ["--smoke", "--size", "16", "--generations", "4",
+         "--checkpoint-every", "2", "--root", str(tmp_path)]))
+    assert len(calls) == 2, "one dispatch per 2-generation flush interval"
+    assert all(kw.get("metrics") for kw in calls)
+
+    recs = [json.loads(l) for l in
+            open(os.path.join(run_dir, "events.jsonl"))]
+    kinds = {r.get("kind") for r in recs}
+    assert {"heartbeat", "metrics"} <= kinds
+    last_metrics = [r for r in recs if r.get("kind") == "metrics"][-1]
+    assert last_metrics["metrics"]["srnn_soup_generations_total"] == 4
+    hb = [r for r in recs if r.get("kind") == "heartbeat"][-1]
+    assert hb["generation"] == 4 and hb["stage"] == "mega_soup"
+    assert os.path.exists(os.path.join(run_dir, "metrics.prom"))
+
+    capsys.readouterr()
+    assert report.main([run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "mega_soup" in out and "srnn_soup_generations_total = 4" in out
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+
+def test_timed_honors_warmup_zero():
+    from srnn_tpu.utils import timed
+
+    ncalls = []
+
+    def fn():
+        ncalls.append(1)
+        return jnp.float32(1.0)
+
+    stats = timed(fn, iters=3, warmup=0)
+    assert len(ncalls) == 3 and stats["iters"] == 3
+    ncalls.clear()
+    timed(fn, iters=2, warmup=2)
+    assert len(ncalls) == 4
+
+
+def test_aot_compile_records_runtime_metrics():
+    from srnn_tpu.telemetry.metrics import RUNTIME
+    from srnn_tpu.utils import aot
+
+    cfg = SoupConfig(topo=Topology("weightwise"), size=4)
+    from srnn_tpu.soup import evolve_step
+
+    aot.clear_executable_cache()
+    name = "telemetry.test.entry"
+    before = RUNTIME.counter("aot_compiles_total").value(entry=name)
+    aot.aot_compile(name, evolve_step, (cfg, aot.abstract_soup_state(cfg)))
+    assert RUNTIME.counter("aot_compiles_total").value(entry=name) \
+        == before + 1
+    hits_before = RUNTIME.counter("aot_memo_hits_total").value(entry=name)
+    aot.aot_compile(name, evolve_step, (cfg, aot.abstract_soup_state(cfg)))
+    assert RUNTIME.counter("aot_memo_hits_total").value(entry=name) \
+        == hits_before + 1
