@@ -127,31 +127,49 @@ def _bench_fn(topo, steps):
 
 
 def _measure(topo, n, steps, calls, stage=None):
-    """Ramped measurement unit: returns applications/sec for (n, steps)."""
+    """Ramped measurement unit: returns (applications/sec, overlap summary)
+    for (n, steps).  The overlap summary is ``OverlapMeter.summary()`` —
+    wall vs device-wait vs host seconds — and the same cumulative numbers
+    ride every heartbeat row, so even a KILLED child's last heartbeat
+    attributes where its budget went (host stall vs device compute)."""
     import jax
 
     from srnn_tpu import init_population
+    from srnn_tpu.utils.pipeline import OverlapMeter
 
     # damped init keeps the iteration numerically tame for the whole run;
     # throughput is magnitude-independent
     wT = (init_population(topo, jax.random.key(0), n) * 0.05).T
     run = _bench_fn(topo, steps)
+    meter = OverlapMeter()
+
+    def attr():
+        t = meter.totals
+        return {"device_wait_s": round(t["device_wait_s"], 3),
+                "wall_s": round(t["wall_s"], 3)}
+
     if stage:
         _hb(stage, "init", n=n, steps=steps)
 
-    _ = float(run(wT)[1])  # compile (persistent-cache served) + warm
+    t0 = time.perf_counter()
+    with meter.waiting():
+        _ = float(run(wT)[1])  # compile (persistent-cache served) + warm
+    meter.chunk_done(time.perf_counter() - t0)
     if stage:
-        _hb(stage, "compiled+warm")
+        _hb(stage, "compiled+warm", **attr())
     # time each dispatch individually so the liveness heartbeat between
     # calls never contaminates the measured window
     dt = 0.0
     for i in range(calls):
         t0 = time.perf_counter()
-        _ = float(run(wT)[1])  # scalar readback forces completion
-        dt += time.perf_counter() - t0
+        with meter.waiting():
+            _ = float(run(wT)[1])  # scalar readback forces completion
+        call_s = time.perf_counter() - t0
+        dt += call_s
+        meter.chunk_done(call_s)
         if stage:
-            _hb(stage, "call", call=i + 1, calls=calls)
-    return n * steps * calls / dt
+            _hb(stage, "call", call=i + 1, calls=calls, **attr())
+    return n * steps * calls / dt, meter.summary()
 
 
 def _precompile(topo, shapes):
@@ -220,18 +238,19 @@ def _child_stage(stage: str) -> None:
     if stage == "ramp":
         # tiny shapes — proves compile + execute end-to-end and leaves a
         # nonzero fail-soft number if the full run dies
-        apps = _measure(topo, RAMP_N, RAMP_STEPS, 1, stage=stage)
+        apps, overlap = _measure(topo, RAMP_N, RAMP_STEPS, 1, stage=stage)
     elif on_cpu:
         # degraded run: the full 1M x 2000-step workload would take hours
         # on host CPU; report a reduced honest measurement
-        apps = _measure(topo, 100_000, 20, 1, stage=stage)
+        apps, overlap = _measure(topo, 100_000, 20, 1, stage=stage)
     else:
-        apps = _measure(topo, N, STEPS_PER_CALL, CALLS, stage=stage)
+        apps, overlap = _measure(topo, N, STEPS_PER_CALL, CALLS, stage=stage)
     out = {
         "apps_per_chip": apps / jax.device_count(),
         "device_count": jax.device_count(),
         "backend": platform + ("-fallback" if fell_back else
                                "-forced" if forced_cpu else ""),
+        "pipeline": overlap,
     }
     print(_SENTINEL + json.dumps(out), flush=True)
     sys.stdout.flush()
@@ -360,6 +379,12 @@ def _orchestrate(result):
             att["outcome"] = "ok" if r is not None else err
             if hb is not None:
                 att["last_heartbeat"] = hb
+            if r is not None and "pipeline" in r:
+                # device-idle/overlap attribution alongside the stage_log
+                # row: a slow-but-successful attempt names host stall vs
+                # device compute (timed-out attempts carry the same
+                # cumulative numbers on their last_heartbeat)
+                att["pipeline"] = r["pipeline"]
             stage_log.append(att)
             if r is not None:
                 return r

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -177,6 +178,10 @@ class Experiment:
         self.dir: Optional[str] = None
         self._t0: Optional[float] = None
         self._prior_wall = 0.0  # accumulated runtime of earlier attach()ed runs
+        # events.jsonl is written from the run loop AND from the async
+        # pipeline's background writer (heartbeat rows, metrics flushes run
+        # as queued jobs) — serialize the write+flush(+fsync) per record
+        self._events_lock = threading.Lock()
 
     @classmethod
     def attach(cls, run_dir: str) -> "Experiment":
@@ -258,10 +263,11 @@ class Experiment:
         ``_fsync=True`` (heartbeats — telemetry liveness rows) additionally
         forces the record to disk past the OS cache."""
         fields.setdefault("t", time.time() - self._t0)
-        self._events.write(json.dumps(_jsonify(fields), default=str) + "\n")
-        self._events.flush()
-        if _fsync:
-            os.fsync(self._events.fileno())
+        with self._events_lock:
+            self._events.write(json.dumps(_jsonify(fields), default=str) + "\n")
+            self._events.flush()
+            if _fsync:
+                os.fsync(self._events.fileno())
 
     def save_log(self, log_name: str = "log"):
         with open(os.path.join(self.dir, f"{log_name}.txt"), "w") as f:

@@ -128,3 +128,46 @@ def load_run_config(run_dir: str, args, fields, legacy_defaults=None) -> None:
     for k in fields:
         fallback = legacy.get(k, getattr(args, k))
         setattr(args, k, saved.get(k, fallback))
+
+
+def add_pipeline_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The async-pipeline CLI knobs shared by the mega-run entry points."""
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="run the blocking chunk loop (frame pulls, "
+                        "checkpoints and sink writes on the critical path) "
+                        "instead of the default dispatch-ahead async "
+                        "pipeline; the captured streams, checkpoints and "
+                        "resume continuations are bit-identical either way")
+    p.add_argument("--heartbeat-fsync-every", type=int, default=1,
+                   metavar="N",
+                   help="fsync every N-th heartbeat row (default 1: "
+                        "row-by-row kill survival; raise to amortize the "
+                        "sync on slow storage)")
+    return p
+
+
+def make_pipeline(args, registry, stage: str):
+    """Build a mega loop's async-pipeline trio (see ``utils.pipeline``):
+    ONE background writer owning every host-I/O job in submission order,
+    the overlap meter attributing each chunk's wall time, and the chunk
+    driver deferring chunk k's host finisher until chunk k+1's device
+    work is dispatched.  ``--no-pipeline`` degrades all three to the
+    blocking order (writer=None, depth=0) — the bit-identical A/B
+    reference.  Returns ``(pipelined, writer, meter, driver)``."""
+    from ..utils.pipeline import BackgroundWriter, ChunkDriver, OverlapMeter
+
+    pipelined = not args.no_pipeline
+    writer = BackgroundWriter(name=f"{stage}-io") if pipelined else None
+    meter = OverlapMeter(registry, stage=stage, writer=writer)
+    driver = ChunkDriver(depth=1 if pipelined else 0)
+    return pipelined, writer, meter, driver
+
+
+def finish_pipeline(exp, driver, writer, meter, pipelined: bool) -> None:
+    """Chunk-loop epilogue: run the deferred finishers, drain the writer
+    (all sinks/checkpoints durable before the final log line), record the
+    run's overlap attribution."""
+    driver.drain()
+    if writer is not None:
+        writer.flush()
+    exp.event(kind="pipeline", pipelined=pipelined, **meter.summary())

@@ -35,9 +35,11 @@ from ..telemetry import Heartbeat, MetricsRegistry
 from ..telemetry.soup_metrics import (type_names, update_class_gauges,
                                       update_multi_registry)
 from ..utils.aot import ensure_compilation_cache
+from ..utils.pipeline import snapshot, submit_or_run
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
-from .common import (base_parser, latest_checkpoint,
+from .common import (add_pipeline_args, base_parser, finish_pipeline,
+                     latest_checkpoint, make_pipeline,
                      load_run_config, register, save_run_config)
 
 
@@ -72,6 +74,7 @@ def build_parser():
     p.add_argument("--sharded", action="store_true",
                    help="shard every type's particle axis over ALL visible "
                         "devices (shard_map data parallel)")
+    add_pipeline_args(p)
     return p
 
 
@@ -208,10 +211,12 @@ def run(args):
                    if mesh is not None else ""))
 
     def _count(s):
+        # device array out: dispatched before the next chunk donates s's
+        # buffers, resolved in the (possibly deferred) chunk finisher
         if mesh is not None:
             from ..parallel import sharded_count_multi
-            return np.asarray(sharded_count_multi(cfg, mesh, s))
-        return np.asarray(count_multi(cfg, s))
+            return sharded_count_multi(cfg, mesh, s)
+        return count_multi(cfg, s)
 
     # Donation discipline (see mega_soup): unsharded chunks are
     # ALL-donated (states entering the loop are jax-owned — seeds are jit
@@ -231,13 +236,21 @@ def run(args):
     # in-scan carries, class gauges per type) + fsync'd heartbeats; both
     # flushed every chunk to events.jsonl and metrics.prom
     registry = MetricsRegistry()
-    hb = Heartbeat(exp, stage="mega_multisoup",
-                   total_generations=args.generations, registry=registry)
-    hb.beat(generation=int(state.time))
-
-    stores = None
+    stores = writer = None
     import time as _time
     try:
+        # writer spawns INSIDE the try (see mega_soup): a crash in this
+        # window must reach writer.close() or the non-daemon worker
+        # hangs interpreter shutdown
+        pipelined, writer, meter, driver = make_pipeline(args, registry,
+                                                         "mega_multisoup")
+        hb = Heartbeat(exp, stage="mega_multisoup",
+                       total_generations=args.generations,
+                       registry=registry,
+                       fsync_every=args.heartbeat_fsync_every,
+                       writer=writer)
+        hb.beat(generation=int(state.time))
+
         if args.capture_every:
             from ..utils import TrajStore, truncate_frames
             paths = [os.path.join(exp.dir, f"soup.t{t}.traj")
@@ -265,46 +278,97 @@ def run(args):
                         f"{stores[0].existing_frames} existing frames")
             exp.log(f"capturing every {args.capture_every} generations to "
                     f"{len(stores)} per-type stores")
-        counts = _count(state)
+            if writer is not None:
+                for store in stores:
+                    # crash path: close() drains queued appends + flushes
+                    writer.add_close_hook(store.join)
+        with meter.waiting():
+            counts = np.asarray(_count(state))
+        # Pipelined order per iteration (see mega_soup): dispatch the
+        # chunk, dispatch its count, snapshot the state for the checkpoint
+        # — all before chunk k+1's donating dispatch — then defer the host
+        # finisher one iteration.  `gen` advances host-side so the loop
+        # condition never forces a device sync.
         owned = False
-        while int(state.time) < args.generations:
-            chunk = min(args.checkpoint_every,
-                        args.generations - int(state.time))
-            t0 = _time.perf_counter()
+        gen = int(state.time)
+        t_last = _time.perf_counter()
+
+        def _class_gauges(counts, prev):
+            for t, tname in enumerate(type_names(cfg)):
+                update_class_gauges(registry, counts[t],
+                                    type_name=tname, prev=prev[t])
+
+        def _finisher(gen, chunk, counts_dev, ckpt_state, ms=None):
+            def finish():
+                nonlocal counts, t_last
+                with meter.waiting():
+                    new_counts = np.asarray(counts_dev)  # chunk landed
+                prev, counts = counts, new_counts
+                now = _time.perf_counter()
+                dt, t_last = max(now - t_last, 1e-9), now
+                exp.log(f"gen {gen}/{args.generations}  "
+                        f"{chunk / dt:.2f} gens/s  "
+                        f"{_format_type_counts(counts)}",
+                        generation=gen, gens_per_sec=round(chunk / dt, 3),
+                        counts=counts.tolist())
+                # registry-mutation ordering + host_io window: see the
+                # mega_soup finisher — chunk k's mutations ride the
+                # writer ahead of chunk k's flush_events
+                with meter.host_io():
+                    if ms is not None:
+                        submit_or_run(writer, update_multi_registry,
+                                      registry, ms, cfg)
+                    submit_or_run(writer, _class_gauges, counts, prev)
+                    hb.beat(generation=gen, gens_per_sec=chunk / dt,
+                            chunk_seconds=round(dt, 3))
+                    submit_or_run(writer, registry.flush_events, exp)
+                    submit_or_run(writer, registry.write_textfile,
+                                  os.path.join(exp.dir, "metrics.prom"))
+                    submit_or_run(writer, save_multi_checkpoint,
+                                  os.path.join(exp.dir,
+                                               f"ckpt-gen{gen:08d}"),
+                                  ckpt_state)
+                meter.chunk_done(dt)
+            return finish
+
+        while gen < args.generations:
+            chunk = min(args.checkpoint_every, args.generations - gen)
+            # non-capture chunks hand their metrics carry to the
+            # finisher, which orders it ahead of the chunk's flush
+            ms = None
             if stores is not None:
                 from ..utils import evolve_multi_captured
                 # owned=True: state is jax-owned (seed/own_pytree) and
                 # rebound every chunk — skip capture's defensive copy
                 state = evolve_multi_captured(cfg, state, chunk, stores,
                                               every=args.capture_every,
-                                              owned=True, registry=registry)
+                                              owned=True, registry=registry,
+                                              pipelined=pipelined,
+                                              writer=writer)
             else:
+                # the metrics carry rides the finisher, ordered ahead of
+                # this chunk's flush_events
                 state, ms = _evolve(state, chunk, owned)
-                update_multi_registry(registry, ms, cfg)
             owned = True
-            prev_counts, counts = counts, _count(state)
-            for t, tname in enumerate(type_names(cfg)):
-                update_class_gauges(registry, counts[t],
-                                    type_name=tname,
-                                    prev=prev_counts[t])
-            dt = _time.perf_counter() - t0
-            gen = int(state.time)
-            exp.log(f"gen {gen}/{args.generations}  {chunk / dt:.2f} gens/s  "
-                    f"{_format_type_counts(counts)}",
-                    generation=gen, gens_per_sec=round(chunk / dt, 3),
-                    counts=counts.tolist())
-            hb.beat(generation=gen, gens_per_sec=chunk / dt,
-                    chunk_seconds=round(dt, 3))
-            registry.flush_events(exp)
-            registry.write_textfile(os.path.join(exp.dir, "metrics.prom"))
-            save_multi_checkpoint(os.path.join(exp.dir, f"ckpt-gen{gen:08d}"),
-                                  state)
+            gen += chunk
+            # both dispatched BEFORE the next iteration donates state
+            # (the metrics carry ms is a fresh jit output, never donated):
+            counts_dev = _count(state)
+            ckpt_state = snapshot(state) if pipelined else state
+            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, ms))
+        finish_pipeline(exp, driver, writer, meter, pipelined)
         exp.log(f"done: {_format_type_counts(counts)}")
     finally:
+        # teardown order (see mega_soup): pipeline writer, then stores,
+        # then the experiment — nested finallys keep meta.json guaranteed
         try:
-            if stores is not None:
-                for store in stores:
-                    store.close()
+            try:
+                if writer is not None:
+                    writer.close()
+            finally:
+                if stores is not None:
+                    for store in stores:
+                        store.close()
         finally:
             exp.__exit__(*sys.exc_info())
     return exp.dir

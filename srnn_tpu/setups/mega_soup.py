@@ -28,9 +28,11 @@ from ..soup import SoupConfig, count, evolve, evolve_donated, seed
 from ..telemetry import Heartbeat, MetricsRegistry
 from ..telemetry.soup_metrics import update_class_gauges, update_registry
 from ..utils.aot import ensure_compilation_cache
+from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
-from .common import (base_parser, latest_checkpoint,
-                     load_run_config, register, save_run_config)
+from .common import (add_pipeline_args, base_parser, finish_pipeline,
+                     latest_checkpoint, load_run_config, make_pipeline,
+                     register, save_run_config)
 
 
 def build_parser():
@@ -75,6 +77,7 @@ def build_parser():
                         "(shard_map data parallel); trajectory capture then "
                         "writes one .traj shard per process (multihost-safe) "
                         "merged offline by read_sharded_store")
+    add_pipeline_args(p)
     return p
 
 
@@ -159,23 +162,36 @@ def run(args):
                    if mesh is not None else ""))
 
     def _count(s):
+        # returns the DEVICE array: the dispatch is cheap and ordered
+        # before the next chunk donates s's buffers; the np.asarray
+        # resolve happens in the chunk's (possibly deferred) finisher
         if mesh is not None:
             from ..parallel import sharded_count
-            return np.asarray(sharded_count(cfg, mesh, s))
-        return np.asarray(count(cfg, s))
+            return sharded_count(cfg, mesh, s)
+        return count(cfg, s)
 
     # telemetry: per-run metrics registry (science counters from the
     # in-scan device carry, class gauges from the chunk counts) flushed to
     # events.jsonl + metrics.prom every chunk, and fsync'd heartbeat rows
     # so a killed run names its last stage/generation/rate
     registry = MetricsRegistry()
-    hb = Heartbeat(exp, stage="mega_soup",
-                   total_generations=args.generations, registry=registry)
-    hb.beat(generation=int(state.time))
-
-    store = None
+    store = writer = None
     import time as _time
     try:
+        # the writer's non-daemon worker spawns INSIDE the try: any
+        # exception from here on (a bad-restore readback in the first
+        # beat, a store open failure, ^C) reaches writer.close() in the
+        # finally — outside it, a crash would strand the thread in
+        # q.get() and hang interpreter shutdown instead of exiting
+        pipelined, writer, meter, driver = make_pipeline(args, registry,
+                                                         "mega_soup")
+        hb = Heartbeat(exp, stage="mega_soup",
+                       total_generations=args.generations,
+                       registry=registry,
+                       fsync_every=args.heartbeat_fsync_every,
+                       writer=writer)
+        hb.beat(generation=int(state.time))
+
         if args.capture_every:
             from ..utils import TrajStore, truncate_sharded_frames
             traj_path = os.path.join(exp.dir, "soup.traj")
@@ -204,7 +220,12 @@ def run(args):
                     f"to soup.traj"
                     + (f" ({jax.process_count()} process shards)"
                        if mesh is not None and jax.process_count() > 1 else ""))
-        counts = _count(state)
+            if writer is not None:
+                # crash path: even if the loop dies mid-chunk, close()
+                # drains the queued appends and joins the store's flush
+                writer.add_close_hook(store.join)
+        with meter.waiting():
+            counts = np.asarray(_count(state))
         # Donation discipline.  Unsharded chunks are ALL-donated — every
         # state entering the loop is jax-owned (seed is a jit output, a
         # restore is own_pytree-copied above), and using ONE executable for
@@ -213,15 +234,70 @@ def run(args):
         # would break bit-exact resume).  The sharded path donates only
         # states this loop itself produced (first chunk plain): a
         # device_put-placed restore has no such ownership guarantee.
+        #
+        # Pipelined order per iteration: dispatch chunk k's device work,
+        # dispatch its count, snapshot the state for the checkpoint (both
+        # MUST precede chunk k+1's donating dispatch — device-stream order
+        # makes them read pre-donation bytes), then hand the host finisher
+        # to the driver, which runs it one iteration later — with chunk
+        # k+1 already queued on the device.  `gen` advances host-side so
+        # the loop condition never forces a device sync.
         sh_owned = False
-        while int(state.time) < args.generations:
-            chunk = min(args.checkpoint_every, args.generations - int(state.time))
-            t0 = _time.perf_counter()
+        gen = int(state.time)
+        t_last = _time.perf_counter()
+
+        def _finisher(gen, chunk, counts_dev, ckpt_state, m=None):
+            def finish():
+                nonlocal counts, t_last
+                with meter.waiting():
+                    new_counts = np.asarray(counts_dev)  # chunk landed
+                prev, counts = counts, new_counts
+                now = _time.perf_counter()
+                dt, t_last = max(now - t_last, 1e-9), now
+                exp.log(f"gen {gen}/{args.generations}  "
+                        f"{chunk / dt:.2f} gens/s  {format_counters(counts)}",
+                        generation=gen, gens_per_sec=round(chunk / dt, 3),
+                        counts=counters_dict(counts))
+                # EVERY registry mutation of chunk k — the in-scan
+                # metrics carry, class gauges, heartbeat gauges — rides
+                # the writer HERE, in submission order ahead of chunk k's
+                # flush_events, so the metrics row can never see chunk
+                # k+1's values (capture-mode science counters are the
+                # documented exception: they enqueue per generation
+                # during chunk k+1's producer loop, so a flush may count
+                # them up to one chunk early).  The host_io window times
+                # the inline work in the blocking loop and the
+                # enqueue/backpressure stall in the pipelined one.
+                with meter.host_io():
+                    if m is not None:
+                        submit_or_run(writer, update_registry, registry,
+                                      m, n_particles=cfg.size)
+                    submit_or_run(writer, update_class_gauges, registry,
+                                  counts, prev=prev)
+                    hb.beat(generation=gen, gens_per_sec=chunk / dt,
+                            chunk_seconds=round(dt, 3))
+                    submit_or_run(writer, registry.flush_events, exp)
+                    submit_or_run(writer, registry.write_textfile,
+                                  os.path.join(exp.dir, "metrics.prom"))
+                    submit_or_run(writer, save_checkpoint,
+                                  os.path.join(exp.dir,
+                                               f"ckpt-gen{gen:08d}"),
+                                  ckpt_state)
+                meter.chunk_done(dt)
+            return finish
+
+        while gen < args.generations:
+            chunk = min(args.checkpoint_every, args.generations - gen)
+            # non-capture chunks hand their metrics carry to the
+            # finisher, which orders it ahead of the chunk's flush
+            m = None
             if store is not None and mesh is not None:
                 from ..utils import sharded_evolve_captured
                 state = sharded_evolve_captured(cfg, mesh, state, chunk, store,
                                                 every=args.capture_every,
-                                                registry=registry)
+                                                registry=registry,
+                                                pipelined=pipelined,
+                                                writer=writer)
             elif store is not None:
                 from ..utils import evolve_captured
                 # owned=True: this loop's state is always jax-owned (seed
@@ -229,42 +305,41 @@ def run(args):
                 # and rebound, so capture skips its defensive copy
                 state = evolve_captured(cfg, state, chunk, store,
                                         every=args.capture_every,
-                                        owned=True, registry=registry)
+                                        owned=True, registry=registry,
+                                        pipelined=pipelined, writer=writer)
             elif mesh is not None:
                 from ..parallel import (sharded_evolve,
                                         sharded_evolve_donated)
                 run = sharded_evolve_donated if sh_owned else sharded_evolve
                 state, m = run(cfg, mesh, state, generations=chunk,
                                metrics=True)
-                update_registry(registry, m, n_particles=cfg.size)
                 sh_owned = True
             else:
                 state, m = evolve_donated(cfg, state, generations=chunk,
                                           metrics=True)
-                update_registry(registry, m, n_particles=cfg.size)
-            prev_counts, counts = counts, _count(state)
-            update_class_gauges(registry, counts, prev=prev_counts)
-            dt = _time.perf_counter() - t0
-            gen = int(state.time)
-            exp.log(f"gen {gen}/{args.generations}  {chunk / dt:.2f} gens/s  "
-                    f"{format_counters(counts)}",
-                    generation=gen, gens_per_sec=round(chunk / dt, 3),
-                    counts=counters_dict(counts))
-            hb.beat(generation=gen, gens_per_sec=chunk / dt,
-                    chunk_seconds=round(dt, 3))
-            registry.flush_events(exp)
-            registry.write_textfile(os.path.join(exp.dir, "metrics.prom"))
-            save_checkpoint(os.path.join(exp.dir, f"ckpt-gen{gen:08d}"), state)
+            gen += chunk
+            # both dispatched BEFORE the next iteration donates state
+            # (the metrics carry m is a fresh jit output, never donated):
+            counts_dev = _count(state)
+            ckpt_state = snapshot(state) if pipelined else state
+            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, m))
+        finish_pipeline(exp, driver, writer, meter, pipelined)
         exp.log(f"done: {counters_dict(counts)}")
     finally:
-        # close the capture store first (joins the native writer thread so
-        # every queued frame hits disk even on a crash path), then close the
-        # experiment exactly once with real exception info so meta.json
-        # records crashes.  The nested finally guarantees meta.json is
-        # written even when store.close() itself raises (e.g. disk full).
+        # teardown order: the pipeline writer first (drains queued frame
+        # appends/checkpoints and joins its thread, re-raising any job
+        # failure), then the capture store (joins the native writer thread
+        # so every appended frame hits disk even on a crash path), then
+        # the experiment exactly once with real exception info so
+        # meta.json records crashes.  Nested finallys guarantee meta.json
+        # is written even when a close itself raises (e.g. disk full).
         try:
-            if store is not None:
-                store.close()
+            try:
+                if writer is not None:
+                    writer.close()
+            finally:
+                if store is not None:
+                    store.close()
         finally:
             exp.__exit__(*sys.exc_info())
     return exp.dir

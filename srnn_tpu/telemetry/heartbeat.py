@@ -68,14 +68,24 @@ class Heartbeat:
     Rows are fsync'd (the whole point is surviving a kill); each row also
     carries ``beat`` (a per-instance sequence number) and the seconds
     since the previous beat, so a trail's cadence is self-describing.
+
+    ``fsync_every=N`` amortizes the sync on slow storage: every row is
+    still flushed (OS-cache durable), but only every N-th row pays the
+    fsync.  The default (1) keeps the kill-survival guarantee row-by-row.
+    ``writer`` (a ``utils.pipeline.BackgroundWriter``) moves the sink
+    write — fsync AND gauge updates, as one ordered job — off the
+    producing thread; the row is still composed (rss/device stats
+    sampled) at beat time.
     """
 
     def __init__(self, exp, stage: str, total_generations: Optional[int] = None,
-                 registry=None):
+                 registry=None, fsync_every: int = 1, writer=None):
         self.exp = exp
         self.stage = stage
         self.total_generations = total_generations
         self.registry = registry
+        self.fsync_every = max(1, int(fsync_every))
+        self.writer = writer
         self.count = 0
         self._last_t: Optional[float] = None
 
@@ -98,20 +108,33 @@ class Heartbeat:
         if dev is not None:
             row["device_memory"] = dev
         row.update(extra)
-        self.exp.event(_fsync=True, kind="heartbeat", **row)
-        if self.registry is not None:
-            g = self.registry.gauge
-            if generation is not None:
-                g("heartbeat_generation",
-                  help="last heartbeat's generation").set(
-                      int(generation), stage=self.stage)
-            if gens_per_sec is not None:
-                g("gens_per_sec", help="generations per second",
-                  unit="1/s").set(round(float(gens_per_sec), 3),
-                                  stage=self.stage)
-            if rss is not None:
-                g("rss_bytes", help="host resident set size",
-                  unit="bytes").set(rss, stage=self.stage)
+        fsync = (self.count % self.fsync_every) == 0
+
+        def sink():
+            # ONE job: row write + gauge updates, all values precomputed
+            # at beat time.  Riding the writer as a unit keeps registry
+            # mutations totally ordered with the queued flush_events
+            # snapshots — chunk k's metrics row can never see beat k+1's
+            # gauges.
+            self.exp.event(_fsync=fsync, kind="heartbeat", **row)
+            if self.registry is not None:
+                g = self.registry.gauge
+                if generation is not None:
+                    g("heartbeat_generation",
+                      help="last heartbeat's generation").set(
+                          int(generation), stage=self.stage)
+                if gens_per_sec is not None:
+                    g("gens_per_sec", help="generations per second",
+                      unit="1/s").set(round(float(gens_per_sec), 3),
+                                      stage=self.stage)
+                if rss is not None:
+                    g("rss_bytes", help="host resident set size",
+                      unit="bytes").set(rss, stage=self.stage)
+
+        if self.writer is not None:
+            self.writer.submit(sink)
+        else:
+            sink()
         self.count += 1
         self._last_t = now
         return row

@@ -29,6 +29,7 @@ import json
 import math
 import os
 import tempfile
+import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 _NAMESPACE = "srnn"
@@ -61,6 +62,10 @@ class _Metric:
         self.help = help
         self.unit = unit
         self._values: Dict[LabelKey, float] = {}
+        # async-safe: the pipeline's background writer resolves registry
+        # updates and renders sinks while the run loop keeps recording, so
+        # every mutation and snapshot takes the metric's lock
+        self._lock = threading.RLock()
 
     @property
     def full_name(self) -> str:
@@ -68,7 +73,9 @@ class _Metric:
 
     def samples(self) -> Iterable[Tuple[str, float]]:
         """(exposition-suffix, value) pairs, one per label set."""
-        for key, value in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, value in items:
             yield _label_suffix(key), value
 
     def expose(self) -> List[str]:
@@ -93,10 +100,12 @@ class Counter(_Metric):
         if amount < 0:
             raise ValueError(f"counter {self.name}: negative inc {amount}")
         key = _label_key(labels)
-        self._values[key] = self._values.get(key, 0) + amount
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + amount
 
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
 
 
 class Gauge(_Metric):
@@ -104,10 +113,12 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def set(self, value: float, **labels) -> None:
-        self._values[_label_key(labels)] = value
+        with self._lock:
+            self._values[_label_key(labels)] = value
 
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
 
 
 #: span/compile wall-clock buckets: 100us .. ~2 min, roughly x4 apart
@@ -129,33 +140,41 @@ class Histogram(_Metric):
 
     def observe(self, value: float, **labels) -> None:
         key = _label_key(labels)
-        h = self._hist.setdefault(key, [0] * (len(self.buckets) + 1) + [0.0])
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                h[i] += 1
-        h[len(self.buckets)] += 1  # +Inf
-        h[-1] += value
+        with self._lock:
+            h = self._hist.setdefault(key,
+                                      [0] * (len(self.buckets) + 1) + [0.0])
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    h[i] += 1
+            h[len(self.buckets)] += 1  # +Inf
+            h[-1] += value
 
     def count(self, **labels) -> int:
-        h = self._hist.get(_label_key(labels))
-        return int(h[len(self.buckets)]) if h else 0
+        with self._lock:
+            h = self._hist.get(_label_key(labels))
+            return int(h[len(self.buckets)]) if h else 0
 
     def sum(self, **labels) -> float:
-        h = self._hist.get(_label_key(labels))
-        return float(h[-1]) if h else 0.0
+        with self._lock:
+            h = self._hist.get(_label_key(labels))
+            return float(h[-1]) if h else 0.0
+
+    def _snapshot(self):
+        with self._lock:
+            return sorted((k, list(h)) for k, h in self._hist.items())
 
     def samples(self):
         # suffix BEFORE the label braces (``name_sum{labels}``) so
         # rows()/flush_events name each series exactly as to_prometheus()
         # exposes it — the two sinks must correlate
-        for key, h in sorted(self._hist.items()):
+        for key, h in self._snapshot():
             yield "_sum" + _label_suffix(key), h[-1]
             yield "_count" + _label_suffix(key), h[len(self.buckets)]
 
     def expose(self) -> List[str]:
         lines = [f"# HELP {self.full_name} {self.help}".rstrip(),
                  f"# TYPE {self.full_name} {self.kind}"]
-        for key, h in sorted(self._hist.items()):
+        for key, h in self._snapshot():
             for i, b in enumerate(self.buckets):
                 lab = _label_suffix(key + (("le", repr(float(b))),))
                 lines.append(f"{self.full_name}_bucket{lab} {_fmt(h[i])}")
@@ -176,17 +195,19 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.RLock()  # async-safe get-or-create
 
     def _get(self, cls, name: str, help: str, unit: str, **kw):
-        m = self._metrics.get(name)
-        if m is None:
-            m = cls(name, help=help, unit=unit, **kw)
-            self._metrics[name] = m
-        elif not isinstance(m, cls):
-            raise TypeError(
-                f"metric {name!r} already registered as {m.kind}, "
-                f"requested {cls.kind}")
-        return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help=help, unit=unit, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
 
     def counter(self, name: str, help: str = "", unit: str = "") -> Counter:
         return self._get(Counter, name, help, unit)
@@ -204,7 +225,9 @@ class MetricsRegistry:
         """Flat ``{exposition-name: value}`` snapshot (cumulative values;
         histograms contribute their ``_sum``/``_count`` series)."""
         out: Dict[str, float] = {}
-        for m in self._metrics.values():
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
             for suffix, value in m.samples():
                 out[m.full_name + suffix] = value
         return out
@@ -212,7 +235,9 @@ class MetricsRegistry:
     def to_prometheus(self) -> str:
         """Prometheus text exposition format (0.0.4) of every metric."""
         lines: List[str] = []
-        for m in self._metrics.values():
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
             lines.extend(m.expose())
         return "\n".join(lines) + ("\n" if lines else "")
 

@@ -2,14 +2,28 @@
 
 Bridges the jitted soup engine and the host-side trajectory store: evolve
 in device-resident chunks of ``every`` generations, pull only the LAST
-frame of each chunk to host, append it to a :class:`TrajStore`.  With the
-native store, the background writer thread overlaps the disk write with the
-next chunk's device compute.
+frame of each chunk to host, append it to a :class:`TrajStore`.
 
 Capture stride is the knob SURVEY §5 calls for: full per-step history of a
 mega-soup cannot leave the device, so the run records every ``every``-th
 generation (``every=1`` reproduces the reference's full
 ``ParticleDecorator.save_state`` history).
+
+Pipelined capture (the default, ``pipelined=True``): frame pulls are
+non-blocking — each captured step's arrays are device-copied
+(:func:`pipeline.snapshot`, donation-safe: the copy is dispatched before
+the source state is donated to the next step) with the device-to-host
+transfer started immediately, and the resolve + ``TrajStore.append`` run
+on a bounded :class:`pipeline.BackgroundWriter`, so the host loop keeps
+dispatching device work while frames drain to disk.  Registry updates
+ride the same writer (the count dispatch stays on the producing thread;
+only the resolve moves).  The captured stream is BIT-IDENTICAL to the
+blocking path: the same donated executables run in the same order, and
+the snapshots are pure copies.  Pass ``writer=`` to share a mega-run
+loop's writer (the caller then owns flush ordering across frames,
+checkpoints, and sinks); otherwise a private writer is created and
+closed — joined and flushed — before returning.  ``pipelined=False``
+keeps the original blocking loop (parity tests, A/B measurement).
 """
 
 from typing import Optional, Tuple
@@ -20,7 +34,22 @@ import numpy as np
 from ..soup import (SoupConfig, SoupState, evolve_donated,
                     evolve_step_donated)
 from .aot import own_pytree
+from .pipeline import BackgroundWriter, resolve, snapshot
 from .trajstore import TrajStore, shard_path
+
+
+def _append_frame(store: TrajStore, snap) -> None:
+    """Writer job: materialize one snapshotted frame and append it."""
+    t, w, uids, action, counterpart, loss = resolve(snap)
+    store.append(int(t), w, uids, action, counterpart, loss)
+
+
+def _append_multi_frame(stores, snap) -> None:
+    """Writer job: one snapshotted heterogeneous frame -> per-type stores."""
+    t, ws, uids, action, counterpart, loss = resolve(snap)
+    for i, store in enumerate(stores):
+        store.append(int(t), ws[i], uids[i], action[i], counterpart[i],
+                     loss[i])
 
 
 def evolve_captured(
@@ -31,6 +60,8 @@ def evolve_captured(
     every: int = 1,
     owned: bool = False,
     registry=None,
+    pipelined: bool = True,
+    writer: Optional[BackgroundWriter] = None,
 ) -> SoupState:
     """Evolve ``generations`` steps, appending one frame per ``every``
     generations to ``store``.  Returns the final state.
@@ -49,6 +80,9 @@ def evolve_captured(
     and the captured step's events — already in hand — are counted with
     one tiny extra dispatch, so the registry sees EVERY generation (not a
     stride sample) at no additional host transfers beyond the frames.
+
+    ``pipelined``/``writer``: see the module docstring — non-blocking
+    frame pulls resolved on a background writer, bit-identical stream.
     """
     if generations % every != 0:
         raise ValueError(f"generations={generations} not divisible by every={every}")
@@ -65,27 +99,63 @@ def evolve_captured(
     # memory saved) for callers that hand the state over.
     if not owned:
         state = own_pytree(state)
-    for _ in range(generations // every):
-        if every > 1:
+    if not pipelined:
+        for _ in range(generations // every):
+            if every > 1:
+                if registry is not None:
+                    state, m = evolve_donated(config, state,
+                                              generations=every - 1,
+                                              metrics=True)
+                    update_registry(registry, m, n_particles=config.size)
+                else:
+                    state = evolve_donated(config, state,
+                                           generations=every - 1)
+            state, events = evolve_step_donated(config, state)
             if registry is not None:
-                state, m = evolve_donated(config, state,
-                                          generations=every - 1,
-                                          metrics=True)
-                update_registry(registry, m, n_particles=config.size)
-            else:
-                state = evolve_donated(config, state, generations=every - 1)
-        state, events = evolve_step_donated(config, state)
-        if registry is not None:
-            update_registry(registry,
-                            count_events(events.action, events.loss),
-                            n_particles=config.size)
-        # one host transfer per captured frame; everything else stays on device
-        frame = jax.device_get(
-            (state.time, state.weights, state.uids,
-             events.action, events.counterpart, events.loss))
-        t, w, uids, action, counterpart, loss = frame
-        store.append(int(t), w, uids, action, counterpart, loss)
-    store.flush()
+                update_registry(registry,
+                                count_events(events.action, events.loss),
+                                n_particles=config.size)
+            # one host transfer per captured frame; all else stays on device
+            frame = jax.device_get(
+                (state.time, state.weights, state.uids,
+                 events.action, events.counterpart, events.loss))
+            t, w, uids, action, counterpart, loss = frame
+            store.append(int(t), w, uids, action, counterpart, loss)
+        store.flush()
+        return state
+    own_writer = writer is None
+    w = BackgroundWriter(name="srnn-capture-io") if own_writer else writer
+    if own_writer:
+        w.add_close_hook(store.join)  # crash path: appended frames durable
+    try:
+        for _ in range(generations // every):
+            if every > 1:
+                if registry is not None:
+                    state, m = evolve_donated(config, state,
+                                              generations=every - 1,
+                                              metrics=True)
+                    w.submit(update_registry, registry, m,
+                             n_particles=config.size)
+                else:
+                    state = evolve_donated(config, state,
+                                           generations=every - 1)
+            state, events = evolve_step_donated(config, state)
+            if registry is not None:
+                # count dispatch on THIS thread (device-stream order);
+                # only the resolve moves to the writer
+                w.submit(update_registry, registry,
+                         count_events(events.action, events.loss),
+                         n_particles=config.size)
+            # snapshot BEFORE the next iteration donates state's buffers;
+            # the append job resolves the in-flight transfer off-thread
+            w.submit(_append_frame, store,
+                     snapshot((state.time, state.weights, state.uids,
+                               events.action, events.counterpart,
+                               events.loss)))
+        w.submit(store.flush)
+    finally:
+        if own_writer:
+            w.close()  # join + flush; re-raises any writer-job error
     return state
 
 
@@ -97,6 +167,8 @@ def evolve_multi_captured(
     every: int = 1,
     owned: bool = False,
     registry=None,
+    pipelined: bool = True,
+    writer: Optional[BackgroundWriter] = None,
 ):
     """Heterogeneous-soup twin of :func:`evolve_captured`: one
     :class:`TrajStore` per TYPE (``stores[t]`` holds type t's (N_t, P_t)
@@ -104,7 +176,9 @@ def evolve_multi_captured(
     way the homogeneous one's does.  Returns the final state.
 
     ``registry`` meters every generation exactly as in
-    :func:`evolve_captured`, with per-type labels (``type=<variant>``)."""
+    :func:`evolve_captured`, with per-type labels (``type=<variant>``);
+    ``pipelined``/``writer`` behave exactly as there (non-blocking frame
+    pulls, background appends, bit-identical per-type streams)."""
     from ..multisoup import evolve_multi_donated, evolve_multi_step_donated
 
     if generations % every != 0:
@@ -125,30 +199,63 @@ def evolve_multi_captured(
     # defensive copy for rebinding callers)
     if not owned:
         state = own_pytree(state)
-    for _ in range(generations // every):
-        if every > 1:
+    if not pipelined:
+        for _ in range(generations // every):
+            if every > 1:
+                if registry is not None:
+                    state, ms = evolve_multi_donated(
+                        config, state, generations=every - 1, metrics=True)
+                    update_multi_registry(registry, ms, config)
+                else:
+                    state = evolve_multi_donated(config, state,
+                                                 generations=every - 1)
+            state, events = evolve_multi_step_donated(config, state)
             if registry is not None:
-                state, ms = evolve_multi_donated(
-                    config, state, generations=every - 1, metrics=True)
-                update_multi_registry(registry, ms, config)
-            else:
-                state = evolve_multi_donated(config, state,
-                                             generations=every - 1)
-        state, events = evolve_multi_step_donated(config, state)
-        if registry is not None:
-            for t, tname in enumerate(tnames):
-                update_registry(
-                    registry, count_events(events.action[t], events.loss[t]),
-                    type_name=tname, n_particles=config.sizes[t])
-        frame = jax.device_get(
-            (state.time, state.weights, state.uids,
-             events.action, events.counterpart, events.loss))
-        t, ws, uids, action, counterpart, loss = frame
-        for i, store in enumerate(stores):
-            store.append(int(t), ws[i], uids[i], action[i], counterpart[i],
-                         loss[i])
-    for store in stores:
-        store.flush()
+                for t, tname in enumerate(tnames):
+                    update_registry(
+                        registry,
+                        count_events(events.action[t], events.loss[t]),
+                        type_name=tname, n_particles=config.sizes[t])
+            frame = jax.device_get(
+                (state.time, state.weights, state.uids,
+                 events.action, events.counterpart, events.loss))
+            t, ws, uids, action, counterpart, loss = frame
+            for i, store in enumerate(stores):
+                store.append(int(t), ws[i], uids[i], action[i],
+                             counterpart[i], loss[i])
+        for store in stores:
+            store.flush()
+        return state
+    own_writer = writer is None
+    w = BackgroundWriter(name="srnn-capture-io") if own_writer else writer
+    if own_writer:
+        for store in stores:
+            w.add_close_hook(store.join)
+    try:
+        for _ in range(generations // every):
+            if every > 1:
+                if registry is not None:
+                    state, ms = evolve_multi_donated(
+                        config, state, generations=every - 1, metrics=True)
+                    w.submit(update_multi_registry, registry, ms, config)
+                else:
+                    state = evolve_multi_donated(config, state,
+                                                 generations=every - 1)
+            state, events = evolve_multi_step_donated(config, state)
+            if registry is not None:
+                for t, tname in enumerate(tnames):
+                    w.submit(update_registry, registry,
+                             count_events(events.action[t], events.loss[t]),
+                             type_name=tname, n_particles=config.sizes[t])
+            w.submit(_append_multi_frame, stores,
+                     snapshot((state.time, state.weights, state.uids,
+                               events.action, events.counterpart,
+                               events.loss)))
+        for store in stores:
+            w.submit(store.flush)
+    finally:
+        if own_writer:
+            w.close()
     return state
 
 
@@ -189,6 +296,20 @@ def _local_rows(arr, lo: int, hi: int, multihost: bool) -> np.ndarray:
     return np.asarray(arr)[lo:hi]
 
 
+def _append_sharded_frame(store: TrajStore, snap, lo: int, hi: int,
+                          multihost: bool) -> None:
+    """Writer job: this process's rows of one snapshotted sharded frame.
+    The snapshot's jit copy preserved the particle-axis sharding, so the
+    shard-local reads below touch only addressable data."""
+    t, w, u, a, c, l = snap
+    store.append(int(jax.device_get(t)),
+                 _local_rows(w, lo, hi, multihost),
+                 _local_rows(u, lo, hi, multihost),
+                 _local_rows(a, lo, hi, multihost),
+                 _local_rows(c, lo, hi, multihost),
+                 _local_rows(l, lo, hi, multihost))
+
+
 def open_process_shard(
     config: SoupConfig,
     base_path: str,
@@ -220,6 +341,8 @@ def sharded_evolve_captured(
     process_index: Optional[int] = None,
     num_processes: Optional[int] = None,
     registry=None,
+    pipelined: bool = True,
+    writer: Optional[BackgroundWriter] = None,
 ) -> SoupState:
     """Sharded-soup evolution with PER-PROCESS trajectory shards.
 
@@ -262,32 +385,70 @@ def sharded_evolve_captured(
         from ..telemetry.device import count_events
         from ..telemetry.soup_metrics import update_registry
 
-    owned = False  # donate internal states only, never the caller's input
-    for _ in range(generations // every):
-        if every > 1:
-            run = sharded_evolve_donated if owned else sharded_evolve
-            if registry is not None:
-                state, m = run(config, mesh, state, generations=every - 1,
-                               metrics=True)
-                update_registry(registry, m, n_particles=config.size)
-            else:
-                state = run(config, mesh, state, generations=every - 1)
+    if not pipelined:
+        owned = False  # donate internal states only, never the caller's
+        for _ in range(generations // every):
+            if every > 1:
+                run = sharded_evolve_donated if owned else sharded_evolve
+                if registry is not None:
+                    state, m = run(config, mesh, state,
+                                   generations=every - 1, metrics=True)
+                    update_registry(registry, m, n_particles=config.size)
+                else:
+                    state = run(config, mesh, state, generations=every - 1)
+                owned = True
+            step = sharded_evolve_step_donated if owned \
+                else sharded_evolve_step
+            state, events = step(config, mesh, state)
             owned = True
-        step = sharded_evolve_step_donated if owned \
-            else sharded_evolve_step
-        state, events = step(config, mesh, state)
-        owned = True
-        if registry is not None:
-            update_registry(registry,
-                            count_events(events.action, events.loss),
-                            n_particles=config.size)
-        t = int(jax.device_get(state.time))
-        store.append(
-            t,
-            _local_rows(state.weights, lo, hi, multihost),
-            _local_rows(state.uids, lo, hi, multihost),
-            _local_rows(events.action, lo, hi, multihost),
-            _local_rows(events.counterpart, lo, hi, multihost),
-            _local_rows(events.loss, lo, hi, multihost))
-    store.flush()
+            if registry is not None:
+                update_registry(registry,
+                                count_events(events.action, events.loss),
+                                n_particles=config.size)
+            t = int(jax.device_get(state.time))
+            store.append(
+                t,
+                _local_rows(state.weights, lo, hi, multihost),
+                _local_rows(state.uids, lo, hi, multihost),
+                _local_rows(events.action, lo, hi, multihost),
+                _local_rows(events.counterpart, lo, hi, multihost),
+                _local_rows(events.loss, lo, hi, multihost))
+        store.flush()
+        return state
+    own_writer = writer is None
+    w = BackgroundWriter(name="srnn-capture-io") if own_writer else writer
+    if own_writer:
+        w.add_close_hook(store.join)
+    try:
+        owned = False  # donate internal states only, never the caller's
+        for _ in range(generations // every):
+            if every > 1:
+                run = sharded_evolve_donated if owned else sharded_evolve
+                if registry is not None:
+                    state, m = run(config, mesh, state,
+                                   generations=every - 1, metrics=True)
+                    w.submit(update_registry, registry, m,
+                             n_particles=config.size)
+                else:
+                    state = run(config, mesh, state, generations=every - 1)
+                owned = True
+            step = sharded_evolve_step_donated if owned \
+                else sharded_evolve_step
+            state, events = step(config, mesh, state)
+            owned = True
+            if registry is not None:
+                w.submit(update_registry, registry,
+                         count_events(events.action, events.loss),
+                         n_particles=config.size)
+            # sharding-preserving snapshot before the next donated
+            # dispatch; the writer does only shard-LOCAL reads of it
+            w.submit(_append_sharded_frame, store,
+                     snapshot((state.time, state.weights, state.uids,
+                               events.action, events.counterpart,
+                               events.loss)),
+                     lo, hi, multihost)
+        w.submit(store.flush)
+    finally:
+        if own_writer:
+            w.close()
     return state

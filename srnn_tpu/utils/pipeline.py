@@ -1,0 +1,507 @@
+"""Async host/device pipeline: dispatch-ahead chunks, non-blocking frame
+capture, and background checkpoint/telemetry I/O.
+
+PR-1 made the soup's device compute AOT-compiled and donation-clean and
+PR-2 metered it, but the mega-run chunk loop still serialized device work
+against host I/O: every chunk blocked on ``jax.device_get`` frame pulls, a
+synchronous orbax checkpoint, and per-row fsync'd heartbeat/telemetry
+writes before the next chunk was dispatched.  This module is the missing
+overlap layer — the device stays busy while a single background worker
+drains the host work:
+
+  * :func:`snapshot` — donation-safe device-side copy of a pytree whose
+    device-to-host transfer is started immediately
+    (``copy_to_host_async``) and resolved later, off the critical path.
+    The copy is the load-bearing half: the ALL-donated chunk loops re-use
+    a state's buffers in place one dispatch later, so an in-flight async
+    transfer must read from a buffer jax owns and nothing ever donates.
+    The copy is dispatched (async) *before* the donating dispatch, so
+    device-stream order guarantees it reads the pre-donation bytes.
+  * :class:`BackgroundWriter` — ONE worker thread draining a bounded FIFO
+    queue of host jobs (TrajStore appends, orbax checkpoint saves,
+    metrics-sink flushes, heartbeat rows).  ``submit`` blocks when the
+    queue is full (**backpressure contract**: the producing loop can run
+    at most ``maxsize`` host jobs ahead, which also bounds the device
+    memory pinned by queued :func:`snapshot` trees).  Jobs execute in
+    submission order, so cross-job invariants — frames flushed *before*
+    the checkpoint that supersedes them — hold exactly as they do in the
+    blocking loop, and a crash loses only a suffix of the job order
+    (which bit-exact ``--resume`` already reconciles).  The first job
+    error latches: later jobs are skipped (never a checkpoint racing
+    ahead of failed frame appends) and the error re-raises on the next
+    ``submit``/``flush``/``close``.  ``close()`` drains, joins, and runs
+    registered close hooks (e.g. ``TrajStore.join``) so shutdown — clean
+    or crashed — leaves no orphan thread and no buffered frame.
+  * :class:`ChunkDriver` — the double-buffered dispatch-ahead scheduler:
+    the mega loops dispatch chunk *k+1*'s device work, *then* run chunk
+    *k*'s host finisher (``depth=1``); ``depth=0`` degrades to the
+    blocking order for A/B measurement and parity tests.
+  * :class:`OverlapMeter` — host-side attribution of each chunk's wall
+    time into device-wait vs host-I/O seconds, exported as the
+    ``pipeline_*`` gauges so a deadline-exhausted run (BENCH_r05) names
+    host stall vs device compute.
+
+Thread hygiene: :func:`spawn_thread` is the only sanctioned way to start
+a thread under ``srnn_tpu`` — it registers the thread with the module's
+join-on-exit registry (``live_threads`` audits it; an AST gate in
+``tests/test_thread_hygiene.py`` enforces the rule), and threads default
+to non-daemon so interpreter exit cannot strand buffered I/O.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# thread registry: every thread this package starts is accounted for
+# ---------------------------------------------------------------------------
+
+_THREADS: "weakref.WeakSet[threading.Thread]" = weakref.WeakSet()
+_THREADS_LOCK = threading.Lock()
+
+
+def register_thread(thread: threading.Thread) -> threading.Thread:
+    """Add ``thread`` to the join-on-exit registry.  Owners still join
+    their own threads (``BackgroundWriter.close``); the registry exists so
+    shutdown tests — and operators — can audit that nothing survived."""
+    with _THREADS_LOCK:
+        _THREADS.add(thread)
+    return thread
+
+
+def live_threads() -> List[threading.Thread]:
+    """Registered threads that are still alive (empty after every pipeline
+    owner has been ``close()``d — the no-orphan-threads invariant)."""
+    with _THREADS_LOCK:
+        return [t for t in _THREADS if t.is_alive()]
+
+
+def spawn_thread(target: Callable, *, name: str, daemon: bool = False,
+                 args: tuple = (), kwargs: Optional[dict] = None
+                 ) -> threading.Thread:
+    """The package's thread factory: explicit daemon-ness (non-daemon by
+    default, so buffered I/O is never stranded by interpreter exit) and
+    registration with the join-on-exit registry."""
+    t = threading.Thread(target=target, name=name, args=args,
+                         kwargs=kwargs or {}, daemon=daemon)
+    register_thread(t)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# the bounded background writer
+# ---------------------------------------------------------------------------
+
+
+class WriterError(RuntimeError):
+    """A background job failed; raised on the submitting thread at the
+    next ``submit``/``flush``/``close`` after the failure."""
+
+
+class BackgroundWriter:
+    """Single worker thread draining a bounded FIFO of host-I/O jobs.
+
+    >>> w = BackgroundWriter(name="capture-io")
+    >>> w.submit(store.append, gen, weights, ...)   # returns immediately
+    >>> w.flush()                                   # barrier: queue drained
+    >>> w.close()                                   # drain + join + hooks
+
+    Contract:
+
+    * **Order** — jobs run in submission order (one worker, FIFO queue),
+      so "frames before the checkpoint that supersedes them" and every
+      other cross-job invariant of the blocking loop is preserved.
+    * **Backpressure** — ``submit`` blocks while ``maxsize`` jobs are
+      pending; a producer can run at most one bounded window ahead.
+    * **Errors** — the first job exception latches: subsequent jobs are
+      skipped (a checkpoint must never land after its chunk's frame
+      appends failed) and the error re-raises, wrapped in
+      :class:`WriterError`, on the next call into the writer.
+    * **Shutdown** — ``close()`` drains the queue, joins the worker, runs
+      close hooks (e.g. ``TrajStore.join``), and re-raises any latched
+      error.  Idempotent; also the context-manager ``__exit__``.
+    """
+
+    def __init__(self, maxsize: int = 8, name: str = "srnn-io"):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(maxsize)))
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._failed = False       # latched forever once any job raised
+        self._closed = False
+        self._busy_s = 0.0
+        self.jobs_done = 0
+        self._close_hooks: List[Callable[[], None]] = []
+        self._thread = spawn_thread(self._run, name=name)
+
+    # -- worker ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is None:
+                    return
+                fn, args, kwargs = job
+                with self._lock:
+                    skip = self._failed
+                if skip:
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    fn(*args, **kwargs)
+                except BaseException as e:  # latch; surface on the producer
+                    with self._lock:
+                        self._error = e
+                        self._failed = True
+                finally:
+                    dt = time.perf_counter() - t0
+                    with self._lock:
+                        self._busy_s += dt
+                        self.jobs_done += 1
+            finally:
+                self._q.task_done()
+
+    # -- producer API ----------------------------------------------------
+
+    @property
+    def busy_s(self) -> float:
+        """Cumulative seconds the worker spent executing jobs (the
+        host-I/O side of :class:`OverlapMeter`'s attribution)."""
+        with self._lock:
+            return self._busy_s
+
+    @property
+    def failed(self) -> bool:
+        with self._lock:
+            return self._failed
+
+    def _raise_pending(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise WriterError(
+                f"background writer job failed: {type(err).__name__}: {err}"
+            ) from err
+
+    def submit(self, fn: Callable, *args, **kwargs) -> None:
+        """Enqueue ``fn(*args, **kwargs)``; blocks while the queue is full
+        (the backpressure bound) and raises any latched job error.  A
+        writer that has ever failed refuses all further jobs — they would
+        be skipped anyway, and a silent no-op submit would let a producer
+        loop run on believing its I/O is landing."""
+        if self._closed:
+            raise WriterError("submit() on a closed BackgroundWriter")
+        self._raise_pending()
+        if self.failed:
+            raise WriterError(
+                "background writer already failed; job refused")
+        self._q.put((fn, args, kwargs))
+
+    def flush(self) -> None:
+        """Block until every submitted job has executed, then raise any
+        latched job error."""
+        self._q.join()
+        self._raise_pending()
+
+    def add_close_hook(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` during ``close()`` after the queue drains — the slot
+        a ``TrajStore`` hands its flush/join hook to, so even an
+        error-path shutdown leaves the frames that DID append durable."""
+        self._close_hooks.append(fn)
+
+    def close(self) -> None:
+        """Drain, join the worker, run close hooks; idempotent.  Raises
+        the latched job (or hook) error after the thread is down."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+        if already:
+            self._raise_pending()
+            return
+        self._q.put(None)               # after all queued jobs (FIFO)
+        self._thread.join()
+        hook_err: Optional[BaseException] = None
+        for hook in self._close_hooks:
+            try:
+                hook()
+            except BaseException as e:
+                hook_err = hook_err or e
+        # surface BOTH failure kinds in one error: a latched job error
+        # must not swallow a close-hook failure (the operator needs to
+        # know the store flush ALSO failed, i.e. what is actually durable)
+        with self._lock:
+            job_err, self._error = self._error, None
+        if job_err is not None or hook_err is not None:
+            parts = [f"background writer job failed: "
+                     f"{type(job_err).__name__}: {job_err}"
+                     ] if job_err is not None else []
+            if hook_err is not None:
+                parts.append(f"close hook failed: "
+                             f"{type(hook_err).__name__}: {hook_err}")
+            raise WriterError("; ".join(parts)) from (job_err or hook_err)
+
+    def __enter__(self) -> "BackgroundWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def submit_or_run(writer: Optional[BackgroundWriter], fn: Callable,
+                  *args, **kwargs) -> None:
+    """Route one host job through ``writer`` when pipelining, else run it
+    inline — the single switch the mega loops use for A/B parity."""
+    if writer is None:
+        fn(*args, **kwargs)
+    else:
+        writer.submit(fn, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# donation-safe device snapshots with async device-to-host transfer
+# ---------------------------------------------------------------------------
+
+
+def _copy_leaf(x):
+    import jax
+    import jax.numpy as jnp
+
+    if not hasattr(x, "dtype"):
+        return x
+    if jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+        return jax.random.wrap_key_data(jnp.copy(jax.random.key_data(x)))
+    return jnp.copy(x)
+
+
+_device_copy = None  # lazily-built jitted tree copy (keeps jax import lazy)
+
+
+def snapshot(tree: Any, transfer: bool = True) -> Any:
+    """Device-side copy of every array leaf of ``tree``, with the
+    device-to-host transfer of the copy started immediately.
+
+    The copy runs as ONE jitted program, so (a) its outputs are fresh
+    jax-owned buffers that never alias the (soon-to-be-donated) inputs —
+    jit outputs only alias *donated* inputs — and (b) input shardings are
+    preserved, so a sharded soup's snapshot keeps its per-device layout
+    for shard-local reads.  Dispatch is async: calling this costs a
+    dispatch, not a device round trip.  Resolve with :func:`resolve` (or
+    shard-local reads) later, typically on the background writer.
+    """
+    global _device_copy
+    import jax
+
+    if _device_copy is None:
+        _device_copy = jax.jit(lambda t: jax.tree.map(_copy_leaf, t))
+    snap = _device_copy(tree)
+    if transfer:
+        for leaf in jax.tree.leaves(snap):
+            start = getattr(leaf, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass  # transfer overlap is an optimization, never load-bearing
+    return snap
+
+
+def resolve(tree: Any) -> Any:
+    """Materialize a :func:`snapshot` (or any pytree of arrays) on host:
+    blocks only until the already-started transfers land."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: np.asarray(x) if hasattr(x, "dtype") else x, tree)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-ahead chunk scheduling
+# ---------------------------------------------------------------------------
+
+
+class ChunkDriver:
+    """Run chunk *k*'s host finisher after chunk *k+1*'s device dispatch.
+
+    The mega loops call ``step(finish)`` once per chunk, right after
+    dispatching that chunk's device work; the driver holds up to ``depth``
+    finishers and runs the oldest one as the ``depth+1``-th arrives —
+    i.e. with the next chunk already queued on the device.  ``depth=1``
+    is the double-buffered production shape; ``depth=0`` runs finishers
+    immediately (the blocking order, for parity/A-B runs).  ``drain()``
+    runs whatever is still pending (call it after the loop).
+    """
+
+    def __init__(self, depth: int = 1):
+        self.depth = max(0, int(depth))
+        self._pending: "deque[Callable[[], None]]" = deque()
+
+    def step(self, finish: Callable[[], None]) -> None:
+        self._pending.append(finish)
+        while len(self._pending) > self.depth:
+            self._pending.popleft()()
+
+    def drain(self) -> None:
+        while self._pending:
+            self._pending.popleft()()
+
+
+# ---------------------------------------------------------------------------
+# overlap attribution: host stall vs device compute
+# ---------------------------------------------------------------------------
+
+
+class OverlapMeter:
+    """Per-chunk wall-time attribution for the async pipeline.
+
+    Two accumulators per chunk, both host-observable and honest about
+    what the host can know without a device profiler:
+
+    * ``device_wait_s`` — seconds the producing thread spent *blocked on
+      device results* (inside :meth:`waiting`): a lower bound on device
+      busy time.
+    * ``host_io_s`` — seconds of host I/O: foreground :meth:`host_io`
+      blocks plus the attached :class:`BackgroundWriter`'s busy-seconds
+      delta.  In the pipelined loop this work runs concurrently with
+      device compute; in the blocking loop it is dead device time.  The
+      writer delta necessarily folds into the GAUGES one chunk late (a
+      chunk's queued jobs mostly execute after its :meth:`chunk_done`);
+      :meth:`summary` adds the still-unfolded tail so run totals are
+      complete once the writer has been flushed.
+
+    ``chunk_done(wall_s)`` folds them into gauges (labeled ``stage=``):
+    ``pipeline_chunk_wall_s``, ``pipeline_chunk_device_wait_s``,
+    ``pipeline_chunk_host_io_s``, ``pipeline_chunk_device_idle_bound_s``
+    (``wall - device_wait``: an upper bound on device idleness — in the
+    blocking loop it IS the host stall; dispatch-ahead shrinks the true
+    value below it) and ``pipeline_overlap_ratio``
+    (``device_wait / wall``: →1.0 means host I/O fully hidden behind
+    device compute) — plus ``pipeline_*_seconds_total`` counters.
+    ``summary()`` returns run totals (the dict ``bench.py`` embeds in its
+    per-attempt JSON).
+    """
+
+    def __init__(self, registry=None, stage: str = "",
+                 writer: Optional[BackgroundWriter] = None):
+        self.registry = registry
+        self.stage = stage
+        self.writer = writer
+        self._lock = threading.Lock()
+        self._wait = 0.0
+        self._io = 0.0
+        self._writer_mark = writer.busy_s if writer is not None else 0.0
+        self.totals = {"wall_s": 0.0, "device_wait_s": 0.0,
+                       "host_io_s": 0.0, "chunks": 0}
+
+    @contextmanager
+    def waiting(self):
+        """Wrap a blocking device resolve (``np.asarray``, scalar
+        readback): the time accrues to ``device_wait_s``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._wait += dt
+
+    @contextmanager
+    def host_io(self):
+        """Wrap foreground host I/O (the blocking loop's sink writes)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._io += dt
+
+    def chunk_done(self, wall_s: float) -> dict:
+        """Close one chunk window: compute the attribution row, export the
+        gauges, add to the run totals, reset the per-chunk accumulators."""
+        with self._lock:
+            wait, self._wait = self._wait, 0.0
+            io, self._io = self._io, 0.0
+        if self.writer is not None:
+            busy = self.writer.busy_s
+            io += busy - self._writer_mark
+            self._writer_mark = busy
+        wall = max(float(wall_s), 0.0)
+        row = {
+            "wall_s": wall,
+            "device_wait_s": wait,
+            "host_io_s": io,
+            "device_idle_bound_s": max(0.0, wall - wait),
+            "overlap_ratio": min(1.0, wait / wall) if wall > 0 else 0.0,
+        }
+        self.totals["wall_s"] += wall
+        self.totals["device_wait_s"] += wait
+        self.totals["host_io_s"] += io
+        self.totals["chunks"] += 1
+        if self.registry is not None:
+            g = self.registry.gauge
+            labels = {"stage": self.stage} if self.stage else {}
+            g("pipeline_chunk_wall_s",
+              help="last chunk wall seconds", unit="seconds").set(
+                  round(wall, 4), **labels)
+            g("pipeline_chunk_device_wait_s",
+              help="last chunk seconds blocked on device results",
+              unit="seconds").set(round(wait, 4), **labels)
+            g("pipeline_chunk_host_io_s",
+              help="last chunk host-I/O seconds (background + foreground)",
+              unit="seconds").set(round(io, 4), **labels)
+            g("pipeline_chunk_device_idle_bound_s",
+              help="last chunk upper bound on device idle seconds "
+                   "(wall - device wait)", unit="seconds").set(
+                  round(row["device_idle_bound_s"], 4), **labels)
+            g("pipeline_overlap_ratio",
+              help="device-bound fraction of the last chunk "
+                   "(1.0 = host I/O fully hidden)").set(
+                  round(row["overlap_ratio"], 4), **labels)
+            c = self.registry.counter
+            c("pipeline_wall_seconds_total",
+              help="chunk-loop wall seconds", unit="seconds").inc(
+                  wall, **labels)
+            c("pipeline_device_wait_seconds_total",
+              help="seconds blocked on device results",
+              unit="seconds").inc(wait, **labels)
+            c("pipeline_host_io_seconds_total",
+              help="host-I/O seconds", unit="seconds").inc(io, **labels)
+        return row
+
+    def summary(self) -> dict:
+        """Run-total attribution (rounded, JSON-ready): wall/device-wait/
+        host-I/O seconds, chunk count, overall overlap ratio and the
+        device-idle upper bound.
+
+        The writer's busy seconds fold into the per-chunk gauges one
+        window LATE (a chunk's queued jobs mostly execute after its
+        ``chunk_done``), so the run total here also counts the
+        still-unfolded busy delta — call after ``writer.flush()`` (as the
+        mega loops do) and the tail chunk's I/O is included too."""
+        t = self.totals
+        wall = t["wall_s"]
+        io = t["host_io_s"]
+        if self.writer is not None:
+            # pending delta read non-destructively: _writer_mark stays,
+            # so a later chunk_done still folds the same seconds into the
+            # gauges and summary() stays idempotent
+            io += max(0.0, self.writer.busy_s - self._writer_mark)
+        return {
+            "chunks": t["chunks"],
+            "wall_s": round(wall, 4),
+            "device_wait_s": round(t["device_wait_s"], 4),
+            "host_io_s": round(io, 4),
+            "device_idle_bound_s": round(max(0.0, wall - t["device_wait_s"]),
+                                         4),
+            "overlap_ratio": round(min(1.0, t["device_wait_s"] / wall), 4)
+            if wall > 0 else 0.0,
+        }

@@ -199,8 +199,25 @@ class TrajStore:
             rc = self._lib.ts_flush(self._h)
             if rc != 0:
                 raise OSError(f"ts_flush failed with {rc}")
-        else:
+        elif self._f is not None:
             self._f.flush()
+
+    # -- pipeline hooks ---------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._h is None and self._f is None
+
+    def join(self):
+        """Block until every queued frame is handed to the OS: the native
+        writer drains its background C++ queue (``ts_flush`` joins the
+        in-flight tail), the pure-Python writer flushes its buffer.  This
+        is the flush/join hook an async pipeline's ``BackgroundWriter``
+        owns (``add_close_hook``), so even an error-path shutdown leaves
+        every frame that DID append durable.  No-op on a closed store —
+        the hook may fire after the owning loop already closed it."""
+        if not self.closed:
+            self.flush()
 
     def close(self):
         if self._h is not None:

@@ -1,0 +1,424 @@
+"""Async host/device pipeline invariants (``srnn_tpu/utils/pipeline.py``).
+
+Three layers, mirroring the module's contract:
+
+  * unit: ``BackgroundWriter`` ordering / backpressure / error-latch /
+    close-hook semantics, ``ChunkDriver`` deferral depth, ``OverlapMeter``
+    attribution, donation-safe ``snapshot``.
+  * parity: the pipelined mega loops (soup, multisoup, sharded) produce
+    BYTE-identical ``.traj`` streams, exactly-equal checkpoints, and
+    bit-identical ``--resume`` continuations vs ``--no-pipeline``.
+  * shutdown: no orphan writer threads and fully-flushed stores after
+    ``close()`` — including after a simulated mid-chunk crash.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from srnn_tpu.utils import pipeline
+from srnn_tpu.utils.pipeline import (BackgroundWriter, ChunkDriver,
+                                     OverlapMeter, WriterError, live_threads,
+                                     resolve, snapshot, submit_or_run)
+
+
+# ---------------------------------------------------------------------------
+# BackgroundWriter units
+# ---------------------------------------------------------------------------
+
+
+def test_writer_runs_jobs_in_submission_order():
+    seen = []
+    with BackgroundWriter(name="t-order") as w:
+        for i in range(20):
+            w.submit(seen.append, i)
+        w.flush()
+        assert seen == list(range(20))
+    assert w.jobs_done == 20
+
+
+def test_writer_backpressure_bounds_the_producer():
+    """submit() blocks while ``maxsize`` jobs are pending — the producer
+    can run at most one bounded window ahead."""
+    gate = threading.Event()
+    w = BackgroundWriter(maxsize=1, name="t-bp")
+    try:
+        w.submit(gate.wait)   # occupies the worker
+        w.submit(lambda: None)  # fills the 1-slot queue
+
+        blocked = threading.Event()
+
+        def producer():
+            w.submit(lambda: None)  # must block until the gate opens
+            blocked.set()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        assert not blocked.wait(0.2), "submit() returned past a full queue"
+        gate.set()
+        assert blocked.wait(5.0), "submit() never unblocked after drain"
+        t.join(5.0)
+    finally:
+        gate.set()
+        w.close()
+
+
+def test_writer_error_latches_skips_later_jobs_and_reraises():
+    seen = []
+
+    def boom():
+        raise RuntimeError("disk gone")
+
+    w = BackgroundWriter(name="t-err")
+    try:
+        w.submit(seen.append, "before")
+        w.submit(boom)
+        w.submit(seen.append, "after")  # must be SKIPPED (latched failure)
+        with pytest.raises(WriterError, match="disk gone"):
+            w.flush()
+        assert seen == ["before"]
+        assert w.failed
+        # a failed writer refuses all further jobs — a silent no-op would
+        # let the producer loop run on believing its I/O is landing
+        with pytest.raises(WriterError, match="refused"):
+            w.submit(seen.append, "rejected")
+    finally:
+        w.close()  # error already surfaced; close is clean and idempotent
+    w.close()
+
+
+def test_writer_close_hooks_run_even_after_job_failure():
+    """The flush/join hook a store registers must run on the error path
+    too — frames that DID append stay durable."""
+    hooks = []
+    w = BackgroundWriter(name="t-hook")
+    w.add_close_hook(lambda: hooks.append("joined"))
+    w.submit(lambda: (_ for _ in ()).throw(OSError("enospc")))
+    with pytest.raises(WriterError, match="enospc"):
+        w.close()
+    assert hooks == ["joined"]
+
+
+def test_writer_close_leaves_no_orphan_threads():
+    writers = [BackgroundWriter(name=f"t-orphan{i}") for i in range(3)]
+    assert len(live_threads()) >= 3
+    for w in writers:
+        w.close()
+    assert live_threads() == []
+
+
+def test_submit_or_run_inline_when_no_writer():
+    seen = []
+    submit_or_run(None, seen.append, 1)
+    assert seen == [1]
+
+
+# ---------------------------------------------------------------------------
+# ChunkDriver / OverlapMeter units
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_driver_depth_one_defers_exactly_one_finisher():
+    ran = []
+    d = ChunkDriver(depth=1)
+    d.step(lambda: ran.append(1))
+    assert ran == []          # held: chunk 2 not dispatched yet
+    d.step(lambda: ran.append(2))
+    assert ran == [1]         # oldest ran as the 2nd arrived
+    d.drain()
+    assert ran == [1, 2]
+
+
+def test_chunk_driver_depth_zero_is_the_blocking_order():
+    ran = []
+    d = ChunkDriver(depth=0)
+    d.step(lambda: ran.append(1))
+    assert ran == [1]
+    d.drain()
+    assert ran == [1]
+
+
+def test_overlap_meter_attribution_and_gauges():
+    from srnn_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    m = OverlapMeter(reg, stage="unit")
+    with m.waiting():
+        time.sleep(0.02)
+    with m.host_io():
+        time.sleep(0.01)
+    row = m.chunk_done(0.05)
+    assert row["device_wait_s"] >= 0.02
+    assert row["host_io_s"] >= 0.01
+    assert row["device_idle_bound_s"] == pytest.approx(
+        0.05 - row["device_wait_s"])
+    assert 0.0 < row["overlap_ratio"] <= 1.0
+    assert reg.gauge("pipeline_overlap_ratio").value(stage="unit") \
+        == pytest.approx(row["overlap_ratio"], abs=1e-4)  # gauge is rounded
+    assert reg.counter("pipeline_wall_seconds_total").value(stage="unit") \
+        == pytest.approx(0.05)
+    s = m.summary()
+    assert s["chunks"] == 1 and s["wall_s"] == pytest.approx(0.05)
+
+
+def test_overlap_meter_folds_writer_busy_seconds_into_host_io():
+    with BackgroundWriter(name="t-meter") as w:
+        m = OverlapMeter(writer=w)
+        w.submit(time.sleep, 0.03)
+        w.flush()
+        row = m.chunk_done(0.1)
+    assert row["host_io_s"] >= 0.03
+
+
+# ---------------------------------------------------------------------------
+# donation-safe snapshots
+# ---------------------------------------------------------------------------
+
+
+def _tiny_config(n=8, train=0):
+    from srnn_tpu.soup import SoupConfig
+    from srnn_tpu.topology import Topology
+
+    return SoupConfig(topo=Topology("weightwise", width=2, depth=2), size=n,
+                      attacking_rate=0.5, train=train, layout="popmajor")
+
+
+def test_snapshot_survives_donation_of_its_source():
+    """The snapshot's device copy must read PRE-donation bytes: resolve()
+    after the source state was donated to the next step returns exactly
+    the values the source held at snapshot time."""
+    import jax
+
+    from srnn_tpu.soup import evolve_step_donated, seed
+
+    cfg = _tiny_config()
+    state = seed(cfg, jax.random.key(0))
+    state, _ev = evolve_step_donated(cfg, state)  # state is now jax-owned
+    before = np.asarray(state.weights).copy()
+
+    snap = snapshot((state.time, state.weights))
+    # donate the snapshot's source buffers to the next generation
+    state, _ev = evolve_step_donated(cfg, state)
+    t, w = resolve(snap)
+    assert int(t) == 1
+    np.testing.assert_array_equal(w, before)
+    assert int(state.time) == 2  # the run itself moved on
+
+
+# ---------------------------------------------------------------------------
+# shutdown: simulated mid-chunk crash
+# ---------------------------------------------------------------------------
+
+
+class _FailingStore:
+    """TrajStore stand-in whose append dies after ``ok`` frames — the
+    simulated mid-chunk crash (ENOSPC / yanked disk) under the writer."""
+
+    def __init__(self, store, ok):
+        self._store = store
+        self._ok = ok
+        self.appends = 0
+
+    def append(self, *args):
+        self.appends += 1
+        if self.appends > self._ok:
+            raise OSError("simulated mid-chunk crash")
+        self._store.append(*args)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+def test_capture_crash_mid_chunk_flushes_survivors_and_joins(tmp_path):
+    """A writer-job crash mid-chunk surfaces as WriterError, leaves NO
+    orphan threads, and the frames appended BEFORE the crash are durable
+    (the store's join hook ran on the error path)."""
+    import jax
+
+    from srnn_tpu.utils import read_store
+    from srnn_tpu.utils.capture import evolve_captured
+    from srnn_tpu.utils.trajstore import TrajStore
+
+    from srnn_tpu.soup import seed
+
+    cfg = _tiny_config()
+    state = seed(cfg, jax.random.key(0))
+    path = str(tmp_path / "crash.traj")
+    store = TrajStore(path, n_particles=cfg.size,
+                      n_weights=cfg.topo.num_weights)
+    failing = _FailingStore(store, ok=2)
+    with pytest.raises(WriterError, match="simulated mid-chunk crash"):
+        evolve_captured(cfg, state, generations=5, store=failing, every=1)
+    store.close()
+    assert live_threads() == []  # the private writer joined on the way out
+    out = read_store(path)
+    assert out["generations"].tolist() == [1, 2]  # survivors durable
+
+
+# ---------------------------------------------------------------------------
+# end-to-end parity: pipelined vs --no-pipeline mega loops
+# ---------------------------------------------------------------------------
+
+
+def _file_bytes(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _assert_soup_ckpt_equal(dir_a, dir_b, gens):
+    from srnn_tpu.experiment import restore_checkpoint
+
+    import jax
+
+    for g in gens:
+        a = restore_checkpoint(os.path.join(dir_a, f"ckpt-gen{g:08d}"))
+        b = restore_checkpoint(os.path.join(dir_b, f"ckpt-gen{g:08d}"))
+        np.testing.assert_array_equal(np.asarray(a.weights),
+                                      np.asarray(b.weights))
+        np.testing.assert_array_equal(np.asarray(a.uids), np.asarray(b.uids))
+        assert int(a.time) == int(b.time) == g
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(a.key)),
+            np.asarray(jax.random.key_data(b.key)))
+
+
+def test_mega_soup_pipeline_parity_and_resume(tmp_path):
+    """Pipelined captured stream + every checkpoint + a resumed
+    continuation are bit-identical to the blocking (--no-pipeline) run."""
+    from srnn_tpu.setups import REGISTRY
+
+    common = ["--smoke", "--capture-every", "1"]
+    d_block = REGISTRY["mega_soup"](
+        common + ["--root", str(tmp_path / "block"), "--no-pipeline"])
+    d_pipe = REGISTRY["mega_soup"](
+        common + ["--root", str(tmp_path / "pipe")])
+    assert live_threads() == []  # the run's writer closed behind itself
+
+    assert _file_bytes(os.path.join(d_pipe, "soup.traj")) \
+        == _file_bytes(os.path.join(d_block, "soup.traj"))
+    _assert_soup_ckpt_equal(d_pipe, d_block, (2, 4, 6))
+    # the pipelined run recorded its overlap attribution
+    rows = [json.loads(l) for l in
+            open(os.path.join(d_pipe, "events.jsonl"))]
+    pipe_rows = [r for r in rows if r.get("kind") == "pipeline"]
+    assert pipe_rows and pipe_rows[-1]["pipelined"] \
+        and pipe_rows[-1]["chunks"] == 3
+
+    # a PIPELINED half-run resumed PIPELINED lands bit-identical to the
+    # uninterrupted BLOCKING reference — stream and final checkpoint
+    d_half = REGISTRY["mega_soup"](
+        common + ["--root", str(tmp_path / "half"), "--generations", "4"])
+    d_resumed = REGISTRY["mega_soup"](["--smoke", "--resume", d_half])
+    assert d_resumed == d_half
+    assert _file_bytes(os.path.join(d_half, "soup.traj")) \
+        == _file_bytes(os.path.join(d_block, "soup.traj"))
+    _assert_soup_ckpt_equal(d_half, d_block, (6,))
+
+
+def test_mega_soup_sharded_pipeline_parity(tmp_path):
+    """The sharded chunk loop's pipelined capture shard is byte-identical
+    to its blocking twin (sharding-preserving snapshots, shard-local
+    reads on the writer)."""
+    from srnn_tpu.setups import REGISTRY
+
+    common = ["--smoke", "--sharded", "--capture-every", "1"]
+    d_block = REGISTRY["mega_soup"](
+        common + ["--root", str(tmp_path / "block"), "--no-pipeline"])
+    d_pipe = REGISTRY["mega_soup"](
+        common + ["--root", str(tmp_path / "pipe")])
+    assert live_threads() == []
+    assert _file_bytes(os.path.join(d_pipe, "soup.traj")) \
+        == _file_bytes(os.path.join(d_block, "soup.traj"))
+    _assert_soup_ckpt_equal(d_pipe, d_block, (2, 4, 6))
+
+
+def test_mega_multisoup_pipeline_parity(tmp_path):
+    """Per-type captured streams and the MultiSoupState checkpoints of the
+    heterogeneous loop are bit-identical pipelined vs blocking.
+
+    Runs as REAL CLI subprocesses for the same reason as
+    test_setups.test_mega_multisoup_per_type_capture_survives_resume: the
+    in-process multisoup capture flow can poison the shared XLA CPU
+    client for later unrelated compiles (upstream bug; isolation is the
+    durable fix)."""
+    import subprocess
+    import sys
+
+    from srnn_tpu.experiment import restore_multi_checkpoint
+
+    def cli(*argv):
+        env = dict(os.environ)
+        env["SRNN_SETUPS_PLATFORM"] = "cpu"  # never dial the tunnel
+        proc = subprocess.run(
+            [sys.executable, "-m", "srnn_tpu.setups", "mega_multisoup",
+             *argv], stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=300, env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        out = proc.stdout.decode()
+        assert proc.returncode == 0, out
+        return out.strip().splitlines()[-1]  # run dir printed last
+
+    common = ("--smoke", "--capture-every", "2")
+    d_block = cli(*common, "--root", str(tmp_path / "block"),
+                  "--no-pipeline")
+    d_pipe = cli(*common, "--root", str(tmp_path / "pipe"))
+
+    for t in range(3):
+        assert _file_bytes(os.path.join(d_pipe, f"soup.t{t}.traj")) \
+            == _file_bytes(os.path.join(d_block, f"soup.t{t}.traj")), \
+            f"type {t} stream differs"
+    a = restore_multi_checkpoint(os.path.join(d_pipe, "ckpt-gen00000006"))
+    b = restore_multi_checkpoint(os.path.join(d_block, "ckpt-gen00000006"))
+    for t in range(3):
+        np.testing.assert_array_equal(np.asarray(a.weights[t]),
+                                      np.asarray(b.weights[t]))
+        np.testing.assert_array_equal(np.asarray(a.uids[t]),
+                                      np.asarray(b.uids[t]))
+    assert int(a.time) == int(b.time) == 6
+
+
+# ---------------------------------------------------------------------------
+# heartbeat satellite: amortized fsync + writer routing
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_fsync_every_amortizes_but_always_flushes(
+        tmp_path, monkeypatch):
+    from srnn_tpu.experiment import Experiment
+    from srnn_tpu.telemetry import Heartbeat
+
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (synced.append(fd),
+                                                 real_fsync(fd)))
+    with Experiment("hb-fsync", root=str(tmp_path)) as exp:
+        hb = Heartbeat(exp, stage="unit", fsync_every=3)
+        for g in range(6):
+            hb.beat(generation=g)
+        run_dir = exp.dir
+        n_synced = len(synced)
+    beats = [json.loads(l) for l in
+             open(os.path.join(run_dir, "events.jsonl"))
+             if '"heartbeat"' in l]
+    assert len(beats) == 6          # every row flushed regardless
+    assert n_synced == 2            # beats 0 and 3 paid the fsync
+
+
+def test_heartbeat_rows_route_through_writer(tmp_path):
+    from srnn_tpu.experiment import Experiment
+    from srnn_tpu.telemetry import Heartbeat
+
+    with Experiment("hb-writer", root=str(tmp_path)) as exp:
+        with BackgroundWriter(name="t-hb") as w:
+            hb = Heartbeat(exp, stage="unit", writer=w)
+            hb.beat(generation=1)
+            w.flush()
+        run_dir = exp.dir
+    beats = [json.loads(l) for l in
+             open(os.path.join(run_dir, "events.jsonl"))
+             if '"heartbeat"' in l]
+    assert [b["generation"] for b in beats] == [1]

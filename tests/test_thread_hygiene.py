@@ -1,0 +1,79 @@
+"""Static thread-hygiene gate: every thread started under ``srnn_tpu/``
+must go through ``utils.pipeline.spawn_thread`` — the package's thread
+factory — so it is (a) registered with the join-on-exit registry that the
+shutdown tests audit (``pipeline.live_threads()``) and (b) non-daemon
+unless explicitly opted out, so interpreter exit can never strand
+buffered I/O (a daemon writer dying mid-fsync is a silent data-loss
+path).
+
+Walks the package AST and fails on any direct ``threading.Thread(...)``
+/ ``Thread(...)`` construction outside ``utils/pipeline.py`` itself (the
+factory's own call site), and on any ``spawn_thread(..., daemon=True)``
+whose literal True sneaks a daemon in without the factory's audit trail —
+daemon-ness must be a reviewed, named decision at the factory.
+"""
+
+import ast
+import os
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "srnn_tpu")
+
+#: the factory's own home — the one sanctioned Thread() call site
+FACTORY_FILE = "utils/pipeline.py"
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return True  # threading.Thread(...), x.Thread(...)
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _offenders(path: str, rel: str):
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=rel)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_thread_ctor(node) and rel != FACTORY_FILE:
+            yield (f"{rel}:{node.lineno}: direct Thread() — use "
+                   "utils.pipeline.spawn_thread (join-on-exit registry)")
+        if (isinstance(node.func, (ast.Name, ast.Attribute))
+                and (getattr(node.func, "id", None) == "spawn_thread"
+                     or getattr(node.func, "attr", None) == "spawn_thread")):
+            for kw in node.keywords:
+                if (kw.arg == "daemon"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    yield (f"{rel}:{node.lineno}: spawn_thread(daemon=True) "
+                           "— daemon threads can strand buffered I/O at "
+                           "interpreter exit; justify and whitelist here "
+                           "if truly needed")
+
+
+def test_no_unregistered_threads():
+    offenders = []
+    for root, _dirs, files in os.walk(PKG):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, PKG).replace(os.sep, "/")
+            offenders.extend(_offenders(path, rel))
+    assert not offenders, "\n".join(offenders)
+
+
+def test_factory_registers_and_joins():
+    """The factory's runtime half of the invariant: spawn_thread lands in
+    live_threads() while running and leaves it once joined."""
+    import threading
+
+    from srnn_tpu.utils.pipeline import live_threads, spawn_thread
+
+    gate = threading.Event()
+    t = spawn_thread(gate.wait, name="hygiene-probe")
+    assert t in live_threads() and not t.daemon
+    gate.set()
+    t.join(5.0)
+    assert t not in live_threads()
