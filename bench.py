@@ -87,6 +87,12 @@ _SENTINEL = "@@BENCH_RESULT "
 #: (backend init / compile / call k of n) instead of just "timeout"
 _HB_SENTINEL = "@@BENCH_HB "
 
+#: child-side stall watchdog state (see _arm_stall_sentinel): the ring
+#: doubles as the triage bundle's flight-recorder trail, the sentinel is
+#: the dead-man's switch that dumps it when the child wedges
+_STALL_RING = None
+_STALL_SENTINEL = None
+
 
 # --------------------------------------------------------------------------
 # child side: one stage per process
@@ -97,7 +103,52 @@ def _hb(stage, step, **extra):
     killed child's partial stdout and records it in the stage log)."""
     row = {"stage": stage, "step": step, "t": round(time.time(), 3)}
     row.update(extra)
+    if _STALL_RING is not None:
+        _STALL_RING.record(dict(row))
+    if _STALL_SENTINEL is not None:
+        _STALL_SENTINEL.mark(f"{stage}:{step}")
     print(_HB_SENTINEL + json.dumps(row), flush=True)
+
+
+def _arm_stall_sentinel(stage: str) -> None:
+    """Arm the flight-recorder dead-man's switch for this child: if no
+    heartbeat lands within SRNN_BENCH_STALL_S seconds (the parent exports
+    ~80% of the attempt timeout), a daemon timer writes a host-only triage
+    bundle — the heartbeat ring, backend metadata, the last mark — and
+    prints its path as a final heartbeat row.  The parent lifts that path
+    into the attempt's stage_log entry, so a timed-out attempt points at
+    an artifact instead of just "timeout".  The wedge typically hangs a
+    blocking C call (tunnel recvfrom), which releases the GIL, so the
+    timer thread still runs."""
+    global _STALL_RING, _STALL_SENTINEL
+
+    deadline = float(os.environ.get("SRNN_BENCH_STALL_S", "0") or 0)
+    if deadline <= 0:
+        return
+    from srnn_tpu.telemetry.flightrec import (FlightRecorder, StallSentinel,
+                                              write_triage_bundle)
+
+    ring = FlightRecorder(capacity=64)
+    root = os.environ.get(
+        "SRNN_BENCH_TRIAGE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_triage"))
+
+    def on_stall(last_mark, waited_s):
+        os.makedirs(root, exist_ok=True)
+        bundle = write_triage_bundle(
+            root, ["stall"], {"stage": stage, "last_mark": last_mark,
+                              "stalled_after_s": round(waited_s, 1)},
+            recorder=ring, thresholds={"stall_s": deadline})
+        # printed WITHOUT _hb (a mark here would re-arm the deadline)
+        row = {"stage": stage, "step": "stall", "t": round(time.time(), 3),
+               "last_mark": last_mark, "triage_bundle": bundle}
+        print(_HB_SENTINEL + json.dumps(row), flush=True)
+        sys.stdout.flush()
+
+    _STALL_RING = ring
+    _STALL_SENTINEL = StallSentinel(deadline, on_stall,
+                                    name=f"bench-{stage}-stall")
 
 def _bench_fn(topo, steps):
     """The measured program: ``steps`` chained self-applications over the
@@ -196,6 +247,9 @@ def _precompile(topo, shapes):
 
 def _child_stage(stage: str) -> None:
     """Run one stage and print its result on a sentinel stdout line."""
+    # the dead-man's switch arms BEFORE the simulated/real wedge windows
+    # (backend init, compile) so a hang still yields a triage artifact
+    _arm_stall_sentinel(stage)
     if stage in os.environ.get("SRNN_BENCH_TEST_HANG", "").split(","):
         time.sleep(3600)  # test hook: simulate a wedged backend init
 
@@ -374,11 +428,21 @@ def _orchestrate(result):
             att = {"stage": tag or stage, "attempt": i + 1,
                    "timeout_s": round(t, 1),
                    "t_start_s": round(time.monotonic() - t_start, 1)}
-            r, err, hb = _run_child(stage, t, stage_env or env)
+            # arm the child's stall sentinel just inside this attempt's
+            # timeout, so a wedge writes its triage bundle BEFORE the kill
+            # (an operator-exported SRNN_BENCH_STALL_S wins)
+            child_env = dict(stage_env or env)
+            child_env.setdefault("SRNN_BENCH_STALL_S",
+                                 str(round(max(20.0, t * 0.8), 1)))
+            r, err, hb = _run_child(stage, t, child_env)
             att["t_end_s"] = round(time.monotonic() - t_start, 1)
             att["outcome"] = "ok" if r is not None else err
             if hb is not None:
                 att["last_heartbeat"] = hb
+                if r is None and hb.get("triage_bundle"):
+                    # a failed/timed-out attempt names its artifact: the
+                    # child's stall sentinel wrote a bundle before the kill
+                    att["triage_bundle"] = hb["triage_bundle"]
             if r is not None and "pipeline" in r:
                 # device-idle/overlap attribution alongside the stage_log
                 # row: a slow-but-successful attempt names host stall vs

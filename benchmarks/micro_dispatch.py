@@ -1,7 +1,9 @@
 """Micro-benchmark: compile-time, dispatch-overhead, and peak-memory rows
 for the soup hot path, before/after the AOT + donation subsystem.
 
-Three rows, one JSON line:
+One JSON line of rows (plus ``telemetry``/``health``: the in-scan metrics
+and health-sentinel carries' dispatch overhead, interleaved
+median-of-medians — see their docstrings):
 
   * ``compile``: wall time of the soup hot path's BACKEND COMPILE (the
     generation step + the 100-generation chunk run, full dynamics) in a
@@ -242,6 +244,65 @@ def row_telemetry() -> dict:
     }
 
 
+def row_health() -> dict:
+    """Walltime overhead of the flight recorder's in-scan HEALTH sentinel
+    carry on top of the metered chunk program — ``evolve(metrics=True,
+    health=True)`` (the mega loops' default spelling) vs plain
+    ``metrics=True``.  The acceptance bound is <= ~5% overhead.
+
+    Same protocol as :func:`row_telemetry`: interleaved calls, per-pass
+    medians, 3 passes, MEDIAN-OF-MEDIANS reported (the row_telemetry
+    docstring explains why anything less is noise on this host)."""
+    import statistics
+
+    import jax
+
+    from srnn_tpu.soup import evolve, seed
+
+    cfg = _config(TELEMETRY_N)
+    st = seed(cfg, jax.random.key(0))
+    calls = 20
+    passes = 3
+
+    def metered():
+        s, _m = evolve(cfg, st, generations=TELEMETRY_GENS, metrics=True)
+        return float(s.next_uid)  # scalar readback forces completion
+
+    def sentineled():
+        s, _m, _h = evolve(cfg, st, generations=TELEMETRY_GENS,
+                           metrics=True, health=True)
+        return float(s.next_uid)
+
+    metered(), sentineled(), metered(), sentineled()  # compile + warm both
+    metered_meds, health_meds = [], []
+    for _ in range(passes):
+        tm, th = [], []
+        for _ in range(calls):
+            t0 = time.perf_counter()
+            metered()
+            tm.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            sentineled()
+            th.append(time.perf_counter() - t0)
+        metered_meds.append(statistics.median(tm))
+        health_meds.append(statistics.median(th))
+    metered_s = statistics.median(metered_meds)
+    health_s = statistics.median(health_meds)
+    return {
+        "row": "health",
+        "n": TELEMETRY_N,
+        "generations": TELEMETRY_GENS,
+        "calls": calls,
+        "passes": passes,
+        "metered_ms_per_chunk": round(metered_s * 1e3, 3),
+        "health_ms_per_chunk": round(health_s * 1e3, 3),
+        "pass_overhead_pct": [
+            round(100 * (h / m - 1), 2)
+            for m, h in zip(metered_meds, health_meds)],
+        "overhead_pct": round(100 * (health_s / metered_s - 1), 2),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--stage", default=None, help=argparse.SUPPRESS)
@@ -256,11 +317,11 @@ def main(argv=None) -> int:
         return 0
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
-            row_telemetry()]
+            row_telemetry(), row_health()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t = rows
+        c, d, m, t, h = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -277,6 +338,10 @@ def main(argv=None) -> int:
               f"{t['metered_ms_per_chunk']:.1f}ms vs plain "
               f"{t['plain_ms_per_chunk']:.1f}ms per chunk "
               f"({t['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# health(N={h['n']}, G={h['generations']}): +sentinels "
+              f"{h['health_ms_per_chunk']:.1f}ms vs metered "
+              f"{h['metered_ms_per_chunk']:.1f}ms per chunk "
+              f"({h['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
     return 0
 
 

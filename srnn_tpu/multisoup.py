@@ -374,13 +374,20 @@ evolve_multi_step_donated = jax.jit(_evolve_multi_step,
 
 
 def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
-                  generations: int = 1, metrics: bool = False):
+                  generations: int = 1, metrics: bool = False,
+                  health: bool = False):
     """Evolve ``generations`` mixed-soup steps as one scan.
 
     ``metrics=True`` additionally returns one
     ``telemetry.device.SoupMetrics`` carry PER TYPE, accumulated inside
     the scan from the per-type event records (zero extra host syncs; the
-    evolved state is bit-identical to the unmetered program)."""
+    evolved state is bit-identical to the unmetered program).
+
+    ``health=True`` additionally returns one
+    ``telemetry.device.HealthStats`` carry PER TYPE — the flight
+    recorder's population-health sentinels, folded from each type's
+    post-step weights with the same guarantees.  Return order: ``final``,
+    metrics carries if metering, health carries if sentineled."""
     if metrics:
         from .telemetry.device import (accumulate_soup_metrics,
                                        zero_soup_metrics)
@@ -392,6 +399,24 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         m0 = tuple(zero_soup_metrics() for _ in config.topos)
     else:
         m0 = None
+    if health:
+        from .telemetry.device import accumulate_health, zero_health
+
+        def acc_h(hs, ws, axis):
+            return tuple(accumulate_health(h, w, axis, config.epsilon)
+                         for h, w in zip(hs, ws))
+
+        h0 = tuple(zero_health() for _ in config.topos)
+    else:
+        h0 = None
+
+    def pack(final, ms, hs):
+        out = (final,)
+        if metrics:
+            out += (ms,)
+        if health:
+            out += (hs,)
+        return out if len(out) > 1 else final
 
     if config.layout == "popmajor":
         # keep every per-type carry transposed across the whole run: one
@@ -399,39 +424,44 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         _check_popmajor_multi(config)
 
         def body_t(carry, _):
-            s, wTs, ms = carry
+            s, wTs, ms, hs = carry
             new_s, ev, new_wTs = _evolve_multi_popmajor(config, s, wTs)
             if metrics:
                 ms = acc(ms, ev)
-            return (new_s, new_wTs, ms), None
+            if health:
+                hs = acc_h(hs, new_wTs, 0)
+            return (new_s, new_wTs, ms, hs), None
 
         light = state._replace(weights=tuple(
             jnp.zeros((0,), w.dtype) for w in state.weights))
-        (final, wTs, ms), _ = jax.lax.scan(
-            body_t, (light, tuple(w.T for w in state.weights), m0), None,
+        (final, wTs, ms, hs), _ = jax.lax.scan(
+            body_t, (light, tuple(w.T for w in state.weights), m0, h0), None,
             length=generations)
         final = final._replace(weights=tuple(wT.T for wT in wTs))
-        return (final, ms) if metrics else final
+        return pack(final, ms, hs)
 
     def body(carry, _):
-        s, ms = carry
+        s, ms, hs = carry
         new_s, ev = evolve_multi_step(config, s)
         if metrics:
             ms = acc(ms, ev)
-        return (new_s, ms), None
+        if health:
+            hs = acc_h(hs, new_s.weights, -1)
+        return (new_s, ms, hs), None
 
-    (final, ms), _ = jax.lax.scan(body, (state, m0), None,
-                                  length=generations)
-    return (final, ms) if metrics else final
+    (final, ms, hs), _ = jax.lax.scan(body, (state, m0, h0), None,
+                                      length=generations)
+    return pack(final, ms, hs)
 
 
 #: jitted multi-generation mixed-soup run + its buffer-donating twin
 #: (mega-run hot loops; state rebound chunk over chunk).
 evolve_multi = jax.jit(_evolve_multi,
-                       static_argnames=("config", "generations", "metrics"))
+                       static_argnames=("config", "generations", "metrics",
+                                        "health"))
 evolve_multi_donated = jax.jit(_evolve_multi,
                                static_argnames=("config", "generations",
-                                                "metrics"),
+                                                "metrics", "health"),
                                donate_argnums=(1,))
 
 

@@ -384,16 +384,31 @@ def _multi_metrics_specs(t: int):
                  for _ in range(t))
 
 
+def _multi_health_specs(t: int):
+    """Replicated placement of the per-type ``HealthStats`` carries
+    (global after the in-body psum/pmin/pmax)."""
+    from ..telemetry.device import HealthStats
+
+    return tuple(
+        HealthStats(checks=P(), nonfinite=P(), nonfinite_peak=P(),
+                    zero=P(), zero_peak=P(), norm_min=P(), norm_max=P(),
+                    norm_hist=P())
+        for _ in range(t))
+
+
 def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
                           state: MultiSoupState, generations: int = 1,
-                          metrics: bool = False):
+                          metrics: bool = False, health: bool = False):
     """Scan ``generations`` sharded mixed-soup steps inside ONE shard_map
     (collectives stay inside the scan).  The popmajor layout keeps every
     per-type local shard transposed (P_t, N_t/D) across generations.
 
     ``metrics=True`` additionally returns the GLOBAL per-type
     ``telemetry.device.SoupMetrics`` carries (per-shard accumulation
-    inside the scan, one psum per type at the shard boundary)."""
+    inside the scan, one psum per type at the shard boundary);
+    ``health=True`` the GLOBAL per-type ``telemetry.device.HealthStats``
+    carries (counts psum'd, extrema pmin/pmax'd).  Return order:
+    ``final``, metrics carries, health carries."""
     if config.layout not in ("rowmajor", "popmajor"):
         raise ValueError(f"unknown multisoup layout {config.layout!r}")
     if metrics:
@@ -408,13 +423,41 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         def flush(ms):
             return tuple(psum_soup_metrics(m, SOUP_AXIS) for m in ms)
 
+    if health:
+        from ..telemetry.device import (accumulate_health, psum_health,
+                                        zero_health)
+
+        def acc_h(hs, ws, axis):
+            return tuple(accumulate_health(h, w, axis, config.epsilon)
+                         for h, w in zip(hs, ws))
+
+        def flush_h(hs):
+            return tuple(psum_health(h, SOUP_AXIS) for h in hs)
+
     def m0():
         return tuple(zero_soup_metrics() for _ in config.topos) \
             if metrics else None
 
+    def h0():
+        return tuple(zero_health() for _ in config.topos) \
+            if health else None
+
+    def pack(final, ms, hs):
+        out = (final,)
+        if metrics:
+            out += (flush(ms),)
+        if health:
+            out += (flush_h(hs),)
+        return out if len(out) > 1 else final
+
     nt = len(config.topos)
-    out_specs = (_mstate_specs(nt), _multi_metrics_specs(nt)) if metrics \
-        else _mstate_specs(nt)
+    out_specs = (_mstate_specs(nt),)
+    if metrics:
+        out_specs += (_multi_metrics_specs(nt),)
+    if health:
+        out_specs += (_multi_health_specs(nt),)
+    if len(out_specs) == 1:
+        out_specs = out_specs[0]
     if config.layout == "popmajor":
         _check_popmajor_multi(config)
 
@@ -423,18 +466,20 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
                 jnp.zeros((0,), w.dtype) for w in st.weights))
 
             def body(carry, _):
-                s, wTs, ms = carry
+                s, wTs, ms, hs = carry
                 new_s, ev, new_wTs = _local_evolve_multi_popmajor(
                     config, s, wTs)
                 if metrics:
                     ms = acc(ms, ev)
-                return (new_s, new_wTs, ms), None
+                if health:
+                    hs = acc_h(hs, new_wTs, 0)
+                return (new_s, new_wTs, ms, hs), None
 
-            (final, wTs, ms), _ = jax.lax.scan(
-                body, (light, tuple(w.T for w in st.weights), m0()), None,
-                length=generations)
+            (final, wTs, ms, hs), _ = jax.lax.scan(
+                body, (light, tuple(w.T for w in st.weights), m0(), h0()),
+                None, length=generations)
             final = final._replace(weights=tuple(wT.T for wT in wTs))
-            return (final, flush(ms)) if metrics else final
+            return pack(final, ms, hs)
 
         fn = shard_map(
             local_run_t,
@@ -447,15 +492,17 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
 
     def local_run(st: MultiSoupState):
         def body(carry, _):
-            s, ms = carry
+            s, ms, hs = carry
             new_s, ev = _local_evolve_multi(config, s)
             if metrics:
                 ms = acc(ms, ev)
-            return (new_s, ms), None
+            if health:
+                hs = acc_h(hs, new_s.weights, -1)
+            return (new_s, ms, hs), None
 
-        (final, ms), _ = jax.lax.scan(body, (st, m0()), None,
-                                      length=generations)
-        return (final, flush(ms)) if metrics else final
+        (final, ms, hs), _ = jax.lax.scan(body, (st, m0(), h0()), None,
+                                          length=generations)
+        return pack(final, ms, hs)
 
     fn = shard_map(
         local_run,
@@ -469,10 +516,10 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
 
 sharded_evolve_multi = jax.jit(
     _sharded_evolve_multi,
-    static_argnames=("config", "mesh", "generations", "metrics"))
+    static_argnames=("config", "mesh", "generations", "metrics", "health"))
 sharded_evolve_multi_donated = jax.jit(
     _sharded_evolve_multi,
-    static_argnames=("config", "mesh", "generations", "metrics"),
+    static_argnames=("config", "mesh", "generations", "metrics", "health"),
     donate_argnums=(2,))
 
 

@@ -364,8 +364,19 @@ def _metrics_specs():
     return SoupMetrics(generations=P(), actions=P(), loss_sum=P())
 
 
+def _health_specs():
+    """Replicated placement of a flushed ``HealthStats`` carry (global
+    after the in-body psum/pmin/pmax)."""
+    from ..telemetry.device import HealthStats
+
+    return HealthStats(checks=P(), nonfinite=P(), nonfinite_peak=P(),
+                       zero=P(), zero_peak=P(), norm_min=P(), norm_max=P(),
+                       norm_hist=P())
+
+
 def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
-                    generations: int = 1, metrics: bool = False):
+                    generations: int = 1, metrics: bool = False,
+                    health: bool = False):
     """Scan ``generations`` sharded steps (collectives stay inside the scan —
     one compiled program for the whole evolution).
 
@@ -377,65 +388,93 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
     ``metrics=True`` additionally returns the GLOBAL
     ``telemetry.device.SoupMetrics`` carry: per-shard accumulation inside
     the scan, one psum at the shard boundary — no per-generation host
-    syncs, state bit-identical to the unmetered program."""
+    syncs, state bit-identical to the unmetered program.  ``health=True``
+    does the same for the GLOBAL ``telemetry.device.HealthStats`` carry
+    (counts/hist psum'd, extrema pmin/pmax'd; peaks are a shard-wise upper
+    bound).  Return order: ``final``, metrics carry, health carry."""
     axes = _soup_axes(mesh)
     if metrics:
         from ..telemetry.device import (accumulate_soup_metrics,
                                         psum_soup_metrics,
                                         zero_soup_metrics)
+    if health:
+        from ..telemetry.device import (accumulate_health, psum_health,
+                                        zero_health)
+
+    def pack(final, m, h):
+        out = (final,)
+        if metrics:
+            out += (m,)
+        if health:
+            out += (h,)
+        return out if len(out) > 1 else final
+
     if config.layout == "popmajor":
         _check_popmajor(config)
 
         def local_run(st: SoupState):
             light = st._replace(weights=jnp.zeros((0,), st.weights.dtype))
             m0 = zero_soup_metrics() if metrics else None
+            h0 = zero_health() if health else None
 
             def body(carry, _):
-                s, wT, m = carry
+                s, wT, m, h = carry
                 new_s, ev, new_wT = _local_evolve_popmajor(config, s, wT,
                                                            axes)
                 if metrics:
                     m = accumulate_soup_metrics(m, ev.action, ev.loss)
-                return (new_s, new_wT, m), None
+                if health:
+                    h = accumulate_health(h, new_wT, 0, config.epsilon)
+                return (new_s, new_wT, m, h), None
 
-            (final, wT, m), _ = jax.lax.scan(
-                body, (light, st.weights.T, m0), None, length=generations)
+            (final, wT, m, h), _ = jax.lax.scan(
+                body, (light, st.weights.T, m0, h0), None,
+                length=generations)
             final = final._replace(weights=wT.T)
-            if metrics:
-                return final, psum_soup_metrics(m, axes)
-            return final
+            return pack(final,
+                        psum_soup_metrics(m, axes) if metrics else None,
+                        psum_health(h, axes) if health else None)
 
+        out_specs = (_state_specs(axes),)
+        if metrics:
+            out_specs += (_metrics_specs(),)
+        if health:
+            out_specs += (_health_specs(),)
         fn = shard_map(
             local_run,
             mesh=mesh,
             in_specs=(_state_specs(axes),),
-            out_specs=(_state_specs(axes), _metrics_specs()) if metrics
-            else _state_specs(axes),
+            out_specs=out_specs if len(out_specs) > 1 else out_specs[0],
             check_vma=False,
         )
         return fn(state)
 
     m0 = zero_soup_metrics() if metrics else None
+    h0 = zero_health() if health else None
 
     def body(carry, _):
-        fn_state, m = carry
+        fn_state, m, h = carry
         new_state, ev = sharded_evolve_step(config, mesh, fn_state)
         if metrics:
             # events come back particle-sharded; the bincount reduction is
             # GSPMD's to place (one small collective per generation)
             m = accumulate_soup_metrics(m, ev.action, ev.loss)
-        return (new_state, m), None
+        if health:
+            h = accumulate_health(h, new_state.weights, -1, config.epsilon)
+        return (new_state, m, h), None
 
-    (final, m), _ = jax.lax.scan(body, (state, m0), None, length=generations)
-    return (final, m) if metrics else final
+    (final, m, h), _ = jax.lax.scan(body, (state, m0, h0), None,
+                                    length=generations)
+    return pack(final, m, h)
 
 
 sharded_evolve = jax.jit(_sharded_evolve,
                          static_argnames=("config", "mesh", "generations",
-                                          "metrics"))
+                                          "metrics", "health"))
 sharded_evolve_donated = jax.jit(_sharded_evolve,
                                  static_argnames=("config", "mesh",
-                                                  "generations", "metrics"),
+                                                  "generations", "metrics",
+                                                  "health"),
                                  donate_argnums=(2,))
 
 

@@ -153,14 +153,123 @@ def make_pipeline(args, registry, stage: str):
     driver deferring chunk k's host finisher until chunk k+1's device
     work is dispatched.  ``--no-pipeline`` degrades all three to the
     blocking order (writer=None, depth=0) — the bit-identical A/B
-    reference.  Returns ``(pipelined, writer, meter, driver)``."""
+    reference.  ``--stall-timeout-s`` arms the driver's finisher deadline
+    (the flight recorder's liveness half; the loop wires ``on_stall``
+    after building its recorder).  Returns
+    ``(pipelined, writer, meter, driver)``."""
     from ..utils.pipeline import BackgroundWriter, ChunkDriver, OverlapMeter
 
     pipelined = not args.no_pipeline
     writer = BackgroundWriter(name=f"{stage}-io") if pipelined else None
     meter = OverlapMeter(registry, stage=stage, writer=writer)
-    driver = ChunkDriver(depth=1 if pipelined else 0)
+    driver = ChunkDriver(depth=1 if pipelined else 0,
+                         stall_timeout_s=getattr(args, "stall_timeout_s",
+                                                 0.0) or 0.0)
     return pipelined, writer, meter, driver
+
+
+# ---- flight recorder / watchdog plumbing (mega_soup / mega_multisoup) ------
+
+
+def add_flightrec_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The flight-recorder CLI knobs shared by the mega-run entry points
+    (see ``telemetry.flightrec``)."""
+    p.add_argument("--no-health", action="store_true",
+                   help="drop the in-scan population-health sentinel carry "
+                        "(NaN/zero fractions, weight-norm sketch); the "
+                        "evolved state is bit-identical either way")
+    p.add_argument("--no-watchdog", action="store_true",
+                   help="record the flight-recorder ring but never trip "
+                        "or write triage bundles")
+    p.add_argument("--flightrec-ring", type=int, default=256, metavar="N",
+                   help="flight-recorder ring capacity in chunks")
+    p.add_argument("--watchdog-nan-frac", type=float, default=0.02,
+                   metavar="F",
+                   help="trip when the NaN/Inf particle fraction exceeds F "
+                        "(<=0 disables)")
+    p.add_argument("--watchdog-zero-frac", type=float, default=0.9,
+                   metavar="F",
+                   help="trip when the zero-collapse fraction exceeds F "
+                        "(<=0 disables)")
+    p.add_argument("--watchdog-respawn-frac", type=float, default=0.25,
+                   metavar="F",
+                   help="trip when a chunk's respawns exceed F of its "
+                        "particle-generations (<=0 disables)")
+    p.add_argument("--watchdog-gens-regress", type=float, default=0.0,
+                   metavar="F",
+                   help="trip when gens/sec falls below (1-F) of the ring "
+                        "median (0 disables; wall-clock is noisy on shared "
+                        "hosts, so this rule is opt-in)")
+    p.add_argument("--watchdog-max-bundles", type=int, default=2,
+                   metavar="N",
+                   help="most triage bundles one run may write (a NaN "
+                        "storm trips every chunk; N bundles tell the story)")
+    p.add_argument("--stall-timeout-s", type=float, default=0.0,
+                   metavar="S",
+                   help="chunk-finisher stall deadline: a chunk whose "
+                        "device results do not land within S seconds "
+                        "raises a named StallError carrying a host-only "
+                        "triage bundle (0 = off)")
+    return p
+
+
+def make_flightrec(args):
+    """Build the (recorder, watchdog) pair from the CLI knobs; watchdog is
+    ``None`` under ``--no-watchdog``."""
+    from ..telemetry.flightrec import FlightRecorder, Watchdog
+
+    recorder = FlightRecorder(capacity=args.flightrec_ring)
+    watchdog = None if args.no_watchdog else Watchdog(
+        recorder,
+        nan_frac=args.watchdog_nan_frac,
+        zero_frac=args.watchdog_zero_frac,
+        respawn_frac=args.watchdog_respawn_frac,
+        gens_regress=args.watchdog_gens_regress,
+        max_bundles=args.watchdog_max_bundles)
+    return recorder, watchdog
+
+
+def make_on_stall(exp, flightrec, registry, current_gen):
+    """The ``ChunkDriver.on_stall`` handler both mega loops arm: write a
+    HOST-ONLY triage bundle (the device is presumed hung, so no snapshot
+    is attempted — the ring + metrics are what the host still has).
+    ``current_gen`` is a zero-arg callable reading the loop's generation
+    counter at stall time."""
+    from ..telemetry.flightrec import write_triage_bundle
+
+    def on_stall(timeout_s):
+        return write_triage_bundle(
+            exp.dir, ["stall"], (flightrec.tail(1) or [None])[-1],
+            recorder=flightrec, registry=registry,
+            thresholds={"stall_timeout_s": timeout_s},
+            generation=current_gen())
+
+    return on_stall
+
+
+def watchdog_chunk(watchdog, row, *, exp, registry, snapshot_state,
+                   save_fn, gen) -> None:
+    """One chunk's watchdog turn, shared by both mega-loop finishers:
+    close a profiler window armed by a previous trip (so the captured
+    window spans roughly the chunk after the trip), evaluate the rules
+    against the ring-stamped ``row``, and on a trip count it, write the
+    bundle (``snapshot_state``/``save_fn`` = the chunk's pre-donation
+    snapshot and the matching checkpoint writer), and log it."""
+    if watchdog is None:
+        return
+    watchdog.chunk_boundary()
+    reasons = watchdog.check(row)
+    if not reasons:
+        return
+    registry.counter("soup_watchdog_trips_total",
+                     help="watchdog anomaly trips").inc(1)
+    bundle = watchdog.trip(reasons, row, run_dir=exp.dir,
+                           snapshot_state=snapshot_state, save_fn=save_fn,
+                           registry=registry, generation=gen)
+    exp.log(f"WATCHDOG tripped [{', '.join(reasons)}]"
+            + (f": triage bundle {bundle}" if bundle
+               else " (bundle quota spent)"),
+            kind="watchdog", reasons=reasons, bundle=bundle, generation=gen)
 
 
 def finish_pipeline(exp, driver, writer, meter, pipelined: bool) -> None:

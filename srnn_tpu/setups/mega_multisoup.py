@@ -31,16 +31,21 @@ from ..experiment import (Experiment, restore_multi_checkpoint,
                           save_multi_checkpoint)
 from ..multisoup import (MultiSoupConfig, count_multi, evolve_multi,
                          evolve_multi_donated, seed_multi)
+from ..soup import ACT_DIV_DEAD, ACT_ZERO_DEAD
 from ..telemetry import Heartbeat, MetricsRegistry
+from ..telemetry.device import probe_health
+from ..telemetry.flightrec import (combined_health_summary, health_summary,
+                                   update_health_gauges)
 from ..telemetry.soup_metrics import (type_names, update_class_gauges,
                                       update_multi_registry)
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
-from .common import (add_pipeline_args, base_parser, finish_pipeline,
-                     latest_checkpoint, make_pipeline,
-                     load_run_config, register, save_run_config)
+from .common import (add_flightrec_args, add_pipeline_args, base_parser,
+                     finish_pipeline, latest_checkpoint, make_flightrec,
+                     make_on_stall, make_pipeline, load_run_config,
+                     register, save_run_config, watchdog_chunk)
 
 
 def build_parser():
@@ -75,6 +80,7 @@ def build_parser():
                    help="shard every type's particle axis over ALL visible "
                         "devices (shard_map data parallel)")
     add_pipeline_args(p)
+    add_flightrec_args(p)
     return p
 
 
@@ -223,19 +229,24 @@ def run(args):
     # outputs, restores own_pytree-copied — and one executable for every
     # chunk keeps resume bitwise); the sharded path donates only states
     # this loop itself produced (first chunk plain).
-    def _evolve(s, gens, owned):
+    def _evolve(s, gens, owned, health):
         if mesh is not None:
             from ..parallel import (sharded_evolve_multi,
                                     sharded_evolve_multi_donated)
             run = sharded_evolve_multi_donated if owned \
                 else sharded_evolve_multi
-            return run(cfg, mesh, s, generations=gens, metrics=True)
-        return evolve_multi_donated(cfg, s, generations=gens, metrics=True)
+            return run(cfg, mesh, s, generations=gens, metrics=True,
+                       health=health)
+        return evolve_multi_donated(cfg, s, generations=gens, metrics=True,
+                                    health=health)
 
     # telemetry: per-run registry (per-type science counters from the
     # in-scan carries, class gauges per type) + fsync'd heartbeats; both
     # flushed every chunk to events.jsonl and metrics.prom
     registry = MetricsRegistry()
+    # flight recorder + watchdog (see mega_soup / telemetry.flightrec)
+    health_on = not args.no_health
+    flightrec, watchdog = make_flightrec(args)
     stores = writer = None
     import time as _time
     try:
@@ -244,6 +255,8 @@ def run(args):
         # hangs interpreter shutdown
         pipelined, writer, meter, driver = make_pipeline(args, registry,
                                                          "mega_multisoup")
+        driver.on_stall = make_on_stall(exp, flightrec, registry,
+                                        lambda: gen)
         hb = Heartbeat(exp, stage="mega_multisoup",
                        total_generations=args.generations,
                        registry=registry,
@@ -298,7 +311,7 @@ def run(args):
                 update_class_gauges(registry, counts[t],
                                     type_name=tname, prev=prev[t])
 
-        def _finisher(gen, chunk, counts_dev, ckpt_state, ms=None):
+        def _finisher(gen, chunk, counts_dev, ckpt_state, ms=None, hs=None):
             def finish():
                 nonlocal counts, t_last
                 with meter.waiting():
@@ -311,6 +324,28 @@ def run(args):
                         f"{_format_type_counts(counts)}",
                         generation=gen, gens_per_sec=round(chunk / dt, 3),
                         counts=counts.tolist())
+                # flight-recorder row (see mega_soup): whole-population
+                # health drives the watchdog; per-type detail rides along
+                row = {"gen": gen, "chunk": chunk,
+                       "gens_per_sec": round(chunk / dt, 3),
+                       "counts": counts.tolist(), "seed": args.seed}
+                by_type = None
+                if ms is not None:
+                    div = sum(int(np.asarray(m.actions)[ACT_DIV_DEAD])
+                              for m in ms)
+                    zero = sum(int(np.asarray(m.actions)[ACT_ZERO_DEAD])
+                               for m in ms)
+                    row["respawns_divergent"] = div
+                    row["respawns_zero"] = zero
+                    row["respawns"] = div + zero
+                    row["particle_gens"] = chunk * cfg.total
+                if hs is not None:
+                    by_type = {tname: health_summary(h, cfg.sizes[t])
+                               for t, (tname, h)
+                               in enumerate(zip(type_names(cfg), hs))}
+                    row["health"] = combined_health_summary(
+                        list(by_type.values()))
+                    row["health_by_type"] = by_type
                 # registry-mutation ordering + host_io window: see the
                 # mega_soup finisher — chunk k's mutations ride the
                 # writer ahead of chunk k's flush_events
@@ -319,6 +354,10 @@ def run(args):
                         submit_or_run(writer, update_multi_registry,
                                       registry, ms, cfg)
                     submit_or_run(writer, _class_gauges, counts, prev)
+                    if by_type is not None:
+                        for tname, hsum in by_type.items():
+                            submit_or_run(writer, update_health_gauges,
+                                          registry, hsum, tname)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
                     submit_or_run(writer, registry.flush_events, exp)
@@ -328,14 +367,19 @@ def run(args):
                                   os.path.join(exp.dir,
                                                f"ckpt-gen{gen:08d}"),
                                   ckpt_state)
-                meter.chunk_done(dt)
+                row["pipeline"] = meter.chunk_done(dt)
+                # stamped copy: see mega_soup (gens_regress seq exclusion)
+                row = flightrec.record(row)
+                watchdog_chunk(watchdog, row, exp=exp, registry=registry,
+                               snapshot_state=ckpt_state,
+                               save_fn=save_multi_checkpoint, gen=gen)
             return finish
 
         while gen < args.generations:
             chunk = min(args.checkpoint_every, args.generations - gen)
-            # non-capture chunks hand their metrics carry to the
-            # finisher, which orders it ahead of the chunk's flush
-            ms = None
+            # non-capture chunks hand their metrics + health carries to
+            # the finisher, which orders them ahead of the chunk's flush
+            ms = hs = None
             if stores is not None:
                 from ..utils import evolve_multi_captured
                 # owned=True: state is jax-owned (seed/own_pytree) and
@@ -345,22 +389,33 @@ def run(args):
                                               owned=True, registry=registry,
                                               pipelined=pipelined,
                                               writer=writer)
+                if health_on:
+                    # end-of-chunk probe per type (one tiny dispatch each,
+                    # ordered before the next donation; see mega_soup)
+                    hs = tuple(probe_health(w, -1, cfg.epsilon)
+                               for w in state.weights)
             else:
-                # the metrics carry rides the finisher, ordered ahead of
-                # this chunk's flush_events
-                state, ms = _evolve(state, chunk, owned)
+                if health_on:
+                    state, ms, hs = _evolve(state, chunk, owned, True)
+                else:
+                    state, ms = _evolve(state, chunk, owned, False)
             owned = True
             gen += chunk
             # both dispatched BEFORE the next iteration donates state
-            # (the metrics carry ms is a fresh jit output, never donated):
+            # (the metrics/health carries are fresh jit outputs, never
+            # donated):
             counts_dev = _count(state)
             ckpt_state = snapshot(state) if pipelined else state
-            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, ms))
+            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, ms,
+                                  hs))
         finish_pipeline(exp, driver, writer, meter, pipelined)
         exp.log(f"done: {_format_type_counts(counts)}")
     finally:
-        # teardown order (see mega_soup): pipeline writer, then stores,
-        # then the experiment — nested finallys keep meta.json guaranteed
+        # teardown order (see mega_soup): armed profiler window, pipeline
+        # writer, then stores, then the experiment — nested finallys keep
+        # meta.json guaranteed
+        if watchdog is not None:
+            watchdog.stop_trace()
         try:
             try:
                 if writer is not None:

@@ -24,15 +24,19 @@ import numpy as np
 
 from ..experiment import (Experiment, counters_dict, format_counters,
                           restore_checkpoint, save_checkpoint)
-from ..soup import SoupConfig, count, evolve, evolve_donated, seed
+from ..soup import (ACT_DIV_DEAD, ACT_ZERO_DEAD, SoupConfig, count, evolve,
+                    evolve_donated, seed)
 from ..telemetry import Heartbeat, MetricsRegistry
+from ..telemetry.device import probe_health
+from ..telemetry.flightrec import health_summary, update_health_gauges
 from ..telemetry.soup_metrics import update_class_gauges, update_registry
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
-from .common import (add_pipeline_args, base_parser, finish_pipeline,
-                     latest_checkpoint, load_run_config, make_pipeline,
-                     register, save_run_config)
+from .common import (add_flightrec_args, add_pipeline_args, base_parser,
+                     finish_pipeline, latest_checkpoint, load_run_config,
+                     make_flightrec, make_on_stall, make_pipeline, register,
+                     save_run_config, watchdog_chunk)
 
 
 def build_parser():
@@ -78,6 +82,7 @@ def build_parser():
                         "writes one .traj shard per process (multihost-safe) "
                         "merged offline by read_sharded_store")
     add_pipeline_args(p)
+    add_flightrec_args(p)
     return p
 
 
@@ -175,6 +180,10 @@ def run(args):
     # events.jsonl + metrics.prom every chunk, and fsync'd heartbeat rows
     # so a killed run names its last stage/generation/rate
     registry = MetricsRegistry()
+    # flight recorder: bounded ring of per-chunk health rows + the anomaly
+    # watchdog that turns a pathological chunk into a triage bundle
+    health_on = not args.no_health
+    flightrec, watchdog = make_flightrec(args)
     store = writer = None
     import time as _time
     try:
@@ -185,6 +194,8 @@ def run(args):
         # q.get() and hang interpreter shutdown instead of exiting
         pipelined, writer, meter, driver = make_pipeline(args, registry,
                                                          "mega_soup")
+        driver.on_stall = make_on_stall(exp, flightrec, registry,
+                                        lambda: gen)
         hb = Heartbeat(exp, stage="mega_soup",
                        total_generations=args.generations,
                        registry=registry,
@@ -246,7 +257,7 @@ def run(args):
         gen = int(state.time)
         t_last = _time.perf_counter()
 
-        def _finisher(gen, chunk, counts_dev, ckpt_state, m=None):
+        def _finisher(gen, chunk, counts_dev, ckpt_state, m=None, h=None):
             def finish():
                 nonlocal counts, t_last
                 with meter.waiting():
@@ -258,22 +269,41 @@ def run(args):
                         f"{chunk / dt:.2f} gens/s  {format_counters(counts)}",
                         generation=gen, gens_per_sec=round(chunk / dt, 3),
                         counts=counters_dict(counts))
+                # flight-recorder row: resolve the tiny health/metrics
+                # carries now (the chunk landed with the counts above)
+                row = {"gen": gen, "chunk": chunk,
+                       "gens_per_sec": round(chunk / dt, 3),
+                       "counts": counters_dict(counts), "seed": args.seed}
+                hsum = None
+                if m is not None:
+                    acts = np.asarray(m.actions)
+                    row["respawns_divergent"] = int(acts[ACT_DIV_DEAD])
+                    row["respawns_zero"] = int(acts[ACT_ZERO_DEAD])
+                    row["respawns"] = row["respawns_divergent"] \
+                        + row["respawns_zero"]
+                    row["particle_gens"] = chunk * cfg.size
+                if h is not None:
+                    hsum = health_summary(h, cfg.size)
+                    row["health"] = hsum
                 # EVERY registry mutation of chunk k — the in-scan
-                # metrics carry, class gauges, heartbeat gauges — rides
-                # the writer HERE, in submission order ahead of chunk k's
-                # flush_events, so the metrics row can never see chunk
-                # k+1's values (capture-mode science counters are the
-                # documented exception: they enqueue per generation
-                # during chunk k+1's producer loop, so a flush may count
-                # them up to one chunk early).  The host_io window times
-                # the inline work in the blocking loop and the
-                # enqueue/backpressure stall in the pipelined one.
+                # metrics carry, class gauges, health gauges, heartbeat
+                # gauges — rides the writer HERE, in submission order
+                # ahead of chunk k's flush_events, so the metrics row can
+                # never see chunk k+1's values (capture-mode science
+                # counters are the documented exception: they enqueue per
+                # generation during chunk k+1's producer loop, so a flush
+                # may count them up to one chunk early).  The host_io
+                # window times the inline work in the blocking loop and
+                # the enqueue/backpressure stall in the pipelined one.
                 with meter.host_io():
                     if m is not None:
                         submit_or_run(writer, update_registry, registry,
                                       m, n_particles=cfg.size)
                     submit_or_run(writer, update_class_gauges, registry,
                                   counts, prev=prev)
+                    if hsum is not None:
+                        submit_or_run(writer, update_health_gauges,
+                                      registry, hsum)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
                     submit_or_run(writer, registry.flush_events, exp)
@@ -283,14 +313,20 @@ def run(args):
                                   os.path.join(exp.dir,
                                                f"ckpt-gen{gen:08d}"),
                                   ckpt_state)
-                meter.chunk_done(dt)
+                row["pipeline"] = meter.chunk_done(dt)
+                # the stamped copy (seq/t) is what the rules see — the
+                # gens_regress median excludes the current row by seq
+                row = flightrec.record(row)
+                watchdog_chunk(watchdog, row, exp=exp, registry=registry,
+                               snapshot_state=ckpt_state,
+                               save_fn=save_checkpoint, gen=gen)
             return finish
 
         while gen < args.generations:
             chunk = min(args.checkpoint_every, args.generations - gen)
-            # non-capture chunks hand their metrics carry to the
-            # finisher, which orders it ahead of the chunk's flush
-            m = None
+            # non-capture chunks hand their metrics + health carries to
+            # the finisher, which orders them ahead of the chunk's flush
+            m = h = None
             if store is not None and mesh is not None:
                 from ..utils import sharded_evolve_captured
                 state = sharded_evolve_captured(cfg, mesh, state, chunk, store,
@@ -311,28 +347,47 @@ def run(args):
                 from ..parallel import (sharded_evolve,
                                         sharded_evolve_donated)
                 run = sharded_evolve_donated if sh_owned else sharded_evolve
-                state, m = run(cfg, mesh, state, generations=chunk,
-                               metrics=True)
+                if health_on:
+                    state, m, h = run(cfg, mesh, state, generations=chunk,
+                                      metrics=True, health=True)
+                else:
+                    state, m = run(cfg, mesh, state, generations=chunk,
+                                   metrics=True)
                 sh_owned = True
             else:
-                state, m = evolve_donated(cfg, state, generations=chunk,
-                                          metrics=True)
+                if health_on:
+                    state, m, h = evolve_donated(cfg, state,
+                                                 generations=chunk,
+                                                 metrics=True, health=True)
+                else:
+                    state, m = evolve_donated(cfg, state, generations=chunk,
+                                              metrics=True)
+            if store is not None and health_on:
+                # capture chunks meter through the capture helpers and lack
+                # the in-scan carry; probe end-of-chunk health with one
+                # tiny extra dispatch (ordered before the next donation)
+                h = probe_health(state.weights, -1, cfg.epsilon)
             gen += chunk
             # both dispatched BEFORE the next iteration donates state
-            # (the metrics carry m is a fresh jit output, never donated):
+            # (the metrics/health carries are fresh jit outputs, never
+            # donated):
             counts_dev = _count(state)
             ckpt_state = snapshot(state) if pipelined else state
-            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, m))
+            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, m, h))
         finish_pipeline(exp, driver, writer, meter, pipelined)
         exp.log(f"done: {counters_dict(counts)}")
     finally:
-        # teardown order: the pipeline writer first (drains queued frame
-        # appends/checkpoints and joins its thread, re-raising any job
-        # failure), then the capture store (joins the native writer thread
-        # so every appended frame hits disk even on a crash path), then
-        # the experiment exactly once with real exception info so
-        # meta.json records crashes.  Nested finallys guarantee meta.json
-        # is written even when a close itself raises (e.g. disk full).
+        # teardown order: any armed watchdog profiler window first (it
+        # must not outlive the run), then the pipeline writer (drains
+        # queued frame appends/checkpoints and joins its thread,
+        # re-raising any job failure), then the capture store (joins the
+        # native writer thread so every appended frame hits disk even on
+        # a crash path), then the experiment exactly once with real
+        # exception info so meta.json records crashes.  Nested finallys
+        # guarantee meta.json is written even when a close itself raises
+        # (e.g. disk full).
+        if watchdog is not None:
+            watchdog.stop_trace()
         try:
             try:
                 if writer is not None:

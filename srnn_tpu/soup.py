@@ -45,10 +45,12 @@ from .topology import Topology
 from .train import DEFAULT_LR, fit_epochs_flat
 from .engine import classify_batch
 
-# action codes for the event log (reference action strings, soup.py:60-85;
-# 'zweo_dead' [sic] is the reference's persisted typo for the zero respawn)
+# action codes for the event log (reference action strings, soup.py:60-85).
+# The reference persists the zero respawn as 'zweo_dead' [sic]; this label
+# set fixes the typo — readers of OLD artifacts/rows that still carry the
+# misspelled key are normalized in telemetry.report.
 ACTION_NAMES = ("none", "init", "attacking", "learn_from", "train_self",
-                "divergent_dead", "zweo_dead")
+                "divergent_dead", "zero_dead")
 (ACT_NONE, ACT_INIT, ACT_ATTACK, ACT_LEARN, ACT_TRAIN,
  ACT_DIV_DEAD, ACT_ZERO_DEAD) = range(7)
 
@@ -637,6 +639,7 @@ def _evolve(
     generations: int = 1,
     record: bool = False,
     metrics: bool = False,
+    health: bool = False,
 ):
     """Evolve ``generations`` steps as one scan.
 
@@ -650,14 +653,23 @@ def _evolve(
     loss) accumulated INSIDE the scan, so a metered chunk costs one
     bincount per generation on device and zero extra host round-trips.
     The evolved state is bit-identical to the unmetered program (the
-    carry only reads the event record; tests assert parity).  Return
-    shape: ``final``, then ``recs`` if recording, then the metrics carry
-    if metering.
+    carry only reads the event record; tests assert parity).
+
+    With ``health=True`` also returns a ``telemetry.device.HealthStats``
+    carry — the flight recorder's population-health sentinels (NaN/Inf and
+    zero-collapse particle counts, weight-norm quantile sketch) folded
+    from each generation's post-step weights, same zero-host-round-trip
+    discipline and the same bit-identical-state guarantee.  Return shape:
+    ``final``, then ``recs`` if recording, then the metrics carry if
+    metering, then the health carry if sentineled.
     """
     if metrics:
         from .telemetry.device import (accumulate_soup_metrics,
                                        zero_soup_metrics)
+    if health:
+        from .telemetry.device import accumulate_health, zero_health
     m0 = zero_soup_metrics() if metrics else None
+    h0 = zero_health() if health else None
 
     if config.layout == "popmajor":
         # keep the carry transposed across the whole run: one transpose at
@@ -665,36 +677,43 @@ def _evolve(
         _check_popmajor(config)
 
         def step_t(carry, _):
-            s, wT, m = carry
+            s, wT, m, h = carry
             new_s, ev, new_wT = _evolve_parallel_popmajor(config, s, wT)
             if metrics:
                 m = accumulate_soup_metrics(m, ev.action, ev.loss)
+            if health:
+                h = accumulate_health(h, new_wT, 0, config.epsilon)
             out = (ev, new_wT.T, new_s.uids) if record else None
-            return (new_s, new_wT, m), out
+            return (new_s, new_wT, m, h), out
 
         # the transposed wT is the live weights carry; null the row-major
         # field so the scan doesn't drag a dead (N, P) buffer along
         light = state._replace(weights=jnp.zeros((0,), state.weights.dtype))
-        (final, wT, m), recs = jax.lax.scan(
-            step_t, (light, state.weights.T, m0), None, length=generations)
+        (final, wT, m, h), recs = jax.lax.scan(
+            step_t, (light, state.weights.T, m0, h0), None,
+            length=generations)
         final = final._replace(weights=wT.T)
     else:
         def step(carry, _):
-            s, m = carry
+            s, m, h = carry
             new_s, ev = evolve_step(config, s)
             if metrics:
                 m = accumulate_soup_metrics(m, ev.action, ev.loss)
+            if health:
+                h = accumulate_health(h, new_s.weights, -1, config.epsilon)
             out = (ev, new_s.weights, new_s.uids) if record else None
-            return (new_s, m), out
+            return (new_s, m, h), out
 
-        (final, m), recs = jax.lax.scan(step, (state, m0), None,
-                                        length=generations)
+        (final, m, h), recs = jax.lax.scan(step, (state, m0, h0), None,
+                                           length=generations)
 
     out = (final,)
     if record:
         out += (recs,)
     if metrics:
         out += (m,)
+    if health:
+        out += (h,)
     return out if len(out) > 1 else final
 
 
@@ -702,10 +721,10 @@ def _evolve(
 #: twin (see ``evolve_step_donated``) used by the mega-run hot loops, where
 #: the state is always rebound chunk over chunk.
 evolve = jax.jit(_evolve, static_argnames=("config", "generations", "record",
-                                           "metrics"))
+                                           "metrics", "health"))
 evolve_donated = jax.jit(_evolve,
                          static_argnames=("config", "generations", "record",
-                                          "metrics"),
+                                          "metrics", "health"),
                          donate_argnums=(1,))
 
 

@@ -19,21 +19,31 @@ The observability triad for soup evolution at production scale:
     and ``python -m srnn_tpu.telemetry.report <run_dir>`` to render it.
 """
 
-from .device import (N_ACTIONS, SoupMetrics, accumulate_soup_metrics,
-                     count_events, merge_soup_metrics, psum_soup_metrics,
-                     zero_soup_metrics)
+from .device import (N_ACTIONS, N_HEALTH_BUCKETS, HealthStats, SoupMetrics,
+                     accumulate_health, accumulate_soup_metrics,
+                     count_events, merge_health, merge_soup_metrics,
+                     probe_health, psum_health, psum_soup_metrics,
+                     zero_health, zero_soup_metrics)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, RUNTIME)
 from .tracing import Span, annotate, span, trace
 from .heartbeat import Heartbeat, device_memory_stats, rss_bytes
 from .soup_metrics import (EVENT_COUNTERS, update_class_gauges,
                            update_multi_registry, update_registry)
+from .flightrec import (FlightRecorder, StallSentinel, Watchdog,
+                        combined_health_summary, health_summary,
+                        update_health_gauges, write_triage_bundle)
 
 __all__ = [
     "N_ACTIONS", "SoupMetrics", "accumulate_soup_metrics", "count_events",
     "merge_soup_metrics", "psum_soup_metrics", "zero_soup_metrics",
+    "N_HEALTH_BUCKETS", "HealthStats", "accumulate_health", "merge_health",
+    "probe_health", "psum_health", "zero_health",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "RUNTIME",
     "Span", "annotate", "span", "trace",
     "Heartbeat", "device_memory_stats", "rss_bytes",
     "EVENT_COUNTERS", "update_class_gauges", "update_multi_registry",
     "update_registry",
+    "FlightRecorder", "StallSentinel", "Watchdog",
+    "combined_health_summary", "health_summary", "update_health_gauges",
+    "write_triage_bundle",
 ]

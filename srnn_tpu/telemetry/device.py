@@ -22,6 +22,7 @@ default 100-generation chunk stays 20x under the int32 ceiling; the host
 registry accumulates across flushes in unbounded python ints.
 """
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -103,3 +104,140 @@ def count_events(action: jnp.ndarray, loss: jnp.ndarray) -> SoupMetrics:
     capture helpers' final step of each stride).  A single tiny dispatch;
     under GSPMD a sharded ``action`` reduces with one collective."""
     return accumulate_soup_metrics(zero_soup_metrics(), action, loss)
+
+
+# ---------------------------------------------------------------------------
+# population-health sentinel carry (the flight recorder's device half)
+# ---------------------------------------------------------------------------
+
+#: log2-bucket layout of the weight-norm quantile sketch: bucket ``i``
+#: covers max-|w| in ``[2^(LO + i*STEP), 2^(LO + (i+1)*STEP))``, clipped at
+#: both ends, so the sketch spans 2^-64 .. 2^32 — from deep zero-collapse
+#: territory to far past any finite divergence precursor.
+N_HEALTH_BUCKETS = 24
+HEALTH_BUCKET_LO = -64
+HEALTH_BUCKET_STEP = 4
+
+
+class HealthStats(NamedTuple):
+    """Per-flush-interval population-health sentinels, accumulated on
+    device alongside :class:`SoupMetrics`.
+
+    The per-particle statistic everything derives from is ``max|w|`` over
+    the particle's weights — nonfinite iff any weight is NaN/Inf (the
+    divergence predicate), ``<= epsilon`` iff the particle zero-collapsed
+    (the ``is_zero`` predicate), and its log2 bucket is the quantile
+    sketch the host turns into min/median/max weight norms.
+
+    ``nonfinite``/``zero`` are END-of-window snapshots (the state the next
+    chunk starts from); the ``*_peak`` twins are window maxima, so a NaN
+    storm that respawn briefly cleans up is still visible.  Under sharding
+    the peaks psum per-shard maxima — an upper bound on the true global
+    per-generation peak (shards may peak in different generations); the
+    end-of-window counts and the histogram are exact.
+    """
+    checks: jnp.ndarray          # () int32 — generations folded in
+    nonfinite: jnp.ndarray       # () int32 — end-of-window NaN/Inf particles
+    nonfinite_peak: jnp.ndarray  # () int32 — window max of the above
+    zero: jnp.ndarray            # () int32 — end-of-window zero-collapsed
+    zero_peak: jnp.ndarray       # () int32
+    norm_min: jnp.ndarray        # () f32 — window min of finite max-|w|
+    norm_max: jnp.ndarray        # () f32 — window max of finite max-|w|
+    norm_hist: jnp.ndarray       # (N_HEALTH_BUCKETS,) int32 — per-gen sketch
+
+
+def zero_health() -> HealthStats:
+    """The identity element the scan carry starts from."""
+    return HealthStats(
+        checks=jnp.int32(0),
+        nonfinite=jnp.int32(0),
+        nonfinite_peak=jnp.int32(0),
+        zero=jnp.int32(0),
+        zero_peak=jnp.int32(0),
+        norm_min=jnp.float32(jnp.inf),
+        norm_max=jnp.float32(-jnp.inf),
+        norm_hist=jnp.zeros(N_HEALTH_BUCKETS, jnp.int32),
+    )
+
+
+def accumulate_health(h: HealthStats, w: jnp.ndarray, axis: int,
+                      epsilon: float) -> HealthStats:
+    """Fold one generation's post-step weights into the carry.
+
+    ``w`` is the population matrix — (N, P) row-major with ``axis=-1``, or
+    the transposed (P, N) lane layout with ``axis=0``; ``epsilon`` is the
+    config's zero-collapse bound.  Pure vectorized work (one abs, one
+    max-reduce over the tiny weight axis, a compare-and-reduce histogram —
+    the same discipline that kept the action histogram under the scatter
+    overhead), reads the weights and writes nothing, so the evolved state
+    stays bit-identical to the unmetered program.
+    """
+    norm = jnp.max(jnp.abs(w), axis=axis)           # (N,) per-particle
+    finite = jnp.isfinite(norm)
+    nonf = (~finite).sum(dtype=jnp.int32)
+    zero = (finite & (norm <= epsilon)).sum(dtype=jnp.int32)
+    # log2 sketch: exactly-zero norms land in bucket 0; nonfinite lanes are
+    # excluded (counted by ``nonfinite`` instead)
+    safe = jnp.where(finite & (norm > 0), norm,
+                     jnp.float32(2.0) ** HEALTH_BUCKET_LO)
+    b = jnp.clip(
+        (jnp.floor(jnp.log2(safe)).astype(jnp.int32) - HEALTH_BUCKET_LO)
+        // HEALTH_BUCKET_STEP, 0, N_HEALTH_BUCKETS - 1)
+    codes = jnp.arange(N_HEALTH_BUCKETS, dtype=jnp.int32)
+    hist = ((b[None, :] == codes[:, None]) & finite[None, :]).sum(
+        axis=1, dtype=jnp.int32)
+    return HealthStats(
+        checks=h.checks + 1,
+        nonfinite=nonf,
+        nonfinite_peak=jnp.maximum(h.nonfinite_peak, nonf),
+        zero=zero,
+        zero_peak=jnp.maximum(h.zero_peak, zero),
+        norm_min=jnp.minimum(h.norm_min,
+                             jnp.where(finite, norm, jnp.inf).min()),
+        norm_max=jnp.maximum(h.norm_max,
+                             jnp.where(finite, norm, -jnp.inf).max()),
+        norm_hist=h.norm_hist + hist,
+    )
+
+
+def merge_health(a: HealthStats, b: HealthStats) -> HealthStats:
+    """Combine two CONSECUTIVE accumulation windows over the same
+    population (``b`` later than ``a``): end-of-window snapshots take
+    ``b``'s, peaks/extrema/hist fold."""
+    return HealthStats(
+        checks=a.checks + b.checks,
+        nonfinite=b.nonfinite,
+        nonfinite_peak=jnp.maximum(a.nonfinite_peak, b.nonfinite_peak),
+        zero=b.zero,
+        zero_peak=jnp.maximum(a.zero_peak, b.zero_peak),
+        norm_min=jnp.minimum(a.norm_min, b.norm_min),
+        norm_max=jnp.maximum(a.norm_max, b.norm_max),
+        norm_hist=a.norm_hist + b.norm_hist,
+    )
+
+
+def psum_health(h: HealthStats, axis_name) -> HealthStats:
+    """Global health from per-shard carries inside a ``shard_map`` body:
+    counts/hist psum over the particle-sharded axis, extrema pmin/pmax;
+    ``checks`` is replicated (every shard stepped the same count).  The
+    psum'd peaks are a shard-wise upper bound (see :class:`HealthStats`)."""
+    return HealthStats(
+        checks=h.checks,
+        nonfinite=jax.lax.psum(h.nonfinite, axis_name),
+        nonfinite_peak=jax.lax.psum(h.nonfinite_peak, axis_name),
+        zero=jax.lax.psum(h.zero, axis_name),
+        zero_peak=jax.lax.psum(h.zero_peak, axis_name),
+        norm_min=jax.lax.pmin(h.norm_min, axis_name),
+        norm_max=jax.lax.pmax(h.norm_max, axis_name),
+        norm_hist=jax.lax.psum(h.norm_hist, axis_name),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "epsilon"))
+def probe_health(w: jnp.ndarray, axis: int = -1,
+                 epsilon: float = 1e-4) -> HealthStats:
+    """One-shot health stats of a population already in hand — the
+    capture-mode chunks' cheap substitute for the in-scan carry (one tiny
+    extra dispatch per chunk; under GSPMD a sharded ``w`` reduces with
+    collectives)."""
+    return accumulate_health(zero_health(), w, axis, epsilon)

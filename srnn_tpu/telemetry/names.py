@@ -1,0 +1,102 @@
+"""The canonical metric-name table.
+
+Every metric name registered anywhere under ``srnn_tpu/`` must be declared
+here with its kind — ``tests/test_metric_names.py`` walks the package AST
+(and the runtime ``EVENT_COUNTERS`` table) and fails on any name that is
+missing, mis-kinded, or breaks the naming convention.  This is the
+collection-time tripwire for the next ``zweo``-style drift: a typo'd or
+ad-hoc name cannot ship, because it is not in this table.
+
+Naming convention (:func:`check_name`):
+
+  * ``snake_case`` throughout (``[a-z][a-z0-9_]*``).
+  * Counters end in ``_total`` (Prometheus monotone-counter convention).
+  * Unit-bearing suffixes are ``_seconds`` / ``_bytes`` (or the
+    grandfathered short ``_s`` on the pipeline chunk gauges); never
+    ``_sec`` / ``_secs`` / ``_ms``.
+
+``GRANDFATHERED`` lists pre-convention names kept for dashboard
+compatibility; do not add new entries — fix the name instead.
+"""
+
+import re
+from typing import Dict
+
+#: name -> kind ("counter" | "gauge" | "histogram"); exported with the
+#: ``srnn_`` namespace prefix by ``telemetry.metrics``.
+CANONICAL_METRICS: Dict[str, str] = {
+    # -- soup science (telemetry.soup_metrics) ---------------------------
+    "soup_generations_total": "counter",
+    "soup_particle_generations_total": "counter",
+    "soup_attacks_total": "counter",
+    "soup_learns_total": "counter",
+    "soup_train_events_total": "counter",
+    "soup_respawns_divergent_total": "counter",
+    "soup_respawns_zero_total": "counter",
+    "soup_train_loss_sum": "counter",
+    "soup_train_loss_nonfinite_flushes_total": "counter",
+    "soup_class_particles": "gauge",
+    "soup_class_delta": "gauge",
+    # -- flight recorder (telemetry.flightrec) ---------------------------
+    "soup_health_nonfinite_particles": "gauge",
+    "soup_health_zero_particles": "gauge",
+    "soup_health_nan_frac": "gauge",
+    "soup_health_zero_frac": "gauge",
+    "soup_health_weight_norm_min": "gauge",
+    "soup_health_weight_norm_max": "gauge",
+    "soup_watchdog_trips_total": "counter",
+    # -- heartbeats (telemetry.heartbeat) --------------------------------
+    "heartbeat_generation": "gauge",
+    "gens_per_sec": "gauge",
+    "rss_bytes": "gauge",
+    # -- spans (telemetry.tracing) ---------------------------------------
+    "span_seconds": "histogram",
+    # -- async pipeline (utils.pipeline) ---------------------------------
+    "pipeline_chunk_wall_s": "gauge",
+    "pipeline_chunk_device_wait_s": "gauge",
+    "pipeline_chunk_host_io_s": "gauge",
+    "pipeline_chunk_device_idle_bound_s": "gauge",
+    "pipeline_overlap_ratio": "gauge",
+    "pipeline_wall_seconds_total": "counter",
+    "pipeline_device_wait_seconds_total": "counter",
+    "pipeline_host_io_seconds_total": "counter",
+    # -- AOT subsystem (utils.aot) ---------------------------------------
+    "aot_compiles_total": "counter",
+    "aot_memo_hits_total": "counter",
+    "aot_lower_seconds_total": "counter",
+    "aot_compile_seconds_total": "counter",
+    "aot_compile_seconds": "histogram",
+}
+
+#: pre-convention names kept for dashboard compatibility (do not extend):
+#: the ``_s`` chunk gauges predate the ``_seconds`` rule; ``gens_per_sec``
+#: and ``soup_train_loss_sum`` predate the suffix rules entirely.
+GRANDFATHERED = frozenset({
+    "soup_train_loss_sum",
+    "gens_per_sec",
+    "pipeline_chunk_wall_s",
+    "pipeline_chunk_device_wait_s",
+    "pipeline_chunk_host_io_s",
+    "pipeline_chunk_device_idle_bound_s",
+})
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_BAD_UNIT_SUFFIXES = ("_sec", "_secs", "_ms", "_millis", "_mb", "_kb")
+
+
+def check_name(name: str, kind: str) -> "list[str]":
+    """Convention violations for one (name, kind) pair (empty = clean)."""
+    problems = []
+    if not _SNAKE.match(name):
+        problems.append(f"{name}: not snake_case")
+    if name in GRANDFATHERED:
+        return problems
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append(f"{name}: counter must end in _total")
+    if kind != "counter" and name.endswith("_total"):
+        problems.append(f"{name}: _total suffix is reserved for counters")
+    if name.endswith(_BAD_UNIT_SUFFIXES):
+        problems.append(
+            f"{name}: use _seconds/_bytes unit suffixes, not "
+            f"{name[name.rfind('_'):]}")
+    return problems

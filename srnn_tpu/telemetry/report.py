@@ -1,6 +1,7 @@
-"""Summarize a run directory's telemetry trail.
+"""Summarize a run directory's telemetry trail — or a triage bundle.
 
     python -m srnn_tpu.telemetry.report <run_dir> [--json]
+    python -m srnn_tpu.telemetry.report --triage <bundle_dir> [--json]
 
 Reads ``meta.json`` + ``events.jsonl`` (the ``Experiment`` channel the
 mega-run loops, heartbeats and metric flushes all write through) and
@@ -9,15 +10,35 @@ last alive (stage / generation / gens-per-sec / memory), what do the
 final cumulative metrics say, and where did the wall time go (spans).
 Works on killed runs — heartbeat rows are fsync'd, and cumulative metric
 snapshots mean the last row is the whole story.
+
+``--triage`` renders a flight-recorder bundle (``telemetry.flightrec``):
+the trip reason and thresholds, the ring tail, the health trajectory
+(NaN/zero fractions + gens/sec over the ring), the population snapshot's
+shapes/dtypes, and a pointer to the captured profiler trace.
 """
 
 import argparse
 import json
 import os
 import sys
-from typing import Dict, List
+from typing import Any, Dict, List
 
 from .metrics import quantile_from_times
+
+#: the reference's persisted zero-respawn typo; rows written before the
+#: rename may carry it as a dict key — normalized on load so existing run
+#: dirs keep rendering (the counter name never carried the typo)
+_LEGACY_KEYS = {"zweo_dead": "zero_dead"}
+
+
+def _normalize_legacy(row: Any) -> Any:
+    """Recursively rename legacy (misspelled) keys in one event row."""
+    if isinstance(row, dict):
+        return {_LEGACY_KEYS.get(k, k): _normalize_legacy(v)
+                for k, v in row.items()}
+    if isinstance(row, list):
+        return [_normalize_legacy(v) for v in row]
+    return row
 
 
 def load_events(run_dir: str) -> List[dict]:
@@ -31,7 +52,7 @@ def load_events(run_dir: str) -> List[dict]:
             if not line:
                 continue
             try:
-                rows.append(json.loads(line))
+                rows.append(_normalize_legacy(json.loads(line)))
             except json.JSONDecodeError:
                 pass  # torn tail of a killed run: keep what parses
     return rows
@@ -159,17 +180,176 @@ def _render(s: dict, out) -> None:
         w("metrics: none recorded\n")
 
 
+# ---------------------------------------------------------------------------
+# triage bundles (telemetry.flightrec)
+# ---------------------------------------------------------------------------
+
+
+def _snapshot_info(bundle_dir: str) -> Dict[str, Any]:
+    """Shapes/dtypes of the bundle's population snapshot.  Tries the
+    homogeneous restore first, then the heterogeneous one; a bundle whose
+    checkpoint cannot be restored (missing orbax, foreign layout) still
+    reports the directory listing."""
+    import glob as _glob
+
+    ckpts = sorted(p for p in _glob.glob(os.path.join(bundle_dir,
+                                                      "ckpt-gen*"))
+                   if p.rsplit("gen", 1)[1].isdigit())
+    if not ckpts:
+        return {}
+    path = ckpts[-1]
+    info: Dict[str, Any] = {"path": os.path.basename(path)}
+    for name, restore in (("soup", "restore_checkpoint"),
+                          ("multisoup", "restore_multi_checkpoint")):
+        try:
+            from .. import experiment
+
+            state = getattr(experiment, restore)(path)
+            import numpy as _np
+
+            def leaf(x):
+                return (f"{tuple(x.shape)} {x.dtype}"
+                        if hasattr(x, "shape") else repr(x))
+
+            fields = {}
+            for k, v in state._asdict().items():
+                fields[k] = ([leaf(_np.asarray(e)) for e in v]
+                             if isinstance(v, (tuple, list))
+                             else leaf(v))
+            info["kind"] = name
+            info["generation"] = int(state.time)
+            info["fields"] = fields
+            # an earlier restore flavor may have failed (and recorded its
+            # error) before this one succeeded — success wins
+            info.pop("restore_error", None)
+            return info
+        except Exception as e:
+            info["restore_error"] = f"{type(e).__name__}: {e}"
+    try:
+        info["contents"] = sorted(os.listdir(path))
+    except OSError:
+        pass
+    return info
+
+
+def summarize_triage(bundle_dir: str) -> dict:
+    """Machine-readable summary of one triage bundle (the ``--triage
+    --json`` output; the text renderer formats this)."""
+    trip = _load_json(bundle_dir, "trip.json")
+    ring: List[dict] = []
+    ring_path = os.path.join(bundle_dir, "ring.jsonl")
+    if os.path.exists(ring_path):
+        with open(ring_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ring.append(_normalize_legacy(json.loads(line)))
+                except json.JSONDecodeError:
+                    pass
+    trajectory = [
+        {k: r.get(k) for k in ("gen", "gens_per_sec")}
+        | {"nan_frac": (r.get("health") or {}).get("nan_frac"),
+           "zero_frac": (r.get("health") or {}).get("zero_frac"),
+           "respawns": r.get("respawns")}
+        for r in ring]
+    trace_dir = os.path.join(bundle_dir, "trace")
+    has_trace = os.path.isdir(trace_dir) and any(os.scandir(trace_dir))
+    return {
+        "bundle_dir": os.path.abspath(bundle_dir),
+        "trip": trip,
+        "config": _load_json(bundle_dir, "config.json"),
+        "metrics": _load_json(bundle_dir, "metrics.json"),
+        "ring_len": len(ring),
+        "ring_tail": ring[-8:],
+        "health_trajectory": trajectory,
+        "snapshot": _snapshot_info(bundle_dir),
+        "trace_dir": os.path.abspath(trace_dir) if has_trace else None,
+    }
+
+
+def _fmt_frac(v) -> str:
+    return f"{v:.4f}" if isinstance(v, (int, float)) else "-"
+
+
+def _render_triage(s: dict, out) -> None:
+    w = out.write
+    trip = s["trip"]
+    w(f"triage bundle: {s['bundle_dir']}\n")
+    if trip:
+        w(f"  tripped: {', '.join(trip.get('reasons', []))} "
+          f"at generation {trip.get('generation')}\n")
+        th = {k: v for k, v in (trip.get("thresholds") or {}).items()
+              if v}
+        if th:
+            w("  thresholds: "
+              + " ".join(f"{k}={v}" for k, v in sorted(th.items())) + "\n")
+        backend = trip.get("backend") or {}
+        if backend:
+            w(f"  backend: {backend.get('backend')} x"
+              f"{backend.get('device_count')} "
+              f"jax={backend.get('jax_version')}\n")
+        if trip.get("errors"):
+            w(f"  bundle-write errors: {trip['errors']}\n")
+    else:
+        w("  (no trip.json — not a triage bundle?)\n")
+    if s["config"]:
+        knobs = " ".join(f"{k}={v}" for k, v in sorted(s["config"].items())
+                         if not isinstance(v, (list, dict)))
+        w(f"  config: {knobs}\n")
+
+    traj = [t for t in s["health_trajectory"] if t.get("gen") is not None]
+    if traj:
+        w(f"health trajectory ({s['ring_len']} ring rows):\n")
+        w("  gen      gens/s   nan_frac  zero_frac  respawns\n")
+        for t in traj[-12:]:
+            gps = t.get("gens_per_sec")
+            w(f"  {t['gen']:<8} {gps if gps is not None else '-':<8} "
+              f"{_fmt_frac(t.get('nan_frac')):<9} "
+              f"{_fmt_frac(t.get('zero_frac')):<10} "
+              f"{t.get('respawns') if t.get('respawns') is not None else '-'}"
+              "\n")
+
+    snap = s["snapshot"]
+    if snap:
+        w(f"snapshot: {snap.get('path')}")
+        if "kind" in snap:
+            w(f" ({snap['kind']}, generation {snap.get('generation')})\n")
+            for k, v in snap["fields"].items():
+                w(f"  {k}: {v}\n")
+        else:
+            w(f"  [{snap.get('restore_error', 'unrestorable')}]\n")
+        w(f"  resume with: python -m srnn_tpu.setups <mega_...> "
+          f"--resume {s['bundle_dir']}\n")
+    else:
+        w("snapshot: none (host-only bundle — stall or snapshot "
+          "failure; see trip.json)\n")
+    if s["trace_dir"]:
+        w(f"profiler trace: {s['trace_dir']}\n")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("run_dir", help="an Experiment run directory")
+    p.add_argument("run_dir", help="an Experiment run directory (or a "
+                                   "triage bundle with --triage)")
+    p.add_argument("--triage", action="store_true",
+                   help="treat run_dir as a flight-recorder triage bundle")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable summary instead of text")
     args = p.parse_args(argv)
     if not os.path.isdir(args.run_dir):
         print(f"report: {args.run_dir}: not a directory", file=sys.stderr)
         return 2
+    if args.triage:
+        s = summarize_triage(args.run_dir)
+        if args.json:
+            print(json.dumps(s, indent=1, default=str))
+        else:
+            _render_triage(s, sys.stdout)
+        return 0
     s = summarize(args.run_dir)
     if args.json:
         print(json.dumps(s, indent=1, default=str))

@@ -23,6 +23,10 @@ assert len(ACTION_NAMES) == N_ACTIONS, (
     "telemetry.device.N_ACTIONS fell out of sync with soup.ACTION_NAMES")
 
 #: action-code -> (counter name, help).  'none'/'init' are not events.
+#: The zero-respawn action is 'zero_dead' (the reference's persisted
+#: 'zweo' typo is fixed at the label level; the COUNTER name below never
+#: carried it and is unchanged — old events.jsonl rows with the
+#: misspelled key are normalized by ``telemetry.report``).
 EVENT_COUNTERS = {
     "attacking": ("soup_attacks_total",
                   "particles whose last action was attacking another"),
@@ -32,7 +36,7 @@ EVENT_COUNTERS = {
                    "particles whose last action was self-training"),
     "divergent_dead": ("soup_respawns_divergent_total",
                        "particles respawned after diverging"),
-    "zweo_dead": ("soup_respawns_zero_total",
+    "zero_dead": ("soup_respawns_zero_total",
                   "particles respawned after collapsing to zero"),
 }
 

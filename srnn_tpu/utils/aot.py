@@ -351,9 +351,13 @@ def _soup_entries(config, generations: int, donate: bool):
     # the mega-run loops and capture helpers dispatch the chunk run with
     # the telemetry carry (metrics=True, a STATIC arg — a different
     # program); warm that spelling too or production's first chunk
-    # re-pays the compile this subsystem exists to remove
+    # re-pays the compile this subsystem exists to remove.  Same story for
+    # the flight recorder's health sentinels (metrics+health, the mega
+    # loops' default spelling).
     yield (f"soup.evolve{tag}.metered", run, (config, st),
            {"generations": generations, "metrics": True})
+    yield (f"soup.evolve{tag}.metered.health", run, (config, st),
+           {"generations": generations, "metrics": True, "health": True})
 
 
 def _multi_entries(config, generations: int, donate: bool):
@@ -370,6 +374,8 @@ def _multi_entries(config, generations: int, donate: bool):
            {"generations": generations})
     yield (f"multisoup.evolve_multi{tag}.metered", run, (config, st),
            {"generations": generations, "metrics": True})
+    yield (f"multisoup.evolve_multi{tag}.metered.health", run, (config, st),
+           {"generations": generations, "metrics": True, "health": True})
 
 
 def _engine_entries(topo, size: int, donate: bool, step_limit: int,
@@ -406,6 +412,9 @@ def _sharded_entries(config, mesh, generations: int, donate: bool):
            {"generations": generations})
     yield (f"parallel.sharded_evolve{tag}.metered", run, (config, mesh, st),
            {"generations": generations, "metrics": True})
+    yield (f"parallel.sharded_evolve{tag}.metered.health", run,
+           (config, mesh, st),
+           {"generations": generations, "metrics": True, "health": True})
 
 
 def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
@@ -424,6 +433,9 @@ def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
     yield (f"parallel.sharded_evolve_multi{tag}.metered", run,
            (config, mesh, st),
            {"generations": generations, "metrics": True})
+    yield (f"parallel.sharded_evolve_multi{tag}.metered.health", run,
+           (config, mesh, st),
+           {"generations": generations, "metrics": True, "health": True})
 
 
 def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,

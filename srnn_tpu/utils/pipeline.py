@@ -328,6 +328,18 @@ def resolve(tree: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
+class StallError(RuntimeError):
+    """A chunk finisher exceeded the :class:`ChunkDriver` stall deadline:
+    the dispatch-ahead loop is wedged (a hung device resolve, a dead
+    tunnel).  Carries ``bundle`` — the triage-bundle path the stall
+    handler wrote, if any — so the failure names an artifact instead of
+    an opaque timeout."""
+
+    def __init__(self, message: str, bundle: Optional[str] = None):
+        super().__init__(message)
+        self.bundle = bundle
+
+
 class ChunkDriver:
     """Run chunk *k*'s host finisher after chunk *k+1*'s device dispatch.
 
@@ -338,20 +350,67 @@ class ChunkDriver:
     is the double-buffered production shape; ``depth=0`` runs finishers
     immediately (the blocking order, for parity/A-B runs).  ``drain()``
     runs whatever is still pending (call it after the loop).
+
+    **Stall deadline** (the flight recorder's liveness half):
+    ``stall_timeout_s > 0`` runs each finisher on a watched daemon thread
+    and raises :class:`StallError` if it does not complete in time — a
+    chunk whose device results never land (wedged backend, dead tunnel)
+    becomes a NAMED failure on the producing thread instead of an
+    indefinite hang.  ``on_stall(elapsed_s)`` (set by the mega loops)
+    runs first and may write a host-only triage bundle; its return value
+    rides the error as ``StallError.bundle``.  The watched thread is
+    daemon by design — it is exactly the thread presumed wedged, and a
+    non-daemon spelling would hang interpreter exit on the very wedge
+    this deadline exists to escape.  With ``stall_timeout_s=0`` (the
+    default) finishers run inline and the hot path is unchanged.
     """
 
-    def __init__(self, depth: int = 1):
+    def __init__(self, depth: int = 1, stall_timeout_s: float = 0.0,
+                 on_stall: Optional[Callable[[float], Optional[str]]] = None):
         self.depth = max(0, int(depth))
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.on_stall = on_stall
         self._pending: "deque[Callable[[], None]]" = deque()
+
+    def _run(self, finish: Callable[[], None]) -> None:
+        if self.stall_timeout_s <= 0:
+            finish()
+            return
+        done = threading.Event()
+        err: List[BaseException] = []
+
+        def watched():
+            try:
+                finish()
+            except BaseException as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        spawn_thread(watched, name="srnn-chunk-finisher", daemon=True)
+        if not done.wait(self.stall_timeout_s):
+            bundle = None
+            if self.on_stall is not None:
+                try:
+                    bundle = self.on_stall(self.stall_timeout_s)
+                except Exception:
+                    pass  # the stall itself is the failure to surface
+            raise StallError(
+                f"chunk finisher exceeded the {self.stall_timeout_s:.0f}s "
+                "stall deadline (device results never landed)"
+                + (f"; triage bundle: {bundle}" if bundle else ""),
+                bundle=bundle)
+        if err:
+            raise err[0]
 
     def step(self, finish: Callable[[], None]) -> None:
         self._pending.append(finish)
         while len(self._pending) > self.depth:
-            self._pending.popleft()()
+            self._run(self._pending.popleft())
 
     def drain(self) -> None:
         while self._pending:
-            self._pending.popleft()()
+            self._run(self._pending.popleft())
 
 
 # ---------------------------------------------------------------------------

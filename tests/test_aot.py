@@ -183,10 +183,12 @@ def test_engine_and_multi_warmup_entries():
     assert "engine.run_fixpoint.donated" in entries
     assert "engine.run_training.donated" in entries
     # non-donating sweep compiles the value-preserving spellings separately
-    # (plus the telemetry-metered chunk run the production loops dispatch)
+    # (plus the telemetry-metered chunk run the production loops dispatch,
+    # with and without the flight recorder's health sentinels)
     plain = aot.warmup(cfg, generations=2, donate=False)
     assert {r["entry"] for r in plain} == {"soup.evolve_step", "soup.evolve",
-                                           "soup.evolve.metered"}
+                                           "soup.evolve.metered",
+                                           "soup.evolve.metered.health"}
     assert not any(r["cached"] for r in plain)
 
 

@@ -95,3 +95,34 @@ def test_all_stages_wedged_lands_cpu_rescue_number():
     assert out["stage"] == "cpu-rescue"
     assert out["backend"] == "cpu-forced"
     assert "timeout" in out["error"]
+
+
+def test_stalled_child_names_triage_bundle(tmp_path):
+    """The flight-recorder satellite: a wedged child's stall sentinel
+    fires INSIDE the attempt timeout, writes a host-only triage bundle,
+    and the parent lifts its path into that attempt's stage_log row — so
+    deadline exhaustion points at an artifact, not just 'timeout'."""
+    triage_root = str(tmp_path / "triage")
+    rc, out = _run_bench({
+        "SRNN_BENCH_TEST_HANG": "ramp,full",
+        "SRNN_BENCH_RAMP_TIMEOUT_S": "12",
+        "SRNN_BENCH_FULL_TIMEOUT_S": "12",
+        "SRNN_BENCH_DEADLINE_S": "500",
+        "SRNN_BENCH_STALL_S": "3",        # operator pin beats the 80% rule
+        "SRNN_BENCH_TRIAGE_DIR": triage_root,
+    })
+    assert rc == 0
+    stalled = [a for a in out["stage_log"] if a.get("triage_bundle")]
+    assert stalled, f"no attempt carried a bundle: {out['stage_log']}"
+    for att in stalled:
+        assert att["outcome"].startswith("timeout")
+        bundle = att["triage_bundle"]
+        assert os.path.isdir(bundle)
+        assert os.path.dirname(bundle) == triage_root
+        trip = json.load(open(os.path.join(bundle, "trip.json")))
+        assert trip["reasons"] == ["stall"]
+        assert trip["row"]["stage"] in ("ramp", "full")
+        assert trip["thresholds"]["stall_s"] == 3.0
+        # the heartbeat ring rode along (empty here: the test hook wedges
+        # before the first real heartbeat, exactly like a dead tunnel)
+        assert os.path.exists(os.path.join(bundle, "ring.jsonl"))

@@ -1,0 +1,478 @@
+"""Flight recorder (``srnn_tpu/telemetry/flightrec.py`` + the ``health=``
+device carry): the forensic layer for the paper's pathologies.
+
+Four layers, mirroring ISSUE 4's acceptance criteria:
+
+  * carry parity: ``health=True`` leaves the evolved state BIT-IDENTICAL
+    on every evolve path, and the device sentinels match a NumPy recount
+    of the same weights (unsharded, multi, and sharded-global).
+  * units: ring bounds/ordering, watchdog trip rules, triage-bundle
+    layout, the ``StallSentinel`` dead-man's switch, and the
+    ``ChunkDriver`` stall deadline (a hung finisher becomes a NAMED
+    ``StallError`` carrying a bundle path).
+  * end-to-end: NaNs injected into a mega-soup population mid-run trip
+    the watchdog, the bundle renders via ``report --triage``, and
+    ``--resume <bundle_dir>`` replays from its snapshot.
+"""
+
+import glob
+import json
+import math
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import Topology
+from srnn_tpu.soup import SoupConfig, evolve, seed
+from srnn_tpu.telemetry import report
+from srnn_tpu.telemetry.device import (HEALTH_BUCKET_LO, HEALTH_BUCKET_STEP,
+                                       N_HEALTH_BUCKETS, probe_health)
+from srnn_tpu.telemetry.flightrec import (FlightRecorder, StallSentinel,
+                                          Watchdog, combined_health_summary,
+                                          health_summary,
+                                          write_triage_bundle)
+from srnn_tpu.utils.pipeline import ChunkDriver, StallError
+
+
+def _full_cfg(layout):
+    return SoupConfig(topo=Topology("weightwise"), size=12,
+                      attacking_rate=0.3, learn_from_rate=0.2,
+                      learn_from_severity=1, train=1,
+                      remove_divergent=True, remove_zero=True, layout=layout)
+
+
+def _np_health(w, epsilon):
+    """NumPy recount of one generation's sentinels from a (N, P) matrix."""
+    norm = np.abs(np.asarray(w, np.float32)).max(axis=-1)
+    finite = np.isfinite(norm)
+    nonfinite = int((~finite).sum())
+    zero = int((finite & (norm <= epsilon)).sum())
+    safe = np.where(finite & (norm > 0), norm,
+                    np.float32(2.0) ** HEALTH_BUCKET_LO)
+    b = np.clip((np.floor(np.log2(safe)).astype(np.int64) - HEALTH_BUCKET_LO)
+                // HEALTH_BUCKET_STEP, 0, N_HEALTH_BUCKETS - 1)
+    hist = np.bincount(b[finite], minlength=N_HEALTH_BUCKETS)
+    fin = norm[finite]
+    return nonfinite, zero, hist, fin
+
+
+# ---------------------------------------------------------------------------
+# device carry: parity + recount
+# ---------------------------------------------------------------------------
+
+
+def test_probe_health_counts_crafted_population():
+    """Known pathologies land in the right sentinel: NaN/Inf rows are
+    nonfinite, exact-zero and sub-epsilon rows are zero-collapsed, finite
+    rows fill the log2 sketch and the extrema."""
+    w = jnp.array([[np.nan, 1.0, 0.5],     # nonfinite (NaN)
+                   [np.inf, 0.0, 0.0],     # nonfinite (Inf)
+                   [0.0, 0.0, 0.0],        # zero-collapsed (exactly)
+                   [1e-5, -1e-5, 0.0],     # zero-collapsed (<= epsilon)
+                   [0.5, -0.25, 0.125],    # healthy
+                   [4.0, -2.0, 1.0]],      # healthy
+                  jnp.float32)
+    h = probe_health(w, -1, 1e-4)
+    assert int(h.checks) == 1
+    assert int(h.nonfinite) == int(h.nonfinite_peak) == 2
+    assert int(h.zero) == int(h.zero_peak) == 2
+    assert float(h.norm_min) == pytest.approx(0.0)  # the zero row
+    assert float(h.norm_max) == pytest.approx(4.0)
+    assert int(h.norm_hist.sum()) == 4  # finite rows only
+    nonf, zero, hist, _fin = _np_health(w, 1e-4)
+    assert (nonf, zero) == (2, 2)
+    np.testing.assert_array_equal(np.asarray(h.norm_hist), hist)
+
+    s = health_summary(h, 6)
+    assert s["nan_frac"] == pytest.approx(2 / 6)
+    assert s["zero_frac"] == pytest.approx(2 / 6)
+    assert s["norm_max"] == pytest.approx(4.0)
+    # p50 falls in the bucket holding the finite norms' median
+    assert s["norm_p50"] > 0
+
+
+@pytest.mark.parametrize("layout", ["rowmajor", "popmajor"])
+def test_health_carry_parity_and_recount(layout):
+    """``health=True`` evolution is bit-identical to plain, composes with
+    ``metrics=``/``record=``, and the carry matches a NumPy recount of the
+    recorded per-generation weight stream."""
+    cfg = _full_cfg(layout)
+    st = seed(cfg, jax.random.key(3))
+    plain = evolve(cfg, st, generations=4)
+    sentineled, h = evolve(cfg, st, generations=4, health=True)
+    np.testing.assert_array_equal(np.asarray(plain.weights),
+                                  np.asarray(sentineled.weights))
+    np.testing.assert_array_equal(np.asarray(plain.uids),
+                                  np.asarray(sentineled.uids))
+    assert int(h.checks) == 4
+
+    # recount every sentinel from the recorded post-step weights
+    _f, (_ev, w_stream, _u) = evolve(cfg, st, generations=4, record=True)
+    w_stream = np.asarray(w_stream)          # (G, N, P)
+    per_gen = [_np_health(w, cfg.epsilon) for w in w_stream]
+    assert int(h.nonfinite) == per_gen[-1][0]       # end-of-window
+    assert int(h.zero) == per_gen[-1][1]
+    assert int(h.nonfinite_peak) == max(g[0] for g in per_gen)
+    assert int(h.zero_peak) == max(g[1] for g in per_gen)
+    np.testing.assert_array_equal(np.asarray(h.norm_hist),
+                                  sum(g[2] for g in per_gen))
+    fins = np.concatenate([g[3] for g in per_gen])
+    assert float(h.norm_min) == pytest.approx(float(fins.min()), rel=1e-6)
+    assert float(h.norm_max) == pytest.approx(float(fins.max()), rel=1e-6)
+
+    # metrics + health compose; the metrics carry is unchanged by health
+    _f2, m2, h2 = evolve(cfg, st, generations=4, metrics=True, health=True)
+    _f3, m3 = evolve(cfg, st, generations=4, metrics=True)
+    np.testing.assert_array_equal(np.asarray(m2.actions),
+                                  np.asarray(m3.actions))
+    np.testing.assert_array_equal(np.asarray(h2.norm_hist),
+                                  np.asarray(h.norm_hist))
+
+
+def test_multi_health_parity_and_probe_agreement():
+    from srnn_tpu.multisoup import MultiSoupConfig, evolve_multi, seed_multi
+
+    mc = MultiSoupConfig(
+        topos=(Topology("weightwise"), Topology("aggregating", aggregates=4)),
+        sizes=(6, 6), attacking_rate=0.4, learn_from_rate=0.3,
+        learn_from_severity=1, train=1, remove_divergent=True,
+        remove_zero=True)
+    st = seed_multi(mc, jax.random.key(0))
+    plain = evolve_multi(mc, st, generations=3)
+    sentineled, ms, hs = evolve_multi(mc, st, generations=3, metrics=True,
+                                      health=True)
+    assert len(hs) == len(mc.topos) == len(ms)
+    for t, (wa, wb) in enumerate(zip(plain.weights, sentineled.weights)):
+        np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+        # each type's end-of-window counts match a recount of ITS weights
+        nonf, zero, _hist, _fin = _np_health(wb, mc.epsilon)
+        assert int(hs[t].nonfinite) == nonf
+        assert int(hs[t].zero) == zero
+        assert int(hs[t].checks) == 3
+
+    combined = combined_health_summary(
+        [health_summary(h, n) for h, n in zip(hs, mc.sizes)])
+    assert combined["n_particles"] == sum(mc.sizes)
+    assert 0 <= combined["zero_frac"] <= 1
+
+
+def test_sharded_health_matches_unsharded_and_recount(mesh):
+    """The sharded scan's psum'd health carry reports GLOBAL fractions:
+    equal to the single-device carry's and to a NumPy recount of the
+    sharded final population."""
+    from srnn_tpu.parallel import make_sharded_state
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve
+
+    cfg = SoupConfig(topo=Topology("weightwise"), size=16,
+                     attacking_rate=0.4, remove_divergent=True,
+                     remove_zero=True, layout="popmajor")
+    sst = make_sharded_state(cfg, mesh, jax.random.key(1))
+    sh, h_sh = sharded_evolve(cfg, mesh, sst, generations=4, health=True)
+    un, h_un = evolve(cfg, seed(cfg, jax.random.key(1)), generations=4,
+                      health=True)
+    for field in ("checks", "nonfinite", "zero"):
+        assert int(getattr(h_sh, field)) == int(getattr(h_un, field))
+    # window peaks: the psum of per-shard maxima upper-bounds the true
+    # global per-generation peak and never undercounts the end state
+    assert int(h_sh.nonfinite_peak) >= int(h_un.nonfinite)
+    assert int(h_sh.zero_peak) >= int(h_un.zero)
+    np.testing.assert_array_equal(np.asarray(h_sh.norm_hist),
+                                  np.asarray(h_un.norm_hist))
+    np.testing.assert_allclose(float(h_sh.norm_min), float(h_un.norm_min),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(h_sh.norm_max), float(h_un.norm_max),
+                               rtol=1e-5)
+    # global end-of-window counts == NumPy recount of the sharded result
+    nonf, zero, _hist, _fin = _np_health(np.asarray(sh.weights), cfg.epsilon)
+    assert int(h_sh.nonfinite) == nonf
+    assert int(h_sh.zero) == zero
+
+
+# ---------------------------------------------------------------------------
+# ring + watchdog units
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounds_orders_and_dumps(tmp_path):
+    ring = FlightRecorder(capacity=4)
+    for i in range(7):
+        ring.record({"gen": i})
+    rows = ring.rows()
+    assert len(rows) == len(ring) == 4
+    assert [r["gen"] for r in rows] == [3, 4, 5, 6]   # oldest dropped
+    assert [r["seq"] for r in rows] == [3, 4, 5, 6]   # monotone stamps
+    assert ring.tail(2) == rows[-2:]
+    path = ring.write(str(tmp_path / "ring.jsonl"))
+    loaded = [json.loads(l) for l in open(path)]
+    assert [r["gen"] for r in loaded] == [3, 4, 5, 6]
+
+
+def test_watchdog_rules():
+    ring = FlightRecorder()
+    wd = Watchdog(ring, nan_frac=0.02, zero_frac=0.9, respawn_frac=0.25,
+                  gens_regress=0.5, min_history=3, profile_trips=False)
+    assert wd.check({"health": {"nan_frac": 0.01, "zero_frac": 0.1}}) == []
+    assert wd.check({"health": {"nan_frac": 0.5}}) == ["nan_frac"]
+    assert wd.check({"health": {"zero_frac": 0.95}}) == ["zero_frac"]
+    assert wd.check({"respawns": 60, "particle_gens": 100}) \
+        == ["respawn_frac"]
+    assert wd.check({"health": {"nan_frac": 0.5, "zero_frac": 0.95}}) \
+        == ["nan_frac", "zero_frac"]
+
+    # gens_regress needs min_history prior rows, then trips on a fall
+    # below (1 - F) of the ring median
+    slow = {"gens_per_sec": 40.0}
+    assert wd.check(slow) == []          # no history yet
+    for _ in range(3):
+        ring.record({"gens_per_sec": 100.0})
+    assert wd.check(slow) == ["gens_regress"]
+    assert wd.check({"gens_per_sec": 60.0}) == []  # above the cut
+
+    # disabled rules (None / <= 0) never trip
+    off = Watchdog(ring, nan_frac=None, zero_frac=0.0, respawn_frac=-1,
+                   gens_regress=0.0, profile_trips=False)
+    assert off.check({"health": {"nan_frac": 1.0, "zero_frac": 1.0},
+                      "respawns": 100, "particle_gens": 100,
+                      "gens_per_sec": 1.0}) == []
+
+
+def test_triage_bundle_layout_and_report_roundtrip(tmp_path, capsys):
+    """A bundle written with a population snapshot restores, renders, and
+    rate-limits at ``max_bundles``."""
+    from srnn_tpu.experiment import restore_checkpoint, save_checkpoint
+
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "config.json"), "w") as f:
+        json.dump({"size": 12, "layout": "rowmajor"}, f)
+
+    cfg = _full_cfg("rowmajor")
+    state = evolve(cfg, seed(cfg, jax.random.key(0)), generations=2)
+    ring = FlightRecorder()
+    row = {"gen": 2, "gens_per_sec": 50.0,
+           "health": {"nan_frac": 0.5, "zero_frac": 0.0}}
+    ring.record(row)
+    wd = Watchdog(ring, max_bundles=1, profile_trips=False)
+    reasons = wd.check(row)
+    assert reasons == ["nan_frac"]
+    bundle = wd.trip(reasons, row, run_dir=run_dir, snapshot_state=state,
+                     save_fn=save_checkpoint, generation=2)
+    assert bundle and os.path.dirname(bundle) == run_dir
+
+    trip = json.load(open(os.path.join(bundle, "trip.json")))
+    assert trip["reasons"] == ["nan_frac"]
+    assert trip["generation"] == 2
+    assert trip["thresholds"]["nan_frac"] == 0.02
+    assert os.path.exists(os.path.join(bundle, "ring.jsonl"))
+    assert os.path.exists(os.path.join(bundle, "config.json"))
+
+    # the snapshot IS a resumable checkpoint at the trip generation
+    restored = restore_checkpoint(os.path.join(bundle, "ckpt-gen00000002"))
+    np.testing.assert_array_equal(np.asarray(restored.weights),
+                                  np.asarray(state.weights))
+
+    # report --triage renders it (text + json)
+    assert report.main(["--triage", bundle]) == 0
+    out = capsys.readouterr().out
+    assert "tripped: nan_frac at generation 2" in out
+    assert "ckpt-gen00000002" in out
+    assert "resume with" in out
+    s = report.summarize_triage(bundle)
+    assert s["trip"]["reasons"] == ["nan_frac"]
+    assert s["snapshot"]["kind"] == "soup"
+    assert s["health_trajectory"][-1]["nan_frac"] == 0.5
+
+    # quota spent: further trips record but write no bundle
+    assert wd.trip(["nan_frac"], row, run_dir=run_dir) is None
+    assert wd.trips == 2 and len(wd.bundles) == 1
+
+
+def test_host_only_bundle_renders_without_snapshot(tmp_path, capsys):
+    """A stall bundle has no population snapshot (the device is presumed
+    hung); the renderer must say so instead of crashing."""
+    run_dir = str(tmp_path)
+    bundle = write_triage_bundle(run_dir, ["stall"], {"gen": 10},
+                                 recorder=FlightRecorder(),
+                                 thresholds={"stall_timeout_s": 5.0})
+    assert report.main(["--triage", bundle]) == 0
+    out = capsys.readouterr().out
+    assert "stall" in out
+    assert "host-only bundle" in out
+
+
+# ---------------------------------------------------------------------------
+# dead-man's switch + chunk-driver stall deadline
+# ---------------------------------------------------------------------------
+
+
+def test_stall_sentinel_fires_once_after_deadline():
+    fired = []
+    s = StallSentinel(0.15, lambda mark, waited: fired.append((mark, waited)))
+    try:
+        s.mark("step-1")
+        time.sleep(0.05)
+        assert not s.fired          # marks keep resetting the deadline
+        time.sleep(0.4)
+        assert s.fired
+        assert len(fired) == 1
+        assert fired[0][0] == "step-1"
+        assert fired[0][1] >= 0.15
+    finally:
+        s.stop()
+
+
+def test_stall_sentinel_stop_disarms():
+    fired = []
+    s = StallSentinel(0.2, lambda *_: fired.append(1))
+    s.stop()
+    time.sleep(0.4)
+    assert not fired and not s.fired
+
+
+def test_chunk_driver_stall_raises_named_error_with_bundle():
+    drv = ChunkDriver(depth=0, stall_timeout_s=0.2,
+                      on_stall=lambda timeout_s: f"/bundles/t{timeout_s}")
+    release = threading.Event()
+    with pytest.raises(StallError) as ei:
+        drv.step(lambda: release.wait(10))
+    assert ei.value.bundle == "/bundles/t0.2"
+    assert "stall deadline" in str(ei.value)
+    release.set()  # unwedge the watched daemon thread
+
+    # a finisher that FAILS inside the deadline re-raises its own error
+    def boom():
+        raise ValueError("finisher bug")
+
+    with pytest.raises(ValueError, match="finisher bug"):
+        drv.step(boom)
+
+    # fast finishers pass through; the deferred-depth contract holds
+    done = []
+    drv2 = ChunkDriver(depth=1, stall_timeout_s=5.0)
+    drv2.step(lambda: done.append(1))
+    assert done == []               # deferred behind depth=1
+    drv2.drain()
+    assert done == [1]
+
+
+def test_chunk_driver_no_deadline_runs_inline():
+    """stall_timeout_s=0 (the default) must not touch threads at all."""
+    tids = []
+    drv = ChunkDriver(depth=0)
+    drv.step(lambda: tids.append(threading.get_ident()))
+    assert tids == [threading.get_ident()]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: injected NaNs -> trip -> bundle -> report -> resume
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_e2e_nan_injection_bundle_resume(tmp_path, monkeypatch,
+                                                  capsys):
+    """The acceptance scenario: NaNs injected into the whole population at
+    chunk 2 of a smoke mega-soup run trip the watchdog (as a respawn
+    storm: the soup cleans the casualties within the chunk), the run
+    still completes, the bundle renders via ``report --triage``, and
+    ``--resume <bundle_dir>`` replays from its snapshot to the end."""
+    import srnn_tpu.setups.mega_soup as mega_soup
+    from srnn_tpu.experiment import restore_checkpoint
+    from srnn_tpu.setups import REGISTRY
+
+    real = mega_soup.evolve_donated
+    calls = {"n": 0}
+
+    def poisoned(cfg, st, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:  # chunk 2's input population: all-NaN
+            st = st._replace(weights=jnp.full_like(st.weights, jnp.nan))
+        return real(cfg, st, **kw)
+
+    monkeypatch.setattr(mega_soup, "evolve_donated", poisoned)
+    d = REGISTRY["mega_soup"](["--smoke", "--root", str(tmp_path / "run")])
+
+    bundles = sorted(glob.glob(os.path.join(d, "triage-gen*")))
+    assert len(bundles) == 1, f"expected exactly one trip, got {bundles}"
+    bundle = bundles[0]
+    trip = json.load(open(os.path.join(bundle, "trip.json")))
+    assert "respawn_frac" in trip["reasons"]
+    assert trip["generation"] == 4              # end of the poisoned chunk
+    assert trip["row"]["respawns"] >= 64        # the whole population died
+    assert math.isfinite(trip["row"]["health"]["nan_frac"])
+    # the ring went into the bundle, and the run dir logged the trip
+    assert os.path.exists(os.path.join(bundle, "ring.jsonl"))
+    events = [json.loads(l) for l in open(os.path.join(d, "events.jsonl"))]
+    wd_rows = [r for r in events if r.get("kind") == "watchdog"]
+    assert wd_rows and wd_rows[0]["bundle"] == bundle
+    metrics = [r for r in events if "srnn_soup_watchdog_trips_total"
+               in json.dumps(r)]
+    assert metrics, "the trip counter must reach the metrics sink"
+
+    # render
+    assert report.main(["--triage", bundle]) == 0
+    out = capsys.readouterr().out
+    assert "respawn_frac" in out and "health trajectory" in out
+
+    # resume FROM THE BUNDLE: its snapshot is generation 4 of 6
+    snap = restore_checkpoint(os.path.join(bundle, "ckpt-gen00000004"))
+    assert int(snap.time) == 4
+    d_resumed = REGISTRY["mega_soup"](["--smoke", "--resume", bundle])
+    assert d_resumed == bundle
+    final = restore_checkpoint(os.path.join(bundle, "ckpt-gen00000006"))
+    assert int(final.time) == 6
+
+
+def test_mega_soup_stall_deadline_names_failure_with_bundle(tmp_path,
+                                                            monkeypatch):
+    """A deliberately hung chunk finisher inside the real mega loop is
+    converted by ``--stall-timeout-s`` into a named ``StallError``
+    carrying a host-only bundle path (no snapshot: the device is presumed
+    hung), instead of an opaque hang."""
+    import srnn_tpu.setups.mega_soup as mega_soup
+    from srnn_tpu.setups import REGISTRY
+    from srnn_tpu.utils.pipeline import live_threads
+
+    release = threading.Event()
+    monkeypatch.setattr(mega_soup, "update_class_gauges",
+                        lambda *a, **k: release.wait(60))
+    try:
+        with pytest.raises(StallError) as ei:
+            REGISTRY["mega_soup"](["--smoke", "--no-pipeline",
+                                   "--stall-timeout-s", "1",
+                                   "--root", str(tmp_path / "run")])
+        bundle = ei.value.bundle
+        assert bundle and os.path.isdir(bundle)
+        assert "stall deadline" in str(ei.value)
+        trip = json.load(open(os.path.join(bundle, "trip.json")))
+        assert trip["reasons"] == ["stall"]
+        assert trip["thresholds"]["stall_timeout_s"] == 1.0
+        assert "snapshot" not in trip          # host-only by design
+        assert os.path.exists(os.path.join(bundle, "ring.jsonl"))
+        assert os.path.exists(os.path.join(bundle, "metrics.json"))
+    finally:
+        release.set()  # unwedge the watched daemon thread
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and any(
+            t.name == "srnn-chunk-finisher" for t in live_threads()):
+        time.sleep(0.05)
+    assert not [t for t in live_threads()
+                if t.name == "srnn-chunk-finisher"]
+
+
+def test_mega_soup_no_health_still_records_ring(tmp_path):
+    """``--no-health`` drops the device sentinels but the flight recorder
+    still rings (gens/sec, counts, respawn counters from the metrics
+    carry) and the run completes with no health rows."""
+    from srnn_tpu.setups import REGISTRY
+
+    d = REGISTRY["mega_soup"](["--smoke", "--no-health",
+                               "--root", str(tmp_path / "run")])
+    events = [json.loads(l) for l in open(os.path.join(d, "events.jsonl"))]
+    assert not glob.glob(os.path.join(d, "triage-gen*"))
+    assert not any("srnn_soup_health_nan_frac" in json.dumps(r)
+                   for r in events)
