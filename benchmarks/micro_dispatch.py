@@ -303,6 +303,71 @@ def row_health() -> dict:
     }
 
 
+def row_lineage() -> dict:
+    """Walltime overhead of the replication-dynamics lineage carry on top
+    of the mega loops' previous default spelling — ``evolve(metrics=True,
+    health=True, lineage=True)`` vs ``metrics=True, health=True`` (the
+    ``metered.health`` baseline ``row_health`` measures).  The documented
+    acceptance bound is <= ~5% overhead.
+
+    Same protocol as :func:`row_telemetry`: interleaved calls, per-pass
+    medians, 3 passes, MEDIAN-OF-MEDIANS reported — and per the memory
+    note on this host, repeat the whole bench before trusting any
+    reading over ~2% (single-pass row_telemetry jitter is ±5%)."""
+    import statistics
+
+    import jax
+
+    from srnn_tpu.soup import evolve, seed
+    from srnn_tpu.telemetry.dynamics import seed_lineage
+
+    cfg = _config(TELEMETRY_N)
+    st = seed(cfg, jax.random.key(0))
+    lin = seed_lineage(cfg.size)
+    calls = 20
+    passes = 3
+
+    def sentineled():
+        s, _m, _h = evolve(cfg, st, generations=TELEMETRY_GENS,
+                           metrics=True, health=True)
+        return float(s.next_uid)  # scalar readback forces completion
+
+    def lineaged():
+        s, _m, _h, _lt = evolve(cfg, st, generations=TELEMETRY_GENS,
+                                metrics=True, health=True, lineage=True,
+                                lineage_state=lin, lineage_capacity=4096)
+        return float(s.next_uid)
+
+    sentineled(), lineaged(), sentineled(), lineaged()  # compile + warm
+    health_meds, lineage_meds = [], []
+    for _ in range(passes):
+        th, tl = [], []
+        for _ in range(calls):
+            t0 = time.perf_counter()
+            sentineled()
+            th.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            lineaged()
+            tl.append(time.perf_counter() - t0)
+        health_meds.append(statistics.median(th))
+        lineage_meds.append(statistics.median(tl))
+    health_s = statistics.median(health_meds)
+    lineage_s = statistics.median(lineage_meds)
+    return {
+        "row": "lineage",
+        "n": TELEMETRY_N,
+        "generations": TELEMETRY_GENS,
+        "calls": calls,
+        "passes": passes,
+        "health_ms_per_chunk": round(health_s * 1e3, 3),
+        "lineage_ms_per_chunk": round(lineage_s * 1e3, 3),
+        "pass_overhead_pct": [
+            round(100 * (l / h - 1), 2)
+            for h, l in zip(health_meds, lineage_meds)],
+        "overhead_pct": round(100 * (lineage_s / health_s - 1), 2),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--stage", default=None, help=argparse.SUPPRESS)
@@ -317,11 +382,11 @@ def main(argv=None) -> int:
         return 0
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
-            row_telemetry(), row_health()]
+            row_telemetry(), row_health(), row_lineage()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h = rows
+        c, d, m, t, h, l = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -342,6 +407,10 @@ def main(argv=None) -> int:
               f"{h['health_ms_per_chunk']:.1f}ms vs metered "
               f"{h['metered_ms_per_chunk']:.1f}ms per chunk "
               f"({h['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# lineage(N={l['n']}, G={l['generations']}): +dynamics "
+              f"{l['lineage_ms_per_chunk']:.1f}ms vs metered.health "
+              f"{l['health_ms_per_chunk']:.1f}ms per chunk "
+              f"({l['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
     return 0
 
 

@@ -143,7 +143,39 @@ def _attack_phase(config: MultiSoupConfig, weights, k_gate, k_tgt):
             )(rows, w_b)
             out = jnp.where(mask[:, None], attacked, out)
         new_weights.append(out)
-    return tuple(new_weights), gate, tgt
+    return tuple(new_weights), gate, tgt, att_idx
+
+
+def _record_multi_lineage(lins, win, gen, lin_info, lincfg, axes=None):
+    """Post-loop lineage bookkeeping for one mixed generation: per type,
+    the fused ``dynamics.record_step`` (attack mints -> learn edges
+    against post-attack pids -> respawn mints) with mint bases chained
+    type-major through ONE shared global pid counter — the respawn
+    uid-block order.  ``lin_info`` is the per-type ``(att_idx slice,
+    learn_gate, learn_tgt, dead)`` the phase loop stashed; running AFTER
+    all the weights math matters: sharing the phase masks with the weight
+    path mid-loop was measured to perturb XLA's fusion of the aggregating
+    cross-apply by 1 ulp, breaking the bit-identity contract."""
+    from .telemetry.dynamics import record_step
+
+    if axes is None:
+        all_pid0 = jnp.concatenate([l.pid for l in lins])
+    else:
+        all_pid0 = jnp.concatenate([
+            jax.lax.all_gather(l.pid, axes, tiled=True) for l in lins])
+    running = lins[0].next_pid
+    new_lins = []
+    for t, (att_b, learn_gate, learn_tgt, dead) in enumerate(lin_info):
+        lin_t = lins[t]._replace(next_pid=running)
+        lin_t, win = record_step(
+            lin_t, win, gen=gen, attacked=att_b >= 0,
+            attacker_pid=all_pid0[jnp.clip(att_b, 0)],
+            learn_gate=learn_gate, learn_tgt=learn_tgt, dead=dead,
+            caps=lincfg[0][t], capacity=lincfg[1], axes=axes)
+        running = lin_t.next_pid
+        new_lins.append(lin_t)
+    # every type's carry ends on the SAME global mint counter
+    return tuple(l._replace(next_pid=running) for l in new_lins), win
 
 
 def _check_popmajor_multi(config: MultiSoupConfig) -> None:
@@ -159,12 +191,19 @@ def _check_popmajor_multi(config: MultiSoupConfig) -> None:
 
 
 def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
-                           wTs: Tuple[jnp.ndarray, ...]):
+                           wTs: Tuple[jnp.ndarray, ...], lins=None, win=None,
+                           lincfg=None):
     """Population-major twin of ``evolve_multi_step``: every per-type
     population is a (P_t, N_t) lane matrix, cross-type attacks ride
     ``cross_apply_popmajor``, and the train/learn phases use the per-variant
     lane kernels.  Same PRNG draws, same phase order, same event record as
-    the row-major path (parity-tested)."""
+    the row-major path (parity-tested).
+
+    ``lins``/``win``/``lincfg`` (per-type caps + window capacity) thread
+    the replication-dynamics carry: per-type ``LineageState`` tuples with
+    mint bases chained type-major through ONE shared global pid counter
+    (the same sequencing the respawn uid blocks use) and one shared
+    event-edge window for the whole mixed population."""
     from .ops.popmajor import learn_epochs_popmajor, train_epochs_popmajor
     from .ops.popmajor_cross import cross_apply_popmajor
     from .ops.predicates import is_diverged, is_zero
@@ -173,6 +212,7 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
     n = config.total
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    att_idx = jnp.full(n, -1, jnp.int32)
 
     # --- attack (cross-type, last-attacker-wins) ------------------------
     with jax.named_scope("multisoup.attack"):
@@ -201,6 +241,7 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
             attack_tgt = jnp.zeros(n, jnp.int32)
 
     all_uids = jnp.concatenate(state.uids)
+    lin_info = []
 
     out_wTs, new_uids, actions, counterparts, losses = [], [], [], [], []
     total_deaths = jnp.int32(0)
@@ -225,6 +266,7 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
                 learn_cp = state.uids[t][learn_tgt]
             else:
                 learn_gate = jnp.zeros(n_t, bool)
+                learn_tgt = jnp.zeros(n_t, jnp.int32)
                 learn_cp = jnp.zeros(n_t, jnp.int32)
 
         # --- train ------------------------------------------------------
@@ -254,6 +296,8 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
             death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
             death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
             death_cp = jnp.where(dead, uids_t, -1)
+        if lins is not None:
+            lin_info.append((sl(att_idx), learn_gate, learn_tgt, dead))
 
         action, counterpart = _event_record(
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -270,17 +314,26 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
         next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
     events = MultiSoupEvents(tuple(actions), tuple(counterparts),
                              tuple(losses))
+    if lins is not None:
+        new_lins, win = _record_multi_lineage(lins, win, state.time,
+                                              lin_info, lincfg)
+        return new_state, events, tuple(out_wTs), new_lins, win
     return new_state, events, tuple(out_wTs)
 
 
-def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
-                       ) -> Tuple[MultiSoupState, MultiSoupEvents]:
-    """One mixed-soup generation (phase order of ``soup.py:51-87``)."""
+def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
+                       lins=None, win=None, lincfg=None):
+    """One mixed-soup generation (phase order of ``soup.py:51-87``).  With
+    a lineage carry (``lins``/``win``/``lincfg``) additionally returns the
+    advanced per-type ``LineageState`` tuple and the shared edge window."""
     if config.layout == "popmajor":
         _check_popmajor_multi(config)
-        new_state, events, wTs = _evolve_multi_popmajor(
-            config, state, tuple(w.T for w in state.weights))
-        return new_state._replace(weights=tuple(wT.T for wT in wTs)), events
+        out = _evolve_multi_popmajor(
+            config, state, tuple(w.T for w in state.weights), lins, win,
+            lincfg)
+        new_state, events, wTs = out[:3]
+        new_state = new_state._replace(weights=tuple(wT.T for wT in wTs))
+        return (new_state, events) + out[3:]
     if config.layout != "rowmajor":
         raise ValueError(f"unknown multisoup layout {config.layout!r}")
     if config.train_impl == "pallas":
@@ -295,11 +348,12 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
     weights = state.weights
+    att_idx = jnp.full(n, -1, jnp.int32)
 
     # --- attack (cross-type) -------------------------------------------
     with jax.named_scope("multisoup.attack"):
         if config.attacking_rate > 0:
-            weights, attack_gate, attack_tgt = _attack_phase(
+            weights, attack_gate, attack_tgt, att_idx = _attack_phase(
                 config, weights, k_ag, k_at)
         else:
             attack_gate = jnp.zeros(n, bool)
@@ -307,6 +361,7 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
 
     # global uid lookup for counterpart logging
     all_uids = jnp.concatenate(state.uids)
+    lin_info = []
 
     new_weights, new_uids, actions, counterparts, losses = [], [], [], [], []
     total_deaths = jnp.int32(0)
@@ -330,6 +385,7 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
                 learn_cp = state.uids[t][learn_tgt]
             else:
                 learn_gate = jnp.zeros(n_t, bool)
+                learn_tgt = jnp.zeros(n_t, jnp.int32)
                 learn_cp = jnp.zeros(n_t, jnp.int32)
 
         # --- train ------------------------------------------------------
@@ -345,6 +401,9 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
                 tc, w_t, state.uids[t], state.next_uid + total_deaths,
                 re_keys[t])
             total_deaths = total_deaths + deaths
+        if lins is not None:
+            lin_info.append((sl(att_idx), learn_gate, learn_tgt,
+                             death_action != ACT_NONE))
 
         action, counterpart = _event_record(
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -359,8 +418,13 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState
     new_state = MultiSoupState(
         weights=tuple(new_weights), uids=tuple(new_uids),
         next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
-    return new_state, MultiSoupEvents(tuple(actions), tuple(counterparts),
-                                      tuple(losses))
+    events = MultiSoupEvents(tuple(actions), tuple(counterparts),
+                             tuple(losses))
+    if lins is not None:
+        new_lins, win = _record_multi_lineage(lins, win, state.time,
+                                              lin_info, lincfg)
+        return new_state, events, new_lins, win
+    return new_state, events
 
 
 #: jitted single-generation mixed-soup step; the ``_donated`` twin donates
@@ -375,7 +439,8 @@ evolve_multi_step_donated = jax.jit(_evolve_multi_step,
 
 def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
                   generations: int = 1, metrics: bool = False,
-                  health: bool = False):
+                  health: bool = False, lineage: bool = False,
+                  lineage_state=None, lineage_capacity: int = 4096):
     """Evolve ``generations`` mixed-soup steps as one scan.
 
     ``metrics=True`` additionally returns one
@@ -386,8 +451,13 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
     ``health=True`` additionally returns one
     ``telemetry.device.HealthStats`` carry PER TYPE — the flight
     recorder's population-health sentinels, folded from each type's
-    post-step weights with the same guarantees.  Return order: ``final``,
-    metrics carries if metering, health carries if sentineled."""
+    post-step weights with the same guarantees.
+
+    ``lineage=True`` (``lineage_state`` = per-type tuple of
+    ``telemetry.dynamics.LineageState``, one shared pid space) returns
+    the replication-dynamics window ``(new_lineage_states, LineageWindow,
+    per-type FixpointStats)`` — see ``soup._evolve``.  Return order:
+    ``final``, metrics carries, health carries, lineage triple."""
     if metrics:
         from .telemetry.device import (accumulate_soup_metrics,
                                        zero_soup_metrics)
@@ -409,14 +479,49 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         h0 = tuple(zero_health() for _ in config.topos)
     else:
         h0 = None
+    l0 = w0 = lincfg = None
+    if lineage:
+        if lineage_state is None or len(lineage_state) != len(config.topos):
+            raise ValueError(
+                "lineage=True needs lineage_state= (one "
+                "telemetry.dynamics.LineageState per type — seed with "
+                "seed_lineage over each type's uid block)")
+        from .soup import _lineage_caps
+        from .telemetry.dynamics import close_window, zero_window
 
-    def pack(final, ms, hs):
+        l0 = tuple(lineage_state)
+        w0 = zero_window(lineage_capacity)
+        lincfg = (tuple(_lineage_caps(n_t, config, lineage_capacity)
+                        for n_t in config.sizes), lineage_capacity)
+
+    def pack(final, ms, hs, ltriple=None):
         out = (final,)
         if metrics:
             out += (ms,)
         if health:
             out += (hs,)
+        if lineage:
+            out += (ltriple,)
         return out if len(out) > 1 else final
+
+    def close(lins, ws, axis):
+        """End-of-window per-type fixpoint census (ws = per-type weights
+        in the layout's orientation)."""
+        from .nets import apply_to_weights
+        from .ops.popmajor import apply_popmajor
+
+        new_lins, stats = [], []
+        for t, (lin_t, w_t) in enumerate(zip(lins, ws)):
+            topo = config.topos[t]
+            if axis == 0:
+                fw = apply_popmajor(topo, w_t, w_t)
+            else:
+                fw = jax.vmap(
+                    lambda wi, topo=topo: apply_to_weights(topo, wi, wi))(w_t)
+            lin_t, s = close_window(lin_t, w_t, fw, axis, config.epsilon)
+            new_lins.append(lin_t)
+            stats.append(s)
+        return tuple(new_lins), tuple(stats)
 
     if config.layout == "popmajor":
         # keep every per-type carry transposed across the whole run: one
@@ -424,44 +529,63 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         _check_popmajor_multi(config)
 
         def body_t(carry, _):
-            s, wTs, ms, hs = carry
-            new_s, ev, new_wTs = _evolve_multi_popmajor(config, s, wTs)
+            s, wTs, ms, hs, lins, win = carry
+            if lineage:
+                new_s, ev, new_wTs, lins, win = _evolve_multi_popmajor(
+                    config, s, wTs, lins, win, lincfg)
+            else:
+                new_s, ev, new_wTs = _evolve_multi_popmajor(config, s, wTs)
             if metrics:
                 ms = acc(ms, ev)
             if health:
                 hs = acc_h(hs, new_wTs, 0)
-            return (new_s, new_wTs, ms, hs), None
+            return (new_s, new_wTs, ms, hs, lins, win), None
 
         light = state._replace(weights=tuple(
             jnp.zeros((0,), w.dtype) for w in state.weights))
-        (final, wTs, ms, hs), _ = jax.lax.scan(
-            body_t, (light, tuple(w.T for w in state.weights), m0, h0), None,
-            length=generations)
+        (final, wTs, ms, hs, lins, win), _ = jax.lax.scan(
+            body_t, (light, tuple(w.T for w in state.weights), m0, h0, l0,
+                     w0), None, length=generations)
         final = final._replace(weights=tuple(wT.T for wT in wTs))
-        return pack(final, ms, hs)
+        ltriple = None
+        if lineage:
+            lins, stats = close(lins, wTs, 0)
+            ltriple = (lins, win, stats)
+        return pack(final, ms, hs, ltriple)
 
     def body(carry, _):
-        s, ms, hs = carry
-        new_s, ev = evolve_multi_step(config, s)
+        s, ms, hs, lins, win = carry
+        if lineage:
+            new_s, ev, lins, win = _evolve_multi_step(config, s, lins, win,
+                                                      lincfg)
+        else:
+            new_s, ev = evolve_multi_step(config, s)
         if metrics:
             ms = acc(ms, ev)
         if health:
             hs = acc_h(hs, new_s.weights, -1)
-        return (new_s, ms, hs), None
+        return (new_s, ms, hs, lins, win), None
 
-    (final, ms, hs), _ = jax.lax.scan(body, (state, m0, h0), None,
-                                      length=generations)
-    return pack(final, ms, hs)
+    (final, ms, hs, lins, win), _ = jax.lax.scan(
+        body, (state, m0, h0, l0, w0), None, length=generations)
+    ltriple = None
+    if lineage:
+        lins, stats = close(lins, final.weights, -1)
+        ltriple = (lins, win, stats)
+    return pack(final, ms, hs, ltriple)
 
 
 #: jitted multi-generation mixed-soup run + its buffer-donating twin
 #: (mega-run hot loops; state rebound chunk over chunk).
 evolve_multi = jax.jit(_evolve_multi,
                        static_argnames=("config", "generations", "metrics",
-                                        "health"))
+                                        "health", "lineage",
+                                        "lineage_capacity"))
 evolve_multi_donated = jax.jit(_evolve_multi,
                                static_argnames=("config", "generations",
-                                                "metrics", "health"),
+                                                "metrics", "health",
+                                                "lineage",
+                                                "lineage_capacity"),
                                donate_argnums=(1,))
 
 

@@ -80,10 +80,13 @@ def _mevent_specs(config: MultiSoupConfig) -> MultiSoupEvents:
     )
 
 
-def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
-                        ) -> Tuple[MultiSoupState, MultiSoupEvents]:
+def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
+                        lins=None, win=None, lincfg=None):
     """Per-device body: ``state.weights[t]``/``uids[t]`` hold the LOCAL
-    (N_t/D, P_t) shards; scalars and the key are replicated."""
+    (N_t/D, P_t) shards; scalars and the key are replicated.  With a
+    lineage carry (``lins``/``win``/``lincfg``) the advanced per-type
+    carries + the per-shard edge window ride along (mint bases from
+    all-gathered mask ranks, chained type-major — the uid-block order)."""
     n = config.total
     offs = config.offsets
     d = jax.lax.axis_index(SOUP_AXIS)
@@ -107,7 +110,8 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
     else:
         attack_gate = jnp.zeros(n, bool)
         attack_tgt = jnp.zeros(n, jnp.int32)
-        att_idx = None
+        att_idx = jnp.full(n, -1, jnp.int32)
+    lin_info = []
 
     new_weights, new_uids, actions, counterparts, losses = [], [], [], [], []
     total_deaths = jnp.int32(0)
@@ -155,6 +159,7 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
                 learn_cp = all_uids_t[t][learn_tgt]
             else:
                 learn_gate = jnp.zeros(n_loc, bool)
+                learn_tgt = jnp.zeros(n_loc, jnp.int32)
                 learn_cp = jnp.zeros(n_loc, jnp.int32)
 
         # --- train ------------------------------------------------------
@@ -186,6 +191,8 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
             death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
             death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
             death_cp = jnp.where(dead, uids_t, -1)
+        if lins is not None:
+            lin_info.append((sl(att_idx), learn_gate, learn_tgt, dead))
 
         action, counterpart = _event_record(
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -200,19 +207,29 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState
     new_state = MultiSoupState(
         weights=tuple(new_weights), uids=tuple(new_uids),
         next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
-    return new_state, MultiSoupEvents(tuple(actions), tuple(counterparts),
-                                      tuple(losses))
+    events = MultiSoupEvents(tuple(actions), tuple(counterparts),
+                             tuple(losses))
+    if lins is not None:
+        from ..multisoup import _record_multi_lineage
+
+        new_lins, win = _record_multi_lineage(lins, win, state.time,
+                                              lin_info, lincfg,
+                                              axes=SOUP_AXIS)
+        return new_state, events, new_lins, win
+    return new_state, events
 
 
 def _local_evolve_multi_popmajor(config: MultiSoupConfig,
                                  state: MultiSoupState,
-                                 wT_locs: Tuple[jnp.ndarray, ...]):
+                                 wT_locs: Tuple[jnp.ndarray, ...],
+                                 lins=None, win=None, lincfg=None):
     """Lane-major per-device body: ``wT_locs[t]`` is the LOCAL (P_t, N_t/D)
     lane shard of type t (``state.weights`` carries only uid/scalar
     metadata).  Same collectives and draw structure as
     ``_local_evolve_multi``; the heavy phases run the per-variant popmajor
     kernels (``ops/popmajor*.py``), cross-type attacks via
-    ``cross_apply_popmajor``."""
+    ``cross_apply_popmajor``.  The lineage carry threads exactly as in
+    ``_local_evolve_multi`` (globally-ranked mint bases, type-major)."""
     from ..ops.popmajor import learn_epochs_popmajor, train_epochs_popmajor
     from ..ops.popmajor_cross import cross_apply_popmajor
 
@@ -237,7 +254,8 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
     else:
         attack_gate = jnp.zeros(n, bool)
         attack_tgt = jnp.zeros(n, jnp.int32)
-        att_idx = None
+        att_idx = jnp.full(n, -1, jnp.int32)
+    lin_info = []
 
     new_wTs, new_uids, actions, counterparts, losses = [], [], [], [], []
     total_deaths = jnp.int32(0)
@@ -286,6 +304,7 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
                 learn_cp = all_uids_t[t][learn_tgt]
             else:
                 learn_gate = jnp.zeros(n_loc, bool)
+                learn_tgt = jnp.zeros(n_loc, jnp.int32)
                 learn_cp = jnp.zeros(n_loc, jnp.int32)
 
         # --- train ------------------------------------------------------
@@ -319,6 +338,8 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
             death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
             death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
             death_cp = jnp.where(dead, uids_t, -1)
+        if lins is not None:
+            lin_info.append((sl(att_idx), learn_gate, learn_tgt, dead))
 
         action, counterpart = _event_record(
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
@@ -335,6 +356,13 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
         next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
     events = MultiSoupEvents(tuple(actions), tuple(counterparts),
                              tuple(losses))
+    if lins is not None:
+        from ..multisoup import _record_multi_lineage
+
+        new_lins, win = _record_multi_lineage(lins, win, state.time,
+                                              lin_info, lincfg,
+                                              axes=SOUP_AXIS)
+        return new_state, events, tuple(new_wTs), new_lins, win
     return new_state, events, tuple(new_wTs)
 
 
@@ -398,7 +426,9 @@ def _multi_health_specs(t: int):
 
 def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
                           state: MultiSoupState, generations: int = 1,
-                          metrics: bool = False, health: bool = False):
+                          metrics: bool = False, health: bool = False,
+                          lineage: bool = False, lineage_state=None,
+                          lineage_capacity: int = 4096):
     """Scan ``generations`` sharded mixed-soup steps inside ONE shard_map
     (collectives stay inside the scan).  The popmajor layout keeps every
     per-type local shard transposed (P_t, N_t/D) across generations.
@@ -407,8 +437,11 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
     ``telemetry.device.SoupMetrics`` carries (per-shard accumulation
     inside the scan, one psum per type at the shard boundary);
     ``health=True`` the GLOBAL per-type ``telemetry.device.HealthStats``
-    carries (counts psum'd, extrema pmin/pmax'd).  Return order:
-    ``final``, metrics carries, health carries."""
+    carries (counts psum'd, extrema pmin/pmax'd); ``lineage=True``
+    (``lineage_state`` = per-type sharded-placed lineage carries, one
+    shared pid space) the replication-dynamics triple
+    ``(lineage_states, per-shard window, per-type FixpointStats)``.
+    Return order: ``final``, metrics carries, health carries, lineage."""
     if config.layout not in ("rowmajor", "popmajor"):
         raise ValueError(f"unknown multisoup layout {config.layout!r}")
     if metrics:
@@ -434,6 +467,21 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         def flush_h(hs):
             return tuple(psum_health(h, SOUP_AXIS) for h in hs)
 
+    lincfg = None
+    if lineage:
+        if lineage_state is None or len(lineage_state) != len(config.topos):
+            raise ValueError(
+                "lineage=True needs lineage_state= (per-type carries from "
+                "telemetry.dynamics.seed_lineage_blocks, sharded-placed)")
+        from ..soup import _lineage_caps
+        from ..telemetry.dynamics import (close_window, fixpoint_specs,
+                                          lineage_specs, psum_fixpoints,
+                                          window_specs, zero_window)
+
+        n_dev = mesh.devices.size
+        lincfg = (tuple(_lineage_caps(n_t // n_dev, config, lineage_capacity)
+                        for n_t in config.sizes), lineage_capacity)
+
     def m0():
         return tuple(zero_soup_metrics() for _ in config.topos) \
             if metrics else None
@@ -442,84 +490,133 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         return tuple(zero_health() for _ in config.topos) \
             if health else None
 
-    def pack(final, ms, hs):
+    def close(lins, ws, axis):
+        from ..nets import apply_to_weights
+        from ..ops.popmajor import apply_popmajor
+
+        new_lins, stats = [], []
+        for t, (lin_t, w_t) in enumerate(zip(lins, ws)):
+            topo = config.topos[t]
+            if axis == 0:
+                fw = apply_popmajor(topo, w_t, w_t)
+            else:
+                fw = jax.vmap(
+                    lambda wi, topo=topo: apply_to_weights(topo, wi, wi))(w_t)
+            lin_t, s = close_window(lin_t, w_t, fw, axis, config.epsilon)
+            new_lins.append(lin_t)
+            stats.append(psum_fixpoints(s, SOUP_AXIS))
+        return tuple(new_lins), tuple(stats)
+
+    def pack(final, ms, hs, ltriple=None):
         out = (final,)
         if metrics:
             out += (flush(ms),)
         if health:
             out += (flush_h(hs),)
+        if lineage:
+            out += (ltriple,)
         return out if len(out) > 1 else final
 
     nt = len(config.topos)
+    in_specs = (_mstate_specs(nt),)
     out_specs = (_mstate_specs(nt),)
     if metrics:
         out_specs += (_multi_metrics_specs(nt),)
     if health:
         out_specs += (_multi_health_specs(nt),)
+    if lineage:
+        in_specs += (tuple(lineage_specs(SOUP_AXIS) for _ in range(nt)),)
+        out_specs += ((tuple(lineage_specs(SOUP_AXIS) for _ in range(nt)),
+                       window_specs(SOUP_AXIS),
+                       tuple(fixpoint_specs() for _ in range(nt))),)
     if len(out_specs) == 1:
         out_specs = out_specs[0]
     if config.layout == "popmajor":
         _check_popmajor_multi(config)
 
-        def local_run_t(st: MultiSoupState):
+        def local_run_t(st: MultiSoupState, *lin_args):
             light = st._replace(weights=tuple(
                 jnp.zeros((0,), w.dtype) for w in st.weights))
+            l0 = lin_args[0] if lineage else None
+            w0 = zero_window(lineage_capacity) if lineage else None
 
             def body(carry, _):
-                s, wTs, ms, hs = carry
-                new_s, ev, new_wTs = _local_evolve_multi_popmajor(
-                    config, s, wTs)
+                s, wTs, ms, hs, lins, win = carry
+                if lineage:
+                    new_s, ev, new_wTs, lins, win = \
+                        _local_evolve_multi_popmajor(config, s, wTs, lins,
+                                                     win, lincfg)
+                else:
+                    new_s, ev, new_wTs = _local_evolve_multi_popmajor(
+                        config, s, wTs)
                 if metrics:
                     ms = acc(ms, ev)
                 if health:
                     hs = acc_h(hs, new_wTs, 0)
-                return (new_s, new_wTs, ms, hs), None
+                return (new_s, new_wTs, ms, hs, lins, win), None
 
-            (final, wTs, ms, hs), _ = jax.lax.scan(
-                body, (light, tuple(w.T for w in st.weights), m0(), h0()),
-                None, length=generations)
+            (final, wTs, ms, hs, lins, win), _ = jax.lax.scan(
+                body, (light, tuple(w.T for w in st.weights), m0(), h0(),
+                       l0, w0), None, length=generations)
             final = final._replace(weights=tuple(wT.T for wT in wTs))
-            return pack(final, ms, hs)
+            ltriple = None
+            if lineage:
+                lins, stats = close(lins, wTs, 0)
+                ltriple = (lins, win, stats)
+            return pack(final, ms, hs, ltriple)
 
         fn = shard_map(
             local_run_t,
             mesh=mesh,
-            in_specs=(_mstate_specs(nt),),
+            in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False,
         )
-        return fn(state)
+        return fn(state, lineage_state) if lineage else fn(state)
 
-    def local_run(st: MultiSoupState):
+    def local_run(st: MultiSoupState, *lin_args):
+        l0 = lin_args[0] if lineage else None
+        w0 = zero_window(lineage_capacity) if lineage else None
+
         def body(carry, _):
-            s, ms, hs = carry
-            new_s, ev = _local_evolve_multi(config, s)
+            s, ms, hs, lins, win = carry
+            if lineage:
+                new_s, ev, lins, win = _local_evolve_multi(config, s, lins,
+                                                           win, lincfg)
+            else:
+                new_s, ev = _local_evolve_multi(config, s)
             if metrics:
                 ms = acc(ms, ev)
             if health:
                 hs = acc_h(hs, new_s.weights, -1)
-            return (new_s, ms, hs), None
+            return (new_s, ms, hs, lins, win), None
 
-        (final, ms, hs), _ = jax.lax.scan(body, (st, m0(), h0()), None,
-                                          length=generations)
-        return pack(final, ms, hs)
+        (final, ms, hs, lins, win), _ = jax.lax.scan(
+            body, (st, m0(), h0(), l0, w0), None, length=generations)
+        ltriple = None
+        if lineage:
+            lins, stats = close(lins, final.weights, -1)
+            ltriple = (lins, win, stats)
+        return pack(final, ms, hs, ltriple)
 
     fn = shard_map(
         local_run,
         mesh=mesh,
-        in_specs=(_mstate_specs(nt),),
+        in_specs=in_specs,
         out_specs=out_specs,
         check_vma=False,
     )
-    return fn(state)
+    return fn(state, lineage_state) if lineage else fn(state)
 
 
 sharded_evolve_multi = jax.jit(
     _sharded_evolve_multi,
-    static_argnames=("config", "mesh", "generations", "metrics", "health"))
+    static_argnames=("config", "mesh", "generations", "metrics", "health",
+                     "lineage", "lineage_capacity"))
 sharded_evolve_multi_donated = jax.jit(
     _sharded_evolve_multi,
-    static_argnames=("config", "mesh", "generations", "metrics", "health"),
+    static_argnames=("config", "mesh", "generations", "metrics", "health",
+                     "lineage", "lineage_capacity"),
     donate_argnums=(2,))
 
 

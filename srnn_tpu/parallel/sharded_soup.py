@@ -92,16 +92,21 @@ def _event_specs(axes=SOUP_AXIS):
 
 
 def _local_evolve(config: SoupConfig, state: SoupState,
-                  axes=SOUP_AXIS) -> Tuple[SoupState, SoupEvents]:
+                  axes=SOUP_AXIS, lin=None, win=None, lincfg=None):
     """Per-device body. ``state.weights``/``uids`` hold the LOCAL shard;
     scalars and the key are replicated.  ``axes`` is the mesh axis name (or
-    tuple: multislice DCN+ICI) the particle dimension shards over."""
+    tuple: multislice DCN+ICI) the particle dimension shards over.  With a
+    lineage carry (``lin``/``win``/``lincfg``, see ``telemetry.dynamics``)
+    the advanced carries ride along — mint bases come from the
+    all-gathered mask ranks, so pids stay globally unique."""
     n = config.size
     w_loc = state.weights
     n_loc = w_loc.shape[0]
     d = jax.lax.axis_index(axes)
     start = d * n_loc
     topo = config.topo
+    has_attacker = jnp.zeros(n_loc, bool)
+    att_loc = jnp.full(n_loc, -1, jnp.int32)
 
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
 
@@ -177,11 +182,24 @@ def _local_evolve(config: SoupConfig, state: SoupState,
         config.train > 0, death_action, death_cp)
 
     new_state = SoupState(new_w, new_uids, next_uid, state.time + 1, key)
-    return new_state, SoupEvents(action, counterpart, train_loss)
+    events = SoupEvents(action, counterpart, train_loss)
+    if lin is None:
+        return new_state, events
+    from ..telemetry.dynamics import lookup_pids, record_step
+
+    caps, capacity = lincfg
+    lin, win = record_step(
+        lin, win, gen=state.time, attacked=has_attacker,
+        attacker_pid=lookup_pids(lin.pid, jnp.clip(att_loc, 0), axes),
+        learn_gate=learn_gate_loc, learn_tgt=learn_tgt_loc,
+        dead=death_action != ACT_NONE, caps=caps, capacity=capacity,
+        axes=axes)
+    return new_state, events, lin, win
 
 
 def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
-                           wT_loc: jnp.ndarray, axes=SOUP_AXIS):
+                           wT_loc: jnp.ndarray, axes=SOUP_AXIS,
+                           lin=None, win=None, lincfg=None):
     """Per-device popmajor generation body: ``wT_loc`` is the LOCAL (P, N/D)
     lane-major shard; ``state.weights`` is ignored (uids are the local shard,
     scalars/key replicated).
@@ -210,6 +228,8 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     d = jax.lax.axis_index(axes)
     start = d * n_loc
     topo = config.topo
+    has_attacker = jnp.zeros(n_loc, bool)
+    att_loc = jnp.full(n_loc, -1, jnp.int32)
 
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
 
@@ -311,7 +331,18 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         config.train > 0, death_action, death_cp)
 
     new_state = SoupState(state.weights, uids, next_uid, state.time + 1, key)
-    return new_state, SoupEvents(action, counterpart, train_loss), wT_loc
+    events = SoupEvents(action, counterpart, train_loss)
+    if lin is None:
+        return new_state, events, wT_loc
+    from ..telemetry.dynamics import lookup_pids, record_step
+
+    caps, capacity = lincfg
+    lin, win = record_step(
+        lin, win, gen=state.time, attacked=has_attacker,
+        attacker_pid=lookup_pids(lin.pid, jnp.clip(att_loc, 0), axes),
+        learn_gate=learn_gate_loc, learn_tgt=learn_tgt_loc, dead=dead,
+        caps=caps, capacity=capacity, axes=axes)
+    return new_state, events, wT_loc, lin, win
 
 
 def _local_popmajor_step(config: SoupConfig, state: SoupState,
@@ -376,7 +407,8 @@ def _health_specs():
 
 def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
                     generations: int = 1, metrics: bool = False,
-                    health: bool = False):
+                    health: bool = False, lineage: bool = False,
+                    lineage_state=None, lineage_capacity: int = 4096):
     """Scan ``generations`` sharded steps (collectives stay inside the scan —
     one compiled program for the whole evolution).
 
@@ -391,7 +423,17 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
     syncs, state bit-identical to the unmetered program.  ``health=True``
     does the same for the GLOBAL ``telemetry.device.HealthStats`` carry
     (counts/hist psum'd, extrema pmin/pmax'd; peaks are a shard-wise upper
-    bound).  Return order: ``final``, metrics carry, health carry."""
+    bound).
+
+    ``lineage=True`` (``lineage_state`` = the sharded-placed
+    ``telemetry.dynamics.LineageState``) threads the replication-dynamics
+    carry: pids mint from globally-ranked bases (popmajor assigns
+    BIT-IDENTICAL pids to the single-device run; row-major differs only
+    where its documented respawn-stream difference changes who dies), the
+    per-SHARD edge windows concatenate at the boundary, and the fixpoint
+    census is psum'd global.  Runs inside ONE ``shard_map`` for both
+    layouts.  Return order: ``final``, metrics carry, health carry,
+    ``(lineage_state, window, fixpoint_stats)``."""
     axes = _soup_axes(mesh)
     if metrics:
         from ..telemetry.device import (accumulate_soup_metrics,
@@ -400,54 +442,137 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
     if health:
         from ..telemetry.device import (accumulate_health, psum_health,
                                         zero_health)
+    lincfg = None
+    if lineage:
+        if lineage_state is None:
+            raise ValueError("lineage=True needs lineage_state= (seed with "
+                             "telemetry.dynamics.seed_lineage, place with "
+                             "place_lineage)")
+        from ..soup import _lineage_caps
+        from ..telemetry.dynamics import (close_window, fixpoint_specs,
+                                          lineage_specs, psum_fixpoints,
+                                          window_specs, zero_window)
 
-    def pack(final, m, h):
+        n_loc = config.size // mesh.devices.size
+        lincfg = (_lineage_caps(n_loc, config, lineage_capacity),
+                  lineage_capacity)
+
+    def pack(final, m, h, ltriple=None):
         out = (final,)
         if metrics:
             out += (m,)
         if health:
             out += (h,)
+        if lineage:
+            out += (ltriple,)
         return out if len(out) > 1 else final
+
+    def in_specs():
+        specs = (_state_specs(axes),)
+        if lineage:
+            specs += (lineage_specs(axes),)
+        return specs
+
+    def out_specs():
+        specs = (_state_specs(axes),)
+        if metrics:
+            specs += (_metrics_specs(),)
+        if health:
+            specs += (_health_specs(),)
+        if lineage:
+            specs += ((lineage_specs(axes), window_specs(axes),
+                       fixpoint_specs()),)
+        return specs if len(specs) > 1 else specs[0]
 
     if config.layout == "popmajor":
         _check_popmajor(config)
 
-        def local_run(st: SoupState):
+        def local_run(st: SoupState, *lin_args):
             light = st._replace(weights=jnp.zeros((0,), st.weights.dtype))
             m0 = zero_soup_metrics() if metrics else None
             h0 = zero_health() if health else None
+            l0 = lin_args[0] if lineage else None
+            w0 = zero_window(lineage_capacity) if lineage else None
 
             def body(carry, _):
-                s, wT, m, h = carry
-                new_s, ev, new_wT = _local_evolve_popmajor(config, s, wT,
-                                                           axes)
+                s, wT, m, h, lin, win = carry
+                if lineage:
+                    new_s, ev, new_wT, lin, win = _local_evolve_popmajor(
+                        config, s, wT, axes, lin, win, lincfg)
+                else:
+                    new_s, ev, new_wT = _local_evolve_popmajor(config, s,
+                                                               wT, axes)
                 if metrics:
                     m = accumulate_soup_metrics(m, ev.action, ev.loss)
                 if health:
                     h = accumulate_health(h, new_wT, 0, config.epsilon)
-                return (new_s, new_wT, m, h), None
+                return (new_s, new_wT, m, h, lin, win), None
 
-            (final, wT, m, h), _ = jax.lax.scan(
-                body, (light, st.weights.T, m0, h0), None,
+            (final, wT, m, h, lin, win), _ = jax.lax.scan(
+                body, (light, st.weights.T, m0, h0, l0, w0), None,
                 length=generations)
             final = final._replace(weights=wT.T)
+            ltriple = None
+            if lineage:
+                from ..ops.popmajor import apply_popmajor
+
+                fw = apply_popmajor(config.topo, wT, wT)
+                lin, fstats = close_window(lin, wT, fw, 0, config.epsilon)
+                ltriple = (lin, win, psum_fixpoints(fstats, axes))
             return pack(final,
                         psum_soup_metrics(m, axes) if metrics else None,
-                        psum_health(h, axes) if health else None)
+                        psum_health(h, axes) if health else None,
+                        ltriple)
 
-        out_specs = (_state_specs(axes),)
-        if metrics:
-            out_specs += (_metrics_specs(),)
-        if health:
-            out_specs += (_health_specs(),)
         fn = shard_map(
             local_run,
             mesh=mesh,
-            in_specs=(_state_specs(axes),),
-            out_specs=out_specs if len(out_specs) > 1 else out_specs[0],
+            in_specs=in_specs(),
+            out_specs=out_specs(),
             check_vma=False,
         )
-        return fn(state)
+        return fn(state, lineage_state) if lineage else fn(state)
+
+    if lineage:
+        # row-major + lineage: the scan moves inside ONE shard_map (the
+        # per-step spelling cannot thread the per-shard window buffers)
+        from ..nets import apply_to_weights as _apply
+
+        def local_run_rm(st: SoupState, l0):
+            w0 = zero_window(lineage_capacity)
+            m0 = zero_soup_metrics() if metrics else None
+            h0 = zero_health() if health else None
+
+            def body(carry, _):
+                s, m, h, lin, win = carry
+                new_s, ev, lin, win = _local_evolve(config, s, axes, lin,
+                                                    win, lincfg)
+                if metrics:
+                    m = accumulate_soup_metrics(m, ev.action, ev.loss)
+                if health:
+                    h = accumulate_health(h, new_s.weights, -1,
+                                          config.epsilon)
+                return (new_s, m, h, lin, win), None
+
+            (final, m, h, lin, win), _ = jax.lax.scan(
+                body, (st, m0, h0, l0, w0), None, length=generations)
+            fw = jax.vmap(lambda wi: _apply(config.topo, wi, wi))(
+                final.weights)
+            lin, fstats = close_window(lin, final.weights, fw, -1,
+                                       config.epsilon)
+            return pack(final,
+                        psum_soup_metrics(m, axes) if metrics else None,
+                        psum_health(h, axes) if health else None,
+                        (lin, win, psum_fixpoints(fstats, axes)))
+
+        fn = shard_map(
+            local_run_rm,
+            mesh=mesh,
+            in_specs=in_specs(),
+            out_specs=out_specs(),
+            check_vma=False,
+        )
+        return fn(state, lineage_state)
 
     m0 = zero_soup_metrics() if metrics else None
     h0 = zero_health() if health else None
@@ -470,11 +595,13 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
 
 sharded_evolve = jax.jit(_sharded_evolve,
                          static_argnames=("config", "mesh", "generations",
-                                          "metrics", "health"))
+                                          "metrics", "health", "lineage",
+                                          "lineage_capacity"))
 sharded_evolve_donated = jax.jit(_sharded_evolve,
                                  static_argnames=("config", "mesh",
                                                   "generations", "metrics",
-                                                  "health"),
+                                                  "health", "lineage",
+                                                  "lineage_capacity"),
                                  donate_argnums=(2,))
 
 

@@ -168,6 +168,104 @@ def make_pipeline(args, registry, stage: str):
     return pipelined, writer, meter, driver
 
 
+# ---- replication-dynamics plumbing (mega_soup / mega_multisoup) ------------
+
+
+def add_dynamics_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The replication-dynamics observatory CLI knobs shared by the
+    mega-run entry points (see ``telemetry.dynamics``)."""
+    p.add_argument("--lineage", action="store_true",
+                   help="carry per-particle lineage ids + attack/learn/"
+                        "respawn event edges + a fixpoint-distance census "
+                        "in the jitted scan and stream one window per "
+                        "chunk to lineage.jsonl (population state is "
+                        "bit-identical either way; render with "
+                        "`report --dynamics <run_dir>`)")
+    p.add_argument("--lineage-edges", type=int, default=4096, metavar="N",
+                   help="per-window per-shard event-edge buffer rows; "
+                        "overflow drops edges (counted in edges_dropped — "
+                        "the stream degrades to a sample, never stalls)")
+    return p
+
+
+def make_lineage(args, exp_dir: str, *, sizes, start_gen: int,
+                 resume: bool, mesh=None, type_names=None):
+    """Build the mega loops' lineage trio ``(state, writer, capacity)`` —
+    ``(None, None, 0)`` without ``--lineage``.
+
+    On ``--resume`` the carry restores from the ``lineage_state.npz``
+    sidecar when its generation stamp matches the checkpoint (the stream
+    then CONTINUES the current epoch); otherwise a fresh carry starts a
+    new epoch (pids are unique per epoch — genealogy analyzes epochs
+    independently).  ``sizes`` is ``(n,)`` for the homogeneous soup or
+    the per-type sizes; the multi carry shares one pid space."""
+    if not getattr(args, "lineage", False):
+        return None, None, 0
+    from ..telemetry.dynamics import (LineageWriter, load_lineage_state,
+                                      place_lineage, seed_lineage,
+                                      seed_lineage_blocks)
+
+    lin = None
+    if resume:
+        lin = load_lineage_state(exp_dir, start_gen)
+    restored = lin is not None
+    if lin is None:
+        lin = (seed_lineage(sizes[0], time=start_gen) if len(sizes) == 1
+               else seed_lineage_blocks(sizes, time=start_gen))
+    if mesh is not None:
+        lin = (place_lineage(mesh, lin) if hasattr(lin, "next_pid")
+               else tuple(place_lineage(mesh, l) for l in lin))
+    meta = {"start_gen": start_gen, "sizes": list(sizes)}
+    if type_names is not None:
+        meta["type_names"] = list(type_names)
+    writer = LineageWriter(exp_dir, n=sum(sizes),
+                           capacity=args.lineage_edges, epsilon=args.epsilon,
+                           resume=resume, continue_epoch=restored, meta=meta)
+    return lin, writer, args.lineage_edges
+
+
+def flush_lineage_window(lwriter, registry, writer, exp_dir: str,
+                         gen_start: int, gen_end: int, ltriple,
+                         capacity: int, type_names=None) -> None:
+    """One chunk's lineage flush, called from the (possibly deferred)
+    chunk finisher: resolve the window on host, append the jsonl row,
+    fold the ``soup_dynamics_*`` metrics, and roll the resume sidecar —
+    all riding the background writer in submission order."""
+    from ..telemetry.dynamics import (save_lineage_state,
+                                      update_dynamics_registry,
+                                      window_record)
+    from ..utils.pipeline import submit_or_run
+
+    lin, win, stats = ltriple
+    # lin is one LineageState (itself a NamedTuple) or a per-type tuple
+    next_pid = (lin if hasattr(lin, "next_pid") else lin[0]).next_pid
+    row = window_record(gen_start, gen_end, win, stats, capacity,
+                        next_pid=int(next_pid), type_names=type_names)
+
+    def flush():
+        lwriter.append(row)
+        update_dynamics_registry(registry, row)
+        save_lineage_state(exp_dir, lin, gen_end)
+
+    submit_or_run(writer, flush)
+
+
+def flush_lineage_probe(lwriter, registry, writer, gen_start: int,
+                        gen_end: int, stats, type_names=None) -> None:
+    """Census-only flush for capture-mode chunks (no in-scan carry there;
+    see ``soup.probe_dynamics``)."""
+    from ..telemetry.dynamics import probe_record, update_dynamics_registry
+    from ..utils.pipeline import submit_or_run
+
+    row = probe_record(gen_start, gen_end, stats, type_names=type_names)
+
+    def flush():
+        lwriter.append(row)
+        update_dynamics_registry(registry, row)
+
+    submit_or_run(writer, flush)
+
+
 # ---- flight recorder / watchdog plumbing (mega_soup / mega_multisoup) ------
 
 

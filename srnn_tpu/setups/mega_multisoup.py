@@ -42,8 +42,10 @@ from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
-from .common import (add_flightrec_args, add_pipeline_args, base_parser,
-                     finish_pipeline, latest_checkpoint, make_flightrec,
+from .common import (add_dynamics_args, add_flightrec_args,
+                     add_pipeline_args, base_parser, finish_pipeline,
+                     flush_lineage_probe, flush_lineage_window,
+                     latest_checkpoint, make_flightrec, make_lineage,
                      make_on_stall, make_pipeline, load_run_config,
                      register, save_run_config, watchdog_chunk)
 
@@ -81,6 +83,7 @@ def build_parser():
                         "devices (shard_map data parallel)")
     add_pipeline_args(p)
     add_flightrec_args(p)
+    add_dynamics_args(p)
     return p
 
 
@@ -229,16 +232,16 @@ def run(args):
     # outputs, restores own_pytree-copied — and one executable for every
     # chunk keeps resume bitwise); the sharded path donates only states
     # this loop itself produced (first chunk plain).
-    def _evolve(s, gens, owned, health):
+    def _evolve(s, gens, owned, health, lkw):
         if mesh is not None:
             from ..parallel import (sharded_evolve_multi,
                                     sharded_evolve_multi_donated)
             run = sharded_evolve_multi_donated if owned \
                 else sharded_evolve_multi
             return run(cfg, mesh, s, generations=gens, metrics=True,
-                       health=health)
+                       health=health, **lkw)
         return evolve_multi_donated(cfg, s, generations=gens, metrics=True,
-                                    health=health)
+                                    health=health, **lkw)
 
     # telemetry: per-run registry (per-type science counters from the
     # in-scan carries, class gauges per type) + fsync'd heartbeats; both
@@ -247,6 +250,16 @@ def run(args):
     # flight recorder + watchdog (see mega_soup / telemetry.flightrec)
     health_on = not args.no_health
     flightrec, watchdog = make_flightrec(args)
+    # replication-dynamics observatory (telemetry.dynamics): per-type
+    # lineage carries over one shared pid space + the lineage.jsonl stream
+    tnames = type_names(cfg)
+    lins, lin_writer, lincap = make_lineage(
+        args, exp.dir, sizes=cfg.sizes, start_gen=int(state.time),
+        resume=bool(args.resume), mesh=mesh, type_names=tnames)
+    lineage_on = lins is not None
+    if lineage_on:
+        exp.log(f"lineage: epoch {lin_writer.epoch}, "
+                f"{lincap} edge rows/window -> lineage.jsonl")
     stores = writer = None
     import time as _time
     try:
@@ -311,7 +324,8 @@ def run(args):
                 update_class_gauges(registry, counts[t],
                                     type_name=tname, prev=prev[t])
 
-        def _finisher(gen, chunk, counts_dev, ckpt_state, ms=None, hs=None):
+        def _finisher(gen, chunk, counts_dev, ckpt_state, ms=None, hs=None,
+                      ldata=None):
             def finish():
                 nonlocal counts, t_last
                 with meter.waiting():
@@ -358,6 +372,17 @@ def run(args):
                         for tname, hsum in by_type.items():
                             submit_or_run(writer, update_health_gauges,
                                           registry, hsum, tname)
+                    if ldata is not None:
+                        kind, payload = ldata
+                        if kind == "window":
+                            flush_lineage_window(
+                                lin_writer, registry, writer, exp.dir,
+                                gen - chunk, gen, payload, lincap,
+                                type_names=tnames)
+                        else:
+                            flush_lineage_probe(lin_writer, registry,
+                                                writer, gen - chunk, gen,
+                                                payload, type_names=tnames)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
                     submit_or_run(writer, registry.flush_events, exp)
@@ -377,9 +402,12 @@ def run(args):
 
         while gen < args.generations:
             chunk = min(args.checkpoint_every, args.generations - gen)
-            # non-capture chunks hand their metrics + health carries to
-            # the finisher, which orders them ahead of the chunk's flush
-            ms = hs = None
+            # non-capture chunks hand their metrics + health (+ lineage)
+            # carries to the finisher, which orders them ahead of the
+            # chunk's flush
+            ms = hs = ldata = None
+            lkw = {"lineage": True, "lineage_state": lins,
+                   "lineage_capacity": lincap} if lineage_on else {}
             if stores is not None:
                 from ..utils import evolve_multi_captured
                 # owned=True: state is jax-owned (seed/own_pytree) and
@@ -394,20 +422,32 @@ def run(args):
                     # ordered before the next donation; see mega_soup)
                     hs = tuple(probe_health(w, -1, cfg.epsilon)
                                for w in state.weights)
+                if lineage_on:
+                    # census-only stand-in for the dynamics carry (no
+                    # pids/edges in capture mode; see telemetry.dynamics)
+                    from ..soup import probe_dynamics
+                    ldata = ("probe",
+                             tuple(probe_dynamics(t, w, cfg.epsilon)
+                                   for t, w in zip(cfg.topos,
+                                                   state.weights)))
             else:
+                out = _evolve(state, chunk, owned, health_on, lkw)
+                state, ms = out[0], out[1]
+                rest = list(out[2:])
                 if health_on:
-                    state, ms, hs = _evolve(state, chunk, owned, True)
-                else:
-                    state, ms = _evolve(state, chunk, owned, False)
+                    hs = rest.pop(0)
+                if lineage_on:
+                    lt = rest.pop(0)
+                    lins, ldata = lt[0], ("window", lt)
             owned = True
             gen += chunk
             # both dispatched BEFORE the next iteration donates state
-            # (the metrics/health carries are fresh jit outputs, never
-            # donated):
+            # (the metrics/health/lineage carries are fresh jit outputs,
+            # never donated):
             counts_dev = _count(state)
             ckpt_state = snapshot(state) if pipelined else state
             driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, ms,
-                                  hs))
+                                  hs, ldata))
         finish_pipeline(exp, driver, writer, meter, pipelined)
         exp.log(f"done: {_format_type_counts(counts)}")
     finally:
@@ -418,12 +458,18 @@ def run(args):
             watchdog.stop_trace()
         try:
             try:
-                if writer is not None:
-                    writer.close()
+                try:
+                    if writer is not None:
+                        writer.close()
+                finally:
+                    if stores is not None:
+                        for store in stores:
+                            store.close()
             finally:
-                if stores is not None:
-                    for store in stores:
-                        store.close()
+                # after the pipeline drained: every queued lineage row is
+                # already appended
+                if lin_writer is not None:
+                    lin_writer.close()
         finally:
             exp.__exit__(*sys.exc_info())
     return exp.dir

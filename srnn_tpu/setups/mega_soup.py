@@ -25,7 +25,7 @@ import numpy as np
 from ..experiment import (Experiment, counters_dict, format_counters,
                           restore_checkpoint, save_checkpoint)
 from ..soup import (ACT_DIV_DEAD, ACT_ZERO_DEAD, SoupConfig, count, evolve,
-                    evolve_donated, seed)
+                    evolve_donated, probe_dynamics, seed)
 from ..telemetry import Heartbeat, MetricsRegistry
 from ..telemetry.device import probe_health
 from ..telemetry.flightrec import health_summary, update_health_gauges
@@ -33,9 +33,11 @@ from ..telemetry.soup_metrics import update_class_gauges, update_registry
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
-from .common import (add_flightrec_args, add_pipeline_args, base_parser,
-                     finish_pipeline, latest_checkpoint, load_run_config,
-                     make_flightrec, make_on_stall, make_pipeline, register,
+from .common import (add_dynamics_args, add_flightrec_args,
+                     add_pipeline_args, base_parser, finish_pipeline,
+                     flush_lineage_probe, flush_lineage_window,
+                     latest_checkpoint, load_run_config, make_flightrec,
+                     make_lineage, make_on_stall, make_pipeline, register,
                      save_run_config, watchdog_chunk)
 
 
@@ -83,6 +85,7 @@ def build_parser():
                         "merged offline by read_sharded_store")
     add_pipeline_args(p)
     add_flightrec_args(p)
+    add_dynamics_args(p)
     return p
 
 
@@ -184,6 +187,15 @@ def run(args):
     # watchdog that turns a pathological chunk into a triage bundle
     health_on = not args.no_health
     flightrec, watchdog = make_flightrec(args)
+    # replication-dynamics observatory: the persistent lineage carry + the
+    # lineage.jsonl window stream (telemetry.dynamics; --lineage opt-in)
+    lin, lin_writer, lincap = make_lineage(
+        args, exp.dir, sizes=(cfg.size,), start_gen=int(state.time),
+        resume=bool(args.resume), mesh=mesh)
+    lineage_on = lin is not None
+    if lineage_on:
+        exp.log(f"lineage: epoch {lin_writer.epoch}, "
+                f"{lincap} edge rows/window -> lineage.jsonl")
     store = writer = None
     import time as _time
     try:
@@ -257,7 +269,8 @@ def run(args):
         gen = int(state.time)
         t_last = _time.perf_counter()
 
-        def _finisher(gen, chunk, counts_dev, ckpt_state, m=None, h=None):
+        def _finisher(gen, chunk, counts_dev, ckpt_state, m=None, h=None,
+                      ldata=None):
             def finish():
                 nonlocal counts, t_last
                 with meter.waiting():
@@ -304,6 +317,16 @@ def run(args):
                     if hsum is not None:
                         submit_or_run(writer, update_health_gauges,
                                       registry, hsum)
+                    if ldata is not None:
+                        kind, payload = ldata
+                        if kind == "window":
+                            flush_lineage_window(
+                                lin_writer, registry, writer, exp.dir,
+                                gen - chunk, gen, payload, lincap)
+                        else:
+                            flush_lineage_probe(lin_writer, registry,
+                                                writer, gen - chunk, gen,
+                                                payload)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
                     submit_or_run(writer, registry.flush_events, exp)
@@ -324,9 +347,16 @@ def run(args):
 
         while gen < args.generations:
             chunk = min(args.checkpoint_every, args.generations - gen)
-            # non-capture chunks hand their metrics + health carries to
-            # the finisher, which orders them ahead of the chunk's flush
-            m = h = None
+            # non-capture chunks hand their metrics + health (+ lineage)
+            # carries to the finisher, which orders them ahead of the
+            # chunk's flush
+            m = h = ldata = None
+            kw = {"generations": chunk, "metrics": True}
+            if health_on:
+                kw["health"] = True
+            if lineage_on:
+                kw.update(lineage=True, lineage_state=lin,
+                          lineage_capacity=lincap)
             if store is not None and mesh is not None:
                 from ..utils import sharded_evolve_captured
                 state = sharded_evolve_captured(cfg, mesh, state, chunk, store,
@@ -347,33 +377,44 @@ def run(args):
                 from ..parallel import (sharded_evolve,
                                         sharded_evolve_donated)
                 run = sharded_evolve_donated if sh_owned else sharded_evolve
+                out = run(cfg, mesh, state, **kw)
+                state, m = out[0], out[1]
+                rest = list(out[2:])
                 if health_on:
-                    state, m, h = run(cfg, mesh, state, generations=chunk,
-                                      metrics=True, health=True)
-                else:
-                    state, m = run(cfg, mesh, state, generations=chunk,
-                                   metrics=True)
+                    h = rest.pop(0)
+                if lineage_on:
+                    lt = rest.pop(0)
+                    lin, ldata = lt[0], ("window", lt)
                 sh_owned = True
             else:
+                out = evolve_donated(cfg, state, **kw)
+                state, m = out[0], out[1]
+                rest = list(out[2:])
                 if health_on:
-                    state, m, h = evolve_donated(cfg, state,
-                                                 generations=chunk,
-                                                 metrics=True, health=True)
-                else:
-                    state, m = evolve_donated(cfg, state, generations=chunk,
-                                              metrics=True)
+                    h = rest.pop(0)
+                if lineage_on:
+                    lt = rest.pop(0)
+                    lin, ldata = lt[0], ("window", lt)
             if store is not None and health_on:
                 # capture chunks meter through the capture helpers and lack
                 # the in-scan carry; probe end-of-chunk health with one
                 # tiny extra dispatch (ordered before the next donation)
                 h = probe_health(state.weights, -1, cfg.epsilon)
+            if store is not None and lineage_on:
+                # same stand-in for the dynamics carry: a census-only
+                # self-application probe (no pids/edges in capture mode —
+                # a documented boundary, see telemetry.dynamics)
+                ldata = ("probe",
+                         probe_dynamics(cfg.topo, state.weights,
+                                        cfg.epsilon))
             gen += chunk
             # both dispatched BEFORE the next iteration donates state
-            # (the metrics/health carries are fresh jit outputs, never
-            # donated):
+            # (the metrics/health/lineage carries are fresh jit outputs,
+            # never donated):
             counts_dev = _count(state)
             ckpt_state = snapshot(state) if pipelined else state
-            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, m, h))
+            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, m, h,
+                                  ldata))
         finish_pipeline(exp, driver, writer, meter, pipelined)
         exp.log(f"done: {counters_dict(counts)}")
     finally:
@@ -390,11 +431,17 @@ def run(args):
             watchdog.stop_trace()
         try:
             try:
-                if writer is not None:
-                    writer.close()
+                try:
+                    if writer is not None:
+                        writer.close()
+                finally:
+                    if store is not None:
+                        store.close()
             finally:
-                if store is not None:
-                    store.close()
+                # after the pipeline drained: every queued lineage row is
+                # already appended
+                if lin_writer is not None:
+                    lin_writer.close()
         finally:
             exp.__exit__(*sys.exc_info())
     return exp.dir

@@ -213,11 +213,17 @@ def _event_record(n, attack_gate, attack_cp, learn_gate, learn_cp, train_on,
     return action, counterpart
 
 
-def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
+def _evolve_parallel(config: SoupConfig, state: SoupState,
+                     lin=None, win=None, lincfg=None):
+    """One parallel row-major generation; with a lineage carry
+    (``lin``/``win``/``lincfg`` = per-gen caps + window capacity, see
+    ``telemetry.dynamics``) additionally returns the advanced carries."""
     n = config.size
     topo = config.topo
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
     w = state.weights
+    has_attacker = jnp.zeros(n, bool)
+    att_idx = jnp.full(n, -1, jnp.int32)
 
     # --- attack phase (soup.py:56-61) ---------------------------------
     with jax.named_scope("soup.attack"):
@@ -275,7 +281,18 @@ def _evolve_parallel(config: SoupConfig, state: SoupState) -> Tuple[SoupState, S
         config.train > 0, death_action, death_cp)
 
     new_state = SoupState(w, uids, next_uid, state.time + 1, key)
-    return new_state, SoupEvents(action, counterpart, train_loss)
+    events = SoupEvents(action, counterpart, train_loss)
+    if lin is None:
+        return new_state, events
+    from .telemetry.dynamics import lookup_pids, record_step
+
+    caps, capacity = lincfg
+    lin, win = record_step(
+        lin, win, gen=state.time, attacked=has_attacker,
+        attacker_pid=lookup_pids(lin.pid, jnp.clip(att_idx, 0)),
+        learn_gate=learn_gate, learn_tgt=learn_tgt,
+        dead=death_action != ACT_NONE, caps=caps, capacity=capacity)
+    return new_state, events, lin, win
 
 
 def _attack_capacity(n: int, rate: float) -> int:
@@ -372,7 +389,8 @@ def _learn_popmajor_compact(config: SoupConfig, wT: jnp.ndarray,
 
 
 def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
-                             wT: jnp.ndarray) -> Tuple[SoupState, SoupEvents, jnp.ndarray]:
+                             wT: jnp.ndarray, lin=None, win=None,
+                             lincfg=None):
     """Population-major twin of ``_evolve_parallel`` (all variants — the
     per-variant lane kernels live in ``ops/popmajor.py`` /
     ``ops/popmajor_kvec.py`` / ``ops/popmajor_rnn.py``).
@@ -390,6 +408,8 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
     n = config.size
     topo = config.topo
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    has_attacker = jnp.zeros(n, bool)
+    att_idx = jnp.full(n, -1, jnp.int32)
 
     # --- attack (soup.py:56-61); same last-attacker-wins resolution -----
     with jax.named_scope("soup.attack"):
@@ -461,7 +481,18 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         config.train > 0, action, death_cp)
     new_state = SoupState(state.weights, uids, state.next_uid + deaths,
                           state.time + 1, key)
-    return new_state, SoupEvents(act, cp, train_loss), wT
+    events = SoupEvents(act, cp, train_loss)
+    if lin is None:
+        return new_state, events, wT
+    from .telemetry.dynamics import lookup_pids, record_step
+
+    caps, capacity = lincfg
+    lin, win = record_step(
+        lin, win, gen=state.time, attacked=has_attacker,
+        attacker_pid=lookup_pids(lin.pid, jnp.clip(att_idx, 0)),
+        learn_gate=learn_gate, learn_tgt=learn_tgt, dead=dead, caps=caps,
+        capacity=capacity)
+    return new_state, events, wT, lin, win
 
 
 def _check_popmajor(config: SoupConfig) -> None:
@@ -633,6 +664,22 @@ evolve_step_donated = jax.jit(_evolve_step, static_argnames=("config",),
                               donate_argnums=(1,))
 
 
+def _lineage_caps(n: int, config, capacity: int) -> Tuple[int, int, int]:
+    """Static per-generation edge-compaction widths (attack, learn,
+    respawn) for an ``n``-particle population — the Binomial bound for the
+    gated phases, full width (clipped to the window) for respawn storms.
+    A statically-disabled phase gets width 0, which elides its whole edge
+    block from the compiled step (``dynamics.record_step``)."""
+    from .telemetry.dynamics import edge_capacity
+
+    return (min(edge_capacity(n, config.attacking_rate), capacity)
+            if config.attacking_rate > 0 else 0,
+            min(edge_capacity(n, config.learn_from_rate), capacity)
+            if config.learn_from_rate > 0 else 0,
+            min(n, capacity)
+            if (config.remove_divergent or config.remove_zero) else 0)
+
+
 def _evolve(
     config: SoupConfig,
     state: SoupState,
@@ -640,6 +687,9 @@ def _evolve(
     record: bool = False,
     metrics: bool = False,
     health: bool = False,
+    lineage: bool = False,
+    lineage_state=None,
+    lineage_capacity: int = 4096,
 ):
     """Evolve ``generations`` steps as one scan.
 
@@ -659,9 +709,18 @@ def _evolve(
     carry — the flight recorder's population-health sentinels (NaN/Inf and
     zero-collapse particle counts, weight-norm quantile sketch) folded
     from each generation's post-step weights, same zero-host-round-trip
-    discipline and the same bit-identical-state guarantee.  Return shape:
-    ``final``, then ``recs`` if recording, then the metrics carry if
-    metering, then the health carry if sentineled.
+    discipline and the same bit-identical-state guarantee.
+
+    With ``lineage=True`` (``lineage_state`` = the persistent
+    ``telemetry.dynamics.LineageState`` carry, seeded once per run with
+    ``seed_lineage``) additionally returns one replication-dynamics
+    window ``(new_lineage_state, LineageWindow, FixpointStats)``:
+    per-particle pids with parent/birth advanced through every attack and
+    respawn, the window's event-edge buffer (``lineage_capacity`` rows;
+    overflow drops and counts), and the end-of-window self-application
+    census.  Same bit-identical-state guarantee; parallel mode only.
+    Return shape: ``final``, then ``recs`` if recording, then the metrics
+    carry, then the health carry, then the lineage triple.
     """
     if metrics:
         from .telemetry.device import (accumulate_soup_metrics,
@@ -670,6 +729,21 @@ def _evolve(
         from .telemetry.device import accumulate_health, zero_health
     m0 = zero_soup_metrics() if metrics else None
     h0 = zero_health() if health else None
+    l0 = w0 = lincfg = None
+    if lineage:
+        if config.mode != "parallel":
+            raise ValueError(
+                "lineage=True rides the parallel step's phase gates; "
+                f"mode={config.mode!r} is unsupported")
+        if lineage_state is None:
+            raise ValueError("lineage=True needs lineage_state= (seed one "
+                             "with telemetry.dynamics.seed_lineage)")
+        from .telemetry.dynamics import close_window, zero_window
+
+        l0 = lineage_state
+        w0 = zero_window(lineage_capacity)
+        lincfg = (_lineage_caps(config.size, config, lineage_capacity),
+                  lineage_capacity)
 
     if config.layout == "popmajor":
         # keep the carry transposed across the whole run: one transpose at
@@ -677,35 +751,53 @@ def _evolve(
         _check_popmajor(config)
 
         def step_t(carry, _):
-            s, wT, m, h = carry
-            new_s, ev, new_wT = _evolve_parallel_popmajor(config, s, wT)
+            s, wT, m, h, lin, win = carry
+            if lineage:
+                new_s, ev, new_wT, lin, win = _evolve_parallel_popmajor(
+                    config, s, wT, lin, win, lincfg)
+            else:
+                new_s, ev, new_wT = _evolve_parallel_popmajor(config, s, wT)
             if metrics:
                 m = accumulate_soup_metrics(m, ev.action, ev.loss)
             if health:
                 h = accumulate_health(h, new_wT, 0, config.epsilon)
             out = (ev, new_wT.T, new_s.uids) if record else None
-            return (new_s, new_wT, m, h), out
+            return (new_s, new_wT, m, h, lin, win), out
 
         # the transposed wT is the live weights carry; null the row-major
         # field so the scan doesn't drag a dead (N, P) buffer along
         light = state._replace(weights=jnp.zeros((0,), state.weights.dtype))
-        (final, wT, m, h), recs = jax.lax.scan(
-            step_t, (light, state.weights.T, m0, h0), None,
+        (final, wT, m, h, lin, win), recs = jax.lax.scan(
+            step_t, (light, state.weights.T, m0, h0, l0, w0), None,
             length=generations)
         final = final._replace(weights=wT.T)
+        if lineage:
+            from .ops.popmajor import apply_popmajor
+
+            fw = apply_popmajor(config.topo, wT, wT)
+            lin, fstats = close_window(lin, wT, fw, 0, config.epsilon)
     else:
         def step(carry, _):
-            s, m, h = carry
-            new_s, ev = evolve_step(config, s)
+            s, m, h, lin, win = carry
+            if lineage:
+                new_s, ev, lin, win = _evolve_parallel(config, s, lin, win,
+                                                       lincfg)
+            else:
+                new_s, ev = evolve_step(config, s)
             if metrics:
                 m = accumulate_soup_metrics(m, ev.action, ev.loss)
             if health:
                 h = accumulate_health(h, new_s.weights, -1, config.epsilon)
             out = (ev, new_s.weights, new_s.uids) if record else None
-            return (new_s, m, h), out
+            return (new_s, m, h, lin, win), out
 
-        (final, m, h), recs = jax.lax.scan(step, (state, m0, h0), None,
-                                           length=generations)
+        (final, m, h, lin, win), recs = jax.lax.scan(
+            step, (state, m0, h0, l0, w0), None, length=generations)
+        if lineage:
+            fw = jax.vmap(lambda wi: apply_to_weights(config.topo, wi, wi))(
+                final.weights)
+            lin, fstats = close_window(lin, final.weights, fw, -1,
+                                       config.epsilon)
 
     out = (final,)
     if record:
@@ -714,6 +806,8 @@ def _evolve(
         out += (m,)
     if health:
         out += (h,)
+    if lineage:
+        out += ((lin, win, fstats),)
     return out if len(out) > 1 else final
 
 
@@ -721,11 +815,27 @@ def _evolve(
 #: twin (see ``evolve_step_donated``) used by the mega-run hot loops, where
 #: the state is always rebound chunk over chunk.
 evolve = jax.jit(_evolve, static_argnames=("config", "generations", "record",
-                                           "metrics", "health"))
+                                           "metrics", "health", "lineage",
+                                           "lineage_capacity"))
 evolve_donated = jax.jit(_evolve,
                          static_argnames=("config", "generations", "record",
-                                          "metrics", "health"),
+                                          "metrics", "health", "lineage",
+                                          "lineage_capacity"),
                          donate_argnums=(1,))
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "epsilon"))
+def probe_dynamics(topo: Topology, weights: jnp.ndarray,
+                   epsilon: float = DEFAULT_EPSILON):
+    """One-shot fixpoint census of a row-major population already in hand
+    (the capture-mode chunks' stand-in for the in-scan lineage carry, like
+    ``telemetry.device.probe_health``): self-apply every particle once and
+    label basins — no pids, no edges, transitions from the unknown row."""
+    from .telemetry.dynamics import fixpoint_stats
+
+    fw = jax.vmap(lambda wi: apply_to_weights(topo, wi, wi))(weights)
+    prev = jnp.full(weights.shape[0], -1, jnp.int32)
+    return fixpoint_stats(weights, fw, -1, epsilon, prev)[1]
 
 
 @functools.partial(jax.jit, static_argnames=("config",))

@@ -32,6 +32,10 @@ from .soup_metrics import (EVENT_COUNTERS, update_class_gauges,
 from .flightrec import (FlightRecorder, StallSentinel, Watchdog,
                         combined_health_summary, health_summary,
                         update_health_gauges, write_triage_bundle)
+from .dynamics import (BASIN_NAMES, EDGE_NAMES, FixpointStats, LineageState,
+                       LineageWindow, LineageWriter, seed_lineage,
+                       seed_lineage_blocks, update_dynamics_registry,
+                       window_record)
 
 __all__ = [
     "N_ACTIONS", "SoupMetrics", "accumulate_soup_metrics", "count_events",
@@ -46,4 +50,7 @@ __all__ = [
     "FlightRecorder", "StallSentinel", "Watchdog",
     "combined_health_summary", "health_summary", "update_health_gauges",
     "write_triage_bundle",
+    "BASIN_NAMES", "EDGE_NAMES", "FixpointStats", "LineageState",
+    "LineageWindow", "LineageWriter", "seed_lineage", "seed_lineage_blocks",
+    "update_dynamics_registry", "window_record",
 ]

@@ -1,0 +1,668 @@
+"""Replication-dynamics telemetry: device-side lineage, event edges, and
+fixpoint-distance census for the soup scan.
+
+The science questions of the source papers — which lineages dominate a
+soup, who attacked whom, how far does each particle sit from its own
+fixpoint — need *experiment*-level observability that PR 2/4's system
+metrics (counters, health sentinels) do not carry.  This module is the
+device half of that layer, accumulated INSIDE the jitted generations scan
+with the same discipline as :class:`~srnn_tpu.telemetry.device.SoupMetrics`:
+zero host round-trips, one flush per chunk, population state bit-identical
+to the unmetered program (the carry only reads weights and the phase
+gates the step already computes).
+
+Three pieces ride the scan behind the ``lineage=True`` static flag on
+``evolve`` / ``evolve_multi`` / ``sharded_evolve`` / ``sharded_evolve_multi``:
+
+  * :class:`LineageState` — per-particle persistent instance ids (pid)
+    with parent pid and birth generation.  A NEW pid is minted whenever a
+    slot's identity changes: at seed and respawn (roots, ``parent=-1``)
+    and when an attack overwrites the victim (``parent`` = attacker's
+    pid — self-replication is the lineage link).  ``learn_from`` perturbs
+    but does not replace, so it mints nothing and only contributes an
+    event edge.  Pids are globally unique across shards: mint bases come
+    from the all-gathered mint mask's global rank (the same shard-offset
+    construction the respawn uids use), so the sharded popmajor path
+    assigns bit-identical pids to the single-device run.
+  * :class:`LineageWindow` — fixed-capacity per-window event-edge buffers
+    (``(kind, gen, src_pid, dst_pid, prev_pid)`` int32 rows) with the
+    compact-lanes discipline by rank: each gated lane's append slot is
+    its mask rank (a cumsum the mint already pays) and a generation's
+    rows land with ONE fused ``mode='drop'`` scatter
+    (:func:`record_step`).  Capacity overflow drops the excess edges and
+    counts them in ``dropped`` (``births`` stays exact — it is summed
+    from the masks, not the buffer), so a mega-scale window degrades to
+    an honest *sample* of the event graph, never a stall.
+  * :class:`FixpointStats` — end-of-window per-particle self-application
+    distance ``‖f(w) − w‖`` (L2 + L∞), sketched into the same log2
+    bucket layout as ``HealthStats``, a per-particle basin label
+    (fixpoint / drifting / divergent / zero — thresholds below) counted
+    into a census, and the window-over-window basin transition matrix
+    (previous labels ride the lineage carry, so the matrix is exact
+    without shipping per-particle labels to the host).
+
+Basin labels (DESIGN.md §11): ``divergent`` iff any weight (or the
+self-application distance) is NaN/Inf; else ``zero`` iff every weight is
+within ``[-epsilon, +epsilon]`` (the ``is_zero`` predicate); else
+``fixpoint`` iff ``L∞ < epsilon`` (the reference's degree-1
+``is_fixpoint`` criterion, strict); else ``drifting``.  Particles minted
+during the window enter the transition matrix from the ``unknown`` row.
+
+Like :mod:`srnn_tpu.telemetry.device` this module is import-cycle-free
+towards the soup modules (``jax``/``jnp`` + stdlib/numpy for the host
+half only), so the jitted bodies can import it freely; the
+self-application ``f(w)`` is computed by the CALLER (it owns the variant
+dispatch) and passed in.  The host half (:class:`LineageWriter`,
+:func:`update_dynamics_registry`) turns flushed windows into the
+append-only ``lineage.jsonl`` stream next to the ``.traj`` store and the
+``soup_dynamics_*`` registry metrics; :mod:`srnn_tpu.telemetry.genealogy`
+reconstructs the ancestry forest offline.
+
+Pids are int32 like the uids: a 1M-particle run mints ~0.2 pids per
+particle-generation at the paper's rates, so the 2^31 ceiling is ~10k
+generations at mega scale — beyond any BASELINE workload; the host
+registry tracks ``next_pid`` so an approach to the ceiling is visible.
+"""
+
+import json
+import math
+import os
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device import HEALTH_BUCKET_LO, HEALTH_BUCKET_STEP, N_HEALTH_BUCKETS
+
+#: basin labels of the fixpoint census (order is the label precedence
+#: used by :func:`fixpoint_stats`, mirroring the reference classification:
+#: divergent > zero > fixpoint > drifting)
+N_BASINS = 4
+BASIN_NAMES = ("fixpoint", "drifting", "divergent", "zero")
+BASIN_FIX, BASIN_DRIFT, BASIN_DIV, BASIN_ZERO = range(N_BASINS)
+BASIN_UNKNOWN = -1  # minted this window / first window: no previous label
+
+#: event-edge kinds (the ``kind`` column of a :class:`LineageWindow` row)
+EDGE_NAMES = ("attack", "learn", "respawn")
+EDGE_ATTACK, EDGE_LEARN, EDGE_RESPAWN = range(3)
+#: columns of one edge row: (kind, gen, src_pid, dst_pid, prev_pid) —
+#: ``src`` is the attacker/teacher pid (-1 for respawn roots), ``dst`` the
+#: (possibly freshly minted) pid at the receiving slot, ``prev`` the pid
+#: the slot held before (-1 when the slot's identity did not change)
+EDGE_WIDTH = 5
+
+#: per-window per-shard edge-buffer rows (the mega loops' --lineage-edges
+#: default; also what the AOT warmup sweep compiles against)
+DEFAULT_EDGE_CAPACITY = 4096
+
+
+class LineageState(NamedTuple):
+    """Persistent per-particle lineage carry (rides the scan like the
+    metrics/health carries, but is INPUT as well as output: pids persist
+    across chunks)."""
+    pid: jnp.ndarray      # (N,) int32 — current instance id of each slot
+    parent: jnp.ndarray   # (N,) int32 — parent pid (-1 for roots)
+    birth: jnp.ndarray    # (N,) int32 — generation the instance was minted
+    basin: jnp.ndarray    # (N,) int32 — label at last window close (-1 unknown)
+    next_pid: jnp.ndarray  # () int32 — global mint counter (replicated)
+
+
+class LineageWindow(NamedTuple):
+    """Per-flush-interval event-edge buffer (per shard under sharding:
+    every field's leading axis concatenates over shards at the
+    ``shard_map`` boundary, so the host sees ``(D*cap, 5)`` edges with a
+    ``(D,)`` valid-row count)."""
+    edges: jnp.ndarray    # (cap, EDGE_WIDTH) int32
+    n_edges: jnp.ndarray  # (1,) int32 — valid rows (per shard)
+    dropped: jnp.ndarray  # (1,) int32 — edges lost to capacity (per shard)
+    births: jnp.ndarray   # (1, 2) int32 — exact attack/respawn mints (per shard)
+
+
+class FixpointStats(NamedTuple):
+    """End-of-window fixpoint census (global after the shard psum)."""
+    census: jnp.ndarray       # (N_BASINS,) int32
+    transitions: jnp.ndarray  # (N_BASINS + 1, N_BASINS) int32 — rows: unknown + prev basin
+    l2_hist: jnp.ndarray      # (N_HEALTH_BUCKETS,) int32 — log2 sketch of finite L2
+    linf_hist: jnp.ndarray    # (N_HEALTH_BUCKETS,) int32
+    l2_max: jnp.ndarray       # () f32 — max finite L2 distance (-inf if none)
+    linf_max: jnp.ndarray     # () f32
+
+
+def seed_lineage(n: int, base: int = 0, time: int = 0) -> LineageState:
+    """Fresh lineage for an ``n``-particle population: the seed particles
+    are roots ``pid = base + [0, n)`` born at ``time``."""
+    return LineageState(
+        pid=jnp.arange(base, base + n, dtype=jnp.int32),
+        parent=jnp.full(n, -1, jnp.int32),
+        birth=jnp.full(n, time, jnp.int32),
+        basin=jnp.full(n, BASIN_UNKNOWN, jnp.int32),
+        next_pid=jnp.int32(base + n),
+    )
+
+
+def seed_lineage_blocks(sizes: Sequence[int], time: int = 0
+                        ) -> Tuple[LineageState, ...]:
+    """Per-type lineage carries over ONE shared pid space: type ``t``'s
+    seed pids are its uid block ``[offs[t], offs[t+1])`` and every carry
+    starts from the same global mint counter (``sum(sizes)``)."""
+    total = sum(sizes)
+    lins, off = [], 0
+    for n in sizes:
+        lin = seed_lineage(n, base=off, time=time)
+        lins.append(lin._replace(next_pid=jnp.int32(total)))
+        off += n
+    return tuple(lins)
+
+
+def zero_window(capacity: int) -> LineageWindow:
+    """The empty per-window buffer the scan carry starts from."""
+    if capacity < 1:
+        raise ValueError(f"lineage edge capacity must be >= 1, got {capacity}")
+    return LineageWindow(
+        edges=jnp.full((capacity, EDGE_WIDTH), -1, jnp.int32),
+        n_edges=jnp.zeros(1, jnp.int32),
+        dropped=jnp.zeros(1, jnp.int32),
+        births=jnp.zeros((1, 2), jnp.int32),
+    )
+
+
+def edge_capacity(n: int, rate: float) -> int:
+    """Static per-generation compaction width for a Binomial(n, rate)
+    gated-lane count: mean + 8 sd rounded up to a 128 multiple (the same
+    bound the compact attack/learn phases use; P(overflow) < 1e-14 —
+    and here overflow only drops edges, never changes semantics)."""
+    rate = min(max(rate, 0.0), 1.0)
+    mean = n * rate
+    sd = math.sqrt(n * rate * (1.0 - rate))
+    cap = int(math.ceil(mean + 8.0 * sd)) + 16
+    return min(n, ((cap + 127) // 128) * 128)
+
+
+def _rank_and_total(mask: jnp.ndarray, axes) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Global mint rank of each masked lane + the global mint count.
+
+    Single device: a plain cumsum.  Inside a ``shard_map`` body (``axes``
+    = the particle mesh axis name/tuple): the all-gathered mask's global
+    cumsum, sliced back to the local lanes — the same construction the
+    sharded respawn uids use, so shard boundaries never change which pid
+    a lane receives."""
+    if axes is None:
+        rank = jnp.cumsum(mask) - 1
+        return rank.astype(jnp.int32), mask.sum(dtype=jnp.int32)
+    n_loc = mask.shape[0]
+    all_mask = jax.lax.all_gather(mask, axes, tiled=True)
+    rank = jnp.cumsum(all_mask) - 1
+    d = jax.lax.axis_index(axes)
+    rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
+    return rank_loc.astype(jnp.int32), all_mask.sum(dtype=jnp.int32)
+
+
+def lookup_pids(pid: jnp.ndarray, idx: jnp.ndarray, axes=None) -> jnp.ndarray:
+    """pid of a (global) particle index — the shard-aware uid-table gather."""
+    table = pid if axes is None else jax.lax.all_gather(pid, axes, tiled=True)
+    return table[idx]
+
+
+def mint(lin: LineageState, mask: jnp.ndarray, parent_pid: jnp.ndarray,
+         gen: jnp.ndarray, axes=None) -> LineageState:
+    """Mint a fresh pid for every masked lane (attack victims or respawned
+    slots): globally-ranked ids from ``next_pid``, ``parent_pid`` recorded
+    per lane (-1 for roots), birth = ``gen``, basin reset to unknown."""
+    rank, total = _rank_and_total(mask, axes)
+    new_pid = lin.next_pid + rank
+    return LineageState(
+        pid=jnp.where(mask, new_pid, lin.pid),
+        parent=jnp.where(mask, parent_pid, lin.parent),
+        birth=jnp.where(mask, gen.astype(jnp.int32), lin.birth),
+        basin=jnp.where(mask, BASIN_UNKNOWN, lin.basin),
+        next_pid=lin.next_pid + total,
+    )
+
+
+def record_step(lin: LineageState, win: LineageWindow, *,
+                gen: jnp.ndarray, attacked: jnp.ndarray,
+                attacker_pid: jnp.ndarray, learn_gate: jnp.ndarray,
+                learn_tgt: jnp.ndarray, dead: jnp.ndarray,
+                caps: Tuple[int, int, int], capacity: int, axes=None
+                ) -> Tuple[LineageState, LineageWindow]:
+    """One generation's COMPLETE lineage bookkeeping in one call, fed the
+    phase info the step already computed: ``attacked`` lanes with their
+    winning ``attacker_pid`` (start-of-generation pids, resolve with
+    :func:`lookup_pids`), learner lanes with their teacher's
+    population-global index (the teacher pid resolves POST-attack-minting
+    — a particle imitating a just-attacked victim learns from the NEW
+    instance), and the respawned ``dead`` lanes.  Attack mints, then
+    learn edges, then respawn mints; every edge row of the generation
+    lands with ONE ``mode='drop'`` scatter, and each mask's cumsum is
+    shared between its mint rank and its append slots.  At small
+    populations the per-lane int ops ARE the lineage bill, so the fusion
+    is what keeps the micro_dispatch ``lineage`` row inside its
+    documented overhead bound.  A zero entry in ``caps`` (static
+    per-phase compaction widths, see :func:`edge_capacity`) elides that
+    whole edge block — the caller's way of saying the phase cannot fire
+    (e.g. ``learn_from_rate <= 0``, the homogeneous mega default).
+
+    The heterogeneous loops call this per type AFTER their whole weights
+    loop (``multisoup._record_multi_lineage``), chaining mint bases
+    type-major through one shared counter."""
+    gen = gen.astype(jnp.int32)
+    g = jnp.broadcast_to(gen, attacked.shape)
+    neg1 = jnp.full_like(lin.pid, -1)
+    zero = jnp.int32(0)
+
+    def mint_ranked(l, mask, parent_pid):
+        rank = (jnp.cumsum(mask) - 1).astype(jnp.int32)
+        cnt = mask.sum(dtype=jnp.int32)
+        if axes is None:
+            minted = l._replace(
+                pid=jnp.where(mask, l.next_pid + rank, l.pid),
+                parent=jnp.where(mask, parent_pid, l.parent),
+                birth=jnp.where(mask, gen, l.birth),
+                basin=jnp.where(mask, BASIN_UNKNOWN, l.basin),
+                next_pid=l.next_pid + cnt)
+        else:
+            minted = mint(l, mask, parent_pid, gen, axes)
+        return minted, rank, cnt
+
+    old_pid = lin.pid
+    cnt_att = cnt_learn = cnt_dead = zero
+    if caps[0] > 0:
+        lin, rank_att, cnt_att = mint_ranked(lin, attacked, attacker_pid)
+    if caps[1] > 0:
+        teacher_pid = lookup_pids(lin.pid, learn_tgt, axes)
+        rank_learn = (jnp.cumsum(learn_gate) - 1).astype(jnp.int32)
+        cnt_learn = learn_gate.sum(dtype=jnp.int32)
+    mid_pid = lin.pid
+    if caps[2] > 0:
+        lin, rank_dead, cnt_dead = mint_ranked(lin, dead, neg1)
+
+    base = win.n_edges[0]
+    pos_parts, row_parts = [], []
+    appended = zero
+    if caps[0] > 0:
+        app = jnp.minimum(jnp.minimum(cnt_att, caps[0]),
+                          jnp.maximum(capacity - base, 0))
+        pos_parts.append(jnp.where(attacked & (rank_att < caps[0]),
+                                   base + rank_att, capacity))
+        row_parts.append(jnp.stack(
+            [jnp.full_like(old_pid, EDGE_ATTACK), g, attacker_pid, mid_pid,
+             old_pid], axis=1))
+        base, appended = base + app, appended + app
+    if caps[1] > 0:
+        app = jnp.minimum(jnp.minimum(cnt_learn, caps[1]),
+                          jnp.maximum(capacity - base, 0))
+        pos_parts.append(jnp.where(learn_gate & (rank_learn < caps[1]),
+                                   base + rank_learn, capacity))
+        row_parts.append(jnp.stack(
+            [jnp.full_like(old_pid, EDGE_LEARN), g, teacher_pid, mid_pid,
+             neg1], axis=1))
+        base, appended = base + app, appended + app
+    if caps[2] > 0:
+        app = jnp.minimum(jnp.minimum(cnt_dead, caps[2]),
+                          jnp.maximum(capacity - base, 0))
+        pos_parts.append(jnp.where(dead & (rank_dead < caps[2]),
+                                   base + rank_dead, capacity))
+        row_parts.append(jnp.stack(
+            [jnp.full_like(old_pid, EDGE_RESPAWN), g, neg1, lin.pid,
+             mid_pid], axis=1))
+        appended = appended + app
+    if not pos_parts:
+        return lin, win
+    total = cnt_att + cnt_learn + cnt_dead
+    return lin, win._replace(
+        edges=win.edges.at[jnp.concatenate(pos_parts)].set(
+            jnp.concatenate(row_parts), mode="drop"),
+        n_edges=win.n_edges + appended,
+        dropped=win.dropped + (total - appended),
+        births=win.births.at[0].add(jnp.stack([cnt_att, cnt_dead])),
+    )
+
+
+def _log2_hist(values: jnp.ndarray, include: jnp.ndarray) -> jnp.ndarray:
+    """The HealthStats log2 bucket sketch over a nonnegative statistic:
+    exact zeros land in bucket 0, excluded lanes count nowhere."""
+    safe = jnp.where(include & (values > 0), values,
+                     jnp.float32(2.0) ** HEALTH_BUCKET_LO)
+    b = jnp.clip(
+        (jnp.floor(jnp.log2(safe)).astype(jnp.int32) - HEALTH_BUCKET_LO)
+        // HEALTH_BUCKET_STEP, 0, N_HEALTH_BUCKETS - 1)
+    codes = jnp.arange(N_HEALTH_BUCKETS, dtype=jnp.int32)
+    return ((b[None, :] == codes[:, None]) & include[None, :]).sum(
+        axis=1, dtype=jnp.int32)
+
+
+def fixpoint_stats(w: jnp.ndarray, fw: jnp.ndarray, axis: int,
+                   epsilon: float, prev_basin: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, FixpointStats]:
+    """Per-particle basin labels + census from the self-application
+    ``fw = f(w)`` (computed by the caller, which owns the variant
+    dispatch).  ``axis`` is the weight axis ((N, P) row-major: -1;
+    (P, N) lane-major: 0); ``epsilon`` doubles as the zero-collapse bound
+    and the strict L∞ fixpoint threshold (reference degree-1
+    ``is_fixpoint``)."""
+    diff = fw - w
+    l2 = jnp.sqrt((diff * diff).sum(axis=axis, dtype=jnp.float32))
+    linf = jnp.max(jnp.abs(diff), axis=axis).astype(jnp.float32)
+    div = jnp.any(~jnp.isfinite(w), axis=axis) | ~jnp.isfinite(linf)
+    zero = jnp.all((w >= -epsilon) & (w <= epsilon), axis=axis) & ~div
+    fix = ~div & ~zero & (linf < epsilon)
+    basin = jnp.where(
+        div, BASIN_DIV,
+        jnp.where(zero, BASIN_ZERO,
+                  jnp.where(fix, BASIN_FIX, BASIN_DRIFT))).astype(jnp.int32)
+    codes = jnp.arange(N_BASINS, dtype=jnp.int32)
+    census = (basin[None, :] == codes[:, None]).sum(axis=1, dtype=jnp.int32)
+    pair = (prev_basin + 1) * N_BASINS + basin
+    pcodes = jnp.arange((N_BASINS + 1) * N_BASINS, dtype=jnp.int32)
+    transitions = (pair[None, :] == pcodes[:, None]).sum(
+        axis=1, dtype=jnp.int32).reshape(N_BASINS + 1, N_BASINS)
+    finite = jnp.isfinite(l2) & jnp.isfinite(linf)
+    stats = FixpointStats(
+        census=census,
+        transitions=transitions,
+        l2_hist=_log2_hist(l2, finite),
+        linf_hist=_log2_hist(linf, finite),
+        l2_max=jnp.where(finite, l2, -jnp.inf).max(),
+        linf_max=jnp.where(finite, linf, -jnp.inf).max(),
+    )
+    return basin, stats
+
+
+def close_window(lin: LineageState, w: jnp.ndarray, fw: jnp.ndarray,
+                 axis: int, epsilon: float
+                 ) -> Tuple[LineageState, FixpointStats]:
+    """End-of-window close: label every particle's basin, fold the
+    window-over-window transition matrix from the carried previous labels,
+    and store the new labels for the next window."""
+    basin, stats = fixpoint_stats(w, fw, axis, epsilon, lin.basin)
+    return lin._replace(basin=basin), stats
+
+
+def psum_fixpoints(s: FixpointStats, axis_name) -> FixpointStats:
+    """Global census from per-shard stats inside a ``shard_map`` body."""
+    return FixpointStats(
+        census=jax.lax.psum(s.census, axis_name),
+        transitions=jax.lax.psum(s.transitions, axis_name),
+        l2_hist=jax.lax.psum(s.l2_hist, axis_name),
+        linf_hist=jax.lax.psum(s.linf_hist, axis_name),
+        l2_max=jax.lax.pmax(s.l2_max, axis_name),
+        linf_max=jax.lax.pmax(s.linf_max, axis_name),
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharding specs (shared by the two sharded twins)
+# ---------------------------------------------------------------------------
+
+
+def lineage_specs(axes) -> LineageState:
+    """Placement of the lineage carry under the soup sharding: per-particle
+    arrays sharded, the mint counter replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return LineageState(pid=P(axes), parent=P(axes), birth=P(axes),
+                        basin=P(axes), next_pid=P())
+
+
+def window_specs(axes) -> LineageWindow:
+    """Per-SHARD window buffers: every field concatenates over the mesh
+    axis, so the host receives all shards' edges side by side with their
+    per-shard valid counts."""
+    from jax.sharding import PartitionSpec as P
+
+    return LineageWindow(edges=P(axes), n_edges=P(axes), dropped=P(axes),
+                         births=P(axes))
+
+
+def fixpoint_specs() -> FixpointStats:
+    """Replicated placement of a psum'd ``FixpointStats``."""
+    from jax.sharding import PartitionSpec as P
+
+    return FixpointStats(census=P(), transitions=P(), l2_hist=P(),
+                         linf_hist=P(), l2_max=P(), linf_max=P())
+
+
+def place_lineage(mesh, lin: LineageState) -> LineageState:
+    """Place a host-constructed lineage carry with the soup sharding."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.sharded_soup import _soup_axes
+
+    specs = lineage_specs(_soup_axes(mesh))
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        lin, specs)
+
+
+# ---------------------------------------------------------------------------
+# host half: the lineage.jsonl stream + registry metrics
+# ---------------------------------------------------------------------------
+
+
+def window_edge_rows(win: LineageWindow, capacity: int) -> list:
+    """Valid edge rows of a flushed window as a plain list of 5-int lists
+    (all shards' segments in shard order)."""
+    edges = np.asarray(win.edges).reshape(-1, capacity, EDGE_WIDTH)
+    counts = np.asarray(win.n_edges).reshape(-1)
+    rows = []
+    for seg, cnt in zip(edges, counts):
+        rows.extend(seg[: int(cnt)].tolist())
+    return rows
+
+
+def _fixpoint_doc(s: FixpointStats) -> dict:
+    census = np.asarray(s.census)
+    l2m, linfm = float(s.l2_max), float(s.linf_max)
+    return {
+        "census": {name: int(census[i]) for i, name in enumerate(BASIN_NAMES)},
+        "transitions": np.asarray(s.transitions).tolist(),
+        "l2_hist": np.asarray(s.l2_hist).tolist(),
+        "linf_hist": np.asarray(s.linf_hist).tolist(),
+        "l2_max": l2m if math.isfinite(l2m) else None,
+        "linf_max": linfm if math.isfinite(linfm) else None,
+    }
+
+
+def window_record(gen_start: int, gen_end: int, win: LineageWindow,
+                  stats, capacity: int, next_pid: int,
+                  type_names: Optional[Sequence[str]] = None) -> dict:
+    """One flushed window as the ``lineage.jsonl`` row the genealogy layer
+    reads.  ``stats`` is one :class:`FixpointStats` (homogeneous soup) or
+    a per-type sequence (multisoup, with ``type_names`` labels)."""
+    births = np.asarray(win.births).reshape(-1, 2).sum(axis=0)
+    doc = {
+        "kind": "window",
+        "gen_start": int(gen_start),
+        "gen_end": int(gen_end),
+        "edges": window_edge_rows(win, capacity),
+        "edges_dropped": int(np.asarray(win.dropped).sum()),
+        "births_attack": int(births[0]),
+        "births_respawn": int(births[1]),
+        "next_pid": int(next_pid),
+    }
+    if type_names is not None:
+        doc["fixpoints_by_type"] = {
+            name: _fixpoint_doc(s) for name, s in zip(type_names, stats)}
+    else:
+        doc["fixpoints"] = _fixpoint_doc(stats)
+    return doc
+
+
+def probe_record(gen_start: int, gen_end: int, stats,
+                 type_names: Optional[Sequence[str]] = None) -> dict:
+    """Census-only window row for capture-mode chunks (the in-scan carry
+    is unavailable there; an end-of-chunk :func:`fixpoint_stats` probe
+    stands in — no edges, no pids, transitions from the unknown row)."""
+    doc = {"kind": "probe", "gen_start": int(gen_start),
+           "gen_end": int(gen_end)}
+    if type_names is not None:
+        doc["fixpoints_by_type"] = {
+            name: _fixpoint_doc(s) for name, s in zip(type_names, stats)}
+    else:
+        doc["fixpoints"] = _fixpoint_doc(stats)
+    return doc
+
+
+class LineageWriter:
+    """Append-only ``lineage.jsonl`` stream next to the ``.traj`` store.
+
+    One JSON object per line: a header row per writer epoch (a fresh run —
+    or a resume that could not restore the lineage carry — starts a new
+    epoch; pids are unique WITHIN an epoch), then one row per flushed
+    window.  A resume that DID restore the carry passes
+    ``continue_epoch=True`` and its header extends the previous epoch
+    (``"continues": true``) instead of opening a new one.  Writes are
+    plain buffered appends meant to ride the ``BackgroundWriter``
+    (``submit_or_run(writer, lineage.append, row)``), with a flush per
+    row so a killed run keeps every completed window."""
+
+    NAME = "lineage.jsonl"
+
+    def __init__(self, run_dir: str, *, n: int, capacity: int,
+                 epsilon: float, resume: bool = False,
+                 continue_epoch: bool = False,
+                 meta: Optional[dict] = None):
+        self.path = os.path.join(run_dir, self.NAME)
+        last = None
+        if resume and os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    for line in f:
+                        try:
+                            row = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if row.get("kind") == "header":
+                            last = int(row.get("epoch", 0))
+            except OSError:
+                pass
+        continues = continue_epoch and last is not None
+        self.epoch = last if continues else (0 if last is None else last + 1)
+        self._f = open(self.path, "a" if resume else "w")
+        if resume:
+            # a kill mid-append can leave a torn final line with no
+            # newline; writing the header straight after it would glue
+            # the two into one unparseable line and collapse the epoch
+            # boundary (the new epoch's windows would fall into the old
+            # one) — terminate the fragment first
+            try:
+                with open(self.path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    if f.tell() > 0:
+                        f.seek(-1, os.SEEK_END)
+                        if f.read(1) != b"\n":
+                            self._f.write("\n")
+            except OSError:
+                pass
+        header = {"kind": "header", "epoch": self.epoch,
+                  "continues": continues, "n": int(n),
+                  "capacity": int(capacity), "epsilon": float(epsilon),
+                  "basins": list(BASIN_NAMES), "edge_kinds": list(EDGE_NAMES)}
+        header.update(meta or {})
+        self.append(header)
+
+    def append(self, row: dict) -> None:
+        self._f.write(json.dumps(row, separators=(",", ":")) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def update_dynamics_registry(registry, row: dict) -> None:
+    """Fold one flushed window row into the ``soup_dynamics_*`` metrics."""
+    registry.counter("soup_dynamics_windows_total",
+                     help="flushed replication-dynamics windows").inc(1)
+    if "edges" in row:
+        by_kind = {}
+        for e in row["edges"]:
+            by_kind[e[0]] = by_kind.get(e[0], 0) + 1
+        for code, name in enumerate(EDGE_NAMES):
+            registry.counter(
+                "soup_dynamics_edges_total",
+                help="recorded lineage event edges").inc(
+                    by_kind.get(code, 0), kind=name)
+        registry.counter(
+            "soup_dynamics_edges_dropped_total",
+            help="event edges lost to window capacity").inc(
+                int(row.get("edges_dropped", 0)))
+        registry.counter("soup_dynamics_births_total",
+                         help="fresh particle instances minted").inc(
+                             int(row.get("births_attack", 0)), kind="attack")
+        registry.counter("soup_dynamics_births_total",
+                         help="fresh particle instances minted").inc(
+                             int(row.get("births_respawn", 0)),
+                             kind="respawn")
+        registry.gauge("soup_dynamics_next_pid",
+                       help="global lineage mint counter").set(
+                           int(row.get("next_pid", 0)))
+    docs = ([(None, row["fixpoints"])] if "fixpoints" in row
+            else list(row.get("fixpoints_by_type", {}).items()))
+    for tname, doc in docs:
+        labels = {"type": tname} if tname else {}
+        for name, count in doc.get("census", {}).items():
+            registry.gauge("soup_dynamics_basin_particles",
+                           help="particles per fixpoint basin").set(
+                               int(count), basin=name, **labels)
+        trans = doc.get("transitions")
+        if trans:
+            src_names = ("unknown",) + BASIN_NAMES
+            for i, src in enumerate(src_names):
+                for j, dst in enumerate(BASIN_NAMES):
+                    v = int(trans[i][j])
+                    if v:
+                        registry.counter(
+                            "soup_dynamics_basin_transitions_total",
+                            help="window-over-window basin transitions"
+                        ).inc(v, src=src, dst=dst, **labels)
+        for key, metric in (("l2_max", "soup_dynamics_fixpoint_l2_max"),
+                            ("linf_max", "soup_dynamics_fixpoint_linf_max")):
+            if doc.get(key) is not None:
+                registry.gauge(
+                    metric,
+                    help="max finite self-application distance").set(
+                        float(doc[key]), **labels)
+
+
+# ---------------------------------------------------------------------------
+# lineage-carry checkpoint sidecar (mega-loop resume)
+# ---------------------------------------------------------------------------
+
+STATE_NAME = "lineage_state.npz"
+
+
+def save_lineage_state(run_dir: str, lin, gen: int) -> None:
+    """Rolling sidecar next to the orbax checkpoints: the lineage carry at
+    generation ``gen`` (atomic replace so a kill never leaves a torn
+    file).  ``lin`` may be one :class:`LineageState` or a per-type tuple."""
+    # one LineageState (itself a NamedTuple) or a per-type tuple of them
+    lins = (lin,) if hasattr(lin, "next_pid") else tuple(lin)
+    arrays = {"gen": np.int64(gen), "types": np.int64(len(lins))}
+    for t, l in enumerate(lins):
+        for field, v in l._asdict().items():
+            arrays[f"t{t}_{field}"] = np.asarray(v)
+    path = os.path.join(run_dir, STATE_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def load_lineage_state(run_dir: str, expect_gen: int):
+    """Restore the sidecar if it matches the resumed generation; ``None``
+    (caller starts a fresh epoch) otherwise."""
+    path = os.path.join(run_dir, STATE_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            if int(z["gen"]) != int(expect_gen):
+                return None
+            lins = tuple(
+                LineageState(**{f: jnp.asarray(z[f"t{t}_{f}"])
+                                for f in LineageState._fields})
+                for t in range(int(z["types"])))
+    except (OSError, KeyError, ValueError):
+        return None
+    return lins if len(lins) > 1 else lins[0]

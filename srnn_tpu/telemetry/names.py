@@ -37,6 +37,16 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_train_loss_nonfinite_flushes_total": "counter",
     "soup_class_particles": "gauge",
     "soup_class_delta": "gauge",
+    # -- replication dynamics (telemetry.dynamics) -----------------------
+    "soup_dynamics_windows_total": "counter",
+    "soup_dynamics_edges_total": "counter",
+    "soup_dynamics_edges_dropped_total": "counter",
+    "soup_dynamics_births_total": "counter",
+    "soup_dynamics_next_pid": "gauge",
+    "soup_dynamics_basin_particles": "gauge",
+    "soup_dynamics_basin_transitions_total": "counter",
+    "soup_dynamics_fixpoint_l2_max": "gauge",
+    "soup_dynamics_fixpoint_linf_max": "gauge",
     # -- flight recorder (telemetry.flightrec) ---------------------------
     "soup_health_nonfinite_particles": "gauge",
     "soup_health_zero_particles": "gauge",

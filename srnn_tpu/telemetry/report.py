@@ -2,6 +2,7 @@
 
     python -m srnn_tpu.telemetry.report <run_dir> [--json]
     python -m srnn_tpu.telemetry.report --triage <bundle_dir> [--json]
+    python -m srnn_tpu.telemetry.report --dynamics <run_dir> [--json]
 
 Reads ``meta.json`` + ``events.jsonl`` (the ``Experiment`` channel the
 mega-run loops, heartbeats and metric flushes all write through) and
@@ -15,6 +16,11 @@ snapshots mean the last row is the whole story.
 the trip reason and thresholds, the ring tail, the health trajectory
 (NaN/zero fractions + gens/sec over the ring), the population snapshot's
 shapes/dtypes, and a pointer to the captured profiler trace.
+
+``--dynamics`` renders a ``--lineage`` run's replication-dynamics trail
+(``telemetry.genealogy`` over ``lineage.jsonl``): the dominant-lineage
+table, clone-survival stats, attack/imitation graph stats, the basin
+transition matrix and the fixpoint census trajectory.
 """
 
 import argparse
@@ -329,6 +335,76 @@ def _render_triage(s: dict, out) -> None:
         w(f"profiler trace: {s['trace_dir']}\n")
 
 
+# ---------------------------------------------------------------------------
+# replication dynamics (telemetry.genealogy over lineage.jsonl)
+# ---------------------------------------------------------------------------
+
+
+def _census_cells(c: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in c.items() if v) or "-"
+
+
+def _render_dynamics(s: dict, out) -> None:
+    w = out.write
+    header = s["header"]
+    w(f"replication dynamics: {s['run_dir']}\n")
+    w(f"  epoch {header.get('epoch', 0)} (of {s['epochs']}): "
+      f"{header.get('n')} particles, {s['windows']} windows, "
+      f"{s['minted']} instances minted, {s['alive']} alive\n")
+    graph = s["graph"]
+    if graph.get("edges_dropped"):
+        w(f"  NOTE: {graph['edges_dropped']} edges dropped to window "
+          "capacity — graph counts are lower bounds (census/births are "
+          "exact)\n")
+
+    w("dominant lineages (root -> live descendants):\n")
+    w("  root     kind     birth  alive  minted\n")
+    for r in s["dominant_lineages"][:10]:
+        w(f"  {r['root']:<8} {r['kind']:<8} "
+          f"{r['birth'] if r['birth'] is not None else '-':<6} "
+          f"{r['alive']:<6} {r['minted']}\n")
+
+    surv = s["survival"]
+    if surv.get("terminated"):
+        ls = surv["lifespan"]
+        w(f"clone survival: {surv['terminated']} terminated, lifespan "
+          f"p50={ls['p50']} p90={ls['p90']} max={ls['max']} generations\n")
+        w("  survival curve: "
+          + "  ".join(f">={p['generations']}g:{p['fraction']:.0%}"
+                      for p in surv.get("curve", [])) + "\n")
+
+    for name in ("attack", "imitation"):
+        g = graph.get(name, {})
+        if g.get("edges"):
+            top = ", ".join(f"pid {t['pid']} x{t['count']}"
+                            for t in g.get("top", [])[:3])
+            w(f"{name} graph: {g['edges']} edges from {g['actors']} actors, "
+              f"max out-degree {g['max_out_degree']} (top: {top})\n")
+
+    basins = s["basins"]
+    for tname, mat in sorted(s["basin_matrix"].items()):
+        label = f" [{tname}]" if tname else ""
+        w(f"basin transitions{label} (rows: from unknown+basins, cols: "
+          + "/".join(basins) + "):\n")
+        for i, src in enumerate(("unknown",) + tuple(basins)):
+            w(f"  {src:<9} " + " ".join(f"{v:>8}" for v in mat[i]) + "\n")
+
+    traj = s["census_trajectory"]
+    if traj:
+        w("fixpoint census trajectory:\n")
+        for row in traj[-12:]:
+            gen = row.get("gen")
+            probe = " (probe)" if row.get("probe") else ""
+            cells = {k: v for k, v in row.items()
+                     if k not in ("gen", "probe")}
+            if cells and all(isinstance(v, dict) for v in cells.values()):
+                body = "  ".join(f"{t}[{_census_cells(c)}]"
+                                 for t, c in cells.items())
+            else:
+                body = _census_cells(cells)
+            w(f"  gen {gen}: {body}{probe}\n")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__,
@@ -337,6 +413,9 @@ def main(argv=None) -> int:
                                    "triage bundle with --triage)")
     p.add_argument("--triage", action="store_true",
                    help="treat run_dir as a flight-recorder triage bundle")
+    p.add_argument("--dynamics", action="store_true",
+                   help="render the run's replication-dynamics trail "
+                        "(lineage.jsonl via telemetry.genealogy)")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable summary instead of text")
     args = p.parse_args(argv)
@@ -349,6 +428,20 @@ def main(argv=None) -> int:
             print(json.dumps(s, indent=1, default=str))
         else:
             _render_triage(s, sys.stdout)
+        return 0
+    if args.dynamics:
+        from .genealogy import summarize_dynamics
+
+        try:
+            s = summarize_dynamics(args.run_dir)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"report: no lineage stream: {e} (run with --lineage)",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(s, indent=1, default=str))
+        else:
+            _render_dynamics(s, sys.stdout)
         return 0
     s = summarize(args.run_dir)
     if args.json:

@@ -201,6 +201,28 @@ def abstract_soup_state(config, mesh=None) -> "Any":
     return _with_shardings(st, _state_specs(_soup_axes(mesh)), mesh)
 
 
+def abstract_lineage_state(n: int, mesh=None) -> "Any":
+    """``telemetry.dynamics.LineageState`` skeleton for an ``n``-particle
+    population (with ``mesh``: the sharded-soup placement, matching
+    ``telemetry.dynamics.place_lineage``)."""
+    import jax.numpy as jnp
+
+    from ..telemetry.dynamics import LineageState, lineage_specs
+
+    st = LineageState(
+        pid=jax.ShapeDtypeStruct((n,), jnp.int32),
+        parent=jax.ShapeDtypeStruct((n,), jnp.int32),
+        birth=jax.ShapeDtypeStruct((n,), jnp.int32),
+        basin=jax.ShapeDtypeStruct((n,), jnp.int32),
+        next_pid=jax.ShapeDtypeStruct((), jnp.int32),
+    )
+    if mesh is None:
+        return st
+    from ..parallel.sharded_soup import _soup_axes
+
+    return _with_shardings(st, lineage_specs(_soup_axes(mesh)), mesh)
+
+
 def abstract_multi_state(config, mesh=None) -> "Any":
     """``MultiSoupState`` skeleton for a ``MultiSoupConfig`` (with ``mesh``:
     per-type particle axes sharded, matching ``make_sharded_multi_state``)."""
@@ -358,6 +380,15 @@ def _soup_entries(config, generations: int, donate: bool):
            {"generations": generations, "metrics": True})
     yield (f"soup.evolve{tag}.metered.health", run, (config, st),
            {"generations": generations, "metrics": True, "health": True})
+    # the --lineage spelling of the mega loop (replication-dynamics carry;
+    # telemetry.dynamics) — a different program again
+    from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY
+
+    yield (f"soup.evolve{tag}.metered.health.lineage", run, (config, st),
+           {"generations": generations, "metrics": True, "health": True,
+            "lineage": True, "lineage_state": abstract_lineage_state(
+                config.size),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
 
 
 def _multi_entries(config, generations: int, donate: bool):
@@ -376,6 +407,14 @@ def _multi_entries(config, generations: int, donate: bool):
            {"generations": generations, "metrics": True})
     yield (f"multisoup.evolve_multi{tag}.metered.health", run, (config, st),
            {"generations": generations, "metrics": True, "health": True})
+    from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY
+
+    yield (f"multisoup.evolve_multi{tag}.metered.health.lineage", run,
+           (config, st),
+           {"generations": generations, "metrics": True, "health": True,
+            "lineage": True, "lineage_state": tuple(
+                abstract_lineage_state(n) for n in config.sizes),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
 
 
 def _engine_entries(topo, size: int, donate: bool, step_limit: int,
@@ -415,6 +454,14 @@ def _sharded_entries(config, mesh, generations: int, donate: bool):
     yield (f"parallel.sharded_evolve{tag}.metered.health", run,
            (config, mesh, st),
            {"generations": generations, "metrics": True, "health": True})
+    from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY
+
+    yield (f"parallel.sharded_evolve{tag}.metered.health.lineage", run,
+           (config, mesh, st),
+           {"generations": generations, "metrics": True, "health": True,
+            "lineage": True, "lineage_state": abstract_lineage_state(
+                config.size, mesh=mesh),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
 
 
 def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
@@ -436,6 +483,15 @@ def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
     yield (f"parallel.sharded_evolve_multi{tag}.metered.health", run,
            (config, mesh, st),
            {"generations": generations, "metrics": True, "health": True})
+    from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY
+
+    yield (f"parallel.sharded_evolve_multi{tag}.metered.health.lineage", run,
+           (config, mesh, st),
+           {"generations": generations, "metrics": True, "health": True,
+            "lineage": True, "lineage_state": tuple(
+                abstract_lineage_state(n, mesh=mesh)
+                for n in config.sizes),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
 
 
 def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,

@@ -440,6 +440,75 @@ def _render_mega_curve(run_dir: str, path: str) -> List[str]:
     return [out]
 
 
+#: basin label colors for the replication-dynamics panels
+#: (telemetry.dynamics.BASIN_NAMES order: fixpoint/drifting/divergent/zero)
+BASIN_COLORS = ("tab:green", "tab:blue", "tab:red", "tab:gray")
+
+
+def _render_dynamics(run_dir: str, path: str) -> List[str]:
+    """Replication-dynamics panels of a ``--lineage`` run, from the
+    ``lineage.jsonl`` window stream (``telemetry.dynamics``): the fixpoint
+    census trajectory (per-type subplots for a multisoup run) and the
+    per-window event-edge/birth rates.  Renders the CURRENT (last) epoch,
+    like ``report --dynamics``."""
+    from .telemetry.dynamics import BASIN_NAMES
+    from .telemetry.genealogy import census_trajectory, load_lineage
+
+    epoch = load_lineage(path + ".jsonl")[-1]
+    windows = epoch["windows"]
+    traj = census_trajectory(windows)
+    multi = bool(traj) and any(
+        isinstance(v, dict) for row in traj for v in row.values())
+    type_names = sorted({k for row in traj for k, v in row.items()
+                         if isinstance(v, dict)}) if multi else [None]
+
+    n_panels = len(type_names)
+    fig, axes = plt.subplots(1, n_panels + 1,
+                             figsize=(6 * (n_panels + 1), 5))
+    axes = list(np.atleast_1d(axes))
+    gens = [row.get("gen") for row in traj]
+    for t, tname in enumerate(type_names):
+        ax = axes[t]
+        for i, basin in enumerate(BASIN_NAMES):
+            if multi:
+                ys = [(row.get(tname) or {}).get(basin, 0) for row in traj]
+            else:
+                ys = [row.get(basin, 0) for row in traj]
+            ax.plot(gens, ys, color=BASIN_COLORS[i], label=basin)
+        ax.set_title(f"fixpoint census — {tname}" if tname
+                     else "fixpoint census")
+        ax.set_xlabel("generation")
+        ax.set_ylabel("particles")
+        ax.grid(alpha=0.3)
+        if gens:
+            ax.legend(fontsize=8)
+        else:
+            ax.set_title("no dynamics windows logged yet")
+
+    # event-rate panel: births + recorded/dropped edges per window
+    ax = axes[-1]
+    wrows = [w for w in windows if w.get("kind") == "window"]
+    wg = [w.get("gen_end") for w in wrows]
+    for key, label, color in (
+            ("births_attack", "attack births", "tab:purple"),
+            ("births_respawn", "respawn births", "tab:orange"),
+            ("edges_dropped", "edges dropped", "tab:red")):
+        ax.plot(wg, [int(w.get(key, 0)) for w in wrows], label=label,
+                color=color)
+    ax.plot(wg, [len(w.get("edges", ())) for w in wrows],
+            label="edges recorded", color="tab:blue")
+    ax.set_title("replication events per window")
+    ax.set_xlabel("generation")
+    ax.set_ylabel("count")
+    ax.grid(alpha=0.3)
+    if wg:
+        ax.legend(fontsize=8)
+    out = os.path.join(run_dir, "dynamics.png")
+    fig.savefig(out, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    return [out]
+
+
 #: artifact basename -> (renderer(run_dir, artifact_path) -> [outputs],
 #:                        output-file marker prefix)
 RENDERERS = {
@@ -449,6 +518,7 @@ RENDERERS = {
     "all_counters": (_render_counters, "counters"),
     "data": (_render_variation, "variation_box"),
     "config": (_render_mega_curve, "mega_curve"),
+    "lineage": (_render_dynamics, "dynamics"),
 }
 
 
@@ -524,7 +594,7 @@ def search_and_apply(directory: str, redo: bool = False,
             except Exception as e:
                 print(f"viz: skipping {f} in {root}: {e!r}")
         basenames = {f.rsplit(".", 1)[0] for f in files
-                     if f.endswith((".npz", ".json"))}
+                     if f.endswith((".npz", ".json", ".jsonl"))}
         for base, (renderer, marker) in RENDERERS.items():
             if base not in basenames:
                 continue
@@ -546,6 +616,12 @@ def search_and_apply(directory: str, redo: bool = False,
                 ev = os.path.join(root, "events.jsonl")
                 done_marker = not os.path.exists(ev) or \
                     os.path.getmtime(png) >= os.path.getmtime(ev)
+            if base == "lineage" and done_marker:
+                # lineage.jsonl is append-only too (resumes extend it)
+                png = os.path.join(render_dir, marker + ".png")
+                src = os.path.join(root, "lineage.jsonl")
+                done_marker = not os.path.exists(src) or \
+                    os.path.getmtime(png) >= os.path.getmtime(src)
             if done_marker and not redo:
                 continue
             try:

@@ -18,16 +18,64 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_test_cache")
 # A test run hard-killed mid-compile can leave a truncated entry in the
 # shared compilation cache, and XLA SEGFAULTS deserializing it on every
 # later run (observed: repeatable crash in backend_compile_and_load until
-# the cache was wiped).  Crash detection: a PER-SESSION marker file
+# the poisoned entry was gone).  Crash detection: a PER-SESSION marker file
 # (.session_running.<pid>) exists for the duration of each session, so
 # concurrent sessions never clobber each other's markers; finding a marker
-# whose owner pid is dead at startup means that run died uncleanly — wipe
-# the cache, unless another session is LIVE right now (its in-flight
-# compiles would be yanked out from under it; the poison, if any, will be
+# whose owner pid is dead at startup means that run died uncleanly.
+#
+# Recovery is SELECTIVE, not a wholesale wipe: a torn entry can only be
+# one the dead session was writing AT the moment it died, so only the
+# TAIL of its writes is suspect — files modified within a short window
+# before the dead session's newest cache write (its last act before the
+# kill), plus zero-length files (a torn write at any age).  Everything
+# else it wrote completed normally and stays; this is what keeps tier-1
+# warm inside its 870s budget.  (Two earlier policies both failed: a full
+# wipe cost ~200s of recompiles after EVERY killed session, and purging
+# everything-since-session-start re-cooled exactly the entries a
+# timed-out run had just compiled, so a suite that timed out once could
+# never re-warm — each retry purged the previous retry's work.)  If
+# another session is LIVE right now, nothing is removed (its in-flight
+# compiles would be yanked out from under it; the poison, if any, is
 # caught by whichever session starts after everything quiesces).
 _CACHE_DIR = os.environ["JAX_COMPILATION_CACHE_DIR"]
 _CRASH_MARKER = os.path.join(
     _CACHE_DIR, f".session_running.{os.getpid()}") if _CACHE_DIR else None
+_PURGE_TAIL_S = 60.0
+
+
+def _purge_suspect_cache_entries(cache_dir, since_mtime, tail_only=True):
+    """Remove the cache entries a crashed session may have left torn:
+    zero-length files, and — with ``tail_only`` (the single-crash case) —
+    files modified within ``_PURGE_TAIL_S`` of the newest
+    post-``since_mtime`` write (the dead session's final moments; a kill
+    tears at most the write in flight, not the whole run's output).  With
+    ``tail_only=False`` (several dead sessions at once: their death times
+    are indistinguishable, so a single global tail could miss the
+    earlier-killed session's torn entry) everything since ``since_mtime``
+    goes.  Marker files manage themselves."""
+    try:
+        with os.scandir(cache_dir) as entries:
+            stats = [(e.path, e.stat()) for e in entries
+                     if e.is_file()
+                     and not e.name.startswith(".session_running")]
+    except OSError:
+        return 0
+    newest = max((st.st_mtime for _p, st in stats
+                  if st.st_mtime >= since_mtime), default=None)
+    removed = 0
+    for path, st in stats:
+        suspect = newest is not None and st.st_mtime >= (
+            max(since_mtime, newest - _PURGE_TAIL_S) if tail_only
+            else since_mtime)
+        if st.st_size == 0 or suspect:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
 if _CRASH_MARKER:
     import glob as _glob
 
@@ -51,11 +99,17 @@ if _CRASH_MARKER:
         (_live if _owner and os.path.exists(f"/proc/{_owner}")
          else _stale).append(_legacy)
     if _stale and not _live:
-        shutil.rmtree(_CACHE_DIR, ignore_errors=True)
-    else:
-        for _m in _stale:  # dead markers under a live session: just tidy
-            try:
-                os.remove(_m)
+        # earliest dead-session start bounds every suspect write; with
+        # SEVERAL dead sessions their death times can't be told apart, so
+        # the warm-friendly tail heuristic degrades to the full
+        # since-marker purge for that (rare) case
+        _since = min((os.path.getmtime(_m) for _m in _stale
+                      if os.path.exists(_m)), default=0.0)
+        _purge_suspect_cache_entries(_CACHE_DIR, _since,
+                                     tail_only=len(_stale) == 1)
+        for _m in _stale:  # tidy ONLY after the purge actually ran —
+            try:           # removing a dead marker while another session
+                os.remove(_m)  # is live would forget its poison forever
             except OSError:
                 pass
     os.makedirs(_CACHE_DIR, exist_ok=True)
@@ -79,6 +133,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 import pytest
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; register the marker so strict runs and
+    # --markers stay clean
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight e2e tests excluded from the tier-1 budget "
+        "(run explicitly or with -m slow)")
 
 
 @pytest.fixture(scope="session")

@@ -184,11 +184,12 @@ def test_engine_and_multi_warmup_entries():
     assert "engine.run_training.donated" in entries
     # non-donating sweep compiles the value-preserving spellings separately
     # (plus the telemetry-metered chunk run the production loops dispatch,
-    # with and without the flight recorder's health sentinels)
+    # with/without the flight recorder's health sentinels and with the
+    # replication-dynamics lineage carry)
     plain = aot.warmup(cfg, generations=2, donate=False)
-    assert {r["entry"] for r in plain} == {"soup.evolve_step", "soup.evolve",
-                                           "soup.evolve.metered",
-                                           "soup.evolve.metered.health"}
+    assert {r["entry"] for r in plain} == {
+        "soup.evolve_step", "soup.evolve", "soup.evolve.metered",
+        "soup.evolve.metered.health", "soup.evolve.metered.health.lineage"}
     assert not any(r["cached"] for r in plain)
 
 

@@ -17,6 +17,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "bench.py")
 
@@ -34,6 +36,7 @@ def _run_bench(extra_env, timeout=600):
     return proc.returncode, json.loads(lines[0])
 
 
+@pytest.mark.slow
 def test_hung_full_stage_still_reports_ramp_number():
     rc, out = _run_bench({
         "SRNN_BENCH_TEST_HANG": "full",      # full stage wedges forever
@@ -65,6 +68,7 @@ def test_hung_ramp_recovers_via_full_stage():
     assert out["backend"] == "cpu-forced"
 
 
+@pytest.mark.slow
 def test_persistent_wedge_reserves_rescue_budget():
     # production-shaped proportions: stage timeouts large relative to the
     # deadline.  The rescue reserve (RESCUE_RESERVE_S=330) must clamp the
@@ -97,6 +101,7 @@ def test_all_stages_wedged_lands_cpu_rescue_number():
     assert "timeout" in out["error"]
 
 
+@pytest.mark.slow
 def test_stalled_child_names_triage_bundle(tmp_path):
     """The flight-recorder satellite: a wedged child's stall sentinel
     fires INSIDE the attempt timeout, writes a host-only triage bundle,

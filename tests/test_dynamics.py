@@ -1,0 +1,587 @@
+"""Replication-dynamics observatory (telemetry.dynamics / genealogy).
+
+Four layers of coverage:
+
+  * **bit-identity** — ``lineage=True`` leaves the evolved population
+    bit-identical to the plain program on both layouts, the multisoup,
+    and the sharded twins (the same guarantee the metrics/health carries
+    give).
+  * **NumPy recount** — the device-side pid minting and event-edge
+    buffers are recomputed on host from an independent replay of the
+    step's phase draws (gates/targets from the same key-split structure,
+    deaths from the uid trail) and must match exactly.
+  * **sharded parity** — globally-unique pids everywhere; the popmajor
+    sharded path assigns BIT-IDENTICAL pids/edges to the single-device
+    run (the documented lineage extension of its bitwise contract).
+  * **host round-trip** — events -> lineage.jsonl -> genealogy forest ->
+    ``report --dynamics`` renders a dominant-lineage table and fixpoint
+    census from a real ``mega_soup`` run end to end, and the resume
+    sidecar continues the pid epoch.
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import multisoup, soup
+from srnn_tpu.telemetry import dynamics, genealogy, report
+from srnn_tpu.topology import Topology
+
+WW = Topology("weightwise", width=2, depth=2)
+AGG = Topology("aggregating", width=2, depth=2, aggregates=4)
+
+
+def _cfg(layout="popmajor", **kw):
+    kw.setdefault("respawn_draws",
+                  "fused" if layout == "popmajor" else "perparticle")
+    return soup.SoupConfig(
+        topo=WW, size=64, attacking_rate=0.3, learn_from_rate=0.2, train=0,
+        remove_divergent=True, remove_zero=True, layout=layout, **kw)
+
+
+def _evolve_lineage(cfg, st, gens, cap=512):
+    lin = dynamics.seed_lineage(cfg.size, time=int(st.time))
+    return soup.evolve(cfg, st, generations=gens, lineage=True,
+                       lineage_state=lin, lineage_capacity=cap)
+
+
+# --------------------------------------------------------------- identity
+
+
+@pytest.mark.parametrize("layout", ["rowmajor", "popmajor"])
+def test_lineage_state_bit_identical(layout):
+    cfg = _cfg(layout)
+    st = soup.seed(cfg, jax.random.key(0))
+    plain = soup.evolve(cfg, st, generations=5)
+    final, (lin, win, stats) = _evolve_lineage(cfg, st, 5)
+    np.testing.assert_array_equal(np.asarray(plain.weights),
+                                  np.asarray(final.weights))
+    np.testing.assert_array_equal(np.asarray(plain.uids),
+                                  np.asarray(final.uids))
+    assert int(plain.next_uid) == int(final.next_uid)
+    # metrics/health spellings compose with lineage unchanged
+    m_plain = soup.evolve(cfg, st, generations=5, metrics=True)[1]
+    out = soup.evolve(cfg, st, generations=5, metrics=True, health=True,
+                      lineage=True,
+                      lineage_state=dynamics.seed_lineage(cfg.size),
+                      lineage_capacity=512)
+    np.testing.assert_array_equal(np.asarray(m_plain.actions),
+                                  np.asarray(out[1].actions))
+    np.testing.assert_array_equal(np.asarray(out[0].weights),
+                                  np.asarray(final.weights))
+
+
+def test_lineage_requires_parallel_mode_and_state():
+    cfg = _cfg("rowmajor")._replace(mode="sequential")
+    st = soup.seed(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="parallel"):
+        soup.evolve(cfg, st, generations=1, lineage=True,
+                    lineage_state=dynamics.seed_lineage(cfg.size))
+    with pytest.raises(ValueError, match="lineage_state"):
+        soup.evolve(_cfg(), st, generations=1, lineage=True)
+
+
+# ---------------------------------------------------------- NumPy recount
+
+
+def _replay_masks(cfg, state):
+    """Independently re-derive one generation's phase draws from the
+    state's key (the step's exact split structure)."""
+    n = cfg.size
+    _key, k_ag, k_at, k_lg, k_lt, _k_re = jax.random.split(state.key, 6)
+    attack_gate = np.asarray(jax.random.uniform(k_ag, (n,))
+                             < cfg.attacking_rate)
+    attack_tgt = np.asarray(jax.random.randint(k_at, (n,), 0, n))
+    att_idx = np.full(n, -1, np.int64)
+    for lane in range(n):  # last-attacker-wins, by construction
+        if attack_gate[lane]:
+            att_idx[attack_tgt[lane]] = max(att_idx[attack_tgt[lane]], lane)
+    learn_gate = np.asarray(jax.random.uniform(k_lg, (n,))
+                            < cfg.learn_from_rate)
+    learn_tgt = np.asarray(jax.random.randint(k_lt, (n,), 0, n))
+    return att_idx, learn_gate, learn_tgt
+
+
+@pytest.mark.parametrize("layout", ["rowmajor", "popmajor"])
+def test_lineage_numpy_recount(layout):
+    """Full host recount of the pid mints + edge stream: replay the phase
+    draws, walk the uid trail for deaths, and rebuild every window row."""
+    cfg = _cfg(layout)
+    n, gens = cfg.size, 5
+    st = soup.seed(cfg, jax.random.key(3))
+    # ground-truth state trail, one generation at a time
+    states = [st]
+    for _ in range(gens):
+        states.append(soup.evolve(cfg, states[-1], generations=1))
+
+    pid = np.arange(n, dtype=np.int64)
+    parent = np.full(n, -1, np.int64)
+    birth = np.zeros(n, np.int64)
+    next_pid = n
+    edges = []
+    for t in range(gens):
+        att_idx, learn_gate, learn_tgt = _replay_masks(cfg, states[t])
+        dead = (np.asarray(states[t].uids)
+                != np.asarray(states[t + 1].uids))
+        old = pid.copy()
+        # attack mints, lane order
+        for lane in np.nonzero(att_idx >= 0)[0]:
+            src = old[att_idx[lane]]
+            pid[lane] = next_pid
+            parent[lane] = src
+            birth[lane] = t
+            next_pid += 1
+            edges.append([dynamics.EDGE_ATTACK, t, src, pid[lane],
+                          old[lane]])
+        mid = pid.copy()
+        for lane in np.nonzero(learn_gate)[0]:
+            edges.append([dynamics.EDGE_LEARN, t, mid[learn_tgt[lane]],
+                          mid[lane], -1])
+        for lane in np.nonzero(dead)[0]:
+            pid[lane] = next_pid
+            parent[lane] = -1
+            birth[lane] = t
+            next_pid += 1
+            edges.append([dynamics.EDGE_RESPAWN, t, -1, pid[lane],
+                          mid[lane]])
+
+    final, (lin, win, _stats) = _evolve_lineage(cfg, st, gens, cap=2048)
+    np.testing.assert_array_equal(np.asarray(final.weights),
+                                  np.asarray(states[-1].weights))
+    np.testing.assert_array_equal(np.asarray(lin.pid), pid)
+    np.testing.assert_array_equal(np.asarray(lin.parent), parent)
+    np.testing.assert_array_equal(np.asarray(lin.birth), birth)
+    assert int(lin.next_pid) == next_pid
+    got = dynamics.window_edge_rows(win, 2048)
+    assert got == edges
+    assert int(np.asarray(win.dropped).sum()) == 0
+    births = np.asarray(win.births).reshape(-1, 2).sum(axis=0)
+    assert births[0] == sum(1 for e in edges
+                            if e[0] == dynamics.EDGE_ATTACK)
+    assert births[1] == sum(1 for e in edges
+                            if e[0] == dynamics.EDGE_RESPAWN)
+
+
+@pytest.mark.parametrize("layout", ["rowmajor", "popmajor"])
+def test_multi_lineage_identity_and_consistency(layout):
+    cfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(24, 16), attacking_rate=0.3,
+        learn_from_rate=0.2, train=0, remove_divergent=True,
+        remove_zero=True, layout=layout)
+    st = multisoup.seed_multi(cfg, jax.random.key(0))
+    lins = dynamics.seed_lineage_blocks(cfg.sizes)
+    plain = multisoup.evolve_multi(cfg, st, generations=4)
+    final, (lins2, win, stats) = multisoup.evolve_multi(
+        cfg, st, generations=4, lineage=True, lineage_state=lins,
+        lineage_capacity=512)
+    for a, b in zip(plain.weights, final.weights):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # one shared pid space: globally unique, every carry on the same counter
+    pids = np.concatenate([np.asarray(l.pid) for l in lins2])
+    assert len(set(pids.tolist())) == cfg.total
+    assert len({int(l.next_pid) for l in lins2}) == 1
+    # edge recount against the carries: every attack/respawn edge's dst is
+    # a minted pid; counts match the exact birth counters
+    rows = dynamics.window_edge_rows(win, 512)
+    births = np.asarray(win.births).reshape(-1, 2).sum(axis=0)
+    n_att = sum(1 for r in rows if r[0] == dynamics.EDGE_ATTACK)
+    n_re = sum(1 for r in rows if r[0] == dynamics.EDGE_RESPAWN)
+    assert int(np.asarray(win.dropped).sum()) == 0
+    assert (births[0], births[1]) == (n_att, n_re)
+    assert int(lins2[0].next_pid) == cfg.total + n_att + n_re
+    # per-type census covers every particle
+    for n_t, s in zip(cfg.sizes, stats):
+        assert int(np.asarray(s.census).sum()) == n_t
+
+
+def test_multi_lineage_numpy_recount_rowmajor():
+    """Multisoup recount: replay the global attack draw + per-type learn
+    draws and the per-type uid trails; mint bases must chain type-major
+    through one shared counter."""
+    cfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(12, 8), attacking_rate=0.4,
+        learn_from_rate=0.3, train=0, remove_divergent=True,
+        remove_zero=True, layout="rowmajor")
+    n, gens = cfg.total, 3
+    offs = cfg.offsets
+    st = multisoup.seed_multi(cfg, jax.random.key(5))
+    states = [st]
+    for _ in range(gens):
+        states.append(multisoup.evolve_multi(cfg, states[-1],
+                                             generations=1))
+
+    pid = [np.arange(offs[t], offs[t + 1], dtype=np.int64)
+           for t in range(2)]
+    next_pid = n
+    edges = []
+    for t in range(gens):
+        s0 = states[t]
+        _key, k_ag, k_at, _k_lg, k_lt, _k_re = jax.random.split(s0.key, 6)
+        attack_gate = np.asarray(jax.random.uniform(k_ag, (n,))
+                                 < cfg.attacking_rate)
+        attack_tgt = np.asarray(jax.random.randint(k_at, (n,), 0, n))
+        att_idx = np.full(n, -1, np.int64)
+        for lane in range(n):
+            if attack_gate[lane]:
+                att_idx[attack_tgt[lane]] = max(att_idx[attack_tgt[lane]],
+                                                lane)
+        _k_lg_arr = np.asarray(jax.random.uniform(_k_lg, (n,)))
+        all_pid0 = np.concatenate(pid)
+
+        def owner(g):  # pid of a global index
+            return all_pid0[g]
+
+        for ty in range(2):
+            n_t = cfg.sizes[ty]
+            att_b = att_idx[offs[ty]:offs[ty + 1]]
+            old = pid[ty].copy()
+            for lane in np.nonzero(att_b >= 0)[0]:
+                src = owner(att_b[lane])
+                pid[ty][lane] = next_pid
+                next_pid += 1
+                edges.append([dynamics.EDGE_ATTACK, t, src,
+                              pid[ty][lane], old[lane]])
+            mid = pid[ty].copy()
+            learn_gate = _k_lg_arr[offs[ty]:offs[ty + 1]] \
+                < cfg.learn_from_rate
+            learn_tgt = np.asarray(jax.random.randint(
+                jax.random.fold_in(k_lt, ty), (n_t,), 0, n_t))
+            for lane in np.nonzero(learn_gate)[0]:
+                edges.append([dynamics.EDGE_LEARN, t,
+                              mid[learn_tgt[lane]], mid[lane], -1])
+            dead = (np.asarray(states[t].uids[ty])
+                    != np.asarray(states[t + 1].uids[ty]))
+            for lane in np.nonzero(dead)[0]:
+                pid[ty][lane] = next_pid
+                next_pid += 1
+                edges.append([dynamics.EDGE_RESPAWN, t, -1,
+                              pid[ty][lane], mid[lane]])
+
+    lins = dynamics.seed_lineage_blocks(cfg.sizes)
+    final, (lins2, win, _stats) = multisoup.evolve_multi(
+        cfg, st, generations=gens, lineage=True, lineage_state=lins,
+        lineage_capacity=1024)
+    for ty in range(2):
+        np.testing.assert_array_equal(np.asarray(lins2[ty].pid), pid[ty])
+    assert int(lins2[0].next_pid) == next_pid
+    assert dynamics.window_edge_rows(win, 1024) == edges
+
+
+# ------------------------------------------------------------- sharded
+
+
+def test_sharded_lineage_popmajor_bitwise_parity(mesh):
+    """Sharded-global ids: unique across shards AND (popmajor) bit-identical
+    pids/parents/births/edges/census to the single-device run."""
+    from srnn_tpu.parallel import make_sharded_state
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve
+
+    cfg = _cfg("popmajor")
+    st = make_sharded_state(cfg, mesh, jax.random.key(0))
+    lin = dynamics.place_lineage(mesh, dynamics.seed_lineage(cfg.size))
+    plain = sharded_evolve(cfg, mesh, st, generations=5)
+    final, (lin2, win, fs) = sharded_evolve(
+        cfg, mesh, st, generations=5, lineage=True, lineage_state=lin,
+        lineage_capacity=64)
+    np.testing.assert_array_equal(np.asarray(plain.weights),
+                                  np.asarray(final.weights))
+    pids = np.asarray(lin2.pid)
+    assert len(set(pids.tolist())) == cfg.size
+
+    st1 = soup.seed(cfg, jax.random.key(0))
+    f1, (l1, w1, fs1) = _evolve_lineage(cfg, st1, 5, cap=512)
+    np.testing.assert_array_equal(np.asarray(l1.pid), pids)
+    np.testing.assert_array_equal(np.asarray(l1.parent),
+                                  np.asarray(lin2.parent))
+    np.testing.assert_array_equal(np.asarray(l1.birth),
+                                  np.asarray(lin2.birth))
+    assert int(l1.next_pid) == int(lin2.next_pid)
+    # per-shard windows concatenate; the edge MULTISET matches exactly
+    assert sorted(map(tuple, dynamics.window_edge_rows(win, 64))) == \
+        sorted(map(tuple, dynamics.window_edge_rows(w1, 512)))
+    np.testing.assert_array_equal(np.asarray(fs.census),
+                                  np.asarray(fs1.census))
+    np.testing.assert_array_equal(np.asarray(fs.transitions),
+                                  np.asarray(fs1.transitions))
+
+
+def test_sharded_lineage_rowmajor_unique_and_identity(mesh):
+    from srnn_tpu.parallel import make_sharded_state
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve
+
+    cfg = _cfg("rowmajor")
+    st = make_sharded_state(cfg, mesh, jax.random.key(1))
+    lin = dynamics.place_lineage(mesh, dynamics.seed_lineage(cfg.size))
+    plain = sharded_evolve(cfg, mesh, st, generations=4)
+    final, (lin2, win, fs) = sharded_evolve(
+        cfg, mesh, st, generations=4, lineage=True, lineage_state=lin,
+        lineage_capacity=64)
+    np.testing.assert_array_equal(np.asarray(plain.weights),
+                                  np.asarray(final.weights))
+    assert len(set(np.asarray(lin2.pid).tolist())) == cfg.size
+    assert int(np.asarray(fs.census).sum()) == cfg.size
+
+
+def test_sharded_multi_lineage_parity(mesh):
+    from srnn_tpu.parallel import make_sharded_multi_state
+    from srnn_tpu.parallel.sharded_multisoup import sharded_evolve_multi
+
+    cfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(24, 16), attacking_rate=0.3,
+        learn_from_rate=0.2, train=0, remove_divergent=True,
+        remove_zero=True, layout="popmajor")
+    st = make_sharded_multi_state(cfg, mesh, jax.random.key(0))
+    lins = tuple(dynamics.place_lineage(mesh, l)
+                 for l in dynamics.seed_lineage_blocks(cfg.sizes))
+    plain = sharded_evolve_multi(cfg, mesh, st, generations=4)
+    final, (lins2, win, stats) = sharded_evolve_multi(
+        cfg, mesh, st, generations=4, lineage=True, lineage_state=lins,
+        lineage_capacity=64)
+    for a, b in zip(plain.weights, final.weights):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    pids = np.concatenate([np.asarray(l.pid) for l in lins2])
+    assert len(set(pids.tolist())) == cfg.total
+
+    st1 = multisoup.seed_multi(cfg, jax.random.key(0))
+    f1, (l1, w1, s1) = multisoup.evolve_multi(
+        cfg, st1, generations=4, lineage=True,
+        lineage_state=dynamics.seed_lineage_blocks(cfg.sizes),
+        lineage_capacity=512)
+    for a, b in zip(l1, lins2):
+        np.testing.assert_array_equal(np.asarray(a.pid), np.asarray(b.pid))
+    assert sorted(map(tuple, dynamics.window_edge_rows(win, 64))) == \
+        sorted(map(tuple, dynamics.window_edge_rows(w1, 512)))
+
+
+# ----------------------------------------------------- capacity overflow
+
+
+def test_edge_capacity_overflow_drops_and_counts():
+    cfg = _cfg("popmajor")
+    st = soup.seed(cfg, jax.random.key(0))
+    _, (lin_big, win_big, _) = _evolve_lineage(cfg, st, 5, cap=2048)
+    _, (lin_small, win_small, _) = _evolve_lineage(cfg, st, 5, cap=8)
+    total = int(np.asarray(win_big.n_edges).sum())
+    kept = int(np.asarray(win_small.n_edges).sum())
+    dropped = int(np.asarray(win_small.dropped).sum())
+    assert kept == 8 and dropped == total - kept and dropped > 0
+    # the sampled prefix is the full stream's prefix
+    assert dynamics.window_edge_rows(win_small, 8) == \
+        dynamics.window_edge_rows(win_big, 2048)[:8]
+    # births/pids are mask-sums, not buffer reads: exact despite the drops
+    np.testing.assert_array_equal(np.asarray(win_small.births),
+                                  np.asarray(win_big.births))
+    np.testing.assert_array_equal(np.asarray(lin_small.pid),
+                                  np.asarray(lin_big.pid))
+
+
+# -------------------------------------------------- fixpoint census math
+
+
+def test_fixpoint_census_and_transitions_crafted():
+    n, p = 6, WW.num_weights
+    w = np.zeros((n, p), np.float32)
+    w[0] = 0.0                      # zero basin
+    w[1] = np.nan                   # divergent (weights nonfinite)
+    w[2] = 3.0                      # drifting (linear ww: f(w) != w)
+    w[3] = 1e9                      # drifting but large
+    w[4] = 5e-5                     # inside epsilon -> zero basin
+    w[5] = 2.0
+    stats = soup.probe_dynamics(WW, jnp.asarray(w), 1e-4)
+    census = np.asarray(stats.census)
+    assert census[dynamics.BASIN_ZERO] == 2
+    assert census[dynamics.BASIN_DIV] >= 1
+    assert census.sum() == n
+    # probe transitions come from the unknown row only
+    trans = np.asarray(stats.transitions)
+    assert trans[0].sum() == n and trans[1:].sum() == 0
+
+    # close_window folds the carried labels into the transition matrix
+    prev = jnp.asarray(np.full(n, dynamics.BASIN_DRIFT, np.int32))
+    lin = dynamics.seed_lineage(n)._replace(basin=prev)
+    fw = jnp.asarray(w)  # pretend f(w) == w: every finite particle "fixed"
+    lin2, s2 = dynamics.close_window(lin, jnp.asarray(w), fw, -1, 1e-4)
+    t2 = np.asarray(s2.transitions)
+    # every particle transitions FROM the drifting row (prev labels)
+    assert t2[1 + dynamics.BASIN_DRIFT].sum() == n and t2[0].sum() == 0
+    # zero-basin precedence beats the fixpoint label (reference class order)
+    c2 = np.asarray(s2.census)
+    assert c2[dynamics.BASIN_ZERO] == 2
+    assert c2[dynamics.BASIN_FIX] == n - 2 - c2[dynamics.BASIN_DIV]
+    # the new labels were stored for the NEXT window's transitions
+    np.testing.assert_array_equal(
+        np.asarray(dynamics.close_window(lin2, jnp.asarray(w), fw, -1,
+                                         1e-4)[1].transitions)[0].sum(), 0)
+
+
+def test_census_matches_numpy_recount_after_run():
+    from srnn_tpu.nets import apply_to_weights
+
+    cfg = _cfg("popmajor")
+    st = soup.seed(cfg, jax.random.key(2))
+    final, (lin, win, stats) = _evolve_lineage(cfg, st, 4)
+    w = np.asarray(final.weights)
+    fw = np.asarray(jax.vmap(
+        lambda wi: apply_to_weights(cfg.topo, wi, wi))(final.weights))
+    linf = np.max(np.abs(fw - w), axis=-1)
+    div = ~np.isfinite(w).all(axis=-1) | ~np.isfinite(linf)
+    zero = (np.abs(w) <= cfg.epsilon).all(axis=-1) & ~div
+    fix = ~div & ~zero & (linf < cfg.epsilon)
+    drift = ~(div | zero | fix)
+    expect = [fix.sum(), drift.sum(), div.sum(), zero.sum()]
+    np.testing.assert_array_equal(np.asarray(stats.census), expect)
+    np.testing.assert_array_equal(np.asarray(lin.basin),
+                                  np.select([div, zero, fix],
+                                            [dynamics.BASIN_DIV,
+                                             dynamics.BASIN_ZERO,
+                                             dynamics.BASIN_FIX],
+                                            dynamics.BASIN_DRIFT))
+
+
+# ------------------------------------------------------- host round-trip
+
+
+def test_genealogy_roundtrip_writer_forest_report(tmp_path, capsys):
+    cfg = _cfg("popmajor")
+    st = soup.seed(cfg, jax.random.key(0))
+    run_dir = str(tmp_path)
+    writer = dynamics.LineageWriter(run_dir, n=cfg.size, capacity=512,
+                                    epsilon=cfg.epsilon)
+    lin = dynamics.seed_lineage(cfg.size)
+    gen = 0
+    for _ in range(3):
+        st, (lin, win, stats) = soup.evolve(
+            cfg, st, generations=4, lineage=True, lineage_state=lin,
+            lineage_capacity=512)
+        row = dynamics.window_record(gen, gen + 4, win, stats, 512,
+                                     next_pid=int(lin.next_pid))
+        writer.append(row)
+        gen += 4
+    writer.close()
+
+    epochs = genealogy.load_lineage(run_dir)
+    assert len(epochs) == 1 and len(epochs[0]["windows"]) == 3
+    forest = genealogy.build_forest(epochs[0])
+    assert forest.dropped == 0
+    # forest state agrees with the device carry: live pids == current pids
+    assert sorted(forest.alive) == sorted(np.asarray(lin.pid).tolist())
+    for lane, p in enumerate(np.asarray(lin.pid).tolist()):
+        assert forest.birth[p] == int(np.asarray(lin.birth)[lane])
+        assert forest.parent[p] == int(np.asarray(lin.parent)[lane])
+    assert len(forest.parent) == int(lin.next_pid)
+    rows = genealogy.dominant_lineages(forest)
+    assert rows and sum(r["alive"] for r in
+                        genealogy.dominant_lineages(forest, top=10**9)) \
+        == cfg.size
+    surv = genealogy.survival_stats(forest)
+    assert surv["terminated"] == int(lin.next_pid) - cfg.size
+    traj = genealogy.census_trajectory(epochs[0]["windows"])
+    assert [r["gen"] for r in traj] == [4, 8, 12]
+
+    # the CLI renders the dominant-lineage table + census trajectory
+    assert report.main(["--dynamics", run_dir]) == 0
+    out = capsys.readouterr().out
+    assert "dominant lineages" in out
+    assert "fixpoint census trajectory" in out
+    assert report.main(["--dynamics", run_dir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["minted"] == int(lin.next_pid)
+
+
+def test_lineage_state_sidecar_roundtrip(tmp_path):
+    lin = dynamics.seed_lineage(16)
+    dynamics.save_lineage_state(str(tmp_path), lin, gen=7)
+    got = dynamics.load_lineage_state(str(tmp_path), 7)
+    assert got is not None and hasattr(got, "next_pid")  # one LineageState
+    np.testing.assert_array_equal(np.asarray(got.pid), np.asarray(lin.pid))
+    assert dynamics.load_lineage_state(str(tmp_path), 8) is None
+    lins = dynamics.seed_lineage_blocks((8, 8))
+    dynamics.save_lineage_state(str(tmp_path), lins, gen=3)
+    got = dynamics.load_lineage_state(str(tmp_path), 3)
+    assert not hasattr(got, "next_pid") and len(got) == 2  # per-type tuple
+    np.testing.assert_array_equal(np.asarray(got[1].pid),
+                                  np.asarray(lins[1].pid))
+
+
+def test_dynamics_registry_metric_names(tmp_path):
+    from srnn_tpu.telemetry.metrics import MetricsRegistry
+    from srnn_tpu.telemetry.names import CANONICAL_METRICS
+
+    cfg = _cfg("popmajor")
+    st = soup.seed(cfg, jax.random.key(0))
+    _, (lin, win, stats) = _evolve_lineage(cfg, st, 3)
+    row = dynamics.window_record(0, 3, win, stats, 512,
+                                 next_pid=int(lin.next_pid))
+    reg = MetricsRegistry()
+    dynamics.update_dynamics_registry(reg, row)
+    prom = str(tmp_path / "dyn_test.prom")
+    reg.write_textfile(prom)
+    with open(prom) as f:
+        text = f.read()
+    assert "srnn_soup_dynamics_windows_total" in text
+    assert "srnn_soup_dynamics_basin_particles" in text
+    for name in ("soup_dynamics_edges_total", "soup_dynamics_births_total",
+                 "soup_dynamics_next_pid"):
+        assert name in CANONICAL_METRICS and name in text
+
+
+# -------------------------------------------------------------- e2e mega
+
+
+def test_mega_soup_lineage_e2e_report_and_resume(tmp_path, capsys):
+    """The acceptance scenario: a real (smoke-scale) mega_soup run with
+    --lineage writes the lineage.jsonl stream, `report --dynamics` renders
+    the dominant-lineage table + fixpoint census from it, and a resumed
+    run CONTINUES the pid epoch from the sidecar."""
+    from srnn_tpu.setups import REGISTRY
+
+    d = REGISTRY["mega_soup"](["--smoke", "--lineage",
+                               "--root", str(tmp_path / "run")])
+    path = os.path.join(d, "lineage.jsonl")
+    assert os.path.exists(path)
+    epochs = genealogy.load_lineage(d)
+    assert len(epochs) == 1
+    assert len(epochs[0]["windows"]) == 3          # 6 gens / 2-gen chunks
+    assert epochs[0]["header"]["n"] == 64
+    assert os.path.exists(os.path.join(d, "lineage_state.npz"))
+    # dynamics metrics reached the prom sink
+    prom = open(os.path.join(d, "metrics.prom")).read()
+    assert "srnn_soup_dynamics_windows_total 3" in prom
+
+    assert report.main(["--dynamics", d]) == 0
+    out = capsys.readouterr().out
+    assert "dominant lineages" in out and "fixpoint census" in out
+
+    # resume: two more generations continue the same epoch and pid space
+    # (--lineage is an observability knob like --no-health: CLI-controlled,
+    # not persisted in config.json — pass it again on resume)
+    d2 = REGISTRY["mega_soup"](["--smoke", "--generations", "8",
+                                "--lineage", "--resume", d])
+    assert d2 == d
+    epochs = genealogy.load_lineage(d)
+    assert len(epochs) == 1, "restored carry must continue the epoch"
+    assert len(epochs[0]["windows"]) == 4
+    forest = genealogy.build_forest(epochs[0])
+    assert len(forest.alive) == 64
+
+
+def test_mega_multisoup_lineage_e2e(tmp_path):
+    from srnn_tpu.setups import REGISTRY
+
+    d = REGISTRY["mega_multisoup"](["--smoke", "--lineage",
+                                    "--root", str(tmp_path / "run")])
+    epochs = genealogy.load_lineage(d)
+    [epoch] = epochs
+    assert epoch["header"]["type_names"] == ["weightwise", "aggregating",
+                                             "recurrent"]
+    w = epoch["windows"][-1]
+    assert set(w["fixpoints_by_type"]) == {"weightwise", "aggregating",
+                                           "recurrent"}
+    total = sum(sum(doc["census"].values())
+                for doc in w["fixpoints_by_type"].values())
+    assert total == 48

@@ -373,6 +373,7 @@ def test_chunk_driver_no_deadline_runs_inline():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_watchdog_e2e_nan_injection_bundle_resume(tmp_path, monkeypatch,
                                                   capsys):
     """The acceptance scenario: NaNs injected into the whole population at
@@ -427,6 +428,7 @@ def test_watchdog_e2e_nan_injection_bundle_resume(tmp_path, monkeypatch,
     assert int(final.time) == 6
 
 
+@pytest.mark.slow
 def test_mega_soup_stall_deadline_names_failure_with_bundle(tmp_path,
                                                             monkeypatch):
     """A deliberately hung chunk finisher inside the real mega loop is
