@@ -183,139 +183,61 @@ TELEMETRY_N = 2048
 TELEMETRY_GENS = 50
 
 
-def row_telemetry() -> dict:
-    """Walltime overhead of the in-scan telemetry metrics carry: the
-    metered chunk program (``evolve(..., metrics=True)``) vs the plain
-    one, same dynamics — the acceptance bound is <= ~2% overhead.
+def _interleaved_medians(fns, calls=20, passes=3):
+    """Shared measurement protocol of every overhead row: the variants in
+    ``fns`` (name -> zero-arg callable, each forcing completion via a
+    scalar readback) run INTERLEAVED call-by-call, per-pass medians are
+    taken, and each variant reports its MEDIAN-OF-MEDIANS plus the
+    per-pass medians.
 
-    Plain/metered calls are INTERLEAVED and compared by median: on a
-    shared host, back-to-back blocks drift by more than the effect being
-    measured (observed ±10% block-to-block on idle-ish CPU).  A single
-    interleaved pass still jitters ±5% run-to-run (the axon tunnel's RPC
-    latency wanders on minute scales), so the whole measurement repeats
-    ``passes``=3 times and the row reports the MEDIAN-OF-MEDIANS — the
-    per-pass medians ride along so an outlier pass is visible."""
+    Why interleaved + median-of-medians: on a shared host, back-to-back
+    blocks drift by more than the effects being measured (observed ±10%
+    block-to-block on idle-ish CPU; PR 5 recorded the BASELINE itself
+    swinging 420-700ms session-to-session).  Interleaving puts every
+    variant under the same instantaneous load, and — since round 6 — the
+    PLAIN baseline is re-measured inside every row's passes, so rows are
+    comparable within one session instead of against a baseline measured
+    minutes earlier."""
     import statistics
 
-    import jax
-
-    from srnn_tpu.soup import evolve, seed
-
-    cfg = _config(TELEMETRY_N)
-    st = seed(cfg, jax.random.key(0))
-    calls = 20
-    passes = 3
-
-    def plain():
-        s = evolve(cfg, st, generations=TELEMETRY_GENS)
-        return float(s.next_uid)  # scalar readback forces completion
-
-    def metered():
-        s, _m = evolve(cfg, st, generations=TELEMETRY_GENS, metrics=True)
-        return float(s.next_uid)
-
-    plain(), metered(), plain(), metered()  # compile + warm both
-    plain_meds, metered_meds = [], []
+    for _ in range(2):  # compile + warm every variant
+        for f in fns.values():
+            f()
+    meds = {name: [] for name in fns}
     for _ in range(passes):
-        tp, tm = [], []
+        ts = {name: [] for name in fns}
         for _ in range(calls):
-            t0 = time.perf_counter()
-            plain()
-            tp.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            metered()
-            tm.append(time.perf_counter() - t0)
-        plain_meds.append(statistics.median(tp))
-        metered_meds.append(statistics.median(tm))
-    plain_s = statistics.median(plain_meds)
-    metered_s = statistics.median(metered_meds)
-    return {
-        "row": "telemetry",
-        "n": TELEMETRY_N,
-        "generations": TELEMETRY_GENS,
-        "calls": calls,
-        "passes": passes,
-        "plain_ms_per_chunk": round(plain_s * 1e3, 3),
-        "metered_ms_per_chunk": round(metered_s * 1e3, 3),
-        "pass_overhead_pct": [
-            round(100 * (m / p - 1), 2)
-            for p, m in zip(plain_meds, metered_meds)],
-        "overhead_pct": round(100 * (metered_s / plain_s - 1), 2),
-    }
+            for name, f in fns.items():
+                t0 = time.perf_counter()
+                f()
+                ts[name].append(time.perf_counter() - t0)
+        for name in fns:
+            meds[name].append(statistics.median(ts[name]))
+    return {name: (statistics.median(m), m) for name, m in meds.items()}
 
 
-def row_health() -> dict:
-    """Walltime overhead of the flight recorder's in-scan HEALTH sentinel
-    carry on top of the metered chunk program — ``evolve(metrics=True,
-    health=True)`` (the mega loops' default spelling) vs plain
-    ``metrics=True``.  The acceptance bound is <= ~5% overhead.
-
-    Same protocol as :func:`row_telemetry`: interleaved calls, per-pass
-    medians, 3 passes, MEDIAN-OF-MEDIANS reported (the row_telemetry
-    docstring explains why anything less is noise on this host)."""
-    import statistics
-
-    import jax
-
-    from srnn_tpu.soup import evolve, seed
-
-    cfg = _config(TELEMETRY_N)
-    st = seed(cfg, jax.random.key(0))
-    calls = 20
-    passes = 3
-
-    def metered():
-        s, _m = evolve(cfg, st, generations=TELEMETRY_GENS, metrics=True)
-        return float(s.next_uid)  # scalar readback forces completion
-
-    def sentineled():
-        s, _m, _h = evolve(cfg, st, generations=TELEMETRY_GENS,
-                           metrics=True, health=True)
-        return float(s.next_uid)
-
-    metered(), sentineled(), metered(), sentineled()  # compile + warm both
-    metered_meds, health_meds = [], []
-    for _ in range(passes):
-        tm, th = [], []
-        for _ in range(calls):
-            t0 = time.perf_counter()
-            metered()
-            tm.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            sentineled()
-            th.append(time.perf_counter() - t0)
-        metered_meds.append(statistics.median(tm))
-        health_meds.append(statistics.median(th))
-    metered_s = statistics.median(metered_meds)
-    health_s = statistics.median(health_meds)
-    return {
-        "row": "health",
-        "n": TELEMETRY_N,
-        "generations": TELEMETRY_GENS,
-        "calls": calls,
-        "passes": passes,
-        "metered_ms_per_chunk": round(metered_s * 1e3, 3),
-        "health_ms_per_chunk": round(health_s * 1e3, 3),
-        "pass_overhead_pct": [
-            round(100 * (h / m - 1), 2)
-            for m, h in zip(metered_meds, health_meds)],
-        "overhead_pct": round(100 * (health_s / metered_s - 1), 2),
-    }
+def _overhead_row(row, fns, base, feature, calls=20, passes=3, extra=None):
+    """One overhead row: every variant in ``fns`` measured interleaved
+    (ALWAYS including 'plain' — the unmetered chunk — as the in-row
+    session baseline); ``overhead_pct`` compares ``feature`` vs ``base``."""
+    res = _interleaved_medians(fns, calls, passes)
+    out = {"row": row, "n": TELEMETRY_N, "generations": TELEMETRY_GENS,
+           "calls": calls, "passes": passes}
+    for name, (med, per_pass) in res.items():
+        out[f"{name}_ms_per_chunk"] = round(med * 1e3, 3)
+    base_s, base_meds = res[base]
+    feat_s, feat_meds = res[feature]
+    out["pass_overhead_pct"] = [
+        round(100 * (f / b - 1), 2) for b, f in zip(base_meds, feat_meds)]
+    out["overhead_pct"] = round(100 * (feat_s / base_s - 1), 2)
+    if extra:
+        out.update(extra)
+    return out
 
 
-def row_lineage() -> dict:
-    """Walltime overhead of the replication-dynamics lineage carry on top
-    of the mega loops' previous default spelling — ``evolve(metrics=True,
-    health=True, lineage=True)`` vs ``metrics=True, health=True`` (the
-    ``metered.health`` baseline ``row_health`` measures).  The documented
-    acceptance bound is <= ~5% overhead.
-
-    Same protocol as :func:`row_telemetry`: interleaved calls, per-pass
-    medians, 3 passes, MEDIAN-OF-MEDIANS reported — and per the memory
-    note on this host, repeat the whole bench before trusting any
-    reading over ~2% (single-pass row_telemetry jitter is ±5%)."""
-    import statistics
-
+def _chunk_fns():
+    """The chunk-program variants the overhead rows sample from (each
+    returns a closure whose scalar readback forces completion)."""
     import jax
 
     from srnn_tpu.soup import evolve, seed
@@ -324,48 +246,82 @@ def row_lineage() -> dict:
     cfg = _config(TELEMETRY_N)
     st = seed(cfg, jax.random.key(0))
     lin = seed_lineage(cfg.size)
-    calls = 20
-    passes = 3
+    fcfg = cfg._replace(generation_impl="fused")
 
-    def sentineled():
+    def plain():
+        s = evolve(cfg, st, generations=TELEMETRY_GENS)
+        return float(s.next_uid)
+
+    def metered():
+        s, _m = evolve(cfg, st, generations=TELEMETRY_GENS, metrics=True)
+        return float(s.next_uid)
+
+    def health():
         s, _m, _h = evolve(cfg, st, generations=TELEMETRY_GENS,
                            metrics=True, health=True)
-        return float(s.next_uid)  # scalar readback forces completion
+        return float(s.next_uid)
 
-    def lineaged():
+    def lineage():
         s, _m, _h, _lt = evolve(cfg, st, generations=TELEMETRY_GENS,
                                 metrics=True, health=True, lineage=True,
                                 lineage_state=lin, lineage_capacity=4096)
         return float(s.next_uid)
 
-    sentineled(), lineaged(), sentineled(), lineaged()  # compile + warm
-    health_meds, lineage_meds = [], []
-    for _ in range(passes):
-        th, tl = [], []
-        for _ in range(calls):
-            t0 = time.perf_counter()
-            sentineled()
-            th.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            lineaged()
-            tl.append(time.perf_counter() - t0)
-        health_meds.append(statistics.median(th))
-        lineage_meds.append(statistics.median(tl))
-    health_s = statistics.median(health_meds)
-    lineage_s = statistics.median(lineage_meds)
-    return {
-        "row": "lineage",
-        "n": TELEMETRY_N,
-        "generations": TELEMETRY_GENS,
-        "calls": calls,
-        "passes": passes,
-        "health_ms_per_chunk": round(health_s * 1e3, 3),
-        "lineage_ms_per_chunk": round(lineage_s * 1e3, 3),
-        "pass_overhead_pct": [
-            round(100 * (l / h - 1), 2)
-            for h, l in zip(health_meds, lineage_meds)],
-        "overhead_pct": round(100 * (lineage_s / health_s - 1), 2),
-    }
+    def fused():
+        s = evolve(fcfg, st, generations=TELEMETRY_GENS)
+        return float(s.next_uid)
+
+    return {"plain": plain, "metered": metered, "health": health,
+            "lineage": lineage, "fused": fused}
+
+
+def row_telemetry() -> dict:
+    """Walltime overhead of the in-scan telemetry metrics carry
+    (``metrics=True`` vs plain, acceptance bound <= ~2%), protocol per
+    :func:`_interleaved_medians`."""
+    fns = _chunk_fns()
+    return _overhead_row("telemetry",
+                         {"plain": fns["plain"], "metered": fns["metered"]},
+                         base="plain", feature="metered")
+
+
+def row_health() -> dict:
+    """Walltime overhead of the flight recorder's in-scan HEALTH sentinel
+    carry on top of the metered chunk (``metrics+health`` vs ``metrics``,
+    acceptance bound <= ~5%); the plain baseline rides in the same passes
+    for cross-row session comparison."""
+    fns = _chunk_fns()
+    return _overhead_row("health",
+                         {"plain": fns["plain"], "metered": fns["metered"],
+                          "health": fns["health"]},
+                         base="metered", feature="health")
+
+
+def row_lineage() -> dict:
+    """Walltime overhead of the replication-dynamics lineage carry on top
+    of the ``metered.health`` spelling (documented bound <= ~5%); plain
+    baseline interleaved per the shared protocol."""
+    fns = _chunk_fns()
+    return _overhead_row("lineage",
+                         {"plain": fns["plain"], "health": fns["health"],
+                          "lineage": fns["lineage"]},
+                         base="health", feature="lineage")
+
+
+def row_fused() -> dict:
+    """``generation_impl='fused'`` vs the phase chain at the micro config
+    (same dynamics, same draws).  On Mosaic backends this measures the
+    megakernel's dispatch/glue win; on non-Mosaic backends the fused
+    spelling IS the phase-chain program (bit-identical XLA fallback), so
+    the row should read ~0% and anything beyond is pure cache/session
+    noise — the in-row plain baseline makes that visible."""
+    from srnn_tpu.ops.pallas_ww import native_mosaic_backend
+
+    fns = _chunk_fns()
+    return _overhead_row(
+        "fused", {"plain": fns["plain"], "fused": fns["fused"]},
+        base="plain", feature="fused",
+        extra={"mosaic_kernel": native_mosaic_backend()})
 
 
 def main(argv=None) -> int:
@@ -382,11 +338,11 @@ def main(argv=None) -> int:
         return 0
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
-            row_telemetry(), row_health(), row_lineage()]
+            row_telemetry(), row_health(), row_lineage(), row_fused()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h, l = rows
+        c, d, m, t, h, l, fu = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -411,6 +367,11 @@ def main(argv=None) -> int:
               f"{l['lineage_ms_per_chunk']:.1f}ms vs metered.health "
               f"{l['health_ms_per_chunk']:.1f}ms per chunk "
               f"({l['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# fused(N={fu['n']}, G={fu['generations']}): "
+              f"{fu['fused_ms_per_chunk']:.1f}ms vs phases "
+              f"{fu['plain_ms_per_chunk']:.1f}ms per chunk "
+              f"({fu['overhead_pct']:+.1f}%, "
+              f"mosaic_kernel={fu['mosaic_kernel']})", file=sys.stderr)
     return 0
 
 

@@ -66,6 +66,15 @@ class MultiSoupConfig(NamedTuple):
     # see SoupConfig.apply_impl; routes the cross-type attack transform
     # per ATTACKER type where a kernel exists (recurrent attackers)
     apply_impl: str = "xla"
+    # see SoupConfig.generation_impl.  The heterogeneous 'fused' spelling
+    # keeps the CROSS-TYPE attack phase in XLA (attacker and victim row
+    # counts differ, so it cannot ride one lane-blocked kernel) and fuses
+    # each type's learn_from + self-train + respawn into one megakernel
+    # launch per type on Mosaic backends; off-envelope types fall back
+    # per type silently (the same policy as train_impl='pallas').
+    generation_impl: str = "phases"
+    # see SoupConfig.population_dtype (per-type populations all share it)
+    population_dtype: str = "f32"
 
     @property
     def total(self) -> int:
@@ -89,7 +98,8 @@ class MultiSoupConfig(NamedTuple):
             remove_zero=self.remove_zero, epsilon=self.epsilon,
             lr=self.lr, train_mode=self.train_mode,
             respawn_draws=self.respawn_draws,
-            train_impl=self.train_impl)
+            train_impl=self.train_impl,
+            population_dtype=self.population_dtype)
 
 
 class MultiSoupState(NamedTuple):
@@ -107,11 +117,14 @@ class MultiSoupEvents(NamedTuple):
 
 
 def seed_multi(config: MultiSoupConfig, key: jax.Array) -> MultiSoupState:
+    from .soup import _pop_dtype
+
     keys = jax.random.split(key, len(config.topos) + 1)
     weights, uids = [], []
     offs = config.offsets
     for t, topo in enumerate(config.topos):
-        weights.append(init_population(topo, keys[t], config.sizes[t]))
+        weights.append(init_population(topo, keys[t], config.sizes[t])
+                       .astype(_pop_dtype(config)))
         uids.append(jnp.arange(offs[t], offs[t + 1], dtype=jnp.int32))
     return MultiSoupState(
         weights=tuple(weights), uids=tuple(uids),
@@ -178,11 +191,53 @@ def _record_multi_lineage(lins, win, gen, lin_info, lincfg, axes=None):
     return tuple(l._replace(next_pid=running) for l in new_lins), win
 
 
+def _fused_type_route(config: MultiSoupConfig, topo: Topology) -> bool:
+    """Does this type's learn+train+respawn block take the fused
+    megakernel?  Per-type silent fallback, mirroring
+    ``popmajor._use_pallas_sgd`` — ``resolved_generation_impl`` surfaces
+    the resolution for run headers.  (Same routing predicate as the
+    homogeneous soup: ``ops.pallas_generation.fused_kernel_route``.)"""
+    from .ops.pallas_generation import fused_kernel_route
+
+    return fused_kernel_route(topo, config.train_mode)
+
+
+def fused_supported_multi(config: MultiSoupConfig) -> bool:
+    """Would ``generation_impl='fused'`` be a valid spelling of this mixed
+    config?  (Per-type kernel eligibility is a SILENT runtime fallback —
+    this only checks the config-level constraints, mirroring
+    ``soup.fused_supported`` for the AOT warmup.)"""
+    if config.layout != "popmajor":
+        return False
+    try:
+        _check_popmajor_multi(config._replace(generation_impl="fused"))
+    except ValueError:
+        return False
+    return True
+
+
+def resolved_generation_impl(config: MultiSoupConfig,
+                             topo: Topology) -> str:
+    """The generation impl this type will ACTUALLY run: 'fused' only
+    where the megakernel applies on this backend, else 'phases'."""
+    return "fused" if (config.generation_impl == "fused"
+                       and _fused_type_route(config, topo)) else "phases"
+
+
 def _check_popmajor_multi(config: MultiSoupConfig) -> None:
     if config.apply_impl not in ("xla", "pallas"):
         raise ValueError(f"unknown apply_impl {config.apply_impl!r}")
     if config.train_impl not in ("xla", "pallas"):
         raise ValueError(f"unknown train_impl {config.train_impl!r}")
+    if config.generation_impl not in ("phases", "fused"):
+        raise ValueError(
+            f"unknown generation_impl {config.generation_impl!r}")
+    if config.generation_impl == "fused" and (
+            config.train_impl == "pallas" or config.apply_impl == "pallas"):
+        raise ValueError(
+            "generation_impl='fused' already fuses the per-type SGD "
+            "chains; use train_impl='xla' and apply_impl='xla' (the "
+            "per-phase pallas legs are subsumed)")
     for topo in config.topos:
         if topo.shuffler == "random":
             raise ValueError(
@@ -207,12 +262,16 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
     from .ops.popmajor import learn_epochs_popmajor, train_epochs_popmajor
     from .ops.popmajor_cross import cross_apply_popmajor
     from .ops.predicates import is_diverged, is_zero
-    from .soup import ACT_DIV_DEAD, ACT_ZERO_DEAD
+    from .soup import ACT_DIV_DEAD, ACT_ZERO_DEAD, _downcast, _upcast
+
+    fused = config.generation_impl == "fused"
+    apply_impl = "xla" if fused else config.apply_impl
 
     n = config.total
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
     att_idx = jnp.full(n, -1, jnp.int32)
+    wTs = tuple(_upcast(config, wT) for wT in wTs)
 
     # --- attack (cross-type, last-attacker-wins) ------------------------
     with jax.named_scope("multisoup.attack"):
@@ -232,7 +291,7 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
                     selfT = wTs[a][:, jnp.clip(att_b - offs[a], 0,
                                                config.sizes[a] - 1)]
                     attacked = cross_apply_popmajor(atk, selfT, vic, wTs[b],
-                                                    impl=config.apply_impl)
+                                                    impl=apply_impl)
                     out = jnp.where(mask[None, :], attacked, out)
                 new_wTs.append(out)
             wTs = tuple(new_wTs)
@@ -251,51 +310,79 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
         n_t = config.sizes[t]
         sl = lambda arr: jax.lax.dynamic_slice_in_dim(arr, offs[t], n_t)
 
-        # --- learn_from (same-type teachers, post-attack weights) -------
-        with jax.named_scope("multisoup.learn_from"):
-            if config.learn_from_rate > 0:
-                learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
-                learn_tgt = jax.random.randint(
-                    jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
-                if config.learn_from_severity > 0:
+        # learn draws are shared by both routes (the event record needs
+        # them even when severity is 0); same key stream either way
+        if config.learn_from_rate > 0:
+            learn_gate = sl(jax.random.uniform(k_lg, (n,))) < config.learn_from_rate
+            learn_tgt = jax.random.randint(
+                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+            learn_cp = state.uids[t][learn_tgt]
+        else:
+            learn_gate = jnp.zeros(n_t, bool)
+            learn_tgt = jnp.zeros(n_t, jnp.int32)
+            learn_cp = jnp.zeros(n_t, jnp.int32)
+        sgd_learn = config.learn_from_rate > 0 \
+            and config.learn_from_severity > 0
+
+        if fused and _fused_type_route(config, topo):
+            # --- fused learn+train+respawn: one launch for this type ----
+            # (the cross-type attack above already ran, so the imitation
+            # columns gather post-attack directly — no in-kernel recompute)
+            from .ops.pallas_generation import generation_popmajor
+
+            with jax.named_scope("multisoup.fused_generation"):
+                fresh = fresh_lanes(topo, re_keys[t], n_t,
+                                    config.respawn_draws)
+                wT_t, loss_t, dead_div, dead_zero = generation_popmajor(
+                    topo, wT_t, fresh,
+                    otherT=wT_t[:, learn_tgt] if sgd_learn else None,
+                    learn_gate=learn_gate if sgd_learn else None,
+                    severity=config.learn_from_severity if sgd_learn else 0,
+                    train=config.train, lr=config.lr,
+                    remove_divergent=config.remove_divergent,
+                    remove_zero=config.remove_zero, epsilon=config.epsilon)
+        else:
+            # --- learn_from (same-type teachers, post-attack weights) ---
+            with jax.named_scope("multisoup.learn_from"):
+                if sgd_learn:
                     learned, _ = learn_epochs_popmajor(
                         topo, wT_t, wT_t[:, learn_tgt],
-                        config.learn_from_severity, config.lr, config.train_mode,
-                        config.train_impl)
+                        config.learn_from_severity, config.lr,
+                        config.train_mode, config.train_impl)
                     wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
-                learn_cp = state.uids[t][learn_tgt]
-            else:
-                learn_gate = jnp.zeros(n_t, bool)
-                learn_tgt = jnp.zeros(n_t, jnp.int32)
-                learn_cp = jnp.zeros(n_t, jnp.int32)
 
-        # --- train ------------------------------------------------------
-        with jax.named_scope("multisoup.train"):
-            if config.train > 0:
-                wT_t, loss_t = train_epochs_popmajor(
-                    topo, wT_t, config.train, config.lr, config.train_mode,
-                    config.train_impl)
-            else:
-                loss_t = jnp.zeros(n_t, wT_t.dtype)
+            # --- train --------------------------------------------------
+            with jax.named_scope("multisoup.train"):
+                if config.train > 0:
+                    wT_t, loss_t = train_epochs_popmajor(
+                        topo, wT_t, config.train, config.lr,
+                        config.train_mode, config.train_impl)
+                else:
+                    loss_t = jnp.zeros(n_t, wT_t.dtype)
 
-        # --- respawn (same draws/uid blocks as the row-major _respawn) --
-        with jax.named_scope("multisoup.respawn"):
-            dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
-                else jnp.zeros(n_t, bool)
-            dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
-                if config.remove_zero else jnp.zeros(n_t, bool)
-            dead = dead_div | dead_zero
-            fresh = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
-            wT_t = jnp.where(dead[None, :], fresh, wT_t)
-            rank = jnp.cumsum(dead) - 1
-            base = state.next_uid + total_deaths
-            uids_t = jnp.where(dead, base + rank.astype(jnp.int32),
-                               state.uids[t])
-            total_deaths = total_deaths + dead.sum(dtype=jnp.int32)
-            death_action = jnp.full(n_t, ACT_NONE, jnp.int32)
-            death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
-            death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
-            death_cp = jnp.where(dead, uids_t, -1)
+            # --- respawn predicates + replacement select ----------------
+            with jax.named_scope("multisoup.respawn"):
+                dead_div = is_diverged(wT_t, axis=0) \
+                    if config.remove_divergent else jnp.zeros(n_t, bool)
+                dead_zero = (is_zero(wT_t, config.epsilon, axis=0)
+                             & ~dead_div) \
+                    if config.remove_zero else jnp.zeros(n_t, bool)
+                fresh = fresh_lanes(topo, re_keys[t], n_t,
+                                    config.respawn_draws)
+                wT_t = jnp.where((dead_div | dead_zero)[None, :], fresh,
+                                 wT_t)
+
+        # --- shared respawn bookkeeping (same uid blocks as row-major) --
+        dead = dead_div | dead_zero
+        rank = jnp.cumsum(dead) - 1
+        base = state.next_uid + total_deaths
+        uids_t = jnp.where(dead, base + rank.astype(jnp.int32),
+                           state.uids[t])
+        total_deaths = total_deaths + dead.sum(dtype=jnp.int32)
+        death_action = jnp.full(n_t, ACT_NONE, jnp.int32)
+        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+        death_cp = jnp.where(dead, uids_t, -1)
         if lins is not None:
             lin_info.append((sl(att_idx), learn_gate, learn_tgt, dead))
 
@@ -303,7 +390,7 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        out_wTs.append(wT_t)
+        out_wTs.append(_downcast(config, wT_t))
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -326,6 +413,9 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
     """One mixed-soup generation (phase order of ``soup.py:51-87``).  With
     a lineage carry (``lins``/``win``/``lincfg``) additionally returns the
     advanced per-type ``LineageState`` tuple and the shared edge window."""
+    from .soup import _pop_dtype
+
+    _pop_dtype(config)  # validates population_dtype
     if config.layout == "popmajor":
         _check_popmajor_multi(config)
         out = _evolve_multi_popmajor(
@@ -344,10 +434,19 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
         raise ValueError(
             "apply_impl='pallas' is the popmajor lane kernel; the "
             "row-major multisoup needs apply_impl='xla'")
+    if config.generation_impl != "phases":
+        if config.generation_impl != "fused":
+            raise ValueError(
+                f"unknown generation_impl {config.generation_impl!r}")
+        raise ValueError(
+            "generation_impl='fused' is the popmajor lane megakernel; the "
+            "row-major multisoup needs generation_impl='phases'")
+    from .soup import _downcast, _upcast
+
     n = config.total
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    weights = state.weights
+    weights = tuple(_upcast(config, w) for w in state.weights)
     att_idx = jnp.full(n, -1, jnp.int32)
 
     # --- attack (cross-type) -------------------------------------------
@@ -409,7 +508,7 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        new_weights.append(w_t)
+        new_weights.append(_downcast(config, w_t))
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -510,9 +609,12 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         from .nets import apply_to_weights
         from .ops.popmajor import apply_popmajor
 
+        from .soup import _upcast
+
         new_lins, stats = [], []
         for t, (lin_t, w_t) in enumerate(zip(lins, ws)):
             topo = config.topos[t]
+            w_t = _upcast(config, w_t)
             if axis == 0:
                 fw = apply_popmajor(topo, w_t, w_t)
             else:

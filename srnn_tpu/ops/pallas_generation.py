@@ -1,0 +1,418 @@
+"""Fused Pallas TPU megakernel for one WHOLE soup generation
+(attack + learn_from + self-train + respawn) per lane block.
+
+BENCH_r05's micro_dispatch rows showed per-generation dispatch and
+gather/compact/scatter glue is a first-order cost at small N: the phase
+chain runs attack, learn_from, train and respawn as separate XLA fusions
+with the (P, N) population round-tripping HBM between each, plus one
+gather per counterpart lookup.  This kernel executes the entire
+generation for a block of particles in ONE ``pallas_call``: the block's
+weights load into VMEM once, every phase runs on the resident rows with
+*phase masks* (the attack/learn gates) replacing the per-phase
+gather/compact/scatter glue, and the block writes back once — one HBM
+read + one write of the population per generation, regardless of phase
+count.
+
+Building blocks are the existing Pallas legs, composed:
+
+  * attack / self-application transform: the weightwise unrolled MLP
+    (``pallas_ww``'s math), ``pallas_rnn_train.rnn_forward_rows`` for the
+    recurrent variant, and the k-vector reduce → MLP → expand chain
+    (reduce shared with ``pallas_kvec_train``; the expand basis is a
+    trace-time constant — irfft/ifft of unit vectors for the fft variant,
+    segment replication with explicit 0-poison terms for aggregating so
+    NaN/Inf propagation matches the XLA one-hot matmul).
+  * learn_from / train SGD chains: ``pallas_ww_train._sgd_chain``,
+    ``pallas_rnn_train._sgd_epochs``, ``pallas_kvec_train._sgd_epochs`` —
+    the already-parity-tested fused chains, now called on rows that never
+    left VMEM.
+  * respawn: divergent/zero predicates evaluated on the resident
+    post-train rows, replacements selected from a pre-drawn fresh block
+    (PRNG stays in XLA — the draw is one threefry call per generation).
+
+Counterpart columns (the attacker seen by each victim, each learner's
+imitation target) are gathered OUTSIDE the kernel from the
+start-of-generation population — the only phase-ordering wrinkle is that
+the single-device phase chain lets a learner imitate a victim attacked
+*this* generation (post-attack weights).  The kernel reproduces that
+without a mid-generation HBM round trip by RECOMPUTING the counterpart's
+attack in-block: the learn operands carry the target's pre-attack column
+plus its attacker's column, and a mask says whether to re-apply the
+transform.  One extra forward per generation — noise next to the SGD
+chains.
+
+Mixed precision: a ``bfloat16`` population loads into VMEM at half the
+bytes; rows upcast to f32 at block load, every phase computes in f32, and
+the result rounds back to bf16 exactly once at block store (the same
+once-per-generation rounding points the XLA bf16 path uses), so the
+kernel and XLA spellings of ``population_dtype='bf16'`` agree on where
+precision is lost.
+
+Backend routing mirrors the other kernels: native Mosaic backends run the
+kernel; everywhere else ``soup.py``/``multisoup.py`` fall back to the XLA
+phase chain (bit-identical to ``generation_impl='phases'`` by
+construction — that fallback IS the acceptance oracle), and
+``interpret=True`` runs this kernel in the Pallas interpreter for CPU
+parity tests (float-tolerance, like every fused chain).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..topology import Topology, aggregation_segments, normalized_weight_coords
+from .activations import output_grad_activations, resolve_activation
+from .pallas_ww import LANE_BLOCK, native_mosaic_backend
+
+#: VMEM budget knob: lanes per grid step scale down as the particle's row
+#: count grows, keeping the resident set (population + attacker +
+#: counterpart + counterpart-attacker + fresh blocks, ~5 tiles f32)
+#: comfortably under ~4 MiB so the SGD chains' live intermediates fit too.
+_ROWS_BUDGET = 32768
+
+
+def generation_block(p: int) -> int:
+    """Lanes per grid step for a ``p``-weight topology (128-multiple)."""
+    return min(LANE_BLOCK, max(128, (_ROWS_BUDGET // max(p, 1)) // 128 * 128))
+
+
+def fused_kernel_route(topo: Topology, train_mode: str) -> bool:
+    """Does a fused generation take the Mosaic megakernel for this
+    topology on this backend?  THE single routing predicate — the soup
+    and the multisoup's per-type dispatch both delegate here, so an
+    envelope change cannot desynchronize them.  Non-Mosaic backends run
+    the full-width masked phase chain instead (the same program as the
+    default path — the CPU bit-identity oracle)."""
+    return native_mosaic_backend() and fused_kernel_supported(topo,
+                                                              train_mode)
+
+
+def fused_kernel_supported(topo: Topology, train_mode: str) -> bool:
+    """Can this topology's generation run as the fused megakernel?
+
+    Same envelope as the fused SGD chains (``popmajor._use_pallas_sgd``):
+    activations with output-expressible derivatives, particles up to 64
+    weights (the unrolled chains' compile-size fence), and the weightwise
+    variant's chain requires the sequential (batch-1) mode.  Off-envelope
+    configs run the XLA phase-chain spelling of ``generation_impl='fused'``
+    instead (full-width masked phases, no compaction).
+    """
+    if topo.activation not in output_grad_activations():
+        return False
+    if topo.num_weights > 64:
+        return False
+    if topo.variant == "weightwise" and train_mode != "sequential":
+        return False
+    if topo.shuffler == "random":
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# row-level transforms (length-P tuples of (B,) lane vectors)
+# ---------------------------------------------------------------------------
+
+
+def _mlp_rows(topo: Topology, rows, feats):
+    """The variant's tiny MLP on one lane block: ``rows`` the per-lane flat
+    parameters, ``feats`` the input feature lane-vectors.  Keras kernel
+    order (flat o + i*b + j = kernel[i, j]), same accumulation order as
+    every popmajor/XLA forward."""
+    act = resolve_activation(topo.activation)
+    h = list(feats)
+    for (a, b), o in zip(topo.layer_shapes, topo.offsets):
+        nxt = []
+        for j in range(b):
+            acc = h[0] * rows[o + j]
+            for i in range(1, a):
+                acc = acc + h[i] * rows[o + i * b + j]
+            nxt.append(act(acc))
+        h = nxt
+    return h
+
+
+@functools.lru_cache(maxsize=None)
+def _kvec_expand_basis(topo: Topology):
+    """(P, k) trace-time constant expand basis for the fft variant: column
+    j is the real inverse transform of the j-th unit coefficient vector,
+    so ``rows[m] = sum_j basis[m][j] * aggs[j]`` equals
+    ``kvec_expand_popmajor`` exactly (ifft real part / irfft)."""
+    assert topo.variant == "fft"
+    p, k = topo.num_weights, topo.aggregates
+    basis = np.zeros((p, k), dtype=np.float64)
+    for j in range(k):
+        e = np.zeros(k)
+        e[j] = 1.0
+        if topo.fft_mode == "rfft":
+            basis[:, j] = np.fft.irfft(e, n=p)
+        else:
+            basis[:, j] = np.fft.ifft(e, n=p).real
+    return tuple(tuple(float(v) for v in row) for row in basis)
+
+
+def apply_rows(topo: Topology, self_rows, x_rows):
+    """Self-application / attack transform on one lane block — the
+    kernel-side twin of ``popmajor.apply_popmajor`` (same-topology pairs;
+    cross-type attacks stay in XLA, see ``multisoup``)."""
+    p = topo.num_weights
+    if topo.variant == "weightwise":
+        coords = normalized_weight_coords(topo)
+        out = []
+        for s in range(p):
+            x = x_rows[s]
+            feats = [x] + [jnp.full_like(x, float(coords[s, k]))
+                           for k in range(3)]
+            out.append(_mlp_rows(topo, self_rows, feats)[0])
+        return out
+    if topo.variant == "recurrent":
+        from .pallas_rnn_train import rnn_forward_rows
+
+        seqs = rnn_forward_rows(topo, self_rows, x_rows)
+        return [seqs[-1][t][0] for t in range(len(x_rows))]
+    # k-vector variants: reduce -> MLP -> expand.  The fft transform reads
+    # its OWN weights unless the quirk-fix flag says otherwise
+    # (``network.py:494-499``); aggregating always reduces the target.
+    from .pallas_kvec_train import _reduce_rows
+
+    src = x_rows if (topo.variant == "aggregating" or topo.fft_use_target) \
+        else self_rows
+    aggs = _reduce_rows(topo, src)
+    outk = _mlp_rows(topo, self_rows, aggs)
+    k = topo.aggregates
+    if topo.variant == "fft":
+        out = []
+        for m in range(p):
+            coeffs = _kvec_expand_basis(topo)[m]
+            acc = None
+            for j, c in enumerate(coeffs):
+                term = outk[j] if c == 1.0 else outk[j] * c
+                acc = term if acc is None else acc + term
+            out.append(acc)
+        return out
+    # aggregating: replicate each segment's output to its rows; the
+    # explicit 0.0-weighted terms reproduce the XLA one-hot matmul's
+    # NaN/Inf poisoning (0 * Inf = NaN) for out-of-segment aggregates
+    seg, _ = aggregation_segments(topo)
+    out = []
+    for m in range(p):
+        acc = None
+        for j in range(k):
+            term = outk[j] if j == int(seg[m]) else outk[j] * 0.0
+            acc = term if acc is None else acc + term
+        out.append(acc)
+    return out
+
+
+def _chain_for(topo: Topology):
+    """(chain, snap_fn) — the variant's fused SGD chain
+    (``chain(topo, rows, snap, epochs, lr, refresh) -> (rows, loss)``)
+    and the imitation-snapshot derivation (identity when None)."""
+    if topo.variant == "weightwise":
+        from .pallas_ww_train import _sgd_chain
+
+        return _sgd_chain, None
+    if topo.variant == "recurrent":
+        from .pallas_rnn_train import _sgd_epochs
+
+        return _sgd_epochs, None
+    from .pallas_kvec_train import _reduce_rows, _sgd_epochs
+
+    return _sgd_epochs, _reduce_rows
+
+
+# ---------------------------------------------------------------------------
+# the megakernel
+# ---------------------------------------------------------------------------
+
+
+def _make_generation_kernel(topo: Topology, *, attack: bool, learn: int,
+                            train: int, lr: float, remove_divergent: bool,
+                            remove_zero: bool, epsilon: float,
+                            recompute_other: bool):
+    """Kernel body for one (P, B) lane block.  Operand order (after the
+    gates/population/fresh prefix) follows the statics: attacker rows iff
+    ``attack``, counterpart rows iff ``learn``, counterpart-attacker rows
+    iff ``learn and recompute_other``.  Outputs: new rows, last train
+    loss, (div, zero) dead masks."""
+    chain, snap_fn = _chain_for(topo)
+    p = topo.num_weights
+
+    def kernel(gates_ref, w_ref, fresh_ref, *rest):
+        rest = list(rest)
+        atk_ref = rest.pop(0) if attack else None
+        oth_ref = rest.pop(0) if learn else None
+        oatk_ref = rest.pop(0) if (learn and recompute_other) else None
+        out_ref, loss_ref, dead_ref = rest
+        f32 = jnp.float32
+
+        rows = tuple(w_ref[r, :].astype(f32) for r in range(p))
+
+        # --- attack: mask-selected in-block transform --------------------
+        if attack:
+            atk_rows = tuple(atk_ref[r, :].astype(f32) for r in range(p))
+            attacked = apply_rows(topo, atk_rows, rows)
+            m = gates_ref[0, :] != 0
+            rows = tuple(jnp.where(m, a, w) for a, w in zip(attacked, rows))
+
+        # --- learn_from: counterpart recomputed to post-attack, then the
+        # fused imitation chain on the resident rows -----------------------
+        if learn:
+            oth = tuple(oth_ref[r, :].astype(f32) for r in range(p))
+            if recompute_other:
+                oatk = tuple(oatk_ref[r, :].astype(f32) for r in range(p))
+                oth_att = apply_rows(topo, oatk, oth)
+                ma = gates_ref[2, :] != 0
+                oth = tuple(jnp.where(ma, a, o)
+                            for a, o in zip(oth_att, oth))
+            snap = snap_fn(topo, oth) if snap_fn is not None else oth
+            learned, _ = chain(topo, rows, snap, learn, lr, False)
+            ml = gates_ref[1, :] != 0
+            rows = tuple(jnp.where(ml, l, w) for l, w in zip(learned, rows))
+
+        # --- self-train: the fused chain, snapshot refreshed per epoch ---
+        if train:
+            rows, loss = chain(topo, rows, None, train, lr, True)
+        else:
+            loss = jnp.zeros_like(rows[0])
+
+        # --- respawn: predicates on resident rows, pre-drawn fresh block -
+        div = jnp.zeros_like(loss, dtype=bool)
+        if remove_divergent:
+            fin = jnp.isfinite(rows[0])
+            for r in range(1, p):
+                fin = fin & jnp.isfinite(rows[r])
+            div = ~fin
+        zero = jnp.zeros_like(div)
+        if remove_zero:
+            z = (rows[0] >= -epsilon) & (rows[0] <= epsilon)
+            for r in range(1, p):
+                z = z & (rows[r] >= -epsilon) & (rows[r] <= epsilon)
+            zero = z & ~div
+        dead = div | zero
+        for r in range(p):
+            out_ref[r, :] = jnp.where(
+                dead, fresh_ref[r, :].astype(f32), rows[r]
+            ).astype(out_ref.dtype)
+        loss_ref[0, :] = loss
+        dead_ref[0, :] = div.astype(jnp.int32)
+        dead_ref[1, :] = zero.astype(jnp.int32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "topo", "severity", "train", "lr", "remove_divergent", "remove_zero",
+    "epsilon", "interpret"))
+def generation_popmajor(topo: Topology, wT, freshT, attackerT=None,
+                        has_attacker=None, otherT=None, other_attackerT=None,
+                        other_attacked=None, learn_gate=None, *,
+                        severity: int = 0, train: int = 0, lr: float = 0.01,
+                        remove_divergent: bool = False,
+                        remove_zero: bool = False, epsilon: float = 1e-4,
+                        interpret: bool = False):
+    """One fused generation over a (P, N) population block-by-block.
+
+    ``attackerT``/``has_attacker`` enable the in-kernel attack phase
+    (``attackerT[:, n]`` is the column that rewrites lane ``n``; both
+    ``None`` = attack pre-applied or disabled, e.g. the multisoup's
+    cross-type XLA attack).  ``otherT``/``learn_gate`` enable the
+    imitation phase (``severity`` epochs); ``other_attackerT``/
+    ``other_attacked`` additionally recompute the counterpart's own
+    attack in-block so imitation sees post-attack weights like the
+    single-device phase chain.  ``freshT`` supplies respawn replacements.
+
+    Returns ``(new_wT, last-train-loss (N,) f32, dead_div (N,) bool,
+    dead_zero (N,) bool)``.  dtype of ``new_wT`` follows ``wT`` (bf16
+    populations round once, at block store).
+    """
+    p, n = wT.shape
+    attack = attackerT is not None
+    learn = otherT is not None and severity > 0
+    recompute_other = learn and other_attackerT is not None
+    if not attack:
+        has_attacker = jnp.zeros(n, bool)
+    if learn_gate is None:
+        learn_gate = jnp.zeros(n, bool)
+    if not recompute_other:
+        other_attacked = jnp.zeros(n, bool)
+    gates = jnp.stack([has_attacker.astype(jnp.int32),
+                       learn_gate.astype(jnp.int32),
+                       other_attacked.astype(jnp.int32)])
+
+    block = min(generation_block(p), n)
+    pad = (-n) % block
+    arrays = [wT, freshT]
+    if attack:
+        arrays.append(attackerT)
+    if learn:
+        arrays.append(otherT)
+        if recompute_other:
+            arrays.append(other_attackerT)
+    if pad:
+        gates = jnp.pad(gates, ((0, 0), (0, pad)))
+        arrays = [jnp.pad(a, ((0, 0), (0, pad))) for a in arrays]
+    padded = n + pad
+
+    kernel = _make_generation_kernel(
+        topo, attack=attack, learn=severity if learn else 0, train=train,
+        lr=float(lr), remove_divergent=remove_divergent,
+        remove_zero=remove_zero, epsilon=float(epsilon),
+        recompute_other=recompute_other)
+    spec = lambda rows: pl.BlockSpec((rows, block), lambda i: (0, i),
+                                     memory_space=pltpu.VMEM)
+    out, loss, dead = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((p, padded), wT.dtype),
+                   jax.ShapeDtypeStruct((1, padded), jnp.float32),
+                   jax.ShapeDtypeStruct((2, padded), jnp.int32)),
+        grid=(padded // block,),
+        in_specs=[spec(3)] + [spec(a.shape[0]) for a in arrays],
+        out_specs=(spec(p), spec(1), spec(2)),
+        interpret=interpret,
+    )(gates, *arrays)
+    if pad:
+        out, loss, dead = out[:, :n], loss[:, :n], dead[:, :n]
+    return out, loss[0], dead[0] != 0, dead[1] != 0
+
+
+# ---------------------------------------------------------------------------
+# lane-blocked chained self-application: the megakernel idea as a pure-XLA
+# program — the CPU fast path for bench.py's applications/sec workload
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("topo", "steps", "block"))
+def apply_chain_blocked(topo: Topology, wT, steps: int, block: int = 2048):
+    """``steps`` chained self-applications with the chain UNROLLED per lane
+    block: a ``lax.scan`` walks (P, block) tiles and each tile runs the
+    whole chain while it is cache-resident, so HBM/DRAM traffic is one
+    read + one write of the population regardless of ``steps`` — the XLA
+    spelling of the megakernel's residency argument.  On CPU this beats
+    the step-by-step ``lax.scan`` (which round-trips the full (P, N)
+    matrix through memory every step) once N is past cache scale; on
+    Mosaic backends prefer ``pallas_ww.ww_apply_population``.
+    Same math as ``steps`` iterations of ``apply_popmajor(topo, w, w)``.
+    """
+    from .popmajor import apply_popmajor
+
+    p, n = wT.shape
+    block = min(block, n)
+    pad = (-n) % block
+    if pad:
+        wT = jnp.pad(wT, ((0, 0), (0, pad)))
+    nb = (n + pad) // block
+    tiles = jnp.moveaxis(wT.reshape(p, nb, block), 1, 0)  # (nb, P, B)
+
+    def one_tile(_, tile):
+        w = tile
+        for _ in range(steps):
+            w = apply_popmajor(topo, w, w)
+        return None, w
+
+    _, out = jax.lax.scan(one_tile, None, tiles)
+    out = jnp.moveaxis(out, 0, 1).reshape(p, nb * block)
+    return out[:, :n] if pad else out

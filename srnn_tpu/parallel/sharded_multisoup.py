@@ -87,15 +87,21 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
     lineage carry (``lins``/``win``/``lincfg``) the advanced per-type
     carries + the per-shard edge window ride along (mint bases from
     all-gathered mask ranks, chained type-major — the uid-block order)."""
+    from ..soup import _downcast, _upcast
+
     n = config.total
     offs = config.offsets
     d = jax.lax.axis_index(SOUP_AXIS)
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    w_loc = list(state.weights)
+    w_loc = [_upcast(config, w) for w in state.weights]
     n_locs = [w.shape[0] for w in w_loc]
 
     # start-of-generation gathers: attacker weight tables + uid tables
-    all_w = tuple(jax.lax.all_gather(w, SOUP_AXIS, tiled=True) for w in w_loc)
+    # (storage dtype on the wire — exact bf16->f32 upcast after, see
+    # sharded_soup._local_evolve)
+    all_w = tuple(_upcast(config, jax.lax.all_gather(w, SOUP_AXIS,
+                                                     tiled=True))
+                  for w in state.weights)
     all_uids_t = tuple(jax.lax.all_gather(u, SOUP_AXIS, tiled=True)
                        for u in state.uids)
     all_uids = jnp.concatenate(all_uids_t)
@@ -198,7 +204,7 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        new_weights.append(w_t)
+        new_weights.append(_downcast(config, w_t))
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -230,17 +236,26 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
     kernels (``ops/popmajor*.py``), cross-type attacks via
     ``cross_apply_popmajor``.  The lineage carry threads exactly as in
     ``_local_evolve_multi`` (globally-ranked mint bases, type-major)."""
+    from ..multisoup import _fused_type_route
     from ..ops.popmajor import learn_epochs_popmajor, train_epochs_popmajor
     from ..ops.popmajor_cross import cross_apply_popmajor
+    from ..soup import _downcast, _upcast
+
+    fused = config.generation_impl == "fused"
+    apply_impl = "xla" if fused else config.apply_impl
 
     n = config.total
     offs = config.offsets
     d = jax.lax.axis_index(SOUP_AXIS)
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    n_locs = [wT.shape[1] for wT in wT_locs]
-
-    all_wT = tuple(jax.lax.all_gather(wT, SOUP_AXIS, axis=1, tiled=True)
+    # storage-dtype shards ride the start-of-generation gather (bf16 ships
+    # half the bytes; the upcast after is exact); the per-type POST-attack
+    # re-gathers stay f32 — mid-generation values, see sharded_soup
+    all_wT = tuple(_upcast(config, jax.lax.all_gather(wT, SOUP_AXIS,
+                                                      axis=1, tiled=True))
                    for wT in wT_locs)
+    wT_locs = tuple(_upcast(config, wT) for wT in wT_locs)
+    n_locs = [wT.shape[1] for wT in wT_locs]
     all_uids_t = tuple(jax.lax.all_gather(u, SOUP_AXIS, tiled=True)
                        for u in state.uids)
     all_uids = jnp.concatenate(all_uids_t)
@@ -280,64 +295,99 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
                                                   config.sizes[a] - 1)]
                     attacked = cross_apply_popmajor(attacker_topo, selfT, topo,
                                                     wT_t,
-                                                    impl=config.apply_impl)
+                                                    impl=apply_impl)
                     out = jnp.where(mask[None, :], attacked, out)
                 wT_t = out
 
-        # --- learn_from (same-type teachers, POST-attack re-gather) -----
-        with jax.named_scope("multisoup.learn_from"):
-            if config.learn_from_rate > 0:
-                learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
-                    < config.learn_from_rate
-                learn_tgt_full = jax.random.randint(
-                    jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
-                learn_tgt = jax.lax.dynamic_slice_in_dim(
-                    learn_tgt_full, d * n_loc, n_loc)
-                if config.learn_from_severity > 0:
+        # learn draws are shared by both routes (the event record needs
+        # them even when severity is 0); same key stream either way
+        if config.learn_from_rate > 0:
+            learn_gate = sl(jax.random.uniform(k_lg, (n,))) \
+                < config.learn_from_rate
+            learn_tgt_full = jax.random.randint(
+                jax.random.fold_in(k_lt, t), (n_t,), 0, n_t)
+            learn_tgt = jax.lax.dynamic_slice_in_dim(
+                learn_tgt_full, d * n_loc, n_loc)
+            learn_cp = all_uids_t[t][learn_tgt]
+        else:
+            learn_gate = jnp.zeros(n_loc, bool)
+            learn_tgt = jnp.zeros(n_loc, jnp.int32)
+            learn_cp = jnp.zeros(n_loc, jnp.int32)
+        sgd_learn = config.learn_from_rate > 0 \
+            and config.learn_from_severity > 0
+
+        if fused and _fused_type_route(config, topo):
+            # --- fused learn+train+respawn: one launch per shard --------
+            # (cross-type attack above ran in XLA, so imitation columns
+            # gather from the post-attack all_gather, no in-kernel
+            # recompute; fresh/rank streams identical to the phase chain)
+            from ..ops.pallas_generation import generation_popmajor
+
+            with jax.named_scope("multisoup.fused_generation"):
+                otherT = None
+                if sgd_learn:
+                    post_attack = jax.lax.all_gather(wT_t, SOUP_AXIS,
+                                                     axis=1, tiled=True)
+                    otherT = post_attack[:, learn_tgt]
+                freshT = fresh_lanes(topo, re_keys[t], n_t,
+                                     config.respawn_draws)
+                freshT_loc = jax.lax.dynamic_slice_in_dim(
+                    freshT, d * n_loc, n_loc, axis=1)
+                wT_t, loss_t, dead_div, dead_zero = generation_popmajor(
+                    topo, wT_t, freshT_loc, otherT=otherT,
+                    learn_gate=learn_gate if sgd_learn else None,
+                    severity=config.learn_from_severity if sgd_learn else 0,
+                    train=config.train, lr=config.lr,
+                    remove_divergent=config.remove_divergent,
+                    remove_zero=config.remove_zero, epsilon=config.epsilon)
+        else:
+            # --- learn_from (same-type teachers, POST-attack re-gather) -
+            with jax.named_scope("multisoup.learn_from"):
+                if sgd_learn:
                     post_attack = jax.lax.all_gather(wT_t, SOUP_AXIS, axis=1,
                                                      tiled=True)
                     learned, _ = learn_epochs_popmajor(
                         topo, wT_t, post_attack[:, learn_tgt],
-                        config.learn_from_severity, config.lr, config.train_mode,
-                        config.train_impl)
+                        config.learn_from_severity, config.lr,
+                        config.train_mode, config.train_impl)
                     wT_t = jnp.where(learn_gate[None, :], learned, wT_t)
-                learn_cp = all_uids_t[t][learn_tgt]
-            else:
-                learn_gate = jnp.zeros(n_loc, bool)
-                learn_tgt = jnp.zeros(n_loc, jnp.int32)
-                learn_cp = jnp.zeros(n_loc, jnp.int32)
 
-        # --- train ------------------------------------------------------
-        with jax.named_scope("multisoup.train"):
-            if config.train > 0:
-                wT_t, loss_t = train_epochs_popmajor(
-                    topo, wT_t, config.train, config.lr, config.train_mode,
-                    config.train_impl)
-            else:
-                loss_t = jnp.zeros(n_loc, wT_t.dtype)
+            # --- train --------------------------------------------------
+            with jax.named_scope("multisoup.train"):
+                if config.train > 0:
+                    wT_t, loss_t = train_epochs_popmajor(
+                        topo, wT_t, config.train, config.lr,
+                        config.train_mode, config.train_impl)
+                else:
+                    loss_t = jnp.zeros(n_loc, wT_t.dtype)
 
-        # --- respawn: global per-type dead-rank, replicated fresh draws -
-        with jax.named_scope("multisoup.respawn"):
-            dead_div = is_diverged(wT_t, axis=0) if config.remove_divergent \
-                else jnp.zeros(n_loc, bool)
-            dead_zero = (is_zero(wT_t, config.epsilon, axis=0) & ~dead_div) \
-                if config.remove_zero else jnp.zeros(n_loc, bool)
-            dead = dead_div | dead_zero
-            all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
-            rank = jnp.cumsum(all_dead) - 1
-            rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
-            freshT = fresh_lanes(topo, re_keys[t], n_t, config.respawn_draws)
-            freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, d * n_loc, n_loc,
-                                                      axis=1)
-            wT_t = jnp.where(dead[None, :], freshT_loc, wT_t)
-            uid_base = state.next_uid + total_deaths
-            uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
-                               state.uids[t])
-            total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
-            death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
-            death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
-            death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
-            death_cp = jnp.where(dead, uids_t, -1)
+            # --- respawn predicates + replacement select ----------------
+            with jax.named_scope("multisoup.respawn"):
+                dead_div = is_diverged(wT_t, axis=0) \
+                    if config.remove_divergent else jnp.zeros(n_loc, bool)
+                dead_zero = (is_zero(wT_t, config.epsilon, axis=0)
+                             & ~dead_div) \
+                    if config.remove_zero else jnp.zeros(n_loc, bool)
+                freshT = fresh_lanes(topo, re_keys[t], n_t,
+                                     config.respawn_draws)
+                freshT_loc = jax.lax.dynamic_slice_in_dim(
+                    freshT, d * n_loc, n_loc, axis=1)
+                wT_t = jnp.where((dead_div | dead_zero)[None, :], freshT_loc,
+                                 wT_t)
+
+        # --- shared bookkeeping: global per-type dead-rank uid blocks ---
+        dead = dead_div | dead_zero
+        all_dead = jax.lax.all_gather(dead, SOUP_AXIS, tiled=True)  # (n_t,)
+        rank = jnp.cumsum(all_dead) - 1
+        rank_loc = jax.lax.dynamic_slice_in_dim(rank, d * n_loc, n_loc)
+        uid_base = state.next_uid + total_deaths
+        uids_t = jnp.where(dead, uid_base + rank_loc.astype(jnp.int32),
+                           state.uids[t])
+        total_deaths = total_deaths + all_dead.sum(dtype=jnp.int32)
+        death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+        death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+        death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+        death_cp = jnp.where(dead, uids_t, -1)
         if lins is not None:
             lin_info.append((sl(att_idx), learn_gate, learn_tgt, dead))
 
@@ -345,7 +395,7 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        new_wTs.append(wT_t)
+        new_wTs.append(_downcast(config, wT_t))
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -381,6 +431,10 @@ def _sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
         _check_popmajor_multi(config)
         body = functools.partial(_local_multi_popmajor_step, config)
     elif config.layout == "rowmajor":
+        if config.generation_impl != "phases":
+            raise ValueError(
+                "generation_impl='fused' is the popmajor lane megakernel; "
+                "the row-major multisoup needs generation_impl='phases'")
         body = functools.partial(_local_evolve_multi, config)
     else:
         raise ValueError(f"unknown multisoup layout {config.layout!r}")
@@ -494,9 +548,12 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         from ..nets import apply_to_weights
         from ..ops.popmajor import apply_popmajor
 
+        from ..soup import _upcast
+
         new_lins, stats = [], []
         for t, (lin_t, w_t) in enumerate(zip(lins, ws)):
             topo = config.topos[t]
+            w_t = _upcast(config, w_t)
             if axis == 0:
                 fw = apply_popmajor(topo, w_t, w_t)
             else:

@@ -99,8 +99,10 @@ def _local_evolve(config: SoupConfig, state: SoupState,
     lineage carry (``lin``/``win``/``lincfg``, see ``telemetry.dynamics``)
     the advanced carries ride along — mint bases come from the
     all-gathered mask ranks, so pids stay globally unique."""
+    from ..soup import _downcast, _upcast
+
     n = config.size
-    w_loc = state.weights
+    w_loc = _upcast(config, state.weights)
     n_loc = w_loc.shape[0]
     d = jax.lax.axis_index(axes)
     start = d * n_loc
@@ -110,8 +112,12 @@ def _local_evolve(config: SoupConfig, state: SoupState,
 
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
 
-    # one collective: everyone sees the start-of-generation population
-    all_w = jax.lax.all_gather(w_loc, axes, tiled=True)  # (N, P)
+    # one collective: everyone sees the start-of-generation population.
+    # The gather ships the STORAGE dtype and upcasts after — for bf16
+    # populations that halves the dominant collective's bytes, and the
+    # bf16->f32 cast is exact so the values are identical either way
+    all_w = _upcast(config, jax.lax.all_gather(state.weights, axes,
+                                               tiled=True))  # (N, P)
 
     # --- attack ---------------------------------------------------------
     with jax.named_scope("soup.attack"):
@@ -181,7 +187,8 @@ def _local_evolve(config: SoupConfig, state: SoupState,
         learn_gate_loc, all_uids[learn_tgt_loc],
         config.train > 0, death_action, death_cp)
 
-    new_state = SoupState(new_w, new_uids, next_uid, state.time + 1, key)
+    new_state = SoupState(_downcast(config, new_w), new_uids, next_uid,
+                          state.time + 1, key)
     events = SoupEvents(action, counterpart, train_loss)
     if lin is None:
         return new_state, events
@@ -222,12 +229,26 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     """
     from ..ops.popmajor import (apply_popmajor, learn_epochs_popmajor,
                                 train_epochs_popmajor)
+    from ..soup import _downcast, _fused_kernel_route, _phases_view, _upcast
+
+    if config.generation_impl == "fused":
+        if _fused_kernel_route(config):
+            return _local_fused_popmajor(config, state, wT_loc, axes, lin,
+                                         win, lincfg)
+        config = _phases_view(config)
 
     n = config.size
     n_loc = wT_loc.shape[1]
     d = jax.lax.axis_index(axes)
     start = d * n_loc
     topo = config.topo
+    # keep the storage-dtype shard for the start-of-generation gather (bf16
+    # ships half the bytes; the upcast after is exact) — the POST-attack
+    # re-gather below must stay f32: its values are mid-generation compute
+    # results and a bf16 bounce there would round where the single-device
+    # path does not
+    wT_store = wT_loc
+    wT_loc = _upcast(config, wT_loc)
     has_attacker = jnp.zeros(n_loc, bool)
     att_loc = jnp.full(n_loc, -1, jnp.int32)
 
@@ -236,7 +257,8 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     # --- attack (soup.py:56-61); last-attacker-wins, same as single-device
     with jax.named_scope("soup.attack"):
         if config.attacking_rate > 0:
-            all_wT = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True)
+            all_wT = _upcast(config, jax.lax.all_gather(wT_store, axes,
+                                                        axis=1, tiled=True))
             attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
             attack_tgt = jax.random.randint(k_at, (n,), 0, n)
             att_idx = jax.ops.segment_max(
@@ -322,8 +344,121 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
         death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
         death_cp = jnp.where(dead, uids, -1)
+    wT_loc = _downcast(config, wT_loc)
 
     # --- event record (last action wins) --------------------------------
+    all_uids = jax.lax.all_gather(state.uids, axes, tiled=True)
+    action, counterpart = _event_record(
+        n_loc, attack_gate_loc, all_uids[attack_tgt_loc],
+        learn_gate_loc, all_uids[learn_tgt_loc],
+        config.train > 0, death_action, death_cp)
+
+    new_state = SoupState(state.weights, uids, next_uid, state.time + 1, key)
+    events = SoupEvents(action, counterpart, train_loss)
+    if lin is None:
+        return new_state, events, wT_loc
+    from ..telemetry.dynamics import lookup_pids, record_step
+
+    caps, capacity = lincfg
+    lin, win = record_step(
+        lin, win, gen=state.time, attacked=has_attacker,
+        attacker_pid=lookup_pids(lin.pid, jnp.clip(att_loc, 0), axes),
+        learn_gate=learn_gate_loc, learn_tgt=learn_tgt_loc, dead=dead,
+        caps=caps, capacity=capacity, axes=axes)
+    return new_state, events, wT_loc, lin, win
+
+
+def _local_fused_popmajor(config: SoupConfig, state: SoupState,
+                          wT_loc: jnp.ndarray, axes=SOUP_AXIS,
+                          lin=None, win=None, lincfg=None):
+    """Per-device fused-generation body (``ops.pallas_generation``):
+    ONE pre-attack all_gather serves both the attacker columns and the
+    imitation counterparts (the kernel re-applies the counterpart's
+    attack in-block, so the phase chain's second, post-attack gather
+    disappears) — psum/all-gather only at the kernel boundary.  Respawn
+    uids use the same global dead-rank and replicated fresh draw as the
+    phase chain, so pids/uids stay bit-identical to the single-device
+    fused step.  Mosaic backends only (``soup._fused_kernel_route``)."""
+    from ..ops.pallas_generation import generation_popmajor
+
+    n = config.size
+    n_loc = wT_loc.shape[1]
+    d = jax.lax.axis_index(axes)
+    start = d * n_loc
+    topo = config.topo
+    has_attacker = jnp.zeros(n_loc, bool)
+    att_loc = jnp.full(n_loc, -1, jnp.int32)
+
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+
+    attacking = config.attacking_rate > 0
+    learning = config.learn_from_rate > 0
+    sgd_learn = learning and config.learn_from_severity > 0
+
+    all_wT = jax.lax.all_gather(wT_loc, axes, axis=1, tiled=True) \
+        if (attacking or sgd_learn) else None
+
+    if attacking:
+        attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt,
+            num_segments=n)
+        att_loc = jax.lax.dynamic_slice_in_dim(att_idx, start, n_loc)
+        has_attacker = att_loc >= 0
+        attack_gate_loc = jax.lax.dynamic_slice_in_dim(attack_gate, start,
+                                                       n_loc)
+        attack_tgt_loc = jax.lax.dynamic_slice_in_dim(attack_tgt, start,
+                                                      n_loc)
+    else:
+        att_idx = jnp.full(n, -1, jnp.int32)
+        attack_gate_loc = jnp.zeros(n_loc, bool)
+        attack_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+    if learning:
+        learn_gate = jax.random.uniform(k_lg, (n,)) < config.learn_from_rate
+        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+        learn_gate_loc = jax.lax.dynamic_slice_in_dim(learn_gate, start,
+                                                      n_loc)
+        learn_tgt_loc = jax.lax.dynamic_slice_in_dim(learn_tgt, start, n_loc)
+    else:
+        learn_gate_loc = jnp.zeros(n_loc, bool)
+        learn_tgt_loc = jnp.zeros(n_loc, jnp.int32)
+
+    attackerT = all_wT[:, jnp.clip(att_loc, 0)] if attacking else None
+    otherT = other_attackerT = other_attacked = None
+    if sgd_learn:
+        otherT = all_wT[:, learn_tgt_loc]
+        if attacking:
+            other_att = att_idx[learn_tgt_loc]
+            other_attackerT = all_wT[:, jnp.clip(other_att, 0)]
+            other_attacked = other_att >= 0
+    # replicated global fresh draw, local slice — bitwise the single-device
+    # respawn stream (same discipline as the phase chain)
+    freshT = fresh_lanes(topo, k_re, n, config.respawn_draws)
+    freshT_loc = jax.lax.dynamic_slice_in_dim(freshT, start, n_loc, axis=1)
+
+    with jax.named_scope("soup.fused_generation"):
+        wT_loc, train_loss, dead_div, dead_zero = generation_popmajor(
+            topo, wT_loc, freshT_loc, attackerT,
+            has_attacker if attacking else None, otherT, other_attackerT,
+            other_attacked, learn_gate_loc if sgd_learn else None,
+            severity=config.learn_from_severity if sgd_learn else 0,
+            train=config.train, lr=config.lr,
+            remove_divergent=config.remove_divergent,
+            remove_zero=config.remove_zero, epsilon=config.epsilon)
+
+    dead = dead_div | dead_zero
+    all_dead = jax.lax.all_gather(dead, axes, tiled=True)
+    rank = jnp.cumsum(all_dead) - 1
+    rank_loc = jax.lax.dynamic_slice_in_dim(rank, start, n_loc)
+    uids = jnp.where(dead, state.next_uid + rank_loc.astype(jnp.int32),
+                     state.uids)
+    next_uid = state.next_uid + all_dead.sum(dtype=jnp.int32)
+    death_action = jnp.full(n_loc, ACT_NONE, jnp.int32)
+    death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
+    death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
+    death_cp = jnp.where(dead, uids, -1)
+
     all_uids = jax.lax.all_gather(state.uids, axes, tiled=True)
     action, counterpart = _event_record(
         n_loc, attack_gate_loc, all_uids[attack_tgt_loc],
@@ -364,6 +499,10 @@ def _sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
             raise ValueError(
                 "attack_impl/learn_from_impl='compact' compact lanes of "
                 "the popmajor layout; layout='rowmajor' needs 'full'")
+        if config.generation_impl != "phases":
+            raise ValueError(
+                "generation_impl='fused' is the popmajor lane megakernel; "
+                "layout='rowmajor' needs generation_impl='phases'")
         body = functools.partial(_local_evolve, config, axes=axes)
     else:
         raise ValueError(f"unknown soup layout {config.layout!r}")

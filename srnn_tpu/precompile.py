@@ -48,6 +48,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
                    default="fused")
     p.add_argument("--train-impl", choices=("xla", "pallas"), default="xla")
+    p.add_argument("--generation-impl", choices=("phases", "fused"),
+                   default="phases",
+                   help="whole-generation execution: 'fused' pre-warms the "
+                        "single-launch megakernel spellings "
+                        "(ops/pallas_generation.py) so a fused run on a "
+                        "fresh TPU window deserializes instead of paying "
+                        "full compile inside the bench deadline")
+    p.add_argument("--population-dtype", choices=("f32", "bf16"),
+                   default="f32",
+                   help="population storage dtype of the warmed "
+                        "executables (bf16 = mixed-precision population "
+                        "mode; a different program than f32)")
     p.add_argument("--attack-impl", choices=("full", "compact"),
                    default="full")
     p.add_argument("--learn-from-impl", choices=("full", "compact"),
@@ -93,6 +105,8 @@ def _make_config(args) -> SoupConfig:
         attack_impl=args.attack_impl,
         learn_from_impl=args.learn_from_impl,
         train_impl=args.train_impl,
+        generation_impl=args.generation_impl,
+        population_dtype=args.population_dtype,
     )
 
 
@@ -115,6 +129,8 @@ def _make_multi(args):
         layout=args.layout,
         respawn_draws=args.respawn_draws,
         train_impl=args.train_impl,
+        generation_impl=args.generation_impl,
+        population_dtype=args.population_dtype,
     )
 
 

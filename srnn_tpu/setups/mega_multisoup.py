@@ -37,6 +37,8 @@ from ..telemetry.device import probe_health
 from ..telemetry.flightrec import (combined_health_summary, health_summary,
                                    update_health_gauges)
 from ..telemetry.soup_metrics import (type_names, update_class_gauges,
+                                      set_precision_gauges,
+                                      update_fused_counters,
                                       update_multi_registry)
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
@@ -67,6 +69,16 @@ def build_parser():
     p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
                    default="fused")
     p.add_argument("--train-impl", choices=("xla", "pallas"), default="xla")
+    p.add_argument("--generation-impl", choices=("phases", "fused"),
+                   default="phases",
+                   help="'fused' fuses each type's learn+train+respawn "
+                        "into one megakernel launch on Mosaic backends "
+                        "(popmajor; cross-type attack stays XLA; "
+                        "bit-identical XLA fallback elsewhere)")
+    p.add_argument("--population-dtype", choices=("f32", "bf16"),
+                   default="f32",
+                   help="per-type population storage dtype (bf16 = "
+                        "mixed-precision mode, see PARITY.md)")
     p.add_argument("--apply-impl", choices=("xla", "pallas"), default="xla",
                    help="'pallas': fused VMEM forward for recurrent "
                         "attackers in the cross-type attack phase "
@@ -90,7 +102,8 @@ def build_parser():
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate",
                   "learn_from_severity", "train", "train_mode", "layout",
                   "epsilon", "sharded", "respawn_draws", "train_impl",
-                  "apply_impl", "capture_every")
+                  "apply_impl", "capture_every", "generation_impl",
+                  "population_dtype")
 
 
 def _make_config(args, n_dev: int = 1) -> MultiSoupConfig:
@@ -117,6 +130,8 @@ def _make_config(args, n_dev: int = 1) -> MultiSoupConfig:
         respawn_draws=args.respawn_draws,
         train_impl=args.train_impl,
         apply_impl=args.apply_impl,
+        generation_impl=args.generation_impl,
+        population_dtype=args.population_dtype,
     )
 
 
@@ -141,12 +156,20 @@ def run(args):
     # so a bad invocation can never leave a run dir without meta.json
     ckpt = None
     if args.resume:
-        load_run_config(args.resume, args, _CONFIG_FIELDS)
+        # original dynamics win over CLI; configs written before the
+        # round-6 fields must resume with the behavior they actually ran
+        # (phase-chain generations, f32 storage), never a newer CLI value
+        load_run_config(args.resume, args, _CONFIG_FIELDS,
+                        legacy_defaults={"generation_impl": "phases",
+                                         "population_dtype": "f32"})
         ckpt = latest_checkpoint(args.resume)
     if (args.train_impl == "pallas" or args.apply_impl == "pallas") \
             and args.layout != "popmajor":
         raise SystemExit("--train-impl/--apply-impl pallas are popmajor "
                          "lane kernels; --layout rowmajor needs 'xla'")
+    if args.generation_impl == "fused" and args.layout != "popmajor":
+        raise SystemExit("--generation-impl fused is the popmajor lane "
+                         "megakernel; --layout rowmajor needs phases")
     if args.capture_every < 0:
         raise SystemExit("--capture-every must be >= 0")
     if args.capture_every and args.checkpoint_every % args.capture_every:
@@ -247,6 +270,13 @@ def run(args):
     # in-scan carries, class gauges per type) + fsync'd heartbeats; both
     # flushed every chunk to events.jsonl and metrics.prom
     registry = MetricsRegistry()
+    set_precision_gauges(registry, cfg)
+    if cfg.generation_impl == "fused":
+        from ..multisoup import resolved_generation_impl
+        exp.log("generation_impl=fused: " + ",".join(
+            f"{t.variant}={resolved_generation_impl(cfg, t)}"
+            for t in cfg.topos)
+            + f", population_dtype={cfg.population_dtype}")
     # flight recorder + watchdog (see mega_soup / telemetry.flightrec)
     health_on = not args.no_health
     flightrec, watchdog = make_flightrec(args)
@@ -367,6 +397,13 @@ def run(args):
                     if ms is not None:
                         submit_or_run(writer, update_multi_registry,
                                       registry, ms, cfg)
+                    if cfg.generation_impl == "fused":
+                        from ..multisoup import _fused_type_route
+                        for tname, t in zip(type_names(cfg), cfg.topos):
+                            submit_or_run(
+                                writer, update_fused_counters, registry,
+                                chunk, _fused_type_route(cfg, t),
+                                type_name=tname)
                     submit_or_run(writer, _class_gauges, counts, prev)
                     if by_type is not None:
                         for tname, hsum in by_type.items():

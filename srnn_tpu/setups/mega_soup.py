@@ -29,7 +29,9 @@ from ..soup import (ACT_DIV_DEAD, ACT_ZERO_DEAD, SoupConfig, count, evolve,
 from ..telemetry import Heartbeat, MetricsRegistry
 from ..telemetry.device import probe_health
 from ..telemetry.flightrec import health_summary, update_health_gauges
-from ..telemetry.soup_metrics import update_class_gauges, update_registry
+from ..telemetry.soup_metrics import (set_precision_gauges,
+                                      update_class_gauges,
+                                      update_fused_counters, update_registry)
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
@@ -72,6 +74,17 @@ def build_parser():
                    help="'pallas': fused VMEM batch-1 SGD chain for the "
                         "train/learn phases (TPU-measured 3.5x on the "
                         "full-dynamics generation; see SoupConfig.train_impl)")
+    p.add_argument("--generation-impl", choices=("phases", "fused"),
+                   default="phases",
+                   help="'fused' runs the whole generation as one "
+                        "megakernel launch per lane block on Mosaic "
+                        "backends (popmajor; ops/pallas_generation.py; "
+                        "bit-identical XLA fallback elsewhere)")
+    p.add_argument("--population-dtype", choices=("f32", "bf16"),
+                   default="f32",
+                   help="population storage dtype; bf16 halves population "
+                        "HBM and gather bytes, computes in f32, weight "
+                        "drift documented in PARITY.md")
     p.add_argument("--respawn-draws", choices=("perparticle", "fused"),
                    default="fused",
                    help="respawn replacement draws: 'fused' (default here — "
@@ -92,7 +105,8 @@ def build_parser():
 _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
                   "train_mode", "layout", "epsilon", "capture_every",
                   "sharded", "respawn_draws", "attack_impl",
-                  "learn_from_impl", "train_impl")
+                  "learn_from_impl", "train_impl", "generation_impl",
+                  "population_dtype")
 
 
 def run(args):
@@ -114,7 +128,9 @@ def run(args):
                         legacy_defaults={"respawn_draws": "perparticle",
                                          "attack_impl": "full",
                                          "learn_from_impl": "full",
-                                         "train_impl": "xla"})
+                                         "train_impl": "xla",
+                                         "generation_impl": "phases",
+                                         "population_dtype": "f32"})
         ckpt = latest_checkpoint(args.resume)
     if (args.attack_impl != "full" or args.learn_from_impl != "full") \
             and args.layout != "popmajor":
@@ -123,6 +139,9 @@ def run(args):
     if args.train_impl == "pallas" and args.layout != "popmajor":
         raise SystemExit("--train-impl pallas is the popmajor lane kernel; "
                          "--layout rowmajor needs --train-impl xla")
+    if args.generation_impl == "fused" and args.layout != "popmajor":
+        raise SystemExit("--generation-impl fused is the popmajor lane "
+                         "megakernel; --layout rowmajor needs phases")
     if args.capture_every < 0:
         raise SystemExit("--capture-every must be >= 0")
     if args.capture_every and args.checkpoint_every % args.capture_every:
@@ -183,6 +202,13 @@ def run(args):
     # events.jsonl + metrics.prom every chunk, and fsync'd heartbeat rows
     # so a killed run names its last stage/generation/rate
     registry = MetricsRegistry()
+    set_precision_gauges(registry, cfg)
+    if cfg.generation_impl == "fused":
+        from ..soup import _fused_kernel_route
+        exp.log("generation_impl=fused: "
+                + ("Mosaic megakernel" if _fused_kernel_route(cfg)
+                   else "XLA phase-chain fallback (no Mosaic backend)")
+                + f", population_dtype={cfg.population_dtype}")
     # flight recorder: bounded ring of per-chunk health rows + the anomaly
     # watchdog that turns a pathological chunk into a triage bundle
     health_on = not args.no_health
@@ -312,6 +338,11 @@ def run(args):
                     if m is not None:
                         submit_or_run(writer, update_registry, registry,
                                       m, n_particles=cfg.size)
+                    if cfg.generation_impl == "fused":
+                        from ..soup import _fused_kernel_route
+                        submit_or_run(writer, update_fused_counters,
+                                      registry, chunk,
+                                      _fused_kernel_route(cfg))
                     submit_or_run(writer, update_class_gauges, registry,
                                   counts, prev=prev)
                     if hsum is not None:
@@ -463,6 +494,8 @@ def _make_config(args) -> SoupConfig:
         attack_impl=args.attack_impl,
         learn_from_impl=args.learn_from_impl,
         train_impl=args.train_impl,
+        generation_impl=args.generation_impl,
+        population_dtype=args.population_dtype,
     )
 
 

@@ -119,6 +119,27 @@ class SoupConfig(NamedTuple):
     # instead of T; the other variants' dense lane programs are already
     # single XLA fusions, so only recurrent configs accept it.
     apply_impl: str = "xla"             # 'xla' | 'pallas'
+    # Whole-generation execution (popmajor parallel only).  'fused' runs
+    # attack + learn_from + self-train + respawn as ONE megakernel launch
+    # per lane block on Mosaic backends (ops/pallas_generation.py):
+    # weights stay resident in VMEM across phases and phase masks replace
+    # the per-phase gather/compact/scatter glue (attack_impl /
+    # learn_from_impl compaction is subsumed and ignored).  On non-Mosaic
+    # backends 'fused' runs the full-width masked phase chain — the SAME
+    # program as the default path, so f32 results are bit-identical to
+    # 'phases' there (the CPU parity oracle); on TPU the kernel agrees to
+    # float tolerance like every fused Pallas chain.
+    generation_impl: str = "phases"     # 'phases' | 'fused'
+    # Population storage dtype.  'bf16' halves the population's HBM (and
+    # the sharded START-of-generation all-gather bytes; the post-attack
+    # imitation re-gather stays f32 — mid-generation values must not take
+    # an extra rounding); every phase still computes in f32 —
+    # weights upcast at generation entry and round back to bf16 exactly
+    # once at generation exit (the kernel rounds at the same points).
+    # Integer state (uids, pids, counters) and the PRNG draw stream are
+    # untouched; weight trajectories drift from f32 within the tolerance
+    # documented in PARITY.md (benchmarks/parity_sweep.py measures it).
+    population_dtype: str = "f32"       # 'f32' | 'bf16'
 
 
 class SoupState(NamedTuple):
@@ -137,10 +158,33 @@ class SoupEvents(NamedTuple):
     loss: jnp.ndarray         # (N,) f32 last train loss or 0
 
 
+def _pop_dtype(config) -> jnp.dtype:
+    """Storage dtype of the population (``population_dtype`` field)."""
+    if config.population_dtype == "bf16":
+        return jnp.bfloat16
+    if config.population_dtype != "f32":
+        raise ValueError(
+            f"unknown population_dtype {config.population_dtype!r}; "
+            "expected 'f32' or 'bf16'")
+    return jnp.float32
+
+
+def _upcast(config, w: jnp.ndarray) -> jnp.ndarray:
+    """bf16 storage -> f32 compute view (no-op for f32 populations)."""
+    return w.astype(jnp.float32) if config.population_dtype == "bf16" else w
+
+
+def _downcast(config, w: jnp.ndarray) -> jnp.ndarray:
+    """f32 compute result -> storage dtype; the bf16 path's single
+    per-generation rounding point."""
+    return w.astype(jnp.bfloat16) if config.population_dtype == "bf16" else w
+
+
 def seed(config: SoupConfig, key: jax.Array) -> SoupState:
     """Create the initial population (``Soup.seed``, ``soup.py:45-49``)."""
     k_init, k_state = jax.random.split(key)
     w = init_population(config.topo, k_init, config.size)
+    w = w.astype(_pop_dtype(config))
     return SoupState(
         weights=w,
         uids=jnp.arange(config.size, dtype=jnp.int32),
@@ -221,7 +265,7 @@ def _evolve_parallel(config: SoupConfig, state: SoupState,
     n = config.size
     topo = config.topo
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    w = state.weights
+    w = _upcast(config, state.weights)
     has_attacker = jnp.zeros(n, bool)
     att_idx = jnp.full(n, -1, jnp.int32)
 
@@ -280,7 +324,8 @@ def _evolve_parallel(config: SoupConfig, state: SoupState,
         n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
         config.train > 0, death_action, death_cp)
 
-    new_state = SoupState(w, uids, next_uid, state.time + 1, key)
+    new_state = SoupState(_downcast(config, w), uids, next_uid,
+                          state.time + 1, key)
     events = SoupEvents(action, counterpart, train_loss)
     if lin is None:
         return new_state, events
@@ -388,6 +433,24 @@ def _learn_popmajor_compact(config: SoupConfig, wT: jnp.ndarray,
     return _compact_gated_lanes(wT, learn_gate, cap, block)
 
 
+def _fused_kernel_route(config: SoupConfig) -> bool:
+    """Does ``generation_impl='fused'`` take the Pallas megakernel on this
+    backend?  (Delegates to the single routing predicate in
+    ``ops.pallas_generation``; the multisoup's per-type dispatch uses the
+    same one, so the two can never desynchronize.)"""
+    from .ops.pallas_generation import fused_kernel_route
+
+    return fused_kernel_route(config.topo, config.train_mode)
+
+
+def _phases_view(config: SoupConfig) -> SoupConfig:
+    """The phase-chain spelling a fused config falls back to: full-width
+    masked phases (compaction and the per-phase pallas legs are subsumed
+    by the megakernel, so they are coerced off rather than layered)."""
+    return config._replace(generation_impl="phases", attack_impl="full",
+                           learn_from_impl="full", apply_impl="xla")
+
+
 def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
                              wT: jnp.ndarray, lin=None, win=None,
                              lincfg=None):
@@ -401,13 +464,24 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
     carry transposed across generations (one transpose per run, not per
     step).  Phase order and event semantics identical to the row-major
     path; arithmetic differs only by reassociation.
+
+    ``generation_impl='fused'`` routes to the single-launch megakernel on
+    Mosaic backends (``_evolve_fused_popmajor``) and to this body with
+    compaction coerced off everywhere else.
     """
     from .ops.popmajor import (apply_popmajor, learn_epochs_popmajor,
                                train_epochs_popmajor)
 
+    if config.generation_impl == "fused":
+        if _fused_kernel_route(config):
+            return _evolve_fused_popmajor(config, state, wT, lin, win,
+                                          lincfg)
+        config = _phases_view(config)
+
     n = config.size
     topo = config.topo
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    wT = _upcast(config, wT)
     has_attacker = jnp.zeros(n, bool)
     att_idx = jnp.full(n, -1, jnp.int32)
 
@@ -475,6 +549,100 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         action = jnp.where(dead_div, ACT_DIV_DEAD, action)
         action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
         death_cp = jnp.where(dead, uids, -1)
+    wT = _downcast(config, wT)
+
+    act, cp = _event_record(
+        n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
+        config.train > 0, action, death_cp)
+    new_state = SoupState(state.weights, uids, state.next_uid + deaths,
+                          state.time + 1, key)
+    events = SoupEvents(act, cp, train_loss)
+    if lin is None:
+        return new_state, events, wT
+    from .telemetry.dynamics import lookup_pids, record_step
+
+    caps, capacity = lincfg
+    lin, win = record_step(
+        lin, win, gen=state.time, attacked=has_attacker,
+        attacker_pid=lookup_pids(lin.pid, jnp.clip(att_idx, 0)),
+        learn_gate=learn_gate, learn_tgt=learn_tgt, dead=dead, caps=caps,
+        capacity=capacity)
+    return new_state, events, wT, lin, win
+
+
+def _evolve_fused_popmajor(config: SoupConfig, state: SoupState,
+                           wT: jnp.ndarray, lin=None, win=None, lincfg=None):
+    """One generation as a single megakernel launch per lane block
+    (``ops.pallas_generation``): same PRNG stream, phase order, event
+    record and lineage bookkeeping as the phase chain; the attack /
+    learn_from / train / respawn math runs on VMEM-resident rows with
+    phase masks instead of per-phase gather/compact/scatter glue.
+
+    Counterpart operands are gathered from the START-of-generation
+    population; the kernel re-applies the attack to imitation targets
+    in-block so learners see post-attack weights like the phase chain.
+    The respawn draw happens in XLA (one threefry call) and rides in as
+    the fresh block.  Mosaic backends only (see ``_fused_kernel_route``).
+    """
+    from .init import fresh_lanes as _fresh_lanes
+    from .ops.pallas_generation import generation_popmajor
+
+    n = config.size
+    topo = config.topo
+    key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    has_attacker = jnp.zeros(n, bool)
+    att_idx = jnp.full(n, -1, jnp.int32)
+
+    attacking = config.attacking_rate > 0
+    learning = config.learn_from_rate > 0
+    sgd_learn = learning and config.learn_from_severity > 0
+
+    if attacking:
+        attack_gate = (jax.random.uniform(k_ag, (n,)) < config.attacking_rate)
+        attack_tgt = jax.random.randint(k_at, (n,), 0, n)
+        att_idx = jax.ops.segment_max(
+            jnp.where(attack_gate, jnp.arange(n), -1), attack_tgt,
+            num_segments=n)
+        has_attacker = att_idx >= 0
+    else:
+        attack_gate = jnp.zeros(n, bool)
+        attack_tgt = jnp.zeros(n, jnp.int32)
+    if learning:
+        learn_gate = (jax.random.uniform(k_lg, (n,)) < config.learn_from_rate)
+        learn_tgt = jax.random.randint(k_lt, (n,), 0, n)
+    else:
+        learn_gate = jnp.zeros(n, bool)
+        learn_tgt = jnp.zeros(n, jnp.int32)
+
+    attackerT = wT[:, jnp.clip(att_idx, 0)] if attacking else None
+    otherT = other_attackerT = other_attacked = None
+    if sgd_learn:
+        otherT = wT[:, learn_tgt]
+        if attacking:
+            other_att = att_idx[learn_tgt]
+            other_attackerT = wT[:, jnp.clip(other_att, 0)]
+            other_attacked = other_att >= 0
+    fresh = _fresh_lanes(topo, k_re, n, config.respawn_draws)
+
+    with jax.named_scope("soup.fused_generation"):
+        wT, train_loss, dead_div, dead_zero = generation_popmajor(
+            topo, wT, fresh, attackerT, has_attacker if attacking else None,
+            otherT, other_attackerT, other_attacked,
+            learn_gate if sgd_learn else None,
+            severity=config.learn_from_severity if sgd_learn else 0,
+            train=config.train, lr=config.lr,
+            remove_divergent=config.remove_divergent,
+            remove_zero=config.remove_zero, epsilon=config.epsilon)
+
+    dead = dead_div | dead_zero
+    action = jnp.full(n, ACT_NONE, jnp.int32)
+    rank = jnp.cumsum(dead) - 1
+    uids = jnp.where(dead, state.next_uid + rank.astype(jnp.int32),
+                     state.uids)
+    deaths = dead.sum(dtype=jnp.int32)
+    action = jnp.where(dead_div, ACT_DIV_DEAD, action)
+    action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
+    death_cp = jnp.where(dead, uids, -1)
 
     act, cp = _event_record(
         n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
@@ -508,6 +676,31 @@ def _check_popmajor(config: SoupConfig) -> None:
             "that defeats the lane layout — use layout='rowmajor'")
     if config.train_impl not in ("xla", "pallas"):
         raise ValueError(f"unknown train_impl {config.train_impl!r}")
+    if config.generation_impl not in ("phases", "fused"):
+        raise ValueError(
+            f"unknown generation_impl {config.generation_impl!r}")
+    if config.generation_impl == "fused":
+        from .ops.pallas_generation import fused_kernel_supported
+
+        if config.train_impl == "pallas" or config.apply_impl == "pallas":
+            raise ValueError(
+                "generation_impl='fused' already fuses the SGD chains and "
+                "the apply transform in one launch; use train_impl='xla' "
+                "and apply_impl='xla' (the per-phase pallas legs are "
+                "subsumed)")
+        if not fused_kernel_supported(config.topo, config.train_mode):
+            raise ValueError(
+                "generation_impl='fused' fuses the whole generation with "
+                "the hand-derived chains: activation with an "
+                "output-expressible derivative (linear/sigmoid/tanh/relu), "
+                "particles up to 64 weights, shuffler='not' (the "
+                "weightwise variant additionally needs "
+                "train_mode='sequential'); this config "
+                f"(variant={config.topo.variant!r}, "
+                f"activation={config.topo.activation!r}, "
+                f"train_mode={config.train_mode!r}, "
+                f"P={config.topo.num_weights}) needs "
+                "generation_impl='phases'")
     if config.attack_impl not in ("full", "compact"):
         raise ValueError(f"unknown attack_impl {config.attack_impl!r}")
     if config.learn_from_impl not in ("full", "compact"):
@@ -550,6 +743,19 @@ def _check_popmajor(config: SoupConfig) -> None:
                 f"train_mode={config.train_mode!r}, "
                 f"activation={config.topo.activation!r}, "
                 f"P={config.topo.num_weights}) needs train_impl='xla'")
+
+
+def fused_supported(config: SoupConfig) -> bool:
+    """Would ``generation_impl='fused'`` be a valid spelling of this
+    config?  (AOT warmup uses this to decide whether to pre-build the
+    ``.fused`` twins of a popmajor config's executables.)"""
+    if config.layout != "popmajor" or config.mode != "parallel":
+        return False
+    try:
+        _check_popmajor(config._replace(generation_impl="fused"))
+    except ValueError:
+        return False
+    return True
 
 
 def _evolve_sequential(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupEvents]:
@@ -623,6 +829,18 @@ def _evolve_step(config: SoupConfig, state: SoupState) -> Tuple[SoupState, SoupE
         raise ValueError(
             "mode='sequential' is the strict-parity mode and requires "
             "respawn_draws='perparticle'")
+    _pop_dtype(config)  # validates population_dtype
+    if config.mode == "sequential" and config.population_dtype != "f32":
+        raise ValueError(
+            "mode='sequential' is the strict-parity mode and requires "
+            "population_dtype='f32'")
+    if config.generation_impl not in ("phases", "fused"):
+        raise ValueError(
+            f"unknown generation_impl {config.generation_impl!r}")
+    if config.generation_impl == "fused" and config.layout != "popmajor":
+        raise ValueError(
+            "generation_impl='fused' is the popmajor lane megakernel; "
+            "layout='rowmajor' needs generation_impl='phases'")
     if config.train_impl == "pallas" and config.layout != "popmajor":
         raise ValueError(
             "train_impl='pallas' is the popmajor lane kernel; "
@@ -774,8 +992,9 @@ def _evolve(
         if lineage:
             from .ops.popmajor import apply_popmajor
 
-            fw = apply_popmajor(config.topo, wT, wT)
-            lin, fstats = close_window(lin, wT, fw, 0, config.epsilon)
+            wc = _upcast(config, wT)
+            fw = apply_popmajor(config.topo, wc, wc)
+            lin, fstats = close_window(lin, wc, fw, 0, config.epsilon)
     else:
         def step(carry, _):
             s, m, h, lin, win = carry
@@ -794,10 +1013,10 @@ def _evolve(
         (final, m, h, lin, win), recs = jax.lax.scan(
             step, (state, m0, h0, l0, w0), None, length=generations)
         if lineage:
+            wc = _upcast(config, final.weights)
             fw = jax.vmap(lambda wi: apply_to_weights(config.topo, wi, wi))(
-                final.weights)
-            lin, fstats = close_window(lin, final.weights, fw, -1,
-                                       config.epsilon)
+                wc)
+            lin, fstats = close_window(lin, wc, fw, -1, config.epsilon)
 
     out = (final,)
     if record:

@@ -47,6 +47,11 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_dynamics_basin_transitions_total": "counter",
     "soup_dynamics_fixpoint_l2_max": "gauge",
     "soup_dynamics_fixpoint_linf_max": "gauge",
+    # -- fused generation & mixed precision (telemetry.soup_metrics) -----
+    "soup_fused_generations_total": "counter",
+    "soup_fused_fallback_generations_total": "counter",
+    "soup_precision_weight_bits": "gauge",
+    "soup_precision_population_bytes": "gauge",
     # -- flight recorder (telemetry.flightrec) ---------------------------
     "soup_health_nonfinite_particles": "gauge",
     "soup_health_zero_particles": "gauge",

@@ -120,3 +120,43 @@ def update_multi_registry(registry: MetricsRegistry,
     for t, m in enumerate(ms):
         update_registry(registry, m, type_name=labels[t],
                         n_particles=config.sizes[t])
+
+
+def set_precision_gauges(registry: MetricsRegistry, config) -> None:
+    """Run-start gauges for the population's precision mode: storage bits
+    per weight and the resulting population bytes (``SoupConfig`` or
+    ``MultiSoupConfig``)."""
+    bits = 16 if config.population_dtype == "bf16" else 32
+    if hasattr(config, "topos"):
+        weights = sum(t.num_weights * n
+                      for t, n in zip(config.topos, config.sizes))
+    else:
+        weights = config.topo.num_weights * config.size
+    registry.gauge("soup_precision_weight_bits",
+                   help="population storage bits per weight").set(bits)
+    registry.gauge("soup_precision_population_bytes",
+                   help="population storage footprint at the configured "
+                   "dtype").set(weights * bits // 8)
+
+
+def update_fused_counters(registry: MetricsRegistry, generations: int,
+                          kernel: bool,
+                          type_name: Optional[str] = None) -> None:
+    """Per-chunk fused-generation accounting for ``generation_impl='fused'``
+    runs: generations executed under the fused spelling, split by whether
+    the Mosaic megakernel route was live or the XLA phase-chain fallback
+    ran (non-Mosaic backend, or an off-envelope type in the multisoup's
+    silent per-type fallback — which is why the heterogeneous loop calls
+    this once per TYPE: a mixed-eligibility run must show its fallback
+    types, not report the whole chunk as kernel-executed)."""
+    labels = {"type": type_name} if type_name else {}
+    registry.counter(
+        "soup_fused_generations_total",
+        help="generations run under generation_impl='fused'").inc(
+            int(generations), **labels)
+    if not kernel:
+        registry.counter(
+            "soup_fused_fallback_generations_total",
+            help="fused-spelling generations that ran the XLA phase-chain "
+            "fallback (no Mosaic backend / off-envelope type)").inc(
+                int(generations), **labels)

@@ -184,11 +184,11 @@ def abstract_soup_state(config, mesh=None) -> "Any":
     scalars/key replicated), matching ``make_sharded_state``."""
     import jax.numpy as jnp
 
-    from ..soup import SoupState
+    from ..soup import SoupState, _pop_dtype
 
     st = SoupState(
         weights=jax.ShapeDtypeStruct(
-            (config.size, config.topo.num_weights), jnp.float32),
+            (config.size, config.topo.num_weights), _pop_dtype(config)),
         uids=jax.ShapeDtypeStruct((config.size,), jnp.int32),
         next_uid=jax.ShapeDtypeStruct((), jnp.int32),
         time=jax.ShapeDtypeStruct((), jnp.int32),
@@ -229,10 +229,11 @@ def abstract_multi_state(config, mesh=None) -> "Any":
     import jax.numpy as jnp
 
     from ..multisoup import MultiSoupState
+    from ..soup import _pop_dtype
 
     st = MultiSoupState(
         weights=tuple(
-            jax.ShapeDtypeStruct((n, t.num_weights), jnp.float32)
+            jax.ShapeDtypeStruct((n, t.num_weights), _pop_dtype(config))
             for t, n in zip(config.topos, config.sizes)),
         uids=tuple(jax.ShapeDtypeStruct((n,), jnp.int32)
                    for n in config.sizes),
@@ -389,6 +390,19 @@ def _soup_entries(config, generations: int, donate: bool):
             "lineage": True, "lineage_state": abstract_lineage_state(
                 config.size),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    # the fused-megakernel spellings (generation_impl='fused') are their
+    # own programs — warm them for every fused-eligible popmajor config so
+    # a `--generation-impl fused` run's first chunk deserializes instead
+    # of compiling (a fused config's OWN entries are already fused)
+    from ..soup import fused_supported
+
+    if config.generation_impl != "fused" and fused_supported(config):
+        fcfg = config._replace(generation_impl="fused")
+        yield (f"soup.evolve_step{tag}.fused", step, (fcfg, st), {})
+        yield (f"soup.evolve{tag}.fused", run, (fcfg, st),
+               {"generations": generations})
+        yield (f"soup.evolve{tag}.fused.metered.health", run, (fcfg, st),
+               {"generations": generations, "metrics": True, "health": True})
 
 
 def _multi_entries(config, generations: int, donate: bool):
@@ -415,6 +429,17 @@ def _multi_entries(config, generations: int, donate: bool):
             "lineage": True, "lineage_state": tuple(
                 abstract_lineage_state(n) for n in config.sizes),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    from ..multisoup import fused_supported_multi
+
+    if config.generation_impl != "fused" and fused_supported_multi(config):
+        fcfg = config._replace(generation_impl="fused")
+        yield (f"multisoup.evolve_multi_step{tag}.fused", step, (fcfg, st),
+               {})
+        yield (f"multisoup.evolve_multi{tag}.fused", run, (fcfg, st),
+               {"generations": generations})
+        yield (f"multisoup.evolve_multi{tag}.fused.metered.health", run,
+               (fcfg, st),
+               {"generations": generations, "metrics": True, "health": True})
 
 
 def _engine_entries(topo, size: int, donate: bool, step_limit: int,
@@ -462,6 +487,20 @@ def _sharded_entries(config, mesh, generations: int, donate: bool):
             "lineage": True, "lineage_state": abstract_lineage_state(
                 config.size, mesh=mesh),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    from ..soup import fused_supported
+
+    if config.generation_impl != "fused" and fused_supported(config):
+        fcfg = config._replace(generation_impl="fused")
+        yield (f"parallel.sharded_evolve_step{tag}.fused", step,
+               (fcfg, mesh, st), {})
+        yield (f"parallel.sharded_evolve{tag}.fused", run, (fcfg, mesh, st),
+               {"generations": generations})
+        # the sharded mega chunk loop dispatches metrics+health by default
+        # — warm that spelling too or a sharded fused run's first chunk
+        # re-pays the compile (same rationale as the unsharded block)
+        yield (f"parallel.sharded_evolve{tag}.fused.metered.health", run,
+               (fcfg, mesh, st),
+               {"generations": generations, "metrics": True, "health": True})
 
 
 def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
@@ -492,6 +531,17 @@ def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
                 abstract_lineage_state(n, mesh=mesh)
                 for n in config.sizes),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    from ..multisoup import fused_supported_multi
+
+    if config.generation_impl != "fused" and fused_supported_multi(config):
+        fcfg = config._replace(generation_impl="fused")
+        yield (f"parallel.sharded_evolve_multi_step{tag}.fused", step,
+               (fcfg, mesh, st), {})
+        yield (f"parallel.sharded_evolve_multi{tag}.fused", run,
+               (fcfg, mesh, st), {"generations": generations})
+        yield (f"parallel.sharded_evolve_multi{tag}.fused.metered.health",
+               run, (fcfg, mesh, st),
+               {"generations": generations, "metrics": True, "health": True})
 
 
 def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,

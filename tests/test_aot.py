@@ -193,6 +193,46 @@ def test_engine_and_multi_warmup_entries():
     assert not any(r["cached"] for r in plain)
 
 
+def test_warmup_fused_spellings_for_popmajor_configs():
+    """A fused-eligible popmajor config's warmup ALSO pre-builds the
+    ``generation_impl='fused'`` twins (their own programs — precompile
+    must cover them or a fused run's first chunk pays full compile inside
+    the bench deadline); rowmajor configs get none (fused is popmajor-only
+    and the entry would be a dead executable)."""
+    aot.clear_executable_cache()
+    cfg = SoupConfig(topo=WW, size=8, attacking_rate=0.2,
+                     remove_divergent=True, remove_zero=True,
+                     layout="popmajor")
+    rows = aot.warmup(cfg, generations=2, donate=False)
+    assert {r["entry"] for r in rows} == {
+        "soup.evolve_step", "soup.evolve", "soup.evolve.metered",
+        "soup.evolve.metered.health", "soup.evolve.metered.health.lineage",
+        "soup.evolve_step.fused", "soup.evolve.fused",
+        "soup.evolve.fused.metered.health"}
+    # a config that is ALREADY fused warms its own (fused) programs under
+    # the base names — no duplicate .fused twins
+    fused_rows = aot.warmup(cfg._replace(generation_impl="fused"),
+                            generations=2, donate=False)
+    assert not any(".fused" in r["entry"] for r in fused_rows)
+    # rowmajor (the engine/parity default): no fused spellings
+    rm = aot.warmup(cfg._replace(layout="rowmajor"), generations=2,
+                    donate=False)
+    assert not any(".fused" in r["entry"] for r in rm)
+
+
+def test_warmup_fused_spellings_for_multi():
+    aot.clear_executable_cache()
+    mcfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(8, 8), attacking_rate=0.2,
+        learn_from_rate=-1.0, remove_divergent=True, remove_zero=True,
+        layout="popmajor")
+    rows = aot.warmup(None, multi=mcfg, generations=2, donate=False)
+    entries = {r["entry"] for r in rows}
+    assert "multisoup.evolve_multi_step.fused" in entries
+    assert "multisoup.evolve_multi.fused" in entries
+    assert "multisoup.evolve_multi.fused.metered.health" in entries
+
+
 def test_warmup_sharded_entries_accept_mesh():
     """A Mesh argument has .shape but no .dtype — the abstraction step
     must pass it through as a static, not explode on it."""

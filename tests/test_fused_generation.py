@@ -1,0 +1,380 @@
+"""Fused soup-generation megakernel (``generation_impl='fused'``,
+``ops/pallas_generation.py``) and the bf16 population mode.
+
+Contracts under test:
+
+  * ``generation_impl='fused'`` at f32 is BIT-identical to the default
+    phase-chain path — population state, uids, events, and the
+    metrics/health/lineage carries — on soup, multisoup, and both sharded
+    twins (on non-Mosaic backends the fused spelling runs the full-width
+    masked phase chain, which makes this exact by construction; the
+    megakernel itself is parity-tested in interpret mode below, to float
+    tolerance like every fused Pallas chain).
+  * the megakernel's in-block phases — attack, counterpart post-attack
+    recompute, imitation/train chains, respawn — agree with the XLA
+    phase composition for every variant (interpret mode).
+  * ``population_dtype='bf16'`` keeps integer state exact (int32
+    arithmetic, never quantized), agrees bitwise between the fused and
+    phase spellings, and stays within the PARITY.md per-generation
+    tolerance vs f32.
+  * compact-phase configs are subsumed under 'fused' (masks replace
+    compaction), including the capacity-overflow regime where the chain's
+    compact path falls back to full width.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu import multisoup, soup
+from srnn_tpu.init import fresh_lanes, init_population
+from srnn_tpu.soup import SoupConfig, evolve, seed
+from srnn_tpu.topology import Topology
+
+WW = Topology("weightwise", width=2, depth=2)
+AGG = Topology("aggregating", width=2, depth=2)
+FFT = Topology("fft", width=2, depth=2)
+RNN = Topology("recurrent", width=2, depth=2)
+
+
+def _full_dynamics(topo, **over):
+    kw = dict(topo=topo, size=32, attacking_rate=0.3, learn_from_rate=0.3,
+              learn_from_severity=1, train=1, remove_divergent=True,
+              remove_zero=True, layout="popmajor")
+    kw.update(over)
+    return SoupConfig(**kw)
+
+
+def _leaves_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        if jax.dtypes.issubdtype(getattr(x, "dtype", None),
+                                 jax.dtypes.prng_key):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------------ soup bit-identity
+
+
+@pytest.mark.parametrize("topo", [WW, RNN], ids=lambda t: t.variant)
+def test_fused_soup_bitwise_f32(topo):
+    """Fused vs phase-chain evolve: state, events-derived carries, and the
+    lineage window all bit-identical at f32 (full dynamics)."""
+    from srnn_tpu.telemetry.dynamics import seed_lineage
+
+    cfg = _full_dynamics(topo)
+    st = seed(cfg, jax.random.key(0))
+    lin = seed_lineage(cfg.size)
+    ref = evolve(cfg, st, generations=3, metrics=True, health=True,
+                 lineage=True, lineage_state=lin, lineage_capacity=256)
+    got = evolve(cfg._replace(generation_impl="fused"), st, generations=3,
+                 metrics=True, health=True, lineage=True, lineage_state=lin,
+                 lineage_capacity=256)
+    _leaves_equal(ref, got)
+
+
+def test_fused_soup_respawn_draws_fused_bitwise():
+    """The fused-draw respawn stream rides the fused path unchanged."""
+    cfg = _full_dynamics(WW, respawn_draws="fused")
+    st = seed(cfg, jax.random.key(1))
+    ref = evolve(cfg, st, generations=3)
+    got = evolve(cfg._replace(generation_impl="fused"), st, generations=3)
+    _leaves_equal(ref, got)
+
+
+def test_fused_multisoup_bitwise_f32():
+    """Mixed population (cross-type attacks stay XLA; per-type blocks take
+    the fused route): bit-identical state + per-type metrics carries."""
+    mcfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(12, 12), attacking_rate=0.4,
+        learn_from_rate=0.3, learn_from_severity=1, train=1,
+        remove_divergent=True, remove_zero=True, layout="popmajor")
+    st = multisoup.seed_multi(mcfg, jax.random.key(2))
+    ref = multisoup.evolve_multi(mcfg, st, generations=3, metrics=True,
+                                 health=True)
+    got = multisoup.evolve_multi(mcfg._replace(generation_impl="fused"), st,
+                                 generations=3, metrics=True, health=True)
+    _leaves_equal(ref, got)
+
+
+def test_fused_sharded_soup_bitwise(mesh=None):
+    """Sharded popmajor soup: fused vs phases bitwise on the same mesh;
+    vs the single-device fused run to the documented compounded-ulp
+    tolerance (shard-width fusion differences), uids exact."""
+    from srnn_tpu.parallel import make_sharded_state, soup_mesh
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve
+
+    mesh = soup_mesh()
+    cfg = _full_dynamics(WW, size=mesh.devices.size * 4)
+    st = make_sharded_state(cfg, mesh, jax.random.key(3))
+    ref = sharded_evolve(cfg, mesh, st, generations=3, metrics=True)
+    got = sharded_evolve(cfg._replace(generation_impl="fused"), mesh, st,
+                         generations=3, metrics=True)
+    _leaves_equal(ref, got)
+    single = evolve(cfg._replace(generation_impl="fused"),
+                    seed(cfg, jax.random.key(3)), generations=3)
+    np.testing.assert_array_equal(np.asarray(single.uids),
+                                  np.asarray(got[0].uids))
+    np.testing.assert_allclose(np.asarray(single.weights),
+                               np.asarray(got[0].weights),
+                               rtol=1e-4, atol=2e-6)
+
+
+def test_fused_sharded_multisoup_bitwise():
+    from srnn_tpu.parallel import soup_mesh
+    from srnn_tpu.parallel.sharded_multisoup import (
+        make_sharded_multi_state, sharded_evolve_multi)
+
+    mesh = soup_mesh()
+    d = mesh.devices.size
+    mcfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(2 * d, 2 * d), attacking_rate=0.4,
+        learn_from_rate=0.3, learn_from_severity=1, train=1,
+        remove_divergent=True, remove_zero=True, layout="popmajor")
+    st = make_sharded_multi_state(mcfg, mesh, jax.random.key(4))
+    ref = sharded_evolve_multi(mcfg, mesh, st, generations=2, metrics=True)
+    got = sharded_evolve_multi(mcfg._replace(generation_impl="fused"), mesh,
+                               st, generations=2, metrics=True)
+    _leaves_equal(ref, got)
+
+
+# --------------------------------------------- megakernel interpret parity
+
+
+@pytest.mark.parametrize("topo", [WW, AGG, FFT, RNN], ids=lambda t: t.variant)
+def test_generation_kernel_interpret_matches_phases(topo):
+    """The megakernel body (attack -> counterpart recompute -> imitation
+    chain -> train chain -> respawn) agrees with the XLA phase composition
+    in interpret mode, per variant — including learners whose imitation
+    target was attacked this generation (the in-block recompute)."""
+    from srnn_tpu.ops.pallas_generation import generation_popmajor
+    from srnn_tpu.ops.popmajor import (apply_popmajor, learn_epochs_popmajor,
+                                       train_epochs_popmajor)
+    from srnn_tpu.ops.predicates import is_diverged, is_zero
+
+    n, sev, train, lr, eps = 40, 1, 2, 0.01, 1e-4
+    wT = (init_population(topo, jax.random.key(1), n) * 0.4).T
+    # every third lane attacked; learn targets stride over the population,
+    # so some imitation targets ARE attacked victims
+    att_idx = jnp.where(jnp.arange(n) % 3 == 0, (jnp.arange(n) * 7) % n, -1)
+    has_attacker = att_idx >= 0
+    learn_gate = (jnp.arange(n) % 4) == 1
+    learn_tgt = (jnp.arange(n) * 3) % n
+    fresh = fresh_lanes(topo, jax.random.key(2), n)
+    assert bool(has_attacker[learn_tgt][learn_gate].any())
+
+    # phase-chain reference
+    ref = jnp.where(has_attacker[None, :],
+                    apply_popmajor(topo, wT[:, jnp.clip(att_idx, 0)], wT), wT)
+    learned, _ = learn_epochs_popmajor(topo, ref, ref[:, learn_tgt], sev, lr,
+                                       "sequential")
+    ref = jnp.where(learn_gate[None, :], learned, ref)
+    ref, ref_loss = train_epochs_popmajor(topo, ref, train, lr, "sequential")
+    ref_div = is_diverged(ref, axis=0)
+    ref_zero = is_zero(ref, eps, axis=0) & ~ref_div
+    ref = jnp.where((ref_div | ref_zero)[None, :], fresh, ref)
+
+    oa = att_idx[learn_tgt]
+    out, loss, div, zero = generation_popmajor(
+        topo, wT, fresh, wT[:, jnp.clip(att_idx, 0)], has_attacker,
+        wT[:, learn_tgt], wT[:, jnp.clip(oa, 0)], oa >= 0, learn_gate,
+        severity=sev, train=train, lr=lr, remove_divergent=True,
+        remove_zero=True, epsilon=eps, interpret=True)
+    np.testing.assert_array_equal(np.asarray(div), np.asarray(ref_div))
+    np.testing.assert_array_equal(np.asarray(zero), np.asarray(ref_zero))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------------------ bf16 mode
+
+
+def test_bf16_fused_matches_phases_bitwise():
+    """At bf16 the fused and phase spellings still agree BITWISE (same
+    rounding points: one downcast per generation)."""
+    cfg = _full_dynamics(WW, population_dtype="bf16")
+    st = seed(cfg, jax.random.key(5))
+    assert st.weights.dtype == jnp.bfloat16
+    ref = evolve(cfg, st, generations=3, metrics=True)
+    got = evolve(cfg._replace(generation_impl="fused"), st, generations=3,
+                 metrics=True)
+    _leaves_equal(ref, got)
+
+
+def test_bf16_integer_state_exact_and_per_gen_tolerance():
+    """100 generations of bf16 full dynamics: integer state stays exact
+    int32 arithmetic (dtype, monotone uid counter, recountable deaths),
+    and ONE generation from a shared state stays within the PARITY.md
+    per-generation tolerance (rel L-inf < 2^-7; bound 2^-8 per rounding,
+    measured ~3e-3 — benchmarks/parity_sweep.py sweeps this)."""
+    cfg16 = _full_dynamics(WW, size=64, train=2,
+                           generation_impl="fused",
+                           population_dtype="bf16",
+                           respawn_draws="fused")
+    cfg32 = cfg16._replace(population_dtype="f32")
+    st16 = seed(cfg16, jax.random.key(7))
+    out = evolve(cfg16, st16, generations=100)
+    assert out.weights.dtype == jnp.bfloat16
+    assert out.uids.dtype == jnp.int32
+    assert int(out.time) == 100
+    # uid invariants: every minted uid came from the exact counter stream
+    assert int(jnp.max(out.uids)) < int(out.next_uid)
+    assert int(out.next_uid) >= cfg16.size
+
+    # per-generation drift vs f32 from the SAME (bf16-cast) start
+    worst = 0.0
+    st32 = st16._replace(weights=st16.weights.astype(jnp.float32))
+    for _ in range(5):
+        n32 = evolve(cfg32, st32, generations=1)
+        st16 = evolve(cfg16, st16, generations=1)
+        np.testing.assert_array_equal(np.asarray(n32.uids),
+                                      np.asarray(st16.uids))
+        w32 = np.asarray(n32.weights, np.float32)
+        w16 = np.asarray(st16.weights, np.float32)
+        fin = np.isfinite(w32).all(1) & np.isfinite(w16).all(1)
+        scale = max(float(np.abs(w32[fin]).max()), 1e-9)
+        worst = max(worst,
+                    float(np.abs(w32[fin] - w16[fin]).max()) / scale)
+        st32 = st16._replace(weights=st16.weights.astype(jnp.float32))
+    assert worst < 2 ** -7, worst
+
+
+def test_bf16_sequential_mode_rejected():
+    cfg = SoupConfig(topo=WW, size=8, mode="sequential",
+                     population_dtype="bf16")
+    with pytest.raises(ValueError, match="population_dtype"):
+        soup.evolve_step(cfg, seed(cfg, jax.random.key(0)))
+
+
+def test_fused_kernel_glue_end_to_end(monkeypatch):
+    """Drive the MOSAIC-route dispatch glue (operand gathers, draw
+    streams, dead-rank uid minting) — not just the kernel body — by
+    forcing the kernel route on and running the kernel in interpret mode.
+    Without this the ~300 lines of fused glue are dead code on CPU CI:
+    every bitwise test above exercises only the XLA fallback."""
+    import functools
+
+    import srnn_tpu.ops.pallas_generation as pg
+    from srnn_tpu import soup as soup_mod
+    from srnn_tpu.parallel import make_sharded_state, soup_mesh
+
+    real = pg.generation_popmajor
+    monkeypatch.setattr(pg, "generation_popmajor",
+                        functools.partial(real, interpret=True))
+    monkeypatch.setattr(soup_mod, "_fused_kernel_route", lambda cfg: True)
+    monkeypatch.setattr(multisoup, "_fused_type_route",
+                        lambda cfg, topo: True)
+
+    def check(ref, got):
+        np.testing.assert_array_equal(np.asarray(ref[0].uids),
+                                      np.asarray(got[0].uids))
+        assert int(ref[0].next_uid) == int(got[0].next_uid)
+        np.testing.assert_array_equal(np.asarray(ref[1].action),
+                                      np.asarray(got[1].action))
+        np.testing.assert_array_equal(np.asarray(ref[1].counterpart),
+                                      np.asarray(got[1].counterpart))
+        r, g = np.asarray(ref[0].weights), np.asarray(got[0].weights)
+        fin = np.isfinite(r) & np.isfinite(g)
+        np.testing.assert_array_equal(np.isfinite(r), np.isfinite(g))
+        np.testing.assert_allclose(g[fin], r[fin], rtol=2e-5, atol=1e-6)
+
+    # sizes unique to THIS test: jit caches on config, and a config traced
+    # elsewhere (kernel route off) would silently bypass the monkeypatch
+    cfg = _full_dynamics(WW, size=24)
+    st = seed(cfg, jax.random.key(11))
+    check(soup.evolve_step(cfg, st),
+          soup.evolve_step(cfg._replace(generation_impl="fused"), st))
+
+    mesh = soup_mesh()
+    shcfg = _full_dynamics(WW, size=mesh.devices.size * 3)
+    shst = make_sharded_state(shcfg, mesh, jax.random.key(12))
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve_step
+
+    check(sharded_evolve_step(shcfg, mesh, shst),
+          sharded_evolve_step(shcfg._replace(generation_impl="fused"),
+                              mesh, shst))
+
+    mcfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(10, 14), attacking_rate=0.4,
+        learn_from_rate=0.3, learn_from_severity=1, train=1,
+        remove_divergent=True, remove_zero=True, layout="popmajor")
+    mst = multisoup.seed_multi(mcfg, jax.random.key(13))
+    mref = multisoup.evolve_multi_step(mcfg, mst)
+    mgot = multisoup.evolve_multi_step(
+        mcfg._replace(generation_impl="fused"), mst)
+    for t in range(2):
+        np.testing.assert_array_equal(np.asarray(mref[0].uids[t]),
+                                      np.asarray(mgot[0].uids[t]))
+        np.testing.assert_array_equal(np.asarray(mref[1].action[t]),
+                                      np.asarray(mgot[1].action[t]))
+        r = np.asarray(mref[0].weights[t])
+        g = np.asarray(mgot[0].weights[t])
+        fin = np.isfinite(r) & np.isfinite(g)
+        np.testing.assert_allclose(g[fin], r[fin], rtol=2e-5, atol=1e-6)
+
+
+# ------------------------------------------------- config fences & compat
+
+
+def test_fused_rowmajor_rejected():
+    cfg = SoupConfig(topo=WW, size=8, layout="rowmajor",
+                     generation_impl="fused")
+    with pytest.raises(ValueError, match="popmajor"):
+        soup.evolve_step(cfg, seed(cfg, jax.random.key(0)))
+
+
+def test_fused_subsumes_pallas_legs_rejected():
+    cfg = _full_dynamics(WW, generation_impl="fused", train_impl="pallas")
+    with pytest.raises(ValueError, match="subsumed"):
+        soup.evolve_step(cfg, seed(cfg._replace(train_impl="xla"),
+                                   jax.random.key(0)))
+
+
+def test_fused_kernel_fence_rejects_offenvelope():
+    """Off-envelope topologies (no output-expressible activation grad)
+    reject upfront with a message, mirroring train_impl='pallas'."""
+    cfg = _full_dynamics(WW.with_(activation="swish"),
+                         generation_impl="fused")
+    with pytest.raises(ValueError, match="generation_impl='phases'"):
+        soup._check_popmajor(cfg)
+
+
+def test_fused_subsumes_compact_incl_overflow(monkeypatch):
+    """attack_impl='compact' under 'fused' is subsumed by phase masks; in
+    the capacity-OVERFLOW regime the chain's compact path falls back to
+    full width, so the two agree (uids exact, weights to the documented
+    lax.cond FMA-contraction ulps)."""
+    from srnn_tpu import soup as soup_mod
+
+    cfg_compact = _full_dynamics(WW, size=64, attacking_rate=0.5,
+                                 learn_from_rate=-1.0,
+                                 attack_impl="compact")
+    st = seed(cfg_compact, jax.random.key(9))
+    # force a capacity below the expected attacker count: the compact
+    # branch overflows and lax.cond takes the full-width fallback
+    monkeypatch.setattr(soup_mod, "_attack_capacity", lambda n, rate: 16)
+    ref = evolve(cfg_compact, st, generations=2)
+    got = evolve(cfg_compact._replace(generation_impl="fused"), st,
+                 generations=2)
+    np.testing.assert_array_equal(np.asarray(ref.uids), np.asarray(got.uids))
+    f, g = np.asarray(ref.weights), np.asarray(got.weights)
+    finite = np.isfinite(f).all(axis=1) & np.isfinite(g).all(axis=1)
+    np.testing.assert_allclose(g[finite], f[finite], rtol=1e-5, atol=1e-7)
+
+
+def test_fused_supported_predicates():
+    from srnn_tpu.multisoup import MultiSoupConfig, fused_supported_multi
+    from srnn_tpu.soup import fused_supported
+
+    assert fused_supported(_full_dynamics(WW))
+    assert not fused_supported(_full_dynamics(WW, layout="rowmajor"))
+    assert not fused_supported(_full_dynamics(WW, train_impl="pallas"))
+    assert not fused_supported(
+        _full_dynamics(WW.with_(activation="swish")))
+    m = MultiSoupConfig(topos=(WW, AGG), sizes=(8, 8), layout="popmajor")
+    assert fused_supported_multi(m)
+    assert not fused_supported_multi(m._replace(layout="rowmajor"))
+    assert not fused_supported_multi(m._replace(apply_impl="pallas"))
