@@ -415,7 +415,16 @@ def _run_child(stage: str, timeout: float, env: dict):
         return parsed, None, hb
     if rc is None:
         return None, f"timeout>{timeout:.0f}s", hb
-    return None, f"rc={rc}, no result line", hb
+    # the elastic supervisor's exit-code vocabulary (srnn_tpu.resilience):
+    # a preempted or retry-exhausted child is a NAMED outcome in the
+    # stage_log, not an anonymous nonzero rc that reads like a wedge
+    try:
+        from srnn_tpu.resilience import EXIT_CODE_NAMES
+        named = EXIT_CODE_NAMES.get(rc)
+    except Exception:
+        named = None
+    suffix = f" ({named})" if named else ""
+    return None, f"rc={rc}{suffix}, no result line", hb
 
 
 def _scan_sentinel(stdout_bytes, sentinel):

@@ -43,6 +43,27 @@ for f in tests/test_*.py; do
     summary+=$(printf '%-34s %-4s %4ss' "$f" "$status" "$((SECONDS-t0))")$'\n'
 done
 
+# Fast chaos smoke (srnn_tpu/resilience/): one injected finisher stall +
+# one poisoned background-writer job in a single supervised smoke run
+# must both be RECOVERED — exit 3 ("recovered") and a "supervisor:
+# restart" line in the run log.  This drills the retry/resume machinery
+# itself on every suite run, not just when the slow e2es are selected.
+t0=$SECONDS
+smoke_root=$(mktemp -d)
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups mega_soup --smoke \
+    --root "$smoke_root" --chaos "stall@2,writer@8" --stall-timeout-s 5 \
+    --backoff-base-s 0.1 --backoff-max-s 1 --max-restarts 3 \
+    > "$smoke_root/out.log" 2>&1
+rc=$?
+if [ "$rc" -eq 3 ] && grep -q "supervisor: restart" "$smoke_root"/exp-*/log.txt; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("chaos_smoke(rc=$rc)")
+    tail -n 30 "$smoke_root/out.log"
+fi
+rm -rf "$smoke_root"
+summary+=$(printf '%-34s %-4s %4ss' "chaos_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 echo
 echo "=== run_tests.sh summary ==="
 printf '%s' "$summary"

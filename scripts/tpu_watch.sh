@@ -18,11 +18,23 @@ while true; do
     if PYTHONPATH= timeout 280 python benchmarks/opportunistic.py \
             --probe-only >> "$LOG" 2>&1; then
         echo "$(date -u +%FT%TZ) tpu_watch: HEALTHY — running window capture" >> "$LOG"
-        if PYTHONPATH= bash scripts/tpu_window.sh >> "$LOG" 2>&1; then
-            echo "$(date -u +%FT%TZ) tpu_watch: window capture complete" >> "$LOG"
-            exit 0
-        fi
-        echo "$(date -u +%FT%TZ) tpu_watch: capture failed; resuming watch" >> "$LOG"
+        PYTHONPATH= bash scripts/tpu_window.sh >> "$LOG" 2>&1
+        rc=$?
+        # The elastic supervisor (srnn_tpu/resilience/) speaks a distinct
+        # exit-code vocabulary; honor it instead of reading every nonzero
+        # exit as a wedge:
+        #   0  clean            3  recovered (succeeded after restarts)
+        #   75 preempted-clean  (SIGTERM honored; checkpoint resumable)
+        #   69 retries-exhausted (recovery budget spent)
+        case "$rc" in
+            0)  echo "$(date -u +%FT%TZ) tpu_watch: window capture complete" >> "$LOG"
+                exit 0 ;;
+            3)  echo "$(date -u +%FT%TZ) tpu_watch: window capture complete (recovered after in-run restarts)" >> "$LOG"
+                exit 0 ;;
+            75) echo "$(date -u +%FT%TZ) tpu_watch: preempted-clean — resumable checkpoint on disk; watching for the next window" >> "$LOG" ;;
+            69) echo "$(date -u +%FT%TZ) tpu_watch: retries exhausted inside the window; watching for the next window" >> "$LOG" ;;
+            *)  echo "$(date -u +%FT%TZ) tpu_watch: capture failed (rc=$rc, possible wedge); resuming watch" >> "$LOG" ;;
+        esac
     fi
     sleep "$INTERVAL"
 done

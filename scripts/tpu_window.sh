@@ -42,20 +42,52 @@ AXON_PP="$PWD:${SRNN_AXON_SITE:-/root/.axon_site}"
 if ! PYTHONPATH="$AXON_PP" timeout 300 python -c "
 from srnn_tpu.utils.backend import ensure_backend
 p, _ = ensure_backend(retries=2, sleep_s=5.0, fallback_cpu=False)
-raise SystemExit(0 if p != 'cpu' else 3)"; then
+raise SystemExit(0 if p != 'cpu' else 4)"; then
+    # exit 4, NOT 3: 3 now means "recovered" in the supervisor exit
+    # vocabulary tpu_watch.sh branches on; 4 lands in its wedge/retry arm
     echo "accelerator gate failed; NOT running mega_soup on CPU"
-    exit 3
+    exit 4
 fi
 # full dynamics at the flagship scale — the same config as the committed
 # CPU north-star run (results_tpu/exp-mega-soup-_1785434317.9088535-0)
 # plus the round-5 fused train kernel; resumable run dir under
-# results_tpu/ (bit-exact resume if the window closes mid-run)
+# results_tpu/ (bit-exact resume if the window closes mid-run).
+#
+# Cross-window elasticity: if the NEWEST mega-soup run dir holds an
+# unfinished checkpoint (gen < 1000 — e.g. last window ended in a
+# preempted-clean exit 75), CONTINUE it instead of starting over; the
+# run's saved config wins over the flags below, so the continuation is
+# bit-exact.  This is what makes tpu_watch.sh's "resumable checkpoint on
+# disk; watching for the next window" actually pay off unattended.
+RESUME=""
+latest_run=$(ls -dt results_tpu/exp-mega-soup-*/ 2>/dev/null | head -1)
+if [ -n "$latest_run" ] && [ -f "$latest_run/config.json" ]; then
+    last_ckpt=$(ls -d "$latest_run"ckpt-gen* 2>/dev/null \
+        | grep -E 'ckpt-gen[0-9]+/?$' | sort | tail -1)
+    if [ -n "$last_ckpt" ]; then
+        gen=$((10#$(basename "$last_ckpt" | sed 's/ckpt-gen//')))
+        if [ "$gen" -lt 1000 ]; then
+            RESUME="${latest_run%/}"
+            echo "resuming unfinished mega-soup at gen $gen: $RESUME"
+        fi
+    fi
+fi
 PYTHONPATH="$AXON_PP" python -m srnn_tpu.setups mega_soup \
+    ${RESUME:+--resume "$RESUME"} \
     --root results_tpu \
     --size 1000000 --generations 1000 \
     --attacking-rate 0.1 --learn-from-rate 0.1 --train 10 \
     --layout popmajor --respawn-draws fused --train-impl pallas \
-    --capture-every 50 --checkpoint-every 100 --seed 7 \
-    || echo "mega_soup failed; rows above still stand"
+    --capture-every 50 --checkpoint-every 100 --seed 7
+rc=$?
+# supervisor exit vocabulary (srnn_tpu/resilience): 3 = recovered after
+# in-run restarts, still a success; 75/69 propagate to tpu_watch.sh
+case "$rc" in
+    0) ;;
+    3) echo "mega_soup recovered after in-run restart(s); run completed" ;;
+    75|69) echo "mega_soup exited $rc (supervisor); rows above still stand"
+           exit "$rc" ;;
+    *) echo "mega_soup failed (rc=$rc); rows above still stand" ;;
+esac
 
 echo "== done; commit results_tpu/ + RESULTS.md updates =="

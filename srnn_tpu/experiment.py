@@ -319,14 +319,31 @@ def _soup_state_from_pytree(tree: Dict[str, Any]) -> SoupState:
     )
 
 
+#: completion marker published (tmp + fsync + atomic rename) inside a
+#: checkpoint dir AFTER orbax finishes — its presence is the positive
+#: proof ``setups.common.checkpoint_intact`` wants before a resume trusts
+#: the dir (orbax's own tmp-dir rename guards against a kill mid-save,
+#: but not against a torn file from a dying disk or a partial copy)
+CKPT_OK_MARKER = "SRNN_CKPT_OK"
+
+
+def _finalize_checkpoint(path: str, time_value) -> None:
+    from .utils.atomicio import atomic_write_text
+
+    atomic_write_text(os.path.join(path, CKPT_OK_MARKER),
+                      json.dumps({"time": int(time_value)}) + "\n")
+
+
 def save_checkpoint(path: str, state: SoupState) -> str:
     """Write a resumable checkpoint of a soup (weights + uids + PRNG key +
-    generation counter) at ``path`` (a directory, created fresh)."""
+    generation counter) at ``path`` (a directory, created fresh), then
+    publish its completion marker (write-tmp + fsync + atomic rename)."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, _soup_state_to_pytree(state), force=True)
+    _finalize_checkpoint(path, state.time)
     return path
 
 
@@ -357,6 +374,7 @@ def save_multi_checkpoint(path: str, state) -> str:
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, tree, force=True)
+    _finalize_checkpoint(path, state.time)
     return path
 
 

@@ -1,4 +1,5 @@
-from .mesh import soup_mesh, shard_population, replicate, initialize_distributed
+from .mesh import (soup_mesh, shard_population, replicate,
+                   initialize_distributed, probe_devices)
 from .sharded_soup import (
     make_sharded_state,
     place_sharded_state,
@@ -25,11 +26,15 @@ from .sharded_apply import (
     sharded_fft_apply,
     sharded_weightwise_apply,
 )
-from .multihost import DCN_AXIS, multislice_soup_mesh
+from .multihost import (DCN_AXIS, multislice_soup_mesh, reramp_soup_mesh,
+                        slice_groups)
 
 __all__ = [
     "DCN_AXIS",
     "multislice_soup_mesh",
+    "probe_devices",
+    "reramp_soup_mesh",
+    "slice_groups",
     "soup_mesh",
     "shard_population",
     "replicate",

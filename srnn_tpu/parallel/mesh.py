@@ -36,6 +36,25 @@ def soup_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (SOUP_AXIS,))
 
 
+def probe_devices(verify: bool = False):
+    """Enumerate the devices that exist *right now* — the supervisor's
+    re-ramp input after a device loss.  ``verify=True`` additionally
+    round-trips one scalar through each device and drops any that fail
+    (a half-dead slice can still enumerate chips it cannot use); plain
+    enumeration is free and good enough for bring-up logging."""
+    devices = jax.devices()
+    if not verify:
+        return devices
+    alive = []
+    for d in devices:
+        try:
+            jax.device_put(np.int32(0), d).block_until_ready()
+            alive.append(d)
+        except Exception:
+            continue
+    return alive
+
+
 def shard_population(mesh: Mesh, pop: jax.Array) -> jax.Array:
     """Place a (N, ...) population with the leading axis sharded over the mesh."""
     return jax.device_put(pop, NamedSharding(mesh, P(SOUP_AXIS)))

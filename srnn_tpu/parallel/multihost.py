@@ -32,3 +32,40 @@ def multislice_soup_mesh(num_slices: int,
             f"{devs.size} devices do not split into {num_slices} slices")
     grid = devs.reshape(num_slices, devs.size // num_slices)
     return Mesh(grid, (DCN_AXIS, SOUP_AXIS))
+
+
+def slice_groups(devices) -> "list[list]":
+    """Partition devices by the slice they live on, parsed from whatever
+    topology attributes the platform exposes (``slice_index`` on TPU,
+    ``process_index`` as the multi-host fallback, one group when neither
+    varies) — the mesh-from-topology idiom: derive placement from the
+    devices actually present instead of from a config that described the
+    hardware the run *used to* have."""
+    groups: "dict[int, list]" = {}
+    for d in devices:
+        key = getattr(d, "slice_index", None)
+        if key is None:
+            key = getattr(d, "process_index", 0) or 0
+        groups.setdefault(int(key), []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def reramp_soup_mesh(devices=None) -> Mesh:
+    """Rebuild the largest *regular* mesh the SURVIVING devices support —
+    the topology re-ramp step after a partial loss (a preempted slice, a
+    dead host).  Slices that kept their full (modal) chip count form the
+    DCN axis of a fresh ``(slices, soup)`` mesh; when fewer than two
+    whole slices survive — or the survivors are ragged — the largest
+    single intact group becomes a 1-D soup mesh, ICI-only.  Raises
+    ``ValueError`` when nothing survives (the supervisor then degrades
+    to the process-restart tier, ``scripts/tpu_watch.sh``)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if not devs:
+        raise ValueError("no surviving devices to re-ramp onto")
+    groups = slice_groups(devs)
+    sizes = [len(g) for g in groups]
+    modal = max(set(sizes), key=lambda s: (sizes.count(s), s))
+    whole = [g for g in groups if len(g) == modal]
+    if len(whole) >= 2:
+        return Mesh(np.asarray(whole), (DCN_AXIS, SOUP_AXIS))
+    return Mesh(np.asarray(max(groups, key=len)), (SOUP_AXIS,))

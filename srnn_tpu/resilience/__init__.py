@@ -1,0 +1,35 @@
+"""Elastic run supervision: fault classification, retry/backoff, topology
+re-ramp and the deterministic chaos harness (see ``supervisor`` and
+``chaos`` module docstrings, and DESIGN.md §13)."""
+
+from .chaos import ChaosEvent, ChaosMonkey, parse_schedule
+from .supervisor import (DEVICE_LOSS, EXIT_CODE_NAMES, EXIT_PREEMPTED_CLEAN,
+                         EXIT_RECOVERED, EXIT_RETRIES_EXHAUSTED, FATAL, IO,
+                         PREEMPT, RETRYABLE, STALL, AttemptContext,
+                         BackoffPolicy, Preempted, Supervisor,
+                         classify_fault, exit_code_for_report,
+                         preempt_requested, supervised_run)
+
+__all__ = [
+    "AttemptContext",
+    "BackoffPolicy",
+    "ChaosEvent",
+    "ChaosMonkey",
+    "DEVICE_LOSS",
+    "EXIT_CODE_NAMES",
+    "EXIT_PREEMPTED_CLEAN",
+    "EXIT_RECOVERED",
+    "EXIT_RETRIES_EXHAUSTED",
+    "FATAL",
+    "IO",
+    "PREEMPT",
+    "Preempted",
+    "RETRYABLE",
+    "STALL",
+    "Supervisor",
+    "classify_fault",
+    "exit_code_for_report",
+    "parse_schedule",
+    "preempt_requested",
+    "supervised_run",
+]

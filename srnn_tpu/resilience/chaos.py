@@ -1,0 +1,241 @@
+"""Deterministic, schedule-driven fault injection for the run supervisor.
+
+Every recovery path in ``resilience.supervisor`` must be exercisable on
+CPU CI — a TPU window is too rare to be the first place a retry branch
+runs.  ``ChaosMonkey`` arms a fixed schedule of faults that fire at
+exact, reproducible points of a mega run:
+
+  * ``device_loss@G[:S]`` — raise a real ``XlaRuntimeError`` at the top
+    of the chunk starting at generation ``G`` (the type XLA itself
+    raises, so the supervisor's classifier — not a test-only branch —
+    routes it).  An optional ``:S`` records that only ``S`` devices
+    "survive": the supervisor's live-device probe honors the override,
+    which is how a topology shrink (2 shards → 1) is simulated on a
+    host whose devices cannot actually die.
+  * ``stall@G[:HOLD_S]`` — condemn the finisher of the chunk covering
+    generation ``G``: it blocks (default an hour) until the supervisor
+    aborts it during recovery, so the armed ``--stall-timeout-s``
+    deadline trips the real ``StallError`` path, watched thread and
+    all.  The condemned finisher never runs — recovery resumes from the
+    last durable checkpoint exactly as it would after a genuine wedge.
+  * ``writer@N`` — poison the ``N``-th job submitted to the background
+    writer (1-based, counted per attempt) with a permanent ``EIO``:
+    exercises the writer's first-error latch, the job-naming error
+    message, and the supervisor's ``io`` retry.
+  * ``sigterm@G`` — ``kill(self, SIGTERM)`` at the chunk boundary: the
+    real signal, the real handler, the graceful-preemption drain.
+  * ``sigkill@G`` — ``kill(self, SIGKILL)``: no cleanup of any kind —
+    the kill-and-resume e2e runs this in a child process and asserts
+    the ``.traj`` stream is bit-identical after resume.
+
+Every event fires **once per process**; an in-process restart keeps the
+consumed schedule, so recovery cannot loop on its own injector.  The
+schedule string is not persisted into ``config.json`` — a later
+``--resume`` of a chaos run is chaos-free unless re-armed explicitly.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+import threading
+from typing import Callable, List, Optional
+
+KINDS = ("device_loss", "stall", "writer", "sigterm", "sigkill")
+
+#: how long a condemned finisher holds before giving up on an abort (the
+#: supervisor aborts it within one backoff; this is the safety net)
+DEFAULT_STALL_HOLD_S = 3600.0
+
+
+class ChaosEvent:
+    __slots__ = ("kind", "at", "arg", "fired")
+
+    def __init__(self, kind: str, at: int, arg: Optional[float] = None):
+        self.kind = kind
+        self.at = int(at)   # generation (writer: 1-based job ordinal)
+        self.arg = arg
+        self.fired = False
+
+    def __repr__(self):
+        return (f"ChaosEvent({self.kind}@{self.at}"
+                + (f":{self.arg:g}" if self.arg is not None else "")
+                + (" fired" if self.fired else "") + ")")
+
+
+def parse_schedule(spec: str) -> List[ChaosEvent]:
+    """Parse ``kind@N[:arg],…`` (see module docstring).  Raises
+    ``ValueError`` on an unknown kind or malformed entry."""
+    events = []
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        try:
+            kind, rest = entry.split("@", 1)
+            arg: Optional[float] = None
+            if ":" in rest:
+                rest, args_ = rest.split(":", 1)
+                arg = float(args_)
+            at = int(rest)
+        except ValueError:
+            raise ValueError(
+                f"bad chaos entry {entry!r} (want kind@N or kind@N:arg)")
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown chaos kind {kind!r} (one of {', '.join(KINDS)})")
+        if at < 0 or (arg is not None and arg < 0):
+            raise ValueError(f"negative value in chaos entry {entry!r}")
+        if kind == "writer" and at < 1:
+            raise ValueError(
+                f"writer job ordinals are 1-based: {entry!r} would never "
+                "fire (the first submitted job is writer@1)")
+        events.append(ChaosEvent(kind, at, arg))
+    events.sort(key=lambda e: e.at)
+    return events
+
+
+def _raise_device_loss(gen: int, survivors: Optional[int]) -> None:
+    """Raise the same exception type a real device loss surfaces as, so
+    the classifier's production branch — not a test shim — handles it."""
+    msg = (f"INTERNAL: chaos: simulated device loss at generation {gen}"
+           + (f" ({survivors} device(s) survive)" if survivors else ""))
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        raise XlaRuntimeError(msg)
+    except ImportError:  # pragma: no cover - jaxlib always has it here
+        raise RuntimeError(f"device lost — {msg}")
+
+
+class ChaosMonkey:
+    """The armed schedule plus the per-run injection hooks the mega loops
+    call (``chunk_start``/``wrap_finisher``/``attach_writer``)."""
+
+    def __init__(self, events: List[ChaosEvent]):
+        self.events = list(events)
+        #: device count the supervisor's live probe reports after a
+        #: shrinking device_loss event (0 = no override; consumed by
+        #: ``take_forced_live`` so only the event that set it is
+        #: simulated — later losses probe for real)
+        self.forced_live = 0
+        # one release event PER condemned finisher: a global flag would
+        # stay set after the first recovery and make every later stall
+        # event skip its finisher silently instead of stalling
+        self._holds: List[threading.Event] = []
+        self._holds_lock = threading.Lock()
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def from_args(cls, args) -> Optional["ChaosMonkey"]:
+        """Build from the ``--chaos`` CLI spec (None when unset) and
+        fail fast on schedules that cannot fire as written."""
+        spec = getattr(args, "chaos", None)
+        if not spec:
+            return None
+        try:
+            events = parse_schedule(spec)
+        except ValueError as e:
+            raise SystemExit(f"--chaos: {e}")
+        if not events:
+            raise SystemExit("--chaos: empty schedule")
+        if any(e.kind == "stall" for e in events) \
+                and not getattr(args, "stall_timeout_s", 0.0):
+            raise SystemExit("--chaos stall@N needs --stall-timeout-s > 0 "
+                             "(nothing would convert the injected hang "
+                             "into a StallError)")
+        return cls(events)
+
+    # -- injection hooks ---------------------------------------------------
+
+    def chunk_start(self, gen: int) -> None:
+        """Fire every due generation-keyed event; called by the mega loops
+        at the top of each chunk iteration, before the chunk's dispatch."""
+        for ev in self.events:
+            if ev.fired or ev.kind in ("writer", "stall") or gen < ev.at:
+                continue
+            ev.fired = True
+            if ev.kind == "device_loss":
+                if ev.arg:
+                    self.forced_live = int(ev.arg)
+                _raise_device_loss(gen, int(ev.arg) if ev.arg else None)
+            elif ev.kind == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif ev.kind == "sigkill":  # pragma: no cover - kills the proc
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def wrap_finisher(self, finish: Callable[[], None],
+                      gen_end: int) -> Callable[[], None]:
+        """Condemn the finisher of the chunk ending at ``gen_end`` when a
+        stall event is due: the replacement blocks until the supervisor's
+        recovery aborts it (or the hold elapses) and NEVER runs the real
+        finisher — its chunk is lost exactly as a genuine wedge loses it."""
+        ev = next((e for e in self.events
+                   if e.kind == "stall" and not e.fired and e.at <= gen_end),
+                  None)
+        if ev is None:
+            return finish
+        ev.fired = True
+        hold = ev.arg if ev.arg else DEFAULT_STALL_HOLD_S
+        release = threading.Event()
+        with self._holds_lock:
+            self._holds.append(release)
+
+        def stalled():
+            release.wait(hold)
+
+        return stalled
+
+    def attach_writer(self, writer) -> None:
+        """Arm the next pending ``writer@N`` event on a freshly-built
+        :class:`~srnn_tpu.utils.pipeline.BackgroundWriter`: its ``N``-th
+        submitted job (1-based) is replaced with one that raises a
+        permanent ``EIO`` — the latch path, with the job named."""
+        ev = next((e for e in self.events
+                   if e.kind == "writer" and not e.fired), None)
+        if ev is None or writer is None:
+            return
+        orig = writer.submit
+        count = [0]
+
+        def submit(fn, *a, **k):
+            count[0] += 1
+            if count[0] == ev.at and not ev.fired:
+                ev.fired = True
+                label = getattr(fn, "__name__", repr(fn))
+                ordinal = count[0]  # bind NOW: the job executes later,
+                # when the shared counter has already moved past it
+
+                def chaos_poisoned_job(*_a, **_k):
+                    raise OSError(
+                        errno.EIO,
+                        f"chaos: injected permanent writer fault in place "
+                        f"of job {ordinal} ({label})")
+
+                return orig(chaos_poisoned_job)
+            return orig(fn, *a, **k)
+
+        writer.submit = submit
+
+    def abort_pending(self) -> None:
+        """Release the currently-condemned finisher threads (recovery
+        calls this before restarting, so no chaos thread outlives its
+        attempt).  Later stall events get fresh holds — releasing is per
+        recovery, never a permanent disarm."""
+        with self._holds_lock:
+            holds, self._holds = self._holds, []
+        for h in holds:
+            h.set()
+
+    def take_forced_live(self) -> int:
+        """Consume the simulated survivor count (0 = none pending): each
+        ``device_loss@G:S`` overrides exactly ONE recovery probe, so a
+        later un-annotated loss probes the real topology."""
+        forced, self.forced_live = self.forced_live, 0
+        return forced
+
+    @property
+    def pending(self) -> List[ChaosEvent]:
+        return [e for e in self.events if not e.fired]

@@ -1,0 +1,508 @@
+"""The elastic run supervisor: preemption-tolerant mega runs.
+
+The paper's soups only say something about fixpoint/divergence dynamics
+if the run *finishes*, yet the opportunistic-TPU machinery
+(``scripts/tpu_watch.sh``, a BENCH history full of wedges and timeouts)
+shows hardware that comes and goes.  Every ingredient for survival
+already exists — bit-exact ``--resume`` from orbax checkpoints, the
+flight recorder's triage bundles, donation-safe ``snapshot()``,
+``StallError`` deadlines — but before this module, nothing turned a
+failure into anything other than a dead process.  The supervisor is
+that missing layer: it wraps one attempt of a mega loop
+(``mega_soup``/``mega_multisoup``) and converts classified faults into
+**checkpoint-from-last-snapshot → bounded retries with exponential
+backoff + deterministic jitter → topology re-ramp**.
+
+Fault taxonomy (:func:`classify_fault`):
+
+  * ``device_loss`` — ``XlaRuntimeError`` (XLA device loss, TPU goaway/
+    maintenance preemption surfacing through a dispatch) or a
+    ``RuntimeError`` whose text names a lost/halted device.  Recovery
+    re-enumerates live devices and may **re-ramp**: rebuild the mesh on
+    the survivors (8→4 devices; repeated losses without an observed
+    shrink degrade by halving) and re-shard the restored population
+    onto it.  TPU→CPU degradation needs a fresh process (a jax backend
+    cannot be re-initialized in-process) — that tier is
+    ``scripts/tpu_watch.sh``'s, driven by this module's exit codes.
+  * ``stall`` — :class:`~srnn_tpu.utils.pipeline.StallError` from the
+    ``ChunkDriver`` finisher deadline (device results never landed).
+  * ``io`` — :class:`~srnn_tpu.utils.pipeline.WriterError` (a
+    background job failed past its own retry budget) or an ``OSError``
+    with a plausibly-transient errno.  ``FileNotFoundError`` and
+    permission errors are deliberately **fatal**: they are user or
+    programming errors that a retry can only repeat.
+  * ``preempt`` — :class:`Preempted`, raised by the mega loops at the
+    next chunk boundary after SIGTERM (TPU maintenance sends SIGTERM
+    before reclaiming a slice).  Never retried: the loop has already
+    drained its pipeline, so the final checkpoint is durable, and the
+    process exits :data:`EXIT_PREEMPTED_CLEAN` so the watch tier knows
+    the run is resumable, not wedged.
+  * ``fatal`` — everything else (including ``SystemExit`` from CLI
+    validation): re-raised unchanged.
+
+Recovery is **resume**: the supervisor points the next attempt at the
+faulted run directory whenever a finalized checkpoint exists there, so
+the entire restore path (config pinning, torn-tail truncation, lineage
+sidecar, ``own_pytree``) is the one ``--resume`` already bit-exact
+tests.  An unchanged-topology recovery therefore replays bit-exactly
+against an uninterrupted single-host run — the parity oracle the chaos
+harness (``resilience.chaos``) asserts on CPU CI.
+
+Exit-code vocabulary (consumed by ``scripts/tpu_watch.sh`` and named by
+``bench.py``):
+
+  * ``0`` — clean success, no faults.
+  * :data:`EXIT_RECOVERED` (3) — success after ≥1 in-process restart
+    (CLI only; the Python API returns the run dir either way).
+  * :data:`EXIT_RETRIES_EXHAUSTED` (69, ``EX_UNAVAILABLE``) — the
+    retry budget is spent; the last traceback was printed.
+  * :data:`EXIT_PREEMPTED_CLEAN` (75, ``EX_TEMPFAIL``) — SIGTERM was
+    honored with a graceful final checkpoint; resume when hardware
+    returns.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, List, Optional
+
+# -- fault taxonomy ---------------------------------------------------------
+
+DEVICE_LOSS = "device_loss"
+STALL = "stall"
+IO = "io"
+PREEMPT = "preempt"
+FATAL = "fatal"
+
+#: retryable faults (everything except PREEMPT, which exits clean, and
+#: FATAL, which re-raises)
+RETRYABLE = (DEVICE_LOSS, STALL, IO)
+
+# CLI exit codes (sysexits.h where one fits); see module docstring
+EXIT_RECOVERED = 3
+EXIT_RETRIES_EXHAUSTED = 69   # EX_UNAVAILABLE
+EXIT_PREEMPTED_CLEAN = 75     # EX_TEMPFAIL
+
+EXIT_CODE_NAMES = {
+    EXIT_RECOVERED: "recovered",
+    EXIT_RETRIES_EXHAUSTED: "retries-exhausted",
+    EXIT_PREEMPTED_CLEAN: "preempted-clean",
+}
+
+#: last supervised run's report — ``setups.__main__`` maps it to the CLI
+#: exit code (the Python API returns run dirs, not codes)
+LAST_REPORT: Optional[dict] = None
+
+
+class Preempted(Exception):
+    """SIGTERM was honored: the mega loop stopped at a chunk boundary,
+    drained its pipeline (final checkpoint durable) and unwound.  Carries
+    the generation of the last durable checkpoint."""
+
+    def __init__(self, generation: int):
+        super().__init__(f"preempted at generation {generation} "
+                         "(final checkpoint durable)")
+        self.generation = generation
+
+
+# a RuntimeError whose text names a lost/halted device counts as device
+# loss even when the concrete XlaRuntimeError type is unavailable
+_DEVICE_LOSS_RE = re.compile(
+    r"goaway|preempt|data_loss|slice.*health|device.*(lost|loss|halt|fail)",
+    re.IGNORECASE)
+
+# XLA statuses that are DETERMINISTIC program/shape/memory failures: a
+# retry repeats them, and the re-ramp's budget-halving makes an OOM
+# strictly worse (fewer devices => bigger shards).  These stay fatal even
+# though they arrive as XlaRuntimeError.
+_DETERMINISTIC_XLA_RE = re.compile(
+    r"RESOURCE_EXHAUSTED|INVALID_ARGUMENT|FAILED_PRECONDITION"
+    r"|UNIMPLEMENTED|OUT_OF_RANGE", re.IGNORECASE)
+
+# OSError errnos worth retrying (transient by nature); everything else —
+# ENOENT, EACCES, EISDIR… — is a user/programming error a retry repeats
+_RETRYABLE_ERRNOS = frozenset({
+    4,    # EINTR
+    5,    # EIO (flaky storage / NFS blips)
+    11,   # EAGAIN
+    28,   # ENOSPC (logs may rotate; the writer already burned its grace)
+    110,  # ETIMEDOUT
+    116,  # ESTALE
+})
+
+
+def _xla_error_types() -> tuple:
+    types: List[type] = []
+    try:  # jax >= 0.4.14 re-exports it
+        from jax.errors import JaxRuntimeError
+        types.append(JaxRuntimeError)
+    except Exception:
+        pass
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+        types.append(XlaRuntimeError)
+    except Exception:
+        pass
+    return tuple(types)
+
+
+def classify_fault(exc: BaseException) -> str:
+    """Map an exception to the fault taxonomy (module docstring)."""
+    from ..utils.pipeline import StallError, WriterError
+
+    if isinstance(exc, Preempted):
+        return PREEMPT
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
+        return FATAL
+    if isinstance(exc, StallError):
+        return STALL
+    if isinstance(exc, WriterError):
+        # the wrapper is only as retryable as what it wraps: a job that
+        # died on ENOENT/EACCES — or on a deterministic logic error —
+        # re-dies identically on every retry, while a device loss
+        # surfacing through a deferred resolve on the writer thread must
+        # keep its device_loss classification (and its re-ramp)
+        cause = exc.__cause__
+        if cause is None:
+            return IO  # writer-internal refusal (closed/latched)
+        inner = classify_fault(cause)
+        return inner if inner in (IO, DEVICE_LOSS) else FATAL
+    xla_types = _xla_error_types()
+    if xla_types and isinstance(exc, xla_types):
+        return FATAL if _DETERMINISTIC_XLA_RE.search(str(exc)) \
+            else DEVICE_LOSS
+    if isinstance(exc, OSError):
+        return IO if exc.errno in _RETRYABLE_ERRNOS else FATAL
+    if isinstance(exc, RuntimeError) and _DEVICE_LOSS_RE.search(str(exc)):
+        return DEVICE_LOSS
+    return FATAL
+
+
+# -- SIGTERM / preemption machinery -----------------------------------------
+
+_PREEMPT = threading.Event()
+
+
+def preempt_requested() -> bool:
+    """True once SIGTERM arrived — the mega loops poll this at every chunk
+    boundary and stop gracefully (drain → final checkpoint → unwind)."""
+    return _PREEMPT.is_set()
+
+
+def _on_sigterm(signum, frame):  # pragma: no cover - trivial
+    _PREEMPT.set()
+
+
+class _SigtermGuard:
+    """Install the graceful-preemption SIGTERM handler for the duration of
+    a supervised run; restore the previous disposition (and clear the
+    flag) on the way out.  A non-main-thread caller (no signal access)
+    degrades to a no-op — preemption then follows the default path."""
+
+    _NOT_INSTALLED = object()
+
+    def __enter__(self):
+        _PREEMPT.clear()
+        self._prev = self._NOT_INSTALLED
+        try:
+            self._prev = signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is not self._NOT_INSTALLED:
+            try:
+                signal.signal(signal.SIGTERM, self._prev)
+            except (ValueError, TypeError):
+                pass
+        _PREEMPT.clear()
+        return False
+
+
+# -- retry policy -----------------------------------------------------------
+
+
+class BackoffPolicy:
+    """Bounded exponential backoff with **deterministic** jitter.
+
+    ``delay(k)`` for restart ``k`` is ``base * 2**k`` capped at ``max_s``,
+    scaled by ``1 ± jitter`` drawn from a ``random.Random(seed)`` stream —
+    the same seed yields the same delay sequence, so a chaos-harness run
+    is reproducible end to end (the jitter still decorrelates real fleets,
+    whose seeds differ)."""
+
+    def __init__(self, max_restarts: int = 3, base_s: float = 2.0,
+                 max_s: float = 60.0, jitter: float = 0.1, seed: int = 0):
+        self.max_restarts = max(0, int(max_restarts))
+        self.base_s = max(0.0, float(base_s))
+        self.max_s = max(0.0, float(max_s))
+        self.jitter = min(1.0, max(0.0, float(jitter)))
+        self._rng = random.Random(int(seed) ^ 0x5E51)
+
+    def delay(self, restart: int) -> float:
+        d = min(self.base_s * (2.0 ** max(0, int(restart))), self.max_s)
+        if self.jitter:
+            d *= 1.0 + self.jitter * self._rng.uniform(-1.0, 1.0)
+        return d
+
+
+# -- the supervisor ---------------------------------------------------------
+
+
+class AttemptContext:
+    """What one attempt of a mega loop shares with its supervisor.
+
+    The loop publishes ``run_dir`` (as soon as its Experiment exists) and
+    ``last_seen_devices``; it reads ``chaos`` (the fault injector, if
+    armed), ``restarts``/``recoveries`` (for the restart log line and the
+    telemetry fold) and ``device_budget`` via :meth:`mesh_devices`."""
+
+    def __init__(self, chaos=None, device_budget: Optional[int] = None):
+        self.chaos = chaos
+        self.device_budget = device_budget  # None = all visible devices
+        self.attempt = 0
+        self.restarts = 0
+        self.run_dir: Optional[str] = None
+        self.last_seen_devices: Optional[int] = None
+        #: the verified-live device OBJECTS from the last re-ramp probe —
+        #: identity matters, not just count: slicing jax.devices() to a
+        #: count could hand the next mesh the very chip that died
+        self.survivor_devices: Optional[list] = None
+        #: population size(s) the particle axis must divide over — the
+        #: loops publish these before building a mesh so a re-ramp can
+        #: only land on a device count the shards actually fit (a
+        #: 1M-particle soup on 3 survivors would otherwise turn a
+        #: retryable loss into a fatal divisibility error)
+        self.shard_sizes: "tuple[int, ...]" = ()
+        self.recoveries: List[dict] = []
+
+    def mesh_devices(self) -> Optional[list]:
+        """Devices the next mesh should ride (None = all visible): the
+        verified survivors of the last re-ramp when there are any,
+        intersected with what exists now, clamped to the budget, and
+        snapped DOWN to a count that divides every published shard size
+        — so a stale budget can fail neither ``soup_mesh``'s fail-fast
+        check nor the sharded state placement."""
+        if self.device_budget is None and self.survivor_devices is None:
+            return None
+        import jax
+
+        visible = jax.devices()
+        devs = [d for d in (self.survivor_devices or visible)
+                if d in visible] or list(visible)
+        if self.device_budget is not None:
+            devs = devs[:max(1, min(self.device_budget, len(devs)))]
+        n = len(devs)
+        while n > 1 and any(s % n for s in self.shard_sizes):
+            n -= 1
+        devs = devs[:n]
+        self.last_seen_devices = len(devs)
+        return devs
+
+
+class Supervisor:
+    """Run ``run_once(args, ctx)`` until it finishes, converting retryable
+    faults into checkpoint-resume attempts (see module docstring)."""
+
+    def __init__(self, policy: BackoffPolicy, chaos=None,
+                 device_budget: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 log: Callable[[str], None] = None):
+        self.policy = policy
+        self.chaos = chaos
+        self.ctx = AttemptContext(chaos=chaos, device_budget=device_budget)
+        self._sleep = sleep
+        self._log = log or (lambda msg: print(f"supervisor: {msg}",
+                                              file=sys.stderr, flush=True))
+
+    # -- device enumeration / topology re-ramp --------------------------
+
+    def _probe_survivors(self) -> "tuple[Optional[int], Optional[list]]":
+        """(count, devices) of what survived — the chaos override first
+        (consumed per event; CPU CI simulates shrink by count, the first
+        N visible devices standing in for the survivors), then a
+        verifying re-enumeration that keeps device IDENTITIES (slicing a
+        count off ``jax.devices()`` could re-adopt the dead chip).
+        ``(None, None)`` when the backend cannot even be asked."""
+        forced = self.chaos.take_forced_live() if self.chaos is not None \
+            else 0
+        if forced:
+            try:
+                import jax
+
+                return forced, list(jax.devices())[:forced]
+            except Exception:
+                return forced, None
+        try:
+            from ..parallel.mesh import probe_devices
+
+            alive = probe_devices(verify=True)
+            return (len(alive) or None), (alive or None)
+        except Exception:
+            return None, None
+
+    def _reramp(self) -> bool:
+        """Choose the next attempt's device budget after a device loss.
+        Survivors win; a loss with no observed shrink (the fault keeps
+        firing on the same topology) degrades by halving, floored at one
+        device.  Returns True when the budget changed.  An attempt that
+        never built a mesh (unsharded) has nothing to re-ramp — retry
+        rides the same single device, and a chip that is truly gone
+        exhausts the budget into the process-restart tier."""
+        ctx = self.ctx
+        prev = ctx.device_budget if ctx.device_budget is not None \
+            else ctx.last_seen_devices
+        if prev is None:
+            return False
+        live, survivors = self._probe_survivors()
+        if survivors is not None:
+            # verified-alive identities win regardless of count — the
+            # next mesh must never re-adopt the chip that just died
+            ctx.survivor_devices = survivors
+        repeat = bool(ctx.recoveries) \
+            and ctx.recoveries[-1]["kind"] == DEVICE_LOSS
+        if live is not None and live < prev:
+            new = live
+        elif repeat:
+            # the loss REPEATS on a topology that still enumerates whole:
+            # degrade below it
+            new = max(1, int(prev) // 2)
+        else:
+            # first loss and the probe shows everything alive — a
+            # transient blip (tunnel hiccup, resolved goaway): retry on
+            # the same topology, halve only when it repeats
+            new = prev
+        changed = new != prev
+        ctx.device_budget = new
+        return changed
+
+    # -- the attempt loop ------------------------------------------------
+
+    def _recover(self, kind: str, exc: BaseException, args) -> None:
+        ctx = self.ctx
+        t0 = time.monotonic()
+        self._log(f"attempt {ctx.attempt + 1} failed with {kind} fault: "
+                  f"{type(exc).__name__}: {exc}")
+        if self.chaos is not None:
+            # release any chaos-condemned finisher threads so this
+            # attempt's pipeline cannot leak into the next one
+            self.chaos.abort_pending()
+        reramped = False
+        if kind == DEVICE_LOSS:
+            reramped = self._reramp()
+            if reramped:
+                self._log(f"topology re-ramp: next attempt on "
+                          f"{ctx.device_budget} device(s)")
+        delay = self.policy.delay(ctx.restarts)
+        if delay > 0:
+            self._log(f"backing off {delay:.2f}s before restart "
+                      f"{ctx.restarts + 1}/{self.policy.max_restarts}")
+            self._sleep(delay)
+        # recovery IS resume whenever a finalized checkpoint exists —
+        # the bit-exact restore path the mega loops already test
+        if ctx.run_dir and not getattr(args, "resume", None):
+            from ..setups.common import latest_checkpoint
+
+            try:
+                latest_checkpoint(ctx.run_dir)
+                args.resume = ctx.run_dir
+                self._log(f"resuming {ctx.run_dir} from its latest "
+                          "finalized checkpoint")
+            except FileNotFoundError:
+                self._log("no finalized checkpoint yet; retrying from "
+                          "scratch (same seed, fresh run dir)")
+        ctx.restarts += 1
+        ctx.attempt += 1
+        ctx.recoveries.append({
+            "kind": kind,
+            "error": f"{type(exc).__name__}: {exc}",
+            "backoff_s": round(delay, 3),
+            "reramped": reramped,
+            "device_budget": ctx.device_budget,
+            "seconds": round(time.monotonic() - t0, 3),
+        })
+
+    def report(self, outcome: str) -> dict:
+        ctx = self.ctx
+        return {
+            "outcome": outcome,
+            "attempts": ctx.attempt + 1,
+            "restarts": ctx.restarts,
+            "reramps": sum(1 for r in ctx.recoveries if r["reramped"]),
+            "device_budget": ctx.device_budget,
+            "run_dir": ctx.run_dir,
+            "recoveries": list(ctx.recoveries),
+        }
+
+    def run(self, run_once: Callable[[Any, AttemptContext], Any],
+            args) -> Any:
+        global LAST_REPORT
+        LAST_REPORT = None
+        ctx = self.ctx
+        with _SigtermGuard():
+            while True:
+                try:
+                    out = run_once(args, ctx)
+                except BaseException as e:
+                    kind = classify_fault(e)
+                    if kind == PREEMPT:
+                        LAST_REPORT = self.report("preempted")
+                        self._log(f"{e} — exiting "
+                                  f"{EXIT_PREEMPTED_CLEAN} (preempted-clean)")
+                        raise SystemExit(EXIT_PREEMPTED_CLEAN) from e
+                    if kind == FATAL or self.policy.max_restarts <= 0:
+                        # unsupervised (or unclassifiable) failures keep
+                        # their original type — tooling that matches on
+                        # StallError/SystemExit sees what it always saw
+                        raise
+                    if ctx.restarts >= self.policy.max_restarts:
+                        traceback.print_exc()
+                        LAST_REPORT = self.report("exhausted")
+                        self._log(
+                            f"{kind} fault after {ctx.restarts} restart(s); "
+                            f"retry budget spent — exiting "
+                            f"{EXIT_RETRIES_EXHAUSTED} (retries-exhausted)")
+                        raise SystemExit(EXIT_RETRIES_EXHAUSTED) from e
+                    self._recover(kind, e, args)
+                    continue
+                LAST_REPORT = self.report(
+                    "recovered" if ctx.restarts else "clean")
+                if ctx.restarts:
+                    self._log(f"run completed after {ctx.restarts} "
+                              f"restart(s)")
+                return out
+
+
+def exit_code_for_report(report: Optional[dict]) -> int:
+    """CLI exit code for a completed (non-raising) supervised run: 0 for a
+    clean pass, :data:`EXIT_RECOVERED` when restarts were needed.  The
+    raising outcomes (preempted/exhausted) exit via ``SystemExit`` with
+    their codes directly."""
+    if report is not None and report.get("outcome") == "recovered":
+        return EXIT_RECOVERED
+    return 0
+
+
+def supervised_run(args, run_once: Callable[[Any, AttemptContext], Any]):
+    """The mega loops' entry: build the chaos injector + policy from the
+    CLI namespace (``setups.common.add_resilience_args``) and run
+    ``run_once`` under a :class:`Supervisor`.  Returns the run dir."""
+    from .chaos import ChaosMonkey
+
+    chaos = ChaosMonkey.from_args(args)
+    policy = BackoffPolicy(
+        max_restarts=getattr(args, "max_restarts", 0),
+        base_s=getattr(args, "backoff_base_s", 2.0),
+        max_s=getattr(args, "backoff_max_s", 60.0),
+        jitter=getattr(args, "backoff_jitter", 0.1),
+        seed=getattr(args, "seed", 0))
+    sup = Supervisor(policy, chaos=chaos,
+                     device_budget=getattr(args, "max_devices", 0) or None)
+    return sup.run(run_once, args)

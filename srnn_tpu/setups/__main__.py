@@ -17,10 +17,19 @@ def main(argv=None):
         from ..utils.backend import force_cpu
 
         force_cpu()
+    # supervised mega runs speak a CLI exit-code vocabulary (0 clean,
+    # 3 recovered; the raising outcomes — 75 preempted-clean, 69
+    # retries-exhausted — exit via SystemExit from the run): tpu_watch.sh
+    # keys on these instead of treating every nonzero exit as a wedge.
+    # Reset first: a command that never enters Supervisor.run must not
+    # inherit the previous command's report in a long-lived process.
+    from ..resilience import exit_code_for_report, supervisor
+
+    supervisor.LAST_REPORT = None
     out = REGISTRY[argv[0]](argv[1:])
     if isinstance(out, str):
         print(out)  # the run directory — scriptable like the run() API
-    return 0
+    return exit_code_for_report(supervisor.LAST_REPORT)
 
 
 if __name__ == "__main__":

@@ -87,31 +87,69 @@ def log_counters(exp: Experiment, name: str, counts) -> None:
 # ---- shared mega-run plumbing (mega_soup / mega_multisoup) ----------------
 
 
+def checkpoint_intact(path: str) -> bool:
+    """Is ``path`` a checkpoint dir a resume may trust?  Checkpoints
+    written since the resilience round carry the ``SRNN_CKPT_OK`` marker
+    (published tmp + fsync + atomic-rename AFTER orbax finishes) — its
+    presence is the positive proof.  Legacy dirs (pre-marker) pass a
+    structural heuristic instead: non-empty, with no zero-length file —
+    a healthy orbax tree has none, while a torn write (kill or dying
+    disk mid-copy) leaves exactly that."""
+    from ..experiment import CKPT_OK_MARKER
+
+    if not os.path.isdir(path):
+        return False
+    if os.path.exists(os.path.join(path, CKPT_OK_MARKER)):
+        return True
+    seen = 0
+    for root, _dirs, files in os.walk(path):
+        for fname in files:
+            seen += 1
+            try:
+                if os.path.getsize(os.path.join(root, fname)) == 0:
+                    return False
+            except OSError:
+                return False
+    return seen > 0
+
+
 def latest_checkpoint(run_dir: str) -> str:
-    """Newest FINALIZED ckpt-gen* dir (a kill during save leaves orbax tmp
-    dirs named ckpt-genNNN.orbax-checkpoint-tmp-* that must not be picked
-    up; the isdigit filter excludes them)."""
+    """Newest INTACT finalized ckpt-gen* dir.  A kill during save leaves
+    orbax tmp dirs named ckpt-genNNN.orbax-checkpoint-tmp-* that must not
+    be picked up (the isdigit filter excludes them), and a torn survivor
+    (:func:`checkpoint_intact` fails) is SKIPPED with a warning — resume
+    falls back to the newest checkpoint that is actually whole instead of
+    crashing hours into a recovery."""
     import glob as _glob
+    import sys as _sys
 
     ckpts = sorted(
         (p for p in _glob.glob(os.path.join(run_dir, "ckpt-gen*"))
          if p.rsplit("gen", 1)[1].isdigit()),
         key=lambda p: int(p.rsplit("gen", 1)[1]))
-    if not ckpts:
-        raise FileNotFoundError(
-            f"no finalized ckpt-gen* checkpoints under {run_dir}")
-    return ckpts[-1]
+    for p in reversed(ckpts):
+        if checkpoint_intact(p):
+            return p
+        print(f"latest_checkpoint: skipping torn checkpoint {p}",
+              file=_sys.stderr, flush=True)
+    raise FileNotFoundError(
+        f"no finalized ckpt-gen* checkpoints under {run_dir}"
+        + (f" ({len(ckpts)} torn candidate(s) skipped)" if ckpts else ""))
 
 
 def save_run_config(run_dir: str, args, fields, extra=None) -> None:
     """Persist the run's dynamics knobs (and optional ``extra`` derived
-    metadata, e.g. per-type names for the viz layer) as config.json."""
+    metadata, e.g. per-type names for the viz layer) as config.json —
+    atomically, because ``--resume`` (and every supervised restart) reads
+    this file first."""
     import json as _json
+
+    from ..utils.atomicio import atomic_write_text
 
     doc = {k: getattr(args, k) for k in fields}
     doc.update(extra or {})
-    with open(os.path.join(run_dir, "config.json"), "w") as f:
-        _json.dump(doc, f, indent=1)
+    atomic_write_text(os.path.join(run_dir, "config.json"),
+                      _json.dumps(doc, indent=1))
 
 
 def load_run_config(run_dir: str, args, fields, legacy_defaults=None) -> None:
@@ -166,6 +204,82 @@ def make_pipeline(args, registry, stage: str):
                          stall_timeout_s=getattr(args, "stall_timeout_s",
                                                  0.0) or 0.0)
     return pipelined, writer, meter, driver
+
+
+# ---- elastic-supervisor plumbing (mega_soup / mega_multisoup) --------------
+
+
+def add_resilience_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The run-supervisor CLI knobs shared by the mega-run entry points
+    (see ``srnn_tpu.resilience``): bounded retries with deterministic
+    backoff, the device budget the topology re-ramp shrinks, and the
+    chaos-harness schedule."""
+    p.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                   help="in-process recovery budget: a classified "
+                        "device-loss/stall/IO fault restarts the run from "
+                        "its newest intact checkpoint at most N times "
+                        "(0 = unsupervised, faults propagate unchanged; "
+                        "exit codes: 0 clean, 3 recovered, 69 retries "
+                        "exhausted, 75 preempted-clean)")
+    p.add_argument("--backoff-base-s", type=float, default=2.0, metavar="S",
+                   help="restart k backs off base*2^k seconds (capped by "
+                        "--backoff-max-s) with deterministic +/-jitter "
+                        "seeded by --seed")
+    p.add_argument("--backoff-max-s", type=float, default=60.0, metavar="S",
+                   help="backoff ceiling in seconds")
+    p.add_argument("--backoff-jitter", type=float, default=0.1, metavar="F",
+                   help="jitter fraction on each backoff delay (0 disables)")
+    p.add_argument("--max-devices", type=int, default=0, metavar="N",
+                   help="initial device budget for --sharded (0 = all "
+                        "visible); a device-loss recovery may shrink it "
+                        "(re-ramp: survivors win, else halve)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic fault injection for recovery "
+                        "drills: comma-separated events — "
+                        "device_loss@G[:S] (raise at generation G, S "
+                        "devices 'survive'), stall@G[:HOLD_S] (condemn "
+                        "that chunk's finisher; needs --stall-timeout-s), "
+                        "writer@N (poison the Nth background-writer job), "
+                        "sigterm@G, sigkill@G; every event fires once "
+                        "(see resilience.chaos)")
+    return p
+
+
+def note_restart(exp, ctx) -> None:
+    """Publish a fresh attempt's Experiment to its supervisor
+    (``ctx.run_dir`` is where a later recovery resumes from) and, on a
+    restarted attempt, log the one ``supervisor: restart`` line the run
+    log carries per recovery.  Shared by both mega loops."""
+    if ctx is None:
+        return
+    ctx.run_dir = exp.dir
+    if not ctx.restarts:
+        return
+    last = ctx.recoveries[-1]
+    exp.log(f"supervisor: restart {ctx.restarts} after "
+            f"{last['kind']} fault ({last['error']}; backoff "
+            f"{last['backoff_s']}s"
+            + (f", re-ramped to {ctx.device_budget} device(s)"
+               if last["reramped"] else "") + ")",
+            kind="restart", restarts=ctx.restarts,
+            fault=last["kind"], reramped=last["reramped"])
+
+
+def chunk_boundary_faults(exp, chaos, gen: int, total: int) -> bool:
+    """Top-of-chunk supervision shared by both mega loops: honor a
+    pending SIGTERM (returns True — the loop breaks; its drain makes the
+    final checkpoint durable before the preempted-clean exit) and fire
+    any due chaos events."""
+    from ..resilience import preempt_requested
+
+    if preempt_requested():
+        exp.log(f"SIGTERM honored: stopping at generation {gen}/{total} "
+                "(drain + final checkpoint, then exit preempted-clean)",
+                kind="preempt", generation=gen)
+        return True
+    if chaos is not None:
+        chaos.chunk_start(gen)
+    return False
 
 
 # ---- replication-dynamics plumbing (mega_soup / mega_multisoup) ------------

@@ -40,16 +40,20 @@ from ..telemetry.soup_metrics import (type_names, update_class_gauges,
                                       set_precision_gauges,
                                       update_fused_counters,
                                       update_multi_registry)
+from ..resilience import Preempted, supervised_run
+from ..telemetry.flightrec import record_recovery
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
 from .common import (add_dynamics_args, add_flightrec_args,
-                     add_pipeline_args, base_parser, finish_pipeline,
+                     add_pipeline_args, add_resilience_args, base_parser,
+                     chunk_boundary_faults, finish_pipeline,
                      flush_lineage_probe, flush_lineage_window,
                      latest_checkpoint, make_flightrec, make_lineage,
                      make_on_stall, make_pipeline, load_run_config,
-                     register, save_run_config, watchdog_chunk)
+                     note_restart, register, save_run_config,
+                     watchdog_chunk)
 
 
 def build_parser():
@@ -96,6 +100,7 @@ def build_parser():
     add_pipeline_args(p)
     add_flightrec_args(p)
     add_dynamics_args(p)
+    add_resilience_args(p)
     return p
 
 
@@ -146,6 +151,13 @@ def _format_type_counts(counts: np.ndarray) -> str:
 
 
 def run(args):
+    """One supervised heterogeneous mega run (see ``mega_soup.run`` — the
+    same elastic-supervisor contract)."""
+    return supervised_run(args, _run_once)
+
+
+def _run_once(args, ctx=None):
+    chaos = ctx.chaos if ctx is not None else None
     if args.smoke:
         args.size = 48 if args.size == 1_000_000 else args.size
         args.generations = 6 if args.generations == 1000 else args.generations
@@ -184,8 +196,19 @@ def run(args):
     n_dev = 1
     if args.sharded:
         from ..parallel import soup_mesh
-        mesh = soup_mesh()
+        # device budget (--max-devices, shrunk by a topology re-ramp to
+        # the verified survivors, by identity).  The total size is
+        # published so a re-ramp snaps to a device count it divides;
+        # per-type checkpoint sizes are re-validated after restore (the
+        # adoption branch below) — a residual mismatch there still exits,
+        # by design.
+        if ctx is not None:
+            ctx.shard_sizes = (args.size,)
+        mesh = soup_mesh(devices=ctx.mesh_devices()
+                         if ctx is not None else None)
         n_dev = mesh.devices.size
+        if ctx is not None:
+            ctx.last_seen_devices = int(n_dev)
         if args.size % n_dev:
             raise SystemExit(
                 f"--sharded needs --size divisible by the {n_dev} visible "
@@ -206,11 +229,20 @@ def run(args):
         if got != cfg.sizes:
             # per-type sizes derive from the CURRENT device count under
             # --sharded; a resume on a different mesh would slice the
-            # restored arrays with wrong offsets deep in jit otherwise
-            raise SystemExit(
-                f"checkpointed per-type sizes {got} do not match this "
-                f"host's derived sizes {cfg.sizes}; resume on the original "
-                "device count")
+            # restored arrays with wrong offsets deep in jit otherwise.
+            # A topology re-ramp is the sanctioned exception: keep the
+            # CHECKPOINT's sizes whenever every type still shards evenly
+            # onto the surviving mesh — the population is what it is, the
+            # mesh is what remains.
+            if mesh is not None and all(s % n_dev == 0 for s in got):
+                cfg = cfg._replace(sizes=got)
+                exp.log(f"re-ramped topology: keeping checkpoint per-type "
+                        f"sizes {got} on {n_dev} device(s)")
+            else:
+                raise SystemExit(
+                    f"checkpointed per-type sizes {got} do not match this "
+                    f"host's derived sizes {cfg.sizes}; resume on the "
+                    "original device count (or one each size divides)")
         if mesh is not None:
             from ..parallel import place_sharded_multi_state
             state = place_sharded_multi_state(mesh, state)
@@ -241,6 +273,7 @@ def run(args):
                 f"train={cfg.train}/{cfg.train_mode} train_impl={impls}"
                 + (f" sharded over {mesh.devices.size} devices"
                    if mesh is not None else ""))
+    note_restart(exp, ctx)
 
     def _count(s):
         # device array out: dispatched before the next chunk donates s's
@@ -280,6 +313,8 @@ def run(args):
     # flight recorder + watchdog (see mega_soup / telemetry.flightrec)
     health_on = not args.no_health
     flightrec, watchdog = make_flightrec(args)
+    # restarted attempt: fold the recovery history (counters + ring row)
+    record_recovery(registry, flightrec, ctx)
     # replication-dynamics observatory (telemetry.dynamics): per-type
     # lineage carries over one shared pid space + the lineage.jsonl stream
     tnames = type_names(cfg)
@@ -298,6 +333,8 @@ def run(args):
         # hangs interpreter shutdown
         pipelined, writer, meter, driver = make_pipeline(args, registry,
                                                          "mega_multisoup")
+        if chaos is not None and writer is not None:
+            chaos.attach_writer(writer)
         driver.on_stall = make_on_stall(exp, flightrec, registry,
                                         lambda: gen)
         hb = Heartbeat(exp, stage="mega_multisoup",
@@ -437,7 +474,11 @@ def run(args):
                                save_fn=save_multi_checkpoint, gen=gen)
             return finish
 
+        preempted = False
         while gen < args.generations:
+            if chunk_boundary_faults(exp, chaos, gen, args.generations):
+                preempted = True
+                break
             chunk = min(args.checkpoint_every, args.generations - gen)
             # non-capture chunks hand their metrics + health (+ lineage)
             # carries to the finisher, which orders them ahead of the
@@ -483,9 +524,14 @@ def run(args):
             # never donated):
             counts_dev = _count(state)
             ckpt_state = snapshot(state) if pipelined else state
-            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, ms,
-                                  hs, ldata))
+            fin = _finisher(gen, chunk, counts_dev, ckpt_state, ms, hs,
+                            ldata)
+            if chaos is not None:
+                fin = chaos.wrap_finisher(fin, gen)
+            driver.step(fin)
         finish_pipeline(exp, driver, writer, meter, pipelined)
+        if preempted:
+            raise Preempted(gen)
         exp.log(f"done: {_format_type_counts(counts)}")
     finally:
         # teardown order (see mega_soup): armed profiler window, pipeline

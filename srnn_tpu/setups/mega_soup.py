@@ -32,15 +32,19 @@ from ..telemetry.flightrec import health_summary, update_health_gauges
 from ..telemetry.soup_metrics import (set_precision_gauges,
                                       update_class_gauges,
                                       update_fused_counters, update_registry)
+from ..resilience import Preempted, supervised_run
+from ..telemetry.flightrec import record_recovery
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
 from .common import (add_dynamics_args, add_flightrec_args,
-                     add_pipeline_args, base_parser, finish_pipeline,
+                     add_pipeline_args, add_resilience_args, base_parser,
+                     chunk_boundary_faults, finish_pipeline,
                      flush_lineage_probe, flush_lineage_window,
                      latest_checkpoint, load_run_config, make_flightrec,
-                     make_lineage, make_on_stall, make_pipeline, register,
-                     save_run_config, watchdog_chunk)
+                     make_lineage, make_on_stall, make_pipeline,
+                     note_restart, register, save_run_config,
+                     watchdog_chunk)
 
 
 def build_parser():
@@ -99,6 +103,7 @@ def build_parser():
     add_pipeline_args(p)
     add_flightrec_args(p)
     add_dynamics_args(p)
+    add_resilience_args(p)
     return p
 
 
@@ -110,6 +115,17 @@ _CONFIG_FIELDS = ("size", "attacking_rate", "learn_from_rate", "train",
 
 
 def run(args):
+    """One supervised mega run: ``_run_once`` under the elastic
+    supervisor (``srnn_tpu.resilience``) — classified faults restart from
+    the newest intact checkpoint with backoff and, on device loss, a
+    topology re-ramp; SIGTERM exits preempted-clean after a graceful
+    drain.  ``--max-restarts 0`` degrades to the bare loop (faults
+    propagate unchanged)."""
+    return supervised_run(args, _run_once)
+
+
+def _run_once(args, ctx=None):
+    chaos = ctx.chaos if ctx is not None else None
     if args.smoke:
         # shrink only the knobs left at their defaults, so e.g.
         # `--smoke --generations 4` still means 4 generations
@@ -159,7 +175,17 @@ def run(args):
     mesh = None
     if args.sharded:
         from ..parallel import soup_mesh
-        mesh = soup_mesh()
+        # the supervisor's device budget (initially --max-devices, shrunk
+        # by a topology re-ramp) bounds the mesh — by verified-survivor
+        # IDENTITY after a device loss, not just count; None = all
+        # visible.  Publishing the population size first lets a re-ramp
+        # snap to a device count the shards actually divide over.
+        if ctx is not None:
+            ctx.shard_sizes = (args.size,)
+        mesh = soup_mesh(devices=ctx.mesh_devices()
+                         if ctx is not None else None)
+        if ctx is not None:
+            ctx.last_seen_devices = int(mesh.devices.size)
 
     if args.resume:
         exp = Experiment.attach(args.resume)
@@ -187,6 +213,7 @@ def run(args):
                 f"attack={cfg.attacking_rate} train={cfg.train}/{cfg.train_mode}"
                 + (f" sharded over {mesh.devices.size} devices"
                    if mesh is not None else ""))
+    note_restart(exp, ctx)
 
     def _count(s):
         # returns the DEVICE array: the dispatch is cheap and ordered
@@ -213,6 +240,9 @@ def run(args):
     # watchdog that turns a pathological chunk into a triage bundle
     health_on = not args.no_health
     flightrec, watchdog = make_flightrec(args)
+    # a restarted attempt folds its recovery history into THIS attempt's
+    # registry + ring (restart counters, recovery-seconds histogram)
+    record_recovery(registry, flightrec, ctx)
     # replication-dynamics observatory: the persistent lineage carry + the
     # lineage.jsonl window stream (telemetry.dynamics; --lineage opt-in)
     lin, lin_writer, lincap = make_lineage(
@@ -232,6 +262,8 @@ def run(args):
         # q.get() and hang interpreter shutdown instead of exiting
         pipelined, writer, meter, driver = make_pipeline(args, registry,
                                                          "mega_soup")
+        if chaos is not None and writer is not None:
+            chaos.attach_writer(writer)
         driver.on_stall = make_on_stall(exp, flightrec, registry,
                                         lambda: gen)
         hb = Heartbeat(exp, stage="mega_soup",
@@ -376,7 +408,11 @@ def run(args):
                                save_fn=save_checkpoint, gen=gen)
             return finish
 
+        preempted = False
         while gen < args.generations:
+            if chunk_boundary_faults(exp, chaos, gen, args.generations):
+                preempted = True
+                break
             chunk = min(args.checkpoint_every, args.generations - gen)
             # non-capture chunks hand their metrics + health (+ lineage)
             # carries to the finisher, which orders them ahead of the
@@ -444,9 +480,14 @@ def run(args):
             # never donated):
             counts_dev = _count(state)
             ckpt_state = snapshot(state) if pipelined else state
-            driver.step(_finisher(gen, chunk, counts_dev, ckpt_state, m, h,
-                                  ldata))
+            fin = _finisher(gen, chunk, counts_dev, ckpt_state, m, h,
+                            ldata)
+            if chaos is not None:
+                fin = chaos.wrap_finisher(fin, gen)
+            driver.step(fin)
         finish_pipeline(exp, driver, writer, meter, pipelined)
+        if preempted:
+            raise Preempted(gen)
         exp.log(f"done: {counters_dict(counts)}")
     finally:
         # teardown order: any armed watchdog profiler window first (it

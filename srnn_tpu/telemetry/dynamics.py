@@ -646,6 +646,9 @@ def save_lineage_state(run_dir: str, lin, gen: int) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())  # replace alone doesn't force data to disk;
+        # a preemption right after the rename must not leave a torn sidecar
     os.replace(tmp, path)
 
 

@@ -155,6 +155,43 @@ def update_health_gauges(registry, summary: Dict[str, Any],
               "(max-|w| per particle) over the flush window").set(v, **labels)
 
 
+def record_recovery(registry, recorder: "FlightRecorder", ctx) -> None:
+    """Fold a supervised attempt's recovery history into the run's
+    telemetry: the restart/re-ramp counters and the per-recovery seconds
+    histogram on ``registry``, and one ``kind="restart"`` row in the
+    flight-recorder ring so a later triage bundle shows WHEN the run was
+    patched back together, interleaved with the health rows.
+
+    ``ctx`` is the supervisor's AttemptContext (duck-typed: ``restarts``,
+    ``attempt``, ``device_budget``, ``recoveries``).  Each attempt builds
+    a fresh registry, so folding the *cumulative* history keeps the
+    exported counters monotone across restarts.  No-op on the first
+    attempt (or unsupervised runs) — the steady-state hot path pays
+    nothing."""
+    if ctx is None or not getattr(ctx, "restarts", 0):
+        return
+    registry.counter("soup_restarts_total",
+                     help="supervised in-process restarts").inc(ctx.restarts)
+    reramps = sum(1 for r in ctx.recoveries if r.get("reramped"))
+    if reramps:
+        registry.counter("soup_topology_reramps_total",
+                         help="mesh rebuilds onto a changed device "
+                              "topology").inc(reramps)
+    hist = registry.histogram("soup_recovery_seconds",
+                              help="seconds from fault to restarted "
+                                   "attempt (incl. backoff)",
+                              unit="seconds")
+    for r in ctx.recoveries:
+        # "seconds" spans catch → restart decision and already contains
+        # the backoff sleep; do not add backoff_s on top
+        hist.observe(float(r.get("seconds", 0.0)))
+    if recorder is not None:
+        recorder.record({"kind": "restart", "attempt": ctx.attempt,
+                         "restarts": ctx.restarts,
+                         "device_budget": ctx.device_budget,
+                         "recoveries": list(ctx.recoveries)})
+
+
 # ---------------------------------------------------------------------------
 # the ring
 # ---------------------------------------------------------------------------

@@ -60,6 +60,11 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_health_weight_norm_min": "gauge",
     "soup_health_weight_norm_max": "gauge",
     "soup_watchdog_trips_total": "counter",
+    # -- elastic run supervisor (resilience/, folded via
+    #    telemetry.flightrec.record_recovery) -----------------------------
+    "soup_restarts_total": "counter",
+    "soup_topology_reramps_total": "counter",
+    "soup_recovery_seconds": "histogram",
     # -- heartbeats (telemetry.heartbeat) --------------------------------
     "heartbeat_generation": "gauge",
     "gens_per_sec": "gauge",

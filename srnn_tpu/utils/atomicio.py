@@ -1,0 +1,33 @@
+"""Crash-safe small-file writes: write-tmp → fsync → atomic rename.
+
+A mega run killed mid-write (SIGKILL, preemption, power) must never leave
+a *plausible-looking but torn* file where the resume path will trip over
+it.  ``os.replace`` alone survives a kill between open and rename, but
+not a kill between rename and the data reaching disk — the fsync before
+the rename closes that window (POSIX: an fsync'd tmp file renamed over
+the target is the canonical atomic-publish sequence).
+
+Used for the checkpoint ``SRNN_CKPT_OK`` markers (``experiment.py``),
+``config.json`` (``setups.common.save_run_config``) and the lineage
+resume sidecar — the files ``--resume`` reads first.
+"""
+
+import os
+
+
+def atomic_write_bytes(path: str, data: bytes) -> str:
+    """Publish ``data`` at ``path`` atomically (tmp + fsync + rename).
+    The tmp file lives in the target's directory so the rename never
+    crosses a filesystem boundary."""
+    path = os.path.abspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def atomic_write_text(path: str, text: str) -> str:
+    return atomic_write_bytes(path, text.encode("utf-8"))

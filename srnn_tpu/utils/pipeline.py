@@ -50,6 +50,7 @@ to non-daemon so interpreter exit cannot strand buffered I/O.
 
 from __future__ import annotations
 
+import errno
 import queue
 import threading
 import time
@@ -104,7 +105,17 @@ def spawn_thread(target: Callable, *, name: str, daemon: bool = False,
 
 class WriterError(RuntimeError):
     """A background job failed; raised on the submitting thread at the
-    next ``submit``/``flush``/``close`` after the failure."""
+    next ``submit``/``flush``/``close`` after the failure.  The message
+    names the failed job (its function name) so an operator — or the run
+    supervisor's fault log — sees *which* write died, not just that one
+    did."""
+
+
+#: errnos retried in place by the worker before the permanent latch trips:
+#: interrupted syscalls and would-block conditions are transient by
+#: definition; ENOSPC gets its own *time*-bounded grace (logs rotate,
+#: sibling runs finish) configured per writer.
+_TRANSIENT_ERRNOS = frozenset({errno.EINTR, errno.EAGAIN})
 
 
 class BackgroundWriter:
@@ -122,27 +133,72 @@ class BackgroundWriter:
       other cross-job invariant of the blocking loop is preserved.
     * **Backpressure** — ``submit`` blocks while ``maxsize`` jobs are
       pending; a producer can run at most one bounded window ahead.
-    * **Errors** — the first job exception latches: subsequent jobs are
-      skipped (a checkpoint must never land after its chunk's frame
-      appends failed) and the error re-raises, wrapped in
-      :class:`WriterError`, on the next call into the writer.
+    * **Errors** — *transient* I/O failures (``EINTR``/``EAGAIN``, and
+      ``ENOSPC`` within a configurable grace window) are retried in
+      place with exponential backoff; the first error that survives its
+      retry budget latches: subsequent jobs are skipped (a checkpoint
+      must never land after its chunk's frame appends failed) and the
+      error re-raises, wrapped in :class:`WriterError` **naming the
+      failed job**, on the next call into the writer.
     * **Shutdown** — ``close()`` drains the queue, joins the worker, runs
       close hooks (e.g. ``TrajStore.join``), and re-raises any latched
       error.  Idempotent; also the context-manager ``__exit__``.
     """
 
-    def __init__(self, maxsize: int = 8, name: str = "srnn-io"):
+    def __init__(self, maxsize: int = 8, name: str = "srnn-io",
+                 io_retries: int = 3, retry_backoff_s: float = 0.05,
+                 enospc_grace_s: float = 5.0):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(maxsize)))
         self._lock = threading.Lock()
         self._error: Optional[BaseException] = None
+        self._error_job: Optional[str] = None
         self._failed = False       # latched forever once any job raised
         self._closed = False
         self._busy_s = 0.0
         self.jobs_done = 0
+        self.jobs_retried = 0
+        self.io_retries = max(0, int(io_retries))
+        self.retry_backoff_s = max(0.0, float(retry_backoff_s))
+        self.enospc_grace_s = max(0.0, float(enospc_grace_s))
         self._close_hooks: List[Callable[[], None]] = []
         self._thread = spawn_thread(self._run, name=name)
 
     # -- worker ----------------------------------------------------------
+
+    def _execute(self, fn, args, kwargs) -> Optional[BaseException]:
+        """Run one job with the transient-I/O retry loop; return the
+        error that should latch (None on success).  EINTR/EAGAIN retry up
+        to ``io_retries`` times; ENOSPC retries while the grace window is
+        open (disk pressure is a fleet condition that clears on its own
+        schedule, not a count of attempts).
+
+        Caveat for append-shaped jobs: a retry re-runs the WHOLE job, so
+        a partial write followed by a successful retry can leave torn
+        bytes mid-stream.  Both stream formats tolerate it — ``.traj``
+        frames are CRC-checked (a torn frame drops on read) and every
+        jsonl reader in the repo skips unparseable lines — so the cost
+        is one lost row, against the satellite win of surviving the
+        EINTR/ENOSPC blips that used to kill whole mega runs."""
+        attempt = 0
+        t0 = time.monotonic()
+        while True:
+            try:
+                fn(*args, **kwargs)
+                return None
+            except OSError as e:
+                transient = e.errno in _TRANSIENT_ERRNOS \
+                    and attempt < self.io_retries
+                enospc = e.errno == errno.ENOSPC \
+                    and (time.monotonic() - t0) < self.enospc_grace_s
+                if not (transient or enospc):
+                    return e
+                attempt += 1
+                with self._lock:
+                    self.jobs_retried += 1
+                time.sleep(min(self.retry_backoff_s * (2.0 ** (attempt - 1)),
+                               1.0))
+            except BaseException as e:
+                return e
 
     def _run(self) -> None:
         while True:
@@ -157,11 +213,13 @@ class BackgroundWriter:
                     continue
                 t0 = time.perf_counter()
                 try:
-                    fn(*args, **kwargs)
-                except BaseException as e:  # latch; surface on the producer
-                    with self._lock:
-                        self._error = e
-                        self._failed = True
+                    err = self._execute(fn, args, kwargs)
+                    if err is not None:  # latch; surface on the producer
+                        with self._lock:
+                            self._error = err
+                            self._error_job = getattr(fn, "__name__",
+                                                      repr(fn))
+                            self._failed = True
                 finally:
                     dt = time.perf_counter() - t0
                     with self._lock:
@@ -184,13 +242,16 @@ class BackgroundWriter:
         with self._lock:
             return self._failed
 
+    def _job_failure_message(self, err: BaseException) -> str:
+        job = self._error_job or "<unknown>"
+        return (f"background writer job '{job}' failed: "
+                f"{type(err).__name__}: {err}")
+
     def _raise_pending(self) -> None:
         with self._lock:
             err, self._error = self._error, None
         if err is not None:
-            raise WriterError(
-                f"background writer job failed: {type(err).__name__}: {err}"
-            ) from err
+            raise WriterError(self._job_failure_message(err)) from err
 
     def submit(self, fn: Callable, *args, **kwargs) -> None:
         """Enqueue ``fn(*args, **kwargs)``; blocks while the queue is full
@@ -241,8 +302,7 @@ class BackgroundWriter:
         with self._lock:
             job_err, self._error = self._error, None
         if job_err is not None or hook_err is not None:
-            parts = [f"background writer job failed: "
-                     f"{type(job_err).__name__}: {job_err}"
+            parts = [self._job_failure_message(job_err)
                      ] if job_err is not None else []
             if hook_err is not None:
                 parts.append(f"close hook failed: "

@@ -444,8 +444,12 @@ def test_mega_soup_stall_deadline_names_failure_with_bundle(tmp_path,
                         lambda *a, **k: release.wait(60))
     try:
         with pytest.raises(StallError) as ei:
+            # --max-restarts 0: this test wants the RAW StallError, not
+            # the supervisor's recovery of it (tests/test_resilience.py
+            # covers the supervised path)
             REGISTRY["mega_soup"](["--smoke", "--no-pipeline",
                                    "--stall-timeout-s", "1",
+                                   "--max-restarts", "0",
                                    "--root", str(tmp_path / "run")])
         bundle = ei.value.bundle
         assert bundle and os.path.isdir(bundle)
