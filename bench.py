@@ -450,6 +450,50 @@ def _parse_last_heartbeat(stdout_bytes):
     return _scan_sentinel(stdout_bytes, _HB_SENTINEL)
 
 
+LINT_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_LINT_TIMEOUT_S", "120"))
+
+
+def _lint_preflight(stage_log, errors, env, t_start) -> bool:
+    """Run ``python -m srnn_tpu.analysis --fast`` before any measured
+    stage.  rc 1 (unwaived findings) FAILS the bench; rc 0 passes;
+    anything else — analyzer crash, timeout — is recorded as
+    inconclusive and does not block (the lint tier must never be able to
+    wedge a bench run the way the tunnel can)."""
+    att = {"stage": "lint", "attempt": 1,
+           "t_start_s": round(time.monotonic() - t_start, 1)}
+    child_env = dict(env)
+    child_env["JAX_PLATFORMS"] = "cpu"   # no device needed for analysis
+    child_env.pop("PYTHONPATH", None)    # never dial the axon tunnel
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "srnn_tpu.analysis", "--fast"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=child_env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=LINT_TIMEOUT_S)
+        rc = proc.returncode
+        out = proc.stdout.decode("utf-8", "replace")
+    except Exception as e:  # TimeoutExpired, missing interpreter, ...
+        att["outcome"] = f"inconclusive: {type(e).__name__}"
+        att["t_end_s"] = round(time.monotonic() - t_start, 1)
+        stage_log.append(att)
+        return True
+    att["t_end_s"] = round(time.monotonic() - t_start, 1)
+    if rc == 0:
+        att["outcome"] = "ok"
+        stage_log.append(att)
+        return True
+    if rc == 1:
+        att["outcome"] = "findings"
+        att["findings"] = [l for l in out.strip().splitlines() if l][-12:]
+        errors.append("lint: unwaived srnnlint findings; run "
+                      "`python -m srnn_tpu.analysis` locally")
+        stage_log.append(att)
+        return False
+    att["outcome"] = f"inconclusive: rc={rc}"
+    stage_log.append(att)
+    return True
+
+
 def main():
     result = {
         "metric": "self-applications/sec/chip",
@@ -489,6 +533,14 @@ def _orchestrate(result):
         # never let cache-dir trouble break the one-JSON-line contract;
         # children just run uncached
         env.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+    # srnnlint preflight: a static-analysis regression fails the bench in
+    # SECONDS, before any measured stage spends minutes compiling — the
+    # numbers' provenance is only trustworthy over a lint-clean tree
+    if not _lint_preflight(stage_log, errors, env, t_start):
+        result["error"] = "srnnlint preflight failed (unwaived findings); " \
+                          "see stage_log"
+        return
 
     def remaining():
         return DEADLINE_S - (time.monotonic() - t_start)

@@ -33,6 +33,17 @@ export JAX_PLATFORMS=cpu
 pass=0; fail=0; failed_groups=()
 summary=""
 
+# srnnlint first: a static-analysis regression fails in seconds, before
+# the suite spends its 870s budget discovering the same thing (or worse,
+# not discovering it).  Same CPU-pinned, tunnel-free env as the suite.
+t0=$SECONDS
+if python -m srnn_tpu.analysis --fast; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("srnnlint")
+fi
+summary+=$(printf '%-34s %-4s %4ss' "srnnlint" "$status" "$((SECONDS-t0))")$'\n'
+
 for f in tests/test_*.py; do
     t0=$SECONDS
     if python -m pytest "$f" -q --no-header "$@"; then

@@ -1,0 +1,30 @@
+"""srnnlint — the project's JAX-aware static-analysis framework.
+
+One walker, one finding type, one waiver file, seven passes (see
+``analysis.passes``).  ``python -m srnn_tpu.analysis`` is the CLI
+(text or ``--json``, nonzero exit on unwaived findings); the pytest
+gate in ``tests/test_analysis.py`` runs the same passes in-process.
+
+The repo's bit-exactness guarantees — bit-identical carries,
+donation-safe snapshots, deterministic resume — are enforced at runtime
+by the parity suites; this package is the layer that catches the
+*classes* of mistake those suites can only catch one concrete instance
+of: use-after-donate, a static flag missing on one of the four evolve
+surfaces, host effects inside traced code, a fault type the supervisor
+would misclassify, a stale exit code in the watch scripts.
+"""
+
+from .core import (AnalysisContext, AnalysisResult, Finding, PassSpec,
+                   run_analysis)
+from .passes import ALL_PASSES, PASSES_BY_ID, select
+
+__all__ = [
+    "AnalysisContext",
+    "AnalysisResult",
+    "Finding",
+    "PassSpec",
+    "run_analysis",
+    "ALL_PASSES",
+    "PASSES_BY_ID",
+    "select",
+]

@@ -1,0 +1,131 @@
+"""``python -m srnn_tpu.analysis`` — the srnnlint CLI.
+
+Runs the registered passes over the repo and reports findings as
+``file:line: severity [pass/code] message`` text (or ``--json``).
+Exit codes: 0 — clean (or every finding explicitly waived with a
+reason); 1 — unwaived error findings (and ONLY that); 2 — usage error;
+3 — the analyzer itself crashed.  The distinction between 1 and 3 is
+load-bearing for ``bench.py``'s preflight, which fails the bench on 1
+but records 3 as inconclusive (an analyzer bug must never block a
+measurement run); ``scripts/run_tests.sh`` is deliberately STRICT and
+fails its srnnlint group on any nonzero exit — the test suite is where
+a crashed analyzer should be noticed.
+
+``--fast`` selects the preflight tier (every pass marked fast — today
+that is all of them; the flag exists so a future expensive pass cannot
+slow the run_tests.sh / bench.py preflights down).  ``--update-baseline``
+appends waiver stubs for the current unwaived findings to the waiver
+file; each stub still needs a human-written reason before it suppresses
+anything (a reasonless waiver is itself a finding).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .core import (AnalysisContext, default_waiver_file, run_analysis)
+from .passes import ALL_PASSES, PASSES_BY_ID, select
+
+
+def _repo_root() -> str:
+    # srnn_tpu/analysis/__main__.py -> repo root two levels above the pkg
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m srnn_tpu.analysis",
+        description="srnnlint: project static analysis "
+                    "(donation safety, flag parity, jit purity, fault "
+                    "taxonomy, prints/threads/metric-name hygiene)")
+    parser.add_argument("passes", nargs="*",
+                        help="pass ids to run (default: all); see --list")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered passes and exit")
+    parser.add_argument("--fast", action="store_true",
+                        help="run only the fast preflight tier")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: autodetect from the "
+                             "installed package)")
+    parser.add_argument("--waivers", default=None,
+                        help="waiver file (default: "
+                             "srnn_tpu/analysis/waivers.txt)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="append waiver stubs for current unwaived "
+                             "findings (reasons still required by hand)")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for p in ALL_PASSES:
+            tier = "fast" if p.fast else "slow"
+            print(f"{p.id:18s} [{tier}] {p.title}")
+        return 0
+    unknown = [p for p in args.passes if p not in PASSES_BY_ID]
+    if unknown:
+        print(f"unknown pass id(s): {', '.join(unknown)} — see --list",
+              file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    root = os.path.abspath(args.root) if args.root else _repo_root()
+    try:
+        ctx = AnalysisContext.from_root(root)
+        passes = select(args.passes or None, fast_only=args.fast)
+        waiver_file = args.waivers or default_waiver_file(root)
+        result = run_analysis(ctx, passes, waiver_file=waiver_file)
+    except Exception:  # analyzer bug: exit 3, never the findings code 1
+        import traceback
+
+        traceback.print_exc()
+        print("srnnlint: internal error (exit 3) — this is an analyzer "
+              "bug, not a finding", file=sys.stderr)
+        return 3
+    elapsed = time.monotonic() - t0
+
+    if args.update_baseline:
+        stubs = [f for f in result.findings if f.pass_id != "waivers"]
+        if stubs:
+            with open(waiver_file, "a", encoding="utf-8") as f:
+                f.write("# --- baseline stubs (write a real reason or "
+                        "fix the finding) ---\n")
+                for fd in stubs:
+                    f.write(f"# {fd.pass_id} {fd.path} {fd.code} "
+                            f"TODO-reason: {fd.message[:60]}\n")
+            print(f"wrote {len(stubs)} commented waiver stub(s) to "
+                  f"{waiver_file}; uncomment with a reason to activate",
+                  file=sys.stderr)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in result.findings],
+            "waived": [{**f.as_dict(), "reason": w.reason}
+                       for f, w in result.waived],
+            "passes": result.pass_ids,
+            "files": len(ctx.modules) + len(ctx.shell_files),
+            "elapsed_s": round(elapsed, 3),
+            "exit_code": result.exit_code,
+        }))
+        return result.exit_code
+
+    for f in result.findings:
+        print(f.render())
+    n_err = len(result.errors)
+    n_warn = len(result.findings) - n_err
+    print(f"srnnlint: {len(ctx.modules)} modules + "
+          f"{len(ctx.shell_files)} scripts, {len(result.pass_ids)} "
+          f"pass(es) in {elapsed:.1f}s — {n_err} error(s), "
+          f"{n_warn} warning(s), {len(result.waived)} waived")
+    if result.waived and not result.findings:
+        for f, w in result.waived:
+            print(f"  waived: {f.location()} [{f.pass_id}/{f.code}] — "
+                  f"{w.reason}")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

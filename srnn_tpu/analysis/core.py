@@ -1,0 +1,358 @@
+"""srnnlint core: the shared pass infrastructure.
+
+One file walker (:class:`AnalysisContext` — every ``.py`` under the
+package parsed ONCE and shared by all passes, plus the shell scripts for
+the textual checks), one finding type (:class:`Finding` — ``file:line``,
+severity, stable ``pass/code`` identity), and one waiver/baseline file
+(:func:`load_waivers` — every waiver carries a REASON; a reasonless or
+unused waiver is itself reported).
+
+Walk-root policy lives here and nowhere else: ``__pycache__``,
+``__graft_entry__.py`` and the ``benchmarks/`` scratch tree are excluded
+from every pass via :data:`SKIP_DIR_NAMES` / :data:`SKIP_FILE_NAMES`
+instead of per-gate hardcoded skips (the three pre-framework gates each
+re-invented a subset of this).
+
+Passes are plain objects (:class:`PassSpec`) registered in
+``analysis.passes.PASSES``; ``run_analysis`` executes a selection against
+a context and splits the findings into active / waived.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: directory basenames never descended into, anywhere under a walk root
+SKIP_DIR_NAMES = {"__pycache__", ".git", ".jax_cache", ".bench_triage",
+                  ".pytest_cache", "node_modules"}
+#: file basenames never analyzed (the graft shim is generated scaffolding)
+SKIP_FILE_NAMES = {"__graft_entry__.py"}
+#: repo-root directories that are scratch/vendored/fixture-bearing, not
+#: product surface — the context's repo walk prunes them (``benchmarks/``
+#: holds throwaway measurement scripts, ``tests/`` deliberately contains
+#: pass-tripping fixture snippets, the rest is artifacts)
+SKIP_REPO_DIRS = {"benchmarks", "results_tpu", "native", "examples",
+                  "tests"}
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One static-analysis finding.
+
+    Identity for waiver matching is ``(pass_id, code, path)`` — line
+    numbers shift too easily to key a baseline on them.
+    """
+    pass_id: str
+    code: str
+    path: str            # repo-relative, '/'-separated
+    line: int
+    message: str
+    severity: str = ERROR
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity} "
+                f"[{self.pass_id}/{self.code}] {self.message}")
+
+    def as_dict(self) -> dict:
+        return {"pass": self.pass_id, "code": self.code, "path": self.path,
+                "line": self.line, "severity": self.severity,
+                "message": self.message}
+
+
+@dataclass(frozen=True)
+class ParsedModule:
+    """One parsed python file: repo-relative path, package-relative path
+    (``""``-prefixed paths are outside the package), AST, and source."""
+    rel: str        # repo-relative, e.g. "srnn_tpu/soup.py"
+    pkg_rel: str    # package-relative, e.g. "soup.py" ("" if outside)
+    path: str       # absolute
+    tree: ast.AST
+    text: str
+
+
+@dataclass(frozen=True)
+class ShellFile:
+    rel: str
+    path: str
+    text: str
+
+
+class AnalysisContext:
+    """Everything a pass may look at, walked and parsed exactly once."""
+
+    def __init__(self, repo_root: str, modules: List[ParsedModule],
+                 shell_files: List[ShellFile],
+                 parse_errors: Optional[List[Finding]] = None):
+        self.repo_root = repo_root
+        self.modules = modules
+        self.shell_files = shell_files
+        #: one core/E001 finding per file the compiler rejected — folded
+        #: into every run_analysis result, because a pass silently seeing
+        #: an empty AST is all seven gates disabled for that file
+        self.parse_errors = list(parse_errors or ())
+        self._by_rel = {m.rel: m for m in modules}
+
+    def module(self, rel: str) -> Optional[ParsedModule]:
+        return self._by_rel.get(rel)
+
+    def package_modules(self) -> List[ParsedModule]:
+        return [m for m in self.modules if m.rel.startswith("srnn_tpu/")]
+
+    @classmethod
+    def from_root(cls, repo_root: str,
+                  package: str = "srnn_tpu") -> "AnalysisContext":
+        repo_root = os.path.abspath(repo_root)
+        modules: List[ParsedModule] = []
+        parse_errors: List[Finding] = []
+        pkg_root = os.path.join(repo_root, package)
+        # the walk starts at the REPO root (bench.py and scripts/*.py are
+        # analyzable surface for passes that want them; package_modules()
+        # is the package-only view) — SKIP_REPO_DIRS prunes the scratch
+        # trees in exactly one place
+        for path in iter_python_files(repo_root, repo_root=repo_root):
+            rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+            pkg_rel = os.path.relpath(path, pkg_root).replace(os.sep, "/") \
+                if (path.startswith(pkg_root + os.sep)) else ""
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            try:
+                tree = ast.parse(text, filename=rel)
+            except SyntaxError as e:
+                # the passes see an empty AST (they cannot reason about a
+                # file the compiler rejects), but the failure is SURFACED
+                # as a finding — otherwise every gate silently reports
+                # clean on the broken file
+                parse_errors.append(Finding(
+                    pass_id="core", code="E001", path=rel,
+                    line=e.lineno or 1,
+                    message=f"unparseable file ({e.msg}) — every pass is "
+                            "blind to it until this is fixed"))
+                tree = ast.Module(body=[], type_ignores=[])
+                text = f"# UNPARSEABLE: {e}\n"
+            modules.append(ParsedModule(rel=rel, pkg_rel=pkg_rel, path=path,
+                                        tree=tree, text=text))
+        shell: List[ShellFile] = []
+        scripts = os.path.join(repo_root, "scripts")
+        if os.path.isdir(scripts):
+            for fname in sorted(os.listdir(scripts)):
+                if not fname.endswith(".sh"):
+                    continue
+                path = os.path.join(scripts, fname)
+                with open(path, encoding="utf-8") as f:
+                    shell.append(ShellFile(rel=f"scripts/{fname}", path=path,
+                                           text=f.read()))
+        return cls(repo_root, modules, shell, parse_errors=parse_errors)
+
+
+def iter_python_files(root: str,
+                      repo_root: Optional[str] = None) -> Iterable[str]:
+    """Every analyzable ``.py`` under ``root``, honoring the shared skip
+    policy: ``__pycache__`` trees and ``__graft_entry__.py`` everywhere,
+    plus the repo-root scratch dirs (:data:`SKIP_REPO_DIRS`) — the latter
+    keyed on ``repo_root`` specifically, so a package subdirectory that
+    happens to share a scratch name is still analyzed."""
+    repo_root = os.path.abspath(repo_root) if repo_root else None
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in SKIP_DIR_NAMES
+                             and not (os.path.abspath(dirpath) == repo_root
+                                      and d in SKIP_REPO_DIRS))
+        for fname in sorted(filenames):
+            if not fname.endswith(".py") or fname in SKIP_FILE_NAMES:
+                continue
+            yield os.path.join(dirpath, fname)
+
+
+# ---------------------------------------------------------------------------
+# pass registry plumbing
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PassSpec:
+    """One registered pass: a stable id, a one-line title, whether the
+    ``--fast`` preflight tier includes it, and the run callable
+    (``ctx -> iterable of Finding``)."""
+    id: str
+    title: str
+    run: Callable[[AnalysisContext], Iterable[Finding]]
+    fast: bool = True
+
+
+# ---------------------------------------------------------------------------
+# waivers / baseline
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Waiver:
+    pass_id: str
+    path: str
+    code: str
+    reason: str
+    line: int           # line in the waiver file, for reporting
+    #: optional message-substring narrowing (``match="..."`` at the start
+    #: of the reason) — without it a (pass, file, code) waiver would also
+    #: swallow every FUTURE distinct finding of that code in the file
+    match: Optional[str] = None
+
+    def matches(self, f: Finding) -> bool:
+        return (self.pass_id == f.pass_id and self.code == f.code
+                and self.path == f.path
+                and (self.match is None or self.match in f.message))
+
+
+def default_waiver_file(repo_root: str) -> str:
+    return os.path.join(repo_root, "srnn_tpu", "analysis", "waivers.txt")
+
+
+def load_waivers(path: str) -> Tuple[List[Waiver], List[Finding]]:
+    """Parse the waiver/baseline file.
+
+    One waiver per line: ``pass-id  repo/rel/path  CODE  reason...`` —
+    whitespace-separated, ``#`` comments and blank lines ignored.  The
+    reason is REQUIRED: a reasonless waiver is reported as a finding
+    (``waivers/W001``) instead of silently suppressing anything.  The
+    reason may begin with ``match="<substring>"`` to waive only findings
+    whose message contains the substring — strongly preferred, since a
+    bare (pass, file, code) waiver also covers future distinct findings
+    of the same code in that file.
+    """
+    match_re = re.compile(r'^match="([^"]+)"\s*(.*)$')
+    waivers: List[Waiver] = []
+    problems: List[Finding] = []
+    if not os.path.exists(path):
+        return waivers, problems
+    rel = os.path.basename(path)
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(None, 3)
+            if len(parts) < 4 or not parts[3].strip():
+                problems.append(Finding(
+                    pass_id="waivers", code="W001", path=rel, line=lineno,
+                    message="malformed waiver — need "
+                            "'pass-id path CODE reason...' with a "
+                            "non-empty reason"))
+                continue
+            reason = parts[3].strip()
+            match = None
+            m = match_re.match(reason)
+            if m:
+                match, rest = m.group(1), m.group(2).strip()
+                if not rest:
+                    problems.append(Finding(
+                        pass_id="waivers", code="W001", path=rel,
+                        line=lineno,
+                        message='match="..." needs a reason after it'))
+                    continue
+                reason = rest
+            waivers.append(Waiver(pass_id=parts[0], path=parts[1],
+                                  code=parts[2], reason=reason,
+                                  line=lineno, match=match))
+    return waivers, problems
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)   # active
+    waived: List[Tuple[Finding, Waiver]] = field(default_factory=list)
+    unused_waivers: List[Waiver] = field(default_factory=list)
+    pass_ids: List[str] = field(default_factory=list)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+
+def run_analysis(ctx: AnalysisContext, passes: Sequence[PassSpec],
+                 waiver_file: Optional[str] = None) -> AnalysisResult:
+    """Run ``passes`` over ``ctx`` and fold in the waiver file.
+
+    An unused waiver becomes a WARNING finding (stale baselines rot);
+    a malformed one an ERROR.  Findings come back sorted by location.
+    """
+    if waiver_file is None:
+        waiver_file = default_waiver_file(ctx.repo_root)
+    waivers, waiver_problems = load_waivers(waiver_file)
+    # files the walker could not parse are findings in EVERY run — a pass
+    # seeing their empty AST would otherwise report clean on them
+    raw: List[Finding] = list(ctx.parse_errors)
+    for spec in passes:
+        for f in spec.run(ctx):
+            if f.pass_id != spec.id:
+                f = replace(f, pass_id=spec.id)
+            raw.append(f)
+    result = AnalysisResult(pass_ids=[p.id for p in passes])
+    used: Dict[int, int] = {}
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.code)):
+        for i, w in enumerate(waivers):
+            if w.matches(f):
+                used[i] = used.get(i, 0) + 1
+                result.waived.append((f, w))
+                break
+        else:
+            result.findings.append(f)
+    result.findings.extend(waiver_problems)
+    wrel = os.path.relpath(waiver_file, ctx.repo_root).replace(os.sep, "/")
+    ran = set(result.pass_ids)
+    for i, w in enumerate(waivers):
+        # a waiver can only be judged stale by a run that included its
+        # pass — single-pass runs must not flag the others' waivers
+        if i not in used and w.pass_id in ran:
+            result.unused_waivers.append(w)
+            result.findings.append(Finding(
+                pass_id="waivers", code="W002", path=wrel, line=w.line,
+                severity=WARNING,
+                message=f"unused waiver ({w.pass_id}/{w.code} on {w.path}) "
+                        "— the finding it covered is gone; delete the line"))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers shared by several passes
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Rightmost name of the callee: ``f`` for both ``f(...)`` and
+    ``mod.f(...)``."""
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,33 @@
+"""The srnnlint pass catalog.
+
+Import order is presentation order in ``--list``.  Adding a pass:
+write ``passes/<name>.py`` exposing a module-level ``PASS``
+(:class:`~srnn_tpu.analysis.core.PassSpec`), import it here, append to
+``ALL_PASSES`` — the CLI, the pytest gate, and the waiver machinery pick
+it up with no further wiring (see DESIGN.md §14).
+"""
+
+from typing import Dict, List
+
+from ..core import PassSpec
+from . import (donation, fault_taxonomy, flag_parity, jit_purity,
+               metric_names, prints, threads)
+
+ALL_PASSES: List[PassSpec] = [
+    prints.PASS,
+    threads.PASS,
+    metric_names.PASS,
+    donation.PASS,
+    flag_parity.PASS,
+    jit_purity.PASS,
+    fault_taxonomy.PASS,
+]
+
+PASSES_BY_ID: Dict[str, PassSpec] = {p.id: p for p in ALL_PASSES}
+
+
+def select(ids=None, fast_only: bool = False) -> List[PassSpec]:
+    chosen = ALL_PASSES if not ids else [PASSES_BY_ID[i] for i in ids]
+    if fast_only:
+        chosen = [p for p in chosen if p.fast]
+    return chosen

@@ -1,0 +1,356 @@
+"""Pass ``donation-safety``: the PR 3 ordering invariant, machine-checked.
+
+The production hot loops dispatch ``*_donated`` jitted steps: XLA reuses
+the input population buffers in place, so the Python-side value a local
+still refers to is GARBAGE after the dispatch.  The only sanctioned
+pattern (``utils/pipeline.py``) is
+
+    snap = snapshot(state)          # async device copy dispatched FIRST
+    out  = evolve_donated(cfg, state)   # ...then the donating dispatch
+    state = out[0]                  # the local rebinds to the new buffers
+
+Two things can silently break it, and until this pass both were enforced
+only by convention and runtime parity tests:
+
+  * reading a local AFTER it was passed in a donated position, before it
+    is rebound (``D001``) — on CPU this often *works* (the backend may
+    not alias), so it ships and corrupts on TPU;
+  * snapshotting a tree AFTER the donating dispatch already consumed it
+    (``D002``) — the snapshot captures poisoned bytes, and the triage
+    bundle / checkpoint built from it replays garbage.
+
+Scope and honesty notes: the analysis is per-function and syntactic.  It
+tracks bare-name locals only (no attribute roots), treats branches as
+may-donate (a name donated in ANY branch arm counts, cleared only by a
+rebind on that path), runs loop bodies twice to catch loop-carried
+use-after-donate, and does not follow donated arguments through calls to
+local helper functions or into lambda bodies.  Donated argument
+positions come from :data:`DONATED_POSITIONS`; an unknown ``*_donated``
+callee conservatively treats every bare-name argument after the first
+(the config slot) as donated.
+
+Codes:
+  * ``D001`` — local read after being passed in a donated position, with
+    no rebinding in between.
+  * ``D002`` — ``snapshot()`` of a tree AFTER the donating dispatch that
+    consumed it (the PR 3 snapshot-before-donation ordering invariant).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisContext, Finding, PassSpec, call_name
+
+#: donating callables -> 0-based positions of the donated arguments
+#: (mirrors each jit wrapper's ``donate_argnums``; keep in sync when a
+#: new ``*_donated`` twin ships — unknown names fall back to the
+#: conservative every-arg-after-the-first rule)
+DONATED_POSITIONS: Dict[str, Tuple[int, ...]] = {
+    "evolve_donated": (1,),
+    "evolve_step_donated": (1,),
+    "evolve_multi_donated": (1,),
+    "evolve_multi_step_donated": (1,),
+    "sharded_evolve_donated": (2,),
+    "sharded_evolve_step_donated": (2,),
+    "sharded_evolve_multi_donated": (2,),
+    "sharded_evolve_multi_step_donated": (2,),
+    "run_fixpoint_donated": (1,),
+    "run_mixed_fixpoint_donated": (1,),
+    "run_training_donated": (1,),
+}
+
+#: names whose call reads a tree for the async pre-donation copy
+SNAPSHOT_NAMES = {"snapshot"}
+
+
+def _donated_positions(name: str) -> Optional[Tuple[int, ...]]:
+    if name in DONATED_POSITIONS:
+        return DONATED_POSITIONS[name]
+    if name.endswith("_donated"):
+        return None  # unknown donating callee: sentinel for "all but arg 0"
+    return ()
+
+
+class _Donation:
+    __slots__ = ("line", "callee")
+
+    def __init__(self, line: int, callee: str):
+        self.line = line
+        self.callee = callee
+
+
+class _Scope:
+    """Linear may-donate analysis of one function body."""
+
+    def __init__(self, mod_rel: str, findings: List[Finding]):
+        self.rel = mod_rel
+        self.findings = findings
+        self.donated: Dict[str, _Donation] = {}
+        #: aliases of donating callables (``run = sharded_evolve_donated
+        #: if owned else sharded_evolve``)
+        self.aliases: Dict[str, str] = {}
+        self._reported: Set[Tuple[int, str, str]] = set()
+
+    # -- expression handling ---------------------------------------------
+
+    def _donating_callee(self, node: ast.Call) -> Optional[str]:
+        name = call_name(node)
+        if name is None:
+            return None
+        if name in self.aliases:
+            name = self.aliases[name]
+        pos = _donated_positions(name)
+        if pos == ():
+            return None
+        return name
+
+    def _donated_args(self, node: ast.Call, callee: str) -> List[ast.Name]:
+        pos = _donated_positions(callee)
+        args = []
+        if pos is None:
+            pos = tuple(range(1, len(node.args)))
+        for i in pos:
+            if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                args.append(node.args[i])
+        return args
+
+    def _report(self, code: str, line: int, name: str, msg: str) -> None:
+        key = (line, name, code)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(Finding(pass_id=PASS.id, code=code,
+                                     path=self.rel, line=line, message=msg))
+
+    def eval_expr(self, node: Optional[ast.AST]) -> None:
+        """Walk one expression: flag reads of donated names, then apply
+        any new donations it performs (the donating occurrence itself is
+        not a read)."""
+        if node is None:
+            return
+        donations: List[Tuple[ast.Call, str]] = []
+        donating_arg_ids: Set[int] = set()
+        snapshot_args: Dict[int, int] = {}  # id(Name node) -> call lineno
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Lambda):
+                continue  # bodies run later (or never); see module doc
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = self._donating_callee(sub)
+            if callee is not None:
+                donations.append((sub, callee))
+                for arg in self._donated_args(sub, callee):
+                    donating_arg_ids.add(id(arg))
+            cname = call_name(sub)
+            if cname in SNAPSHOT_NAMES:
+                for arg in ast.walk(sub):
+                    if isinstance(arg, ast.Name) \
+                            and isinstance(arg.ctx, ast.Load):
+                        snapshot_args.setdefault(id(arg), sub.lineno)
+        lambda_nodes = [n for n in ast.walk(node)
+                        if isinstance(n, ast.Lambda)]
+
+        def inside_lambda(n: ast.AST) -> bool:
+            return any(n is sub for lam in lambda_nodes
+                       for sub in ast.walk(lam.body))
+
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)):
+                continue
+            don = self.donated.get(sub.id)
+            if don is None or id(sub) in donating_arg_ids \
+                    or inside_lambda(sub):
+                continue
+            if id(sub) in snapshot_args:
+                self._report(
+                    "D002", sub.lineno, sub.id,
+                    f"snapshot of {sub.id!r} AFTER {don.callee}() already "
+                    f"donated its buffers (line {don.line}) — dispatch the "
+                    "snapshot BEFORE the donating step (PR 3 ordering "
+                    "invariant) or snapshot the step's OUTPUT")
+            else:
+                self._report(
+                    "D001", sub.lineno, sub.id,
+                    f"{sub.id!r} read after being donated to "
+                    f"{don.callee}() (line {don.line}) with no rebinding "
+                    "in between — the buffer is garbage after the donating "
+                    "dispatch; rebind from the step's output or snapshot() "
+                    "first")
+        for call, callee in donations:
+            for arg in self._donated_args(call, callee):
+                self.donated[arg.id] = _Donation(call.lineno, callee)
+
+    # -- binding handling -------------------------------------------------
+
+    def _clear_target(self, target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                self.donated.pop(sub.id, None)
+                # rebinding also retires any donating-callable alias the
+                # name held — `run = evolve` after `run = evolve_donated`
+                # must stop treating run() as donating
+                self.aliases.pop(sub.id, None)
+
+    def _maybe_alias(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            return
+        cands: List[ast.AST] = [node.value]
+        if isinstance(node.value, ast.IfExp):
+            cands = [node.value.body, node.value.orelse]
+        for cand in cands:
+            name = None
+            if isinstance(cand, ast.Name):
+                name = cand.id
+            elif isinstance(cand, ast.Attribute):
+                name = cand.attr
+            if name is not None and _donated_positions(name) != ():
+                self.aliases[node.targets[0].id] = name
+                return
+
+    # -- statement walk ---------------------------------------------------
+
+    def run_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self.run_stmt(stmt)
+
+    def _branch(self, bodies: List[List[ast.stmt]]) -> None:
+        """May-analysis over alternative branches: each runs from a copy
+        of the current state; the merged state keeps a name donated if ANY
+        branch ends with it donated."""
+        pre = dict(self.donated)
+        merged: Dict[str, _Donation] = {}
+        for body in bodies:
+            self.donated = dict(pre)
+            self.run_body(body)
+            merged.update(self.donated)
+        self.donated = merged
+
+    def run_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # analyzed as its own scope by the pass driver; the def just
+            # (re)binds its name here
+            self.donated.pop(stmt.name, None)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            self.donated.pop(stmt.name, None)
+            return
+        if isinstance(stmt, ast.Assign):
+            self.eval_expr(stmt.value)
+            # clear first (retires stale donated marks AND aliases), then
+            # record the fresh alias if this assignment creates one
+            for t in stmt.targets:
+                self._clear_target(t)
+            self._maybe_alias(stmt)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self.eval_expr(stmt.value)
+            self.eval_expr(stmt.target)  # augmented target is also a read
+            self._clear_target(stmt.target)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self.eval_expr(stmt.value)
+            if stmt.value is not None:
+                self._clear_target(stmt.target)
+            return
+        if isinstance(stmt, (ast.Expr, ast.Return)):
+            self.eval_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._clear_target(t)
+            return
+        if isinstance(stmt, ast.If):
+            self.eval_expr(stmt.test)
+            self._branch([stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.eval_expr(stmt.iter)
+            pre = dict(self.donated)
+            self._clear_target(stmt.target)
+            # two passes over the body: the second catches loop-carried
+            # use-after-donate (donated at the bottom, read at the top of
+            # the next iteration)
+            self.run_body(stmt.body)
+            self._clear_target(stmt.target)
+            self.run_body(stmt.body)
+            post = self.donated
+            self.donated = dict(pre)
+            self.donated.update(post)   # may-donate: 0 or >=1 iterations
+            self.run_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self.eval_expr(stmt.test)
+            pre = dict(self.donated)
+            self.run_body(stmt.body)
+            self.run_body(stmt.body)
+            post = self.donated
+            self.donated = dict(pre)
+            self.donated.update(post)
+            self.run_body(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._clear_target(item.optional_vars)
+            self.run_body(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            pre = dict(self.donated)
+            self.run_body(stmt.body)
+            post_body = dict(self.donated)
+            # handlers may run from anywhere in the body: start them from
+            # the union of pre and post-body state
+            merged = dict(pre)
+            merged.update(post_body)
+            ends = [post_body]
+            for handler in stmt.handlers:
+                self.donated = dict(merged)
+                if handler.name:
+                    self.donated.pop(handler.name, None)
+                self.run_body(handler.body)
+                ends.append(dict(self.donated))
+            self.donated = {}
+            for e in ends:
+                self.donated.update(e)
+            self.run_body(stmt.orelse)
+            self.run_body(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            self.eval_expr(getattr(stmt, "exc", None)
+                           or getattr(stmt, "test", None))
+            return
+        if isinstance(stmt, ast.Match):
+            self.eval_expr(stmt.subject)
+            for case in stmt.cases:
+                self.eval_expr(case.guard)
+            self._branch([case.body for case in stmt.cases] + [[]])
+            return
+        # Import/Global/Nonlocal/Pass/Break/Continue: nothing to track
+        return
+
+
+def _function_scopes(tree: ast.AST):
+    """Every function body in the module (module top level included),
+    each analyzed independently."""
+    yield tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body
+
+
+def run(ctx: AnalysisContext):
+    for mod in ctx.package_modules():
+        findings: List[Finding] = []
+        for body in _function_scopes(mod.tree):
+            scope = _Scope(mod.rel, findings)
+            scope.run_body(body)
+        yield from findings
+
+
+PASS = PassSpec(
+    id="donation-safety",
+    title="no use-after-donate; snapshots dispatch before the donating "
+          "step (PR 3 ordering invariant)",
+    run=run)

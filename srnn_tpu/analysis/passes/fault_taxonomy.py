@@ -1,0 +1,234 @@
+"""Pass ``fault-taxonomy``: the resilience layer's classifier, raise
+sites, status vocabulary, and exit codes stay mutually consistent.
+
+PR 7's supervisor turns faults into recoveries only when three
+independently-edited artifacts agree: the exception types the package
+raises, the ``classify_fault`` kind table that maps them, and the
+0/3/69/75 exit-code vocabulary that ``setups/__main__.py`` emits and the
+shell watch tier (``scripts/tpu_watch.sh`` / ``tpu_window.sh``) branches
+on.  Each has already drifted once (the ``tpu_window.sh`` accelerator
+gate used exit 3 until it collided with ``EXIT_RECOVERED``).  This pass
+checks, statically:
+
+  * every ``raise`` site of a taxonomy exception (``StallError``,
+    ``WriterError``, ``Preempted``) anywhere in the package has a
+    matching ``isinstance`` arm in ``classify_fault`` (``T001``);
+  * every XLA status string named in ``resilience/supervisor.py``'s
+    regexes is a REAL XLA/absl status (``T002`` — a typo'd status
+    silently reclassifies a deterministic failure as retryable), and
+    every status-bearing regex is actually consulted (``T003``);
+  * the supervisor's exit-code constants are each named in
+    ``setups/__main__.py`` (``T004``) and handled by a ``case`` arm in
+    each watch script (``T005``, textual), and no script claims a
+    supervisor code for its own ``exit`` (``T006`` — the PR 7 collision,
+    machine-checked).
+
+Codes: ``T001``–``T006`` above; ``T007`` when the supervisor module or
+``classify_fault`` itself cannot be located (stale registry).
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import AnalysisContext, Finding, PassSpec, dotted_name
+
+SUPERVISOR_REL = "srnn_tpu/resilience/supervisor.py"
+MAIN_REL = "srnn_tpu/setups/__main__.py"
+WATCH_SCRIPTS = ("scripts/tpu_watch.sh", "scripts/tpu_window.sh")
+
+#: the taxonomy exception types whose raise sites must classify
+TAXONOMY_EXCEPTIONS = ("StallError", "WriterError", "Preempted")
+
+#: the canonical XLA/absl status vocabulary (status.proto)
+XLA_STATUSES = frozenset({
+    "OK", "CANCELLED", "UNKNOWN", "INVALID_ARGUMENT", "DEADLINE_EXCEEDED",
+    "NOT_FOUND", "ALREADY_EXISTS", "PERMISSION_DENIED",
+    "RESOURCE_EXHAUSTED", "FAILED_PRECONDITION", "ABORTED", "OUT_OF_RANGE",
+    "UNIMPLEMENTED", "INTERNAL", "UNAVAILABLE", "DATA_LOSS",
+    "UNAUTHENTICATED",
+})
+
+_STATUS_TOKEN_RE = re.compile(r"[A-Z][A-Z_]{2,}")
+_CASE_ARM_RE = re.compile(r"^\s*([0-9|* ]+)\)", re.MULTILINE)
+_EXIT_LITERAL_RE = re.compile(r"\bexit\s+(\d+)\b")
+
+
+def _raise_name(node: ast.Raise) -> Optional[str]:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    name = dotted_name(exc) if exc is not None else None
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _classifier_types(fn: ast.FunctionDef) -> Set[str]:
+    """Type names appearing as the second isinstance() argument anywhere
+    in classify_fault (tuples flattened, attribute tails taken)."""
+    types: Set[str] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "isinstance"
+                and len(node.args) == 2):
+            continue
+        second = node.args[1]
+        elts = second.elts if isinstance(second, ast.Tuple) else [second]
+        for e in elts:
+            name = dotted_name(e)
+            if name:
+                types.add(name.rsplit(".", 1)[-1])
+    return types
+
+
+def _exit_constants(tree: ast.AST) -> Dict[str, int]:
+    consts: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.startswith("EXIT_") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, int):
+            consts[node.targets[0].id] = node.value.value
+    return consts
+
+
+def _regex_literals(tree: ast.AST) -> Dict[str, "tuple[int, str]"]:
+    """module-level ``NAME_RE = re.compile("...")`` -> (line, pattern)."""
+    out: Dict[str, "tuple[int, str]"] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.endswith("_RE") \
+                and isinstance(node.value, ast.Call) \
+                and dotted_name(node.value.func) == "re.compile" \
+                and node.value.args \
+                and isinstance(node.value.args[0], ast.Constant) \
+                and isinstance(node.value.args[0].value, str):
+            out[node.targets[0].id] = (node.lineno, node.value.args[0].value)
+    return out
+
+
+def run(ctx: AnalysisContext):
+    sup = ctx.module(SUPERVISOR_REL)
+    if sup is None:
+        yield Finding(pass_id=PASS.id, code="T007", path=SUPERVISOR_REL,
+                      line=1,
+                      message="resilience/supervisor.py not found — the "
+                              "fault-taxonomy pass registry is stale")
+        return
+    classify = None
+    for node in ast.walk(sup.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "classify_fault":
+            classify = node
+            break
+    if classify is None:
+        yield Finding(pass_id=PASS.id, code="T007", path=sup.rel, line=1,
+                      message="classify_fault() not found in supervisor.py "
+                              "— update the fault-taxonomy pass")
+        return
+    handled = _classifier_types(classify)
+
+    # T001: every taxonomy raise site classifies
+    for mod in ctx.package_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Raise):
+                continue
+            name = _raise_name(node)
+            if name in TAXONOMY_EXCEPTIONS and name not in handled:
+                yield Finding(
+                    pass_id=PASS.id, code="T001", path=mod.rel,
+                    line=node.lineno,
+                    message=f"raise {name} has no isinstance arm in "
+                            "classify_fault — the supervisor would "
+                            "classify it FATAL by fallthrough; add it to "
+                            "the kind table deliberately")
+
+    # T002/T003: status regexes
+    regexes = _regex_literals(sup.tree)
+    sup_src_names = {n.id for n in ast.walk(sup.tree)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+    for rname, (lineno, pattern) in sorted(regexes.items()):
+        tokens = set(_STATUS_TOKEN_RE.findall(pattern))
+        if not tokens:
+            continue
+        for tok in sorted(tokens):
+            if tok not in XLA_STATUSES:
+                yield Finding(
+                    pass_id=PASS.id, code="T002", path=sup.rel, line=lineno,
+                    message=f"{rname} names {tok!r}, which is not an XLA/"
+                            "absl status — a typo here silently "
+                            "reclassifies the fault")
+        if rname not in sup_src_names:
+            yield Finding(
+                pass_id=PASS.id, code="T003", path=sup.rel, line=lineno,
+                message=f"{rname} is compiled but never consulted — the "
+                        "statuses it names are unreachable in the "
+                        "classifier")
+
+    # exit-code vocabulary
+    exits = _exit_constants(sup.tree)
+    vocab = dict(sorted(exits.items()))
+    main_mod = ctx.module(MAIN_REL)
+    if main_mod is not None:
+        # a code only counts as "named" when it appears in exit-code
+        # CONTEXT (its line, or a neighbor, mentions "exit") — an
+        # unrelated standalone digit elsewhere must not satisfy the check
+        lines = main_mod.text.splitlines()
+        for const, code in vocab.items():
+            named = any(
+                re.search(rf"\b{code}\b", line)
+                and any("exit" in lines[j].lower()
+                        for j in range(max(0, i - 1),
+                                       min(len(lines), i + 2)))
+                for i, line in enumerate(lines))
+            if not named:
+                yield Finding(
+                    pass_id=PASS.id, code="T004", path=main_mod.rel, line=1,
+                    message=f"exit code {code} ({const}) is not named in "
+                            "exit-code context in setups/__main__.py — "
+                            "the CLI contract doc/mapping went stale")
+    for script_rel in WATCH_SCRIPTS:
+        sh = next((s for s in ctx.shell_files if s.rel == script_rel), None)
+        if sh is None:
+            continue
+        arm_codes: Set[int] = set()
+        for m in _CASE_ARM_RE.finditer(sh.text):
+            for tok in m.group(1).split("|"):
+                tok = tok.strip()
+                if tok.isdigit():
+                    arm_codes.add(int(tok))
+        for const, code in vocab.items():
+            if code not in arm_codes:
+                line = 1 + sh.text[:sh.text.find("case")].count("\n") \
+                    if "case" in sh.text else 1
+                yield Finding(
+                    pass_id=PASS.id, code="T005", path=sh.rel, line=line,
+                    message=f"supervisor exit code {code} ({const}) has no "
+                            "case arm — the watch tier would read it as a "
+                            "wedge")
+        # strip comments before hunting exit literals — a comment that
+        # NAMES a supervisor code (e.g. "ended in exit 75") is fine
+        code_only = "\n".join(line.split("#", 1)[0]
+                              for line in sh.text.splitlines())
+        for m in _EXIT_LITERAL_RE.finditer(code_only):
+            code = int(m.group(1))
+            if code in vocab.values():
+                const = next(k for k, v in vocab.items() if v == code)
+                # offset is into code_only; its per-line strip preserved
+                # line structure, so count newlines in the SAME text
+                line = 1 + code_only[:m.start()].count("\n")
+                yield Finding(
+                    pass_id=PASS.id, code="T006", path=sh.rel, line=line,
+                    message=f"script claims 'exit {code}' for itself, but "
+                            f"{code} means {const} in the supervisor "
+                            "vocabulary — pick an unclaimed code (the "
+                            "PR 7 accelerator-gate collision)")
+
+
+PASS = PassSpec(
+    id="fault-taxonomy",
+    title="raise sites classify, XLA statuses are real, and the "
+          "0/3/69/75 exit vocabulary agrees across python and shell",
+    run=run)

@@ -1,0 +1,518 @@
+"""Pass ``flag-parity``: the four evolve surfaces stay interchangeable,
+and the AOT warmup spelling zoo covers every flag combination the
+production setups can dispatch.
+
+ROADMAP item 1 documents the tax this pass collects up front: every new
+static flag (``metrics=``, ``health=``, ``lineage=``, …) must be
+hand-threaded through four near-copy evolve surfaces
+(``soup.evolve``, ``multisoup.evolve_multi``,
+``parallel.sharded_evolve``, ``parallel.sharded_evolve_multi``) and the
+``utils/aot.py`` warmup spelling zoo, and PRs 2–7 each re-paid it.
+Until the carry-plugin refactor lands, this pass makes the invariant
+machine-checked instead of reviewer-checked:
+
+  * **surface parity** — the four private ``_evolve*`` bodies must
+    expose identical keyword flags with identical defaults (soup's
+    ``record`` is the one documented per-surface extra: trajectory
+    recording predates the carry contract and has no sharded twin);
+  * **static-argnames parity** — every flag must be listed in
+    ``static_argnames`` of BOTH jit wrappers (plain + ``_donated``) of
+    its surface, except ``lineage_state`` which is a traced carry and
+    must NOT be static;
+  * **warmup coverage** — every carry-flag combination
+    (``metrics``/``health``/``lineage``) that a ``setups/`` dispatch can
+    reach must have a matching warmup entry in ``utils/aot.py``, or a
+    production run's first chunk re-pays the compile the AOT subsystem
+    exists to remove.  Setups' flag dicts are tracked through the
+    ``kw = {...}; if cond: kw["health"] = True; run(..., **kw)`` idiom
+    (additions under a conditional make the flag optional, and the
+    check covers the whole lattice of reachable combinations).
+
+Codes:
+  * ``F001`` — contract flag missing on a surface.
+  * ``F002`` — contract flag default differs between surfaces.
+  * ``F003`` — flag missing from a jit wrapper's ``static_argnames``.
+  * ``F004`` — ``lineage_state`` (a traced carry) listed as static.
+  * ``F005`` — a surface function or jit wrapper could not be located
+    (the registry below went stale — update it with the refactor).
+  * ``F010`` — a setups dispatch reaches a flag combination with no
+    matching ``utils/aot.py`` warmup entry.
+  * ``F011`` — a warmup-entries generator in ``utils/aot.py`` could not
+    be parsed (the zoo moved; update the registry below).
+  * ``F012`` — a dispatch's flags could not be resolved statically
+    (warning; the coverage check cannot see through it).
+"""
+
+import ast
+import itertools
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..core import (AnalysisContext, Finding, PassSpec, WARNING, call_name,
+                    dotted_name)
+
+#: the carry flags whose combinations define the warmup spelling zoo
+CARRY_FLAGS = ("metrics", "health", "lineage")
+
+#: surface id -> (module rel, private fn, jit wrapper names,
+#:               aot entries generator, allowed per-surface extra flags)
+SURFACES = {
+    "soup.evolve": (
+        "srnn_tpu/soup.py", "_evolve",
+        ("evolve", "evolve_donated"), "_soup_entries",
+        # trajectory recording predates the carry contract and has no
+        # sharded twin; it rides only the single-device surface
+        frozenset({"record"})),
+    "multisoup.evolve_multi": (
+        "srnn_tpu/multisoup.py", "_evolve_multi",
+        ("evolve_multi", "evolve_multi_donated"), "_multi_entries",
+        frozenset()),
+    "parallel.sharded_evolve": (
+        "srnn_tpu/parallel/sharded_soup.py", "_sharded_evolve",
+        ("sharded_evolve", "sharded_evolve_donated"), "_sharded_entries",
+        frozenset()),
+    "parallel.sharded_evolve_multi": (
+        "srnn_tpu/parallel/sharded_multisoup.py", "_sharded_evolve_multi",
+        ("sharded_evolve_multi", "sharded_evolve_multi_donated"),
+        "_sharded_multi_entries",
+        frozenset()),
+}
+
+#: dispatch callee name -> surface id (what the setups call)
+DISPATCH_NAMES: Dict[str, str] = {}
+for _sid, (_, _, _wrappers, _, _) in SURFACES.items():
+    for _w in _wrappers:
+        DISPATCH_NAMES[_w] = _sid
+
+#: the carry flag that is traced, not static
+TRACED_FLAGS = frozenset({"lineage_state"})
+
+AOT_REL = "srnn_tpu/utils/aot.py"
+SETUPS_PREFIX = "srnn_tpu/setups/"
+
+
+def _find_def(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _keyword_flags(fn: ast.FunctionDef) -> Dict[str, str]:
+    """Parameters with defaults -> unparsed default literal."""
+    flags: Dict[str, str] = {}
+    pos = fn.args.args
+    defaults = fn.args.defaults
+    for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+        flags[arg.arg] = ast.unparse(default)
+    for arg, default in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if default is not None:
+            flags[arg.arg] = ast.unparse(default)
+    return flags
+
+
+def _static_argnames(tree: ast.AST, wrapper: str) \
+        -> Optional[Tuple[int, Set[str]]]:
+    """(lineno, static names) of ``wrapper = jax.jit(_fn, static_argnames=
+    (...))`` — also matches the ``jax.jit(\n _fn, ...)`` multiline and
+    bare ``jit`` spellings."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == wrapper
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = dotted_name(node.value.func)
+        if callee not in ("jax.jit", "jit"):
+            continue
+        for kw in node.value.keywords:
+            if kw.arg == "static_argnames":
+                names = {e.value for e in ast.walk(kw.value)
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)}
+                return node.lineno, names
+        return node.lineno, set()
+    return None
+
+
+def _surface_parity(ctx: AnalysisContext):
+    per_surface: Dict[str, Dict[str, str]] = {}
+    for sid, (rel, fn_name, wrappers, _entries, extras) in SURFACES.items():
+        mod = ctx.module(rel)
+        fn = _find_def(mod.tree, fn_name) if mod else None
+        if fn is None:
+            yield Finding(
+                pass_id=PASS.id, code="F005", path=rel, line=1,
+                message=f"surface {sid}: {fn_name}() not found — the "
+                        "flag-parity registry is stale; update "
+                        "analysis/passes/flag_parity.py alongside the "
+                        "refactor")
+            continue
+        flags = {k: v for k, v in _keyword_flags(fn).items()
+                 if k not in extras}
+        per_surface[sid] = flags
+        # static_argnames discipline on both wrappers
+        static_expected = set(flags) - TRACED_FLAGS
+        for wrapper in wrappers:
+            got = _static_argnames(mod.tree, wrapper)
+            if got is None:
+                yield Finding(
+                    pass_id=PASS.id, code="F005", path=rel, line=fn.lineno,
+                    message=f"surface {sid}: jit wrapper {wrapper!r} not "
+                            "found — update the flag-parity registry")
+                continue
+            lineno, names = got
+            for flag in sorted(static_expected - names):
+                yield Finding(
+                    pass_id=PASS.id, code="F003", path=rel, line=lineno,
+                    message=f"{wrapper}: flag {flag!r} missing from "
+                            "static_argnames — a non-static flag retraces "
+                            "per value instead of selecting a program")
+            for flag in sorted(TRACED_FLAGS & names):
+                yield Finding(
+                    pass_id=PASS.id, code="F004", path=rel, line=lineno,
+                    message=f"{wrapper}: {flag!r} is a traced carry and "
+                            "must NOT be in static_argnames")
+    if not per_surface:
+        return
+    contract: Set[str] = set()
+    for flags in per_surface.values():
+        contract |= set(flags)
+    for sid, flags in per_surface.items():
+        rel, fn_name = SURFACES[sid][0], SURFACES[sid][1]
+        mod = ctx.module(rel)
+        fn = _find_def(mod.tree, fn_name)
+        for flag in sorted(contract - set(flags)):
+            holders = sorted(s for s, f in per_surface.items() if flag in f)
+            yield Finding(
+                pass_id=PASS.id, code="F001", path=rel, line=fn.lineno,
+                message=f"surface {sid} is missing flag {flag!r} "
+                        f"(present on {', '.join(holders)}) — the four "
+                        "evolve surfaces must expose identical static "
+                        "keyword flags")
+        for flag, default in sorted(flags.items()):
+            others = {s: f[flag] for s, f in per_surface.items()
+                      if flag in f and f[flag] != default}
+            if others and sid == min(s for s, f in per_surface.items()
+                                     if flag in f):
+                detail = ", ".join(f"{s}={d}" for s, d in sorted(
+                    others.items()))
+                yield Finding(
+                    pass_id=PASS.id, code="F002", path=rel, line=fn.lineno,
+                    message=f"flag {flag!r} default {default} differs "
+                            f"across surfaces ({detail}) — identical "
+                            "defaults are part of the contract")
+
+
+# ---------------------------------------------------------------------------
+# warmup coverage
+# ---------------------------------------------------------------------------
+
+
+def _warmed_combos(ctx: AnalysisContext):
+    """surface id -> set of warmed carry-flag combos, from the kwargs
+    dict literal of every ``yield (name, fn, args, {kwargs})`` in the
+    surface's entries generator in utils/aot.py."""
+    warmed: Dict[str, Set[FrozenSet[str]]] = {}
+    problems: List[Finding] = []
+    mod = ctx.module(AOT_REL)
+    if mod is None:
+        problems.append(Finding(
+            pass_id=PASS.id, code="F011", path=AOT_REL, line=1,
+            message="utils/aot.py not found — warmup coverage cannot run"))
+        return warmed, problems
+    for sid, (_rel, _fn, _wrappers, entries_fn, _extras) in SURFACES.items():
+        fn = _find_def(mod.tree, entries_fn)
+        if fn is None:
+            problems.append(Finding(
+                pass_id=PASS.id, code="F011", path=AOT_REL, line=1,
+                message=f"warmup entries generator {entries_fn}() not "
+                        f"found for surface {sid} — the spelling zoo "
+                        "moved; update analysis/passes/flag_parity.py"))
+            continue
+        combos: Set[FrozenSet[str]] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Yield)
+                    and isinstance(node.value, ast.Tuple)
+                    and node.value.elts
+                    and isinstance(node.value.elts[-1], ast.Dict)):
+                continue
+            keys = {k.value for k in node.value.elts[-1].keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            combos.add(frozenset(keys & set(CARRY_FLAGS)))
+        if not combos:
+            problems.append(Finding(
+                pass_id=PASS.id, code="F011", path=AOT_REL, line=fn.lineno,
+                message=f"{entries_fn}() yields no parseable warmup "
+                        "entries — the zoo extraction went stale"))
+            continue
+        warmed[sid] = combos
+    return warmed, problems
+
+
+class _DictFlags:
+    """required / optional carry flags accumulated into one dict local."""
+
+    def __init__(self, required: Set[str] = None, optional: Set[str] = None):
+        self.required = set(required or ())
+        self.optional = set(optional or ())
+
+
+def _scope_nodes(body: List[ast.stmt]):
+    """Every AST node belonging to this scope — nested function/class
+    bodies are their own scopes and are NOT descended into (lambdas are:
+    they cannot rebind, so their calls belong to the enclosing scope)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # its body is its own scope (visited separately)
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _classify_flag(value: ast.AST) -> Optional[bool]:
+    """How a flag binding contributes to the reachable-combo lattice:
+    True = definitely passed (constant truthy), False = definitely absent
+    (constant falsy == the default), None = runtime-dependent (optional)
+    — the SAME semantics the direct-keyword path uses."""
+    if isinstance(value, ast.Constant):
+        return bool(value.value)
+    return None
+
+
+def _dict_flag_sets(node: ast.Dict) -> "tuple[Set[str], Set[str]]":
+    """(required, optional) carry flags of one dict literal, value-aware."""
+    required: Set[str] = set()
+    optional: Set[str] = set()
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and k.value in CARRY_FLAGS):
+            continue
+        cls = _classify_flag(v)
+        if cls is True:
+            required.add(k.value)
+        elif cls is None:
+            optional.add(k.value)
+    return required, optional
+
+
+def _collect_dict_flags(fn_body: List[ast.stmt],
+                        out: Dict[str, _DictFlags],
+                        conditional: bool = False) -> None:
+    """Track ``kw = {...}`` / ``kw["health"] = True`` / ``kw.update(...)``
+    over ONE scope's body (nested defs excluded — they are their own
+    scopes); additions under any conditional — or with a non-constant
+    value — are optional."""
+    for stmt in fn_body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            value = stmt.value
+            # an UNCONDITIONAL dict-literal assignment re-initializes the
+            # local: its keys are required from there on even under a loop
+            # (the dispatch it feeds sits under the same loop) and earlier
+            # tracked state is dead.  A CONDITIONAL reassignment may or
+            # may not run, so the post-state is either the old or the new
+            # dict: required shrinks to the intersection, everything else
+            # becomes optional — never wipe a reachable combination.
+            new = None
+            if isinstance(value, ast.Dict):
+                req, opt = _dict_flag_sets(value)
+                new = _DictFlags(required=req, optional=opt)
+            elif isinstance(value, ast.IfExp) \
+                    and isinstance(value.body, ast.Dict) \
+                    and isinstance(value.orelse, ast.Dict):
+                req_b, opt_b = _dict_flag_sets(value.body)
+                req_o, opt_o = _dict_flag_sets(value.orelse)
+                always = req_b & req_o
+                new = _DictFlags(
+                    required=always,
+                    optional=(req_b | opt_b | req_o | opt_o) - always)
+            if new is not None:
+                old = out.get(name)
+                if conditional and old is not None:
+                    required = old.required & new.required
+                    new = _DictFlags(
+                        required=required,
+                        optional=(old.required | old.optional
+                                  | new.required | new.optional) - required)
+                out[name] = new
+        elif isinstance(stmt, ast.Assign) \
+                and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Subscript) \
+                and isinstance(stmt.targets[0].value, ast.Name):
+            name = stmt.targets[0].value.id
+            key = stmt.targets[0].slice
+            if isinstance(key, ast.Constant) and key.value in CARRY_FLAGS:
+                d = out.setdefault(name, _DictFlags())
+                cls = _classify_flag(stmt.value)
+                if cls is True and not conditional:
+                    d.required.add(key.value)
+                elif cls is not False:
+                    d.optional.add(key.value)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "update" \
+                    and isinstance(call.func.value, ast.Name):
+                name = call.func.value.id
+                d = out.setdefault(name, _DictFlags())
+                pairs = [(kw.arg, kw.value) for kw in call.keywords
+                         if kw.arg in CARRY_FLAGS]
+                for arg in call.args:
+                    if isinstance(arg, ast.Dict):
+                        pairs += [(k.value, v) for k, v
+                                  in zip(arg.keys, arg.values)
+                                  if isinstance(k, ast.Constant)
+                                  and k.value in CARRY_FLAGS]
+                for flag, v in pairs:
+                    cls = _classify_flag(v)
+                    if cls is True and not conditional:
+                        d.required.add(flag)
+                    elif cls is not False:
+                        d.optional.add(flag)
+        # recurse into compound statements; everything below a branch,
+        # loop, or match arm is conditional
+        for body in (getattr(stmt, "body", None), getattr(stmt, "orelse",
+                                                          None),
+                     getattr(stmt, "finalbody", None)):
+            if isinstance(body, list):
+                _collect_dict_flags(body, out, conditional=True)
+        for handler in getattr(stmt, "handlers", []) or []:
+            _collect_dict_flags(handler.body, out, conditional=True)
+        for case in getattr(stmt, "cases", []) or []:
+            _collect_dict_flags(case.body, out, conditional=True)
+
+
+def _combo_name(combo: FrozenSet[str]) -> str:
+    if not combo:
+        return "(no carry flags)"
+    order = {f: i for i, f in enumerate(CARRY_FLAGS)}
+    tags = {"metrics": "metered", "health": "health", "lineage": "lineage"}
+    return "." + ".".join(tags[f] for f in sorted(combo, key=order.get))
+
+
+def _warmup_coverage(ctx: AnalysisContext):
+    warmed, problems = _warmed_combos(ctx)
+    yield from problems
+    if not warmed:
+        return
+    setups = [m for m in ctx.package_modules()
+              if m.rel.startswith(SETUPS_PREFIX)]
+    for mod in setups:
+        scopes = [mod.tree.body] + [
+            n.body for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # module-wide FALLBACK environments, used only for names a scope
+        # does not define itself — dicts/aliases passed into local
+        # helpers as parameters (the ``_evolve(s, gens, owned, health,
+        # lkw)`` idiom).  Same-named locals in different functions never
+        # shadow each other: the scope-local environment wins.
+        module_env: Dict[str, _DictFlags] = {}
+        module_aliases: Dict[str, str] = {}
+        for body in scopes:
+            _collect_dict_flags(body, module_env)
+            _collect_aliases(body, module_aliases)
+        for body in scopes:
+            yield from _scope_dispatches(mod, body, warmed,
+                                         module_env, module_aliases)
+
+
+def _collect_aliases(body: List[ast.stmt], out: Dict[str, str]) -> None:
+    """``run = sharded_evolve_donated if c else ...`` alias tracking,
+    scoped like :func:`_collect_dict_flags`."""
+    for node in _scope_nodes(body):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            cands = [node.value]
+            if isinstance(node.value, ast.IfExp):
+                cands = [node.value.body, node.value.orelse]
+            for cand in cands:
+                name = cand.id if isinstance(cand, ast.Name) else (
+                    cand.attr if isinstance(cand, ast.Attribute)
+                    else None)
+                if name in DISPATCH_NAMES:
+                    out[node.targets[0].id] = name
+                    break
+
+
+def _scope_dispatches(mod, body: List[ast.stmt],
+                      warmed: Dict[str, Set[FrozenSet[str]]],
+                      module_env: Dict[str, _DictFlags],
+                      module_aliases: Dict[str, str]):
+    local_env: Dict[str, _DictFlags] = {}
+    local_aliases: Dict[str, str] = {}
+    _collect_dict_flags(body, local_env)
+    _collect_aliases(body, local_aliases)
+    for node in _scope_nodes(body):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        cname = local_aliases.get(cname, module_aliases.get(cname, cname))
+        sid = DISPATCH_NAMES.get(cname)
+        if sid is None or sid not in warmed:
+            # a surface whose entries generator went stale already
+            # reported F011; don't crash the rest of the coverage scan
+            continue
+        required: Set[str] = set()
+        optional: Set[str] = set()
+        resolved = True
+        for kw in node.keywords:
+            if kw.arg is None:
+                star = kw.value
+                d = None
+                if isinstance(star, ast.Name):
+                    # scope-local definition wins; the module-wide union
+                    # is only a fallback for names this scope never
+                    # defines (helper parameters like ``lkw``)
+                    d = local_env.get(star.id, module_env.get(star.id))
+                if d is not None:
+                    required |= d.required
+                    optional |= d.optional
+                else:
+                    resolved = False
+            elif kw.arg in CARRY_FLAGS:
+                if isinstance(kw.value, ast.Constant):
+                    if kw.value.value:
+                        required.add(kw.arg)
+                else:
+                    optional.add(kw.arg)
+        if not resolved:
+            yield Finding(
+                pass_id=PASS.id, code="F012", path=mod.rel,
+                line=node.lineno, severity=WARNING,
+                message=f"dispatch of {sid} passes **kwargs this pass "
+                        "cannot resolve statically — warmup coverage "
+                        "is blind here; build the flag dict as a "
+                        "tracked local literal")
+            continue
+        optional -= required
+        for extra in itertools.chain.from_iterable(
+                itertools.combinations(sorted(optional), r)
+                for r in range(len(optional) + 1)):
+            combo = frozenset(required | set(extra))
+            if combo not in warmed[sid]:
+                yield Finding(
+                    pass_id=PASS.id, code="F010", path=mod.rel,
+                    line=node.lineno,
+                    message=f"dispatch of {sid} can reach flag combo "
+                            f"{_combo_name(combo)} but utils/aot.py "
+                            "warms no such spelling — the first chunk "
+                            "of that run re-pays the compile; add the "
+                            "warmup entry or waive with a reason")
+
+
+def run(ctx: AnalysisContext):
+    yield from _surface_parity(ctx)
+    yield from _warmup_coverage(ctx)
+
+
+PASS = PassSpec(
+    id="flag-parity",
+    title="four evolve surfaces expose identical static flags; every "
+          "setups flag combo has an AOT warmup spelling",
+    run=run)

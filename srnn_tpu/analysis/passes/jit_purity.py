@@ -1,0 +1,177 @@
+"""Pass ``jit-purity``: no host-side effects syntactically inside traced
+code.
+
+A function that runs under ``jax.jit`` / ``shard_map`` / ``pallas_call``
+or as a ``lax.scan`` body executes its Python exactly ONCE, at trace
+time.  ``time.time()`` reads the clock when the program is *compiled*,
+``np.random`` draws a constant that is baked into the executable,
+``print`` fires once and never again, file I/O happens on the tracing
+host at the wrong moment, and a ``global`` mutation is invisible to
+retraces — every one of them is a silent wrong-answer generator, which
+for this repo means silent bit-drift between spellings that the whole
+parity discipline exists to prevent.
+
+Traced functions are discovered per module:
+
+  * ``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)``
+    decorators;
+  * ``name = jax.jit(_fn, ...)`` wrapper assignments;
+  * first arguments of ``lax.scan`` / ``shard_map`` / ``pallas_call``
+    calls (local function names and lambdas).
+
+The whole body of a traced function counts, nested defs included — a
+host effect in a nested helper still fires at trace time.  Uses of
+``jax.debug.print`` / ``jax.random`` are of course fine (attribute
+calls on ``jax`` never match these patterns).
+
+Codes:
+  * ``J001`` — ``print()`` inside traced code (trace-time only; use
+    ``jax.debug.print`` for per-step output).
+  * ``J002`` — ``time.*`` call inside traced code.
+  * ``J003`` — ``np.random.*`` / ``numpy.random.*`` / stdlib
+    ``random.*`` inside traced code (use ``jax.random`` with a threaded
+    key).
+  * ``J004`` — host file I/O (``open``, ``os.*`` file ops, ``shutil.*``)
+    inside traced code.
+  * ``J005`` — ``global`` mutation inside traced code.
+"""
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import AnalysisContext, Finding, PassSpec, call_name, dotted_name
+
+#: callees whose FIRST positional argument is traced
+TRACING_CALLS = {"scan", "shard_map", "pallas_call"}
+
+#: os.* attrs that are file I/O (reading the env is trace-legal, if ugly)
+OS_FILE_OPS = {"open", "remove", "unlink", "rename", "replace", "makedirs",
+               "mkdir", "rmdir", "write", "read", "fsync", "truncate"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` or ``functools.partial(jax.jit, ...)``."""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _traced_defs(tree: ast.AST) -> List[ast.AST]:
+    """FunctionDef/Lambda nodes traced by jit/scan/shard_map/pallas_call."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    traced: List[ast.AST] = []
+    traced_ids: Set[int] = set()
+
+    def mark_name(name: str) -> None:
+        for d in defs_by_name.get(name, ()):
+            if id(d) not in traced_ids:
+                traced_ids.add(id(d))
+                traced.append(d)
+
+    def mark_arg(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Name):
+            mark_name(arg.id)
+        elif isinstance(arg, ast.Lambda) and id(arg) not in traced_ids:
+            traced_ids.add(id(arg))
+            traced.append(arg)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                mark_name(node.name)
+        elif isinstance(node, ast.Call):
+            if _is_jit_expr(node.func) and node.args:
+                mark_arg(node.args[0])
+            elif call_name(node) in TRACING_CALLS and node.args:
+                mark_arg(node.args[0])
+    return traced
+
+
+def _host_random_imported(tree: ast.AST) -> bool:
+    """True when the module's bare ``random`` name is a HOST RNG —
+    stdlib ``import random`` or ``from numpy import random``.
+    ``from jax import random`` (the common trace-safe spelling) must not
+    make ``random.split(key)`` look like a host call; an unrecognized
+    provenance stays quiet (a false J003 would force a bogus waiver)."""
+    host = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "random" and alias.asname in (None,
+                                                               "random"):
+                    host = True
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if (alias.asname or alias.name) != "random":
+                    continue
+                if node.module in ("numpy", "np"):
+                    host = True
+                elif node.module != "random":
+                    return False  # jax.random or another traced namespace
+    return host
+
+
+def _violations(body_root: ast.AST, rel: str, flag_bare_random: bool):
+    nodes = ast.walk(body_root.body if isinstance(body_root, ast.Lambda)
+                     else body_root)
+    for node in nodes:
+        if isinstance(node, ast.Global):
+            yield Finding(
+                pass_id=PASS.id, code="J005", path=rel, line=node.lineno,
+                message="global mutation inside traced code — retraces "
+                        "never see it; thread state through the carry")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        chain = dotted_name(node.func) or ""
+        root = chain.split(".", 1)[0]
+        if isinstance(node.func, ast.Name) and cname == "print":
+            yield Finding(
+                pass_id=PASS.id, code="J001", path=rel, line=node.lineno,
+                message="print() inside traced code fires once at trace "
+                        "time and never per step — use jax.debug.print "
+                        "or hoist to the host loop")
+        elif root == "time":
+            yield Finding(
+                pass_id=PASS.id, code="J002", path=rel, line=node.lineno,
+                message=f"{chain}() inside traced code reads the clock at "
+                        "COMPILE time — measure around the dispatch on "
+                        "the host instead")
+        elif chain.startswith(("np.random.", "numpy.random.")) \
+                or (root == "random" and flag_bare_random):
+            yield Finding(
+                pass_id=PASS.id, code="J003", path=rel, line=node.lineno,
+                message=f"{chain}() inside traced code bakes one host draw "
+                        "into the executable — use jax.random with a "
+                        "threaded key")
+        elif (isinstance(node.func, ast.Name) and cname == "open") \
+                or (root == "os" and cname in OS_FILE_OPS) \
+                or root == "shutil":
+            yield Finding(
+                pass_id=PASS.id, code="J004", path=rel, line=node.lineno,
+                message=f"host file I/O ({chain or cname}) inside traced "
+                        "code runs at trace time on the tracing host — "
+                        "move it to the chunk finisher / BackgroundWriter")
+
+
+def run(ctx: AnalysisContext):
+    for mod in ctx.package_modules():
+        flag_bare_random = _host_random_imported(mod.tree)
+        for traced in _traced_defs(mod.tree):
+            yield from _violations(traced, mod.rel, flag_bare_random)
+
+
+PASS = PassSpec(
+    id="jit-purity",
+    title="no time/np.random/print/file-I/O/global-mutation inside "
+          "jitted, shard_mapped, pallas, or scanned bodies",
+    run=run)

@@ -1,0 +1,100 @@
+"""Pass ``metric-names``: every metric registered anywhere under
+``srnn_tpu/`` must be declared in the canonical table
+(``telemetry.names``) with the right kind and follow the naming
+convention — the collection-time tripwire for the next ``zweo``-style
+drift.
+
+Migrated from the pre-framework ``tests/test_metric_names.py`` walker:
+the AST half (literal ``.counter("…")``/``.gauge("…")``/
+``.histogram("…")`` registrations, including the ``g = registry.gauge;
+g("…")`` aliasing idiom the hot paths use) lives here; the runtime
+halves (the ``EVENT_COUNTERS`` table import, the ``ACTION_NAMES``
+spelling assertion) stay runtime tests in the wrapper.
+
+Codes:
+  * ``M001`` — registered metric name missing from ``CANONICAL_METRICS``.
+  * ``M002`` — registered with a kind different from its declaration.
+  * ``M003`` — a canonical name violates the naming convention.
+  * ``M004`` — the AST scan found no registrations at all (the pass
+    itself would be dead — fail loudly).
+"""
+
+import ast
+
+from ..core import AnalysisContext, Finding, PassSpec
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+def _registrations(tree):
+    """(kind, name, lineno) for every literal metric registration in one
+    module, resolving single-letter aliases like ``g = registry.gauge``."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr in _KINDS:
+            aliases[node.targets[0].id] = node.value.attr
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        arg0 = node.args[0]
+        if not (isinstance(arg0, ast.Constant) and isinstance(arg0.value, str)):
+            continue
+        f = node.func
+        kind = None
+        if isinstance(f, ast.Attribute) and f.attr in _KINDS:
+            kind = f.attr
+        elif isinstance(f, ast.Name) and f.id in aliases:
+            kind = aliases[f.id]
+        if kind is not None:
+            yield kind, arg0.value, node.lineno
+
+
+def run(ctx: AnalysisContext):
+    # the canonical table and convention checker are the product source of
+    # truth — import them instead of re-parsing (the CLI already paid the
+    # package import; drifting a re-implementation would defeat the gate)
+    from ...telemetry.names import CANONICAL_METRICS, check_name
+
+    seen = False
+    for mod in ctx.package_modules():
+        for kind, name, lineno in _registrations(mod.tree):
+            seen = True
+            declared = CANONICAL_METRICS.get(name)
+            if declared is None:
+                yield Finding(
+                    pass_id=PASS.id, code="M001", path=mod.rel, line=lineno,
+                    message=f"metric {name!r} not in telemetry.names."
+                            "CANONICAL_METRICS — declare it (and check the "
+                            "spelling: this gate exists because of "
+                            "'zweo_dead')")
+            elif declared != kind:
+                yield Finding(
+                    pass_id=PASS.id, code="M002", path=mod.rel, line=lineno,
+                    message=f"metric {name!r} registered as {kind}, "
+                            f"declared as {declared}")
+    names_mod = ctx.module("srnn_tpu/telemetry/names.py")
+    names_rel = names_mod.rel if names_mod else "srnn_tpu/telemetry/names.py"
+    for name, kind in CANONICAL_METRICS.items():
+        if kind not in _KINDS:
+            yield Finding(pass_id=PASS.id, code="M003", path=names_rel,
+                          line=1, message=f"{name}: unknown kind {kind!r}")
+            continue
+        for problem in check_name(name, kind):
+            yield Finding(pass_id=PASS.id, code="M003", path=names_rel,
+                          line=1, message=problem)
+    if not seen:
+        yield Finding(
+            pass_id=PASS.id, code="M004",
+            path="srnn_tpu/telemetry/names.py", line=1,
+            message="AST scan found no metric registrations — the "
+                    "metric-names pass is broken or the walk roots moved")
+
+
+PASS = PassSpec(
+    id="metric-names",
+    title="every registered metric is declared in telemetry.names with "
+          "the right kind and convention",
+    run=run)

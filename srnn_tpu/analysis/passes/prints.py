@@ -1,0 +1,57 @@
+"""Pass ``stray-prints``: runtime output must route through
+``Experiment.log`` / the telemetry sinks, never bare ``print()``.
+
+Migrated from the pre-framework ``tests/test_no_stray_prints.py`` walker.
+A ``print(...)`` call outside the sanctioned modules — the reference
+``PrintingObject`` shim, ``experiment.py`` (whose ``log``/``__enter__``
+ARE the human stdout channel), and the CLI entry points — is a finding
+unless it explicitly routes via a ``file=`` keyword (diagnostics
+deliberately sent to stderr, e.g. backend-init retries, stay legal
+everywhere).
+
+Codes:
+  * ``P001`` — bare ``print()`` outside the sanctioned output channels.
+"""
+
+import ast
+
+from ..core import AnalysisContext, Finding, PassSpec
+
+#: package-relative modules whose stdout prints ARE their contract
+ALLOWED_FILES = {
+    "utils/printing.py",     # the reference PrintingObject parity shim
+    "experiment.py",         # Experiment.log is the human stdout channel
+    "precompile.py",         # CLI: prints its one JSON result line
+    "viz.py",                # CLI: run-dir walker output
+    "telemetry/report.py",   # CLI: renders the telemetry summary
+    "analysis/__main__.py",  # CLI: this analyzer's own report output
+}
+#: CLI entry-point trees (every setup is a __main__-dispatched script)
+ALLOWED_DIRS = ("setups/",)
+
+
+def run(ctx: AnalysisContext):
+    for mod in ctx.package_modules():
+        if mod.pkg_rel in ALLOWED_FILES or mod.pkg_rel.startswith(ALLOWED_DIRS):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                continue
+            if any(kw.arg == "file" for kw in node.keywords):
+                continue  # explicitly routed (stderr diagnostics)
+            yield Finding(
+                pass_id=PASS.id, code="P001", path=mod.rel,
+                line=node.lineno,
+                message="bare print() outside the sanctioned output "
+                        "channels — route through Experiment.log / "
+                        "telemetry sinks, or print(..., file=sys.stderr) "
+                        "for diagnostics")
+
+
+PASS = PassSpec(
+    id="stray-prints",
+    title="runtime output routes through Experiment.log/telemetry, "
+          "never bare print()",
+    run=run)

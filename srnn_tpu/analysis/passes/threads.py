@@ -1,0 +1,99 @@
+"""Pass ``thread-hygiene``: every thread started under ``srnn_tpu/``
+must go through ``utils.pipeline.spawn_thread`` — the package's thread
+factory — so it is (a) registered with the join-on-exit registry that the
+shutdown tests audit (``pipeline.live_threads()``) and (b) non-daemon
+unless explicitly opted out, so interpreter exit can never strand
+buffered I/O (a daemon writer dying mid-fsync is a silent data-loss
+path).
+
+Migrated from the pre-framework ``tests/test_thread_hygiene.py`` walker,
+including the daemon whitelist and its max-ONE-reviewed-site-per-file
+rule.  The factory's runtime half (spawn lands in ``live_threads()``,
+joins out of it) stays a runtime test in the wrapper.
+
+Codes:
+  * ``H001`` — direct ``Thread()`` construction outside the factory.
+  * ``H002`` — ``spawn_thread(daemon=True)`` in an unwhitelisted file.
+  * ``H003`` — a SECOND daemon site in a whitelisted file.
+"""
+
+import ast
+
+from ..core import AnalysisContext, Finding, PassSpec
+
+#: the factory's own home — the one sanctioned Thread() call site
+FACTORY_FILE = "utils/pipeline.py"
+
+#: reviewed daemon-thread call sites (pkg-relative file -> justification),
+#: ONE per file — a second daemon call in a whitelisted file still fails,
+#: so the BackgroundWriter (buffered I/O, same file as the ChunkDriver)
+#: can never silently go daemon.  Both sites are deliberately NOT
+#: joinable: they exist to escape/observe a thread that is presumed
+#: wedged below Python, own no buffered I/O, and a non-daemon spelling
+#: would hang interpreter exit on the very wedge they watch for.
+DAEMON_WHITELIST = {
+    "utils/pipeline.py":
+        "ChunkDriver stall deadline: the watched finisher thread IS the "
+        "presumed-wedged thread",
+    "telemetry/flightrec.py":
+        "StallSentinel dead-man's switch: fires while the main thread "
+        "hangs in a dead backend call",
+}
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return True  # threading.Thread(...), x.Thread(...)
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _is_spawn_thread(node: ast.Call) -> bool:
+    return (isinstance(node.func, (ast.Name, ast.Attribute))
+            and (getattr(node.func, "id", None) == "spawn_thread"
+                 or getattr(node.func, "attr", None) == "spawn_thread"))
+
+
+def run(ctx: AnalysisContext):
+    for mod in ctx.package_modules():
+        daemon_sites = 0
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_thread_ctor(node) and mod.pkg_rel != FACTORY_FILE:
+                yield Finding(
+                    pass_id=PASS.id, code="H001", path=mod.rel,
+                    line=node.lineno,
+                    message="direct Thread() — use "
+                            "utils.pipeline.spawn_thread "
+                            "(join-on-exit registry)")
+            if _is_spawn_thread(node):
+                for kw in node.keywords:
+                    if (kw.arg == "daemon"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is True):
+                        daemon_sites += 1
+                        if mod.pkg_rel not in DAEMON_WHITELIST:
+                            yield Finding(
+                                pass_id=PASS.id, code="H002", path=mod.rel,
+                                line=node.lineno,
+                                message="spawn_thread(daemon=True) — daemon "
+                                        "threads can strand buffered I/O at "
+                                        "interpreter exit; justify and "
+                                        "whitelist in analysis/passes/"
+                                        "threads.py if truly needed")
+                        elif daemon_sites > 1:
+                            yield Finding(
+                                pass_id=PASS.id, code="H003", path=mod.rel,
+                                line=node.lineno,
+                                message="second spawn_thread(daemon=True) in "
+                                        "a whitelisted file — the whitelist "
+                                        "covers ONE reviewed site per file; "
+                                        "review this one separately")
+
+
+PASS = PassSpec(
+    id="thread-hygiene",
+    title="threads only via utils.pipeline.spawn_thread; daemon sites "
+          "whitelisted one-per-file",
+    run=run)

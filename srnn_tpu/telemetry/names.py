@@ -1,9 +1,11 @@
 """The canonical metric-name table.
 
 Every metric name registered anywhere under ``srnn_tpu/`` must be declared
-here with its kind — ``tests/test_metric_names.py`` walks the package AST
-(and the runtime ``EVENT_COUNTERS`` table) and fails on any name that is
-missing, mis-kinded, or breaks the naming convention.  This is the
+here with its kind — the srnnlint ``metric-names`` pass
+(``srnn_tpu/analysis/passes/metric_names.py``; run via ``python -m
+srnn_tpu.analysis`` or the ``tests/test_metric_names.py`` wrapper) walks
+the package AST (and the runtime ``EVENT_COUNTERS`` table) and fails on
+any name that is missing, mis-kinded, or breaks the naming convention.  This is the
 collection-time tripwire for the next ``zweo``-style drift: a typo'd or
 ad-hoc name cannot ship, because it is not in this table.
 

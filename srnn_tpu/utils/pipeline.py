@@ -43,8 +43,8 @@ drains the host work:
 
 Thread hygiene: :func:`spawn_thread` is the only sanctioned way to start
 a thread under ``srnn_tpu`` — it registers the thread with the module's
-join-on-exit registry (``live_threads`` audits it; an AST gate in
-``tests/test_thread_hygiene.py`` enforces the rule), and threads default
+join-on-exit registry (``live_threads`` audits it; the srnnlint
+``thread-hygiene`` pass enforces the rule), and threads default
 to non-daemon so interpreter exit cannot strand buffered I/O.
 """
 

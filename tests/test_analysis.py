@@ -1,0 +1,850 @@
+"""srnnlint framework tests: deliberately-bad fixture snippets per pass
+(each pass must FIRE on its seeded violation), the clean-repo gate (the
+real repo yields zero unwaived findings), and the waiver machinery
+(reasons required, stale waivers reported, matching suppresses)."""
+
+import os
+import textwrap
+
+import pytest
+
+from srnn_tpu.analysis import (AnalysisContext, run_analysis, select,
+                               ALL_PASSES, PASSES_BY_ID)
+from srnn_tpu.analysis.core import ERROR, WARNING, load_waivers
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_repo(tmp_path, files):
+    """Write a mini repo ({rel: source}) and parse it into a context."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return AnalysisContext.from_root(str(tmp_path))
+
+
+def run_pass(ctx, pass_id):
+    return list(PASSES_BY_ID[pass_id].run(ctx))
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# the clean-repo gate: zero unwaived findings on the real tree
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def repo_ctx():
+    return AnalysisContext.from_root(REPO_ROOT)
+
+
+@pytest.mark.parametrize("pass_id", [p.id for p in ALL_PASSES])
+def test_repo_is_clean_per_pass(repo_ctx, pass_id):
+    result = run_analysis(repo_ctx, select([pass_id]))
+    assert not result.errors, "\n".join(f.render() for f in result.errors)
+
+
+def test_cli_clean_run_exits_zero(capsys):
+    from srnn_tpu.analysis.__main__ import main
+
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "srnnlint:" in out and "0 error(s)" in out
+
+
+def test_cli_json_and_list(capsys):
+    import json
+
+    from srnn_tpu.analysis.__main__ import main
+
+    assert main(["--list"]) == 0
+    listing = capsys.readouterr().out
+    for p in ALL_PASSES:
+        assert p.id in listing
+    assert main(["--json", "--fast"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["exit_code"] == 0
+    assert set(data["passes"]) == {p.id for p in ALL_PASSES if p.fast}
+
+
+def test_cli_unknown_pass_is_usage_error(capsys):
+    from srnn_tpu.analysis.__main__ import main
+
+    assert main(["no-such-pass"]) == 2
+    capsys.readouterr()
+
+
+def test_shipped_waivers_all_used_with_reasons(repo_ctx):
+    """The checked-in baseline cannot rot: every line matches a live
+    finding and carries a reason (W001/W002 would be findings)."""
+    result = run_analysis(repo_ctx, select(None))
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+    assert result.waived, "expected the documented F010 waivers to be live"
+    assert all(w.reason for _f, w in result.waived)
+
+
+def test_walk_roots_shared_config(repo_ctx):
+    """The one shared walk-root policy: no __pycache__, no graft shim,
+    no benchmarks/tests scratch (fixture snippets would trip passes),
+    repo-level surface present, and the scripts walk sees the watch
+    scripts."""
+    rels = [m.rel for m in repo_ctx.modules]
+    assert not any("__pycache__" in r for r in rels)
+    assert not any(r.endswith("__graft_entry__.py") for r in rels)
+    assert not any(r.startswith(("benchmarks/", "tests/", "examples/"))
+                   for r in rels)
+    assert "srnn_tpu/soup.py" in rels
+    assert "bench.py" in rels          # repo-level surface is walked
+    pkg = [m.rel for m in repo_ctx.package_modules()]
+    assert "bench.py" not in pkg       # ...but package view excludes it
+    shell = [s.rel for s in repo_ctx.shell_files]
+    assert "scripts/tpu_watch.sh" in shell
+    assert "scripts/tpu_window.sh" in shell
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+# ---------------------------------------------------------------------------
+
+
+def test_donation_use_after_donate(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/loop.py": """
+        def loop(cfg, state):
+            out = evolve_donated(cfg, state)
+            census = state.weights.sum()
+            state = out[0]
+            return census
+        """})
+    found = run_pass(ctx, "donation-safety")
+    assert codes(found) == ["D001"]
+    assert found[0].line == 4
+
+
+def test_donation_snapshot_after_donate(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/loop.py": """
+        from .pipeline import snapshot
+
+        def loop(cfg, mesh, state):
+            out = sharded_evolve_donated(cfg, mesh, state)
+            snap = snapshot(state)
+            state = out[0]
+            return snap
+        """})
+    found = run_pass(ctx, "donation-safety")
+    assert codes(found) == ["D002"]
+
+
+def test_donation_sanctioned_pattern_is_clean(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/loop.py": """
+        from .pipeline import snapshot
+
+        def loop(cfg, state, writer):
+            for _ in range(10):
+                snap = snapshot(state)           # BEFORE the donation
+                out = evolve_donated(cfg, state)
+                state = out[0]
+                writer.submit(lambda: resolve(snap))
+                census = state.weights.sum()     # rebound: fine
+            return state, census
+        """})
+    assert run_pass(ctx, "donation-safety") == []
+
+
+def test_donation_loop_carried_use(tmp_path):
+    """Donated at the bottom of a loop body without rebinding: the next
+    iteration's read at the top is the bug."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/loop.py": """
+        def loop(cfg, state):
+            for _ in range(10):
+                census = state.weights.sum()
+                evolve_donated(cfg, state)
+            return census
+        """})
+    found = run_pass(ctx, "donation-safety")
+    assert "D001" in codes(found)
+
+
+def test_donation_alias_and_branch_merge(tmp_path):
+    """The mega-loop idiom: a maybe-donating alias donates, a read after
+    an if that rebinds on BOTH arms is clean, a read after an if that
+    rebinds on only one arm fires."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/loop.py": """
+        def ok(cfg, mesh, state, sharded):
+            run = sharded_evolve_donated if sharded else sharded_evolve
+            out = run(cfg, mesh, state)
+            if sharded:
+                state = out[0]
+            else:
+                state = out[0]
+            return state.uids
+
+        def bad(cfg, mesh, state, sharded):
+            run = sharded_evolve_donated if sharded else sharded_evolve
+            out = run(cfg, mesh, state)
+            if sharded:
+                state = out[0]
+            return state.uids
+        """})
+    found = run_pass(ctx, "donation-safety")
+    assert codes(found) == ["D001"]
+    assert found[0].line == 16   # the read in bad(), not the one in ok()
+
+
+def test_donation_sees_into_match_statements(tmp_path):
+    """match/case bodies are part of the scope — a use-after-donate
+    inside a case arm must not be a blind spot."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/loop.py": """
+        def loop(cfg, state, mode):
+            match mode:
+                case "fast":
+                    out = evolve_donated(cfg, state)
+                    census = state.weights.sum()
+                case _:
+                    out = None
+            return out
+        """})
+    assert codes(run_pass(ctx, "donation-safety")) == ["D001"]
+
+
+def test_donation_alias_retired_on_rebind(tmp_path):
+    """Rebinding an alias to a non-donating callee must stop treating its
+    calls as donating — correct code stays clean."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/loop.py": """
+        def loop(cfg, state, state2, owned):
+            run = evolve_donated if owned else evolve
+            out = run(cfg, state)
+            state = out[0]
+            run = evolve
+            out2 = run(cfg, state2)
+            return state2.weights.sum()
+        """})
+    assert run_pass(ctx, "donation-safety") == []
+
+
+# ---------------------------------------------------------------------------
+# flag parity
+# ---------------------------------------------------------------------------
+
+
+_SURFACE_TEMPLATE = """
+    import jax
+
+    def {fn}({head}generations=1, metrics=False, health=False,
+             lineage=False, lineage_state=None, lineage_capacity={cap}):
+        return 0
+
+    {plain} = jax.jit({fn}, static_argnames=({statics}))
+    {donated} = jax.jit({fn}, static_argnames=({statics}),
+                        donate_argnums=(1,))
+    """
+
+_FULL_STATICS = ('"config", "generations", "metrics", "health", '
+                 '"lineage", "lineage_capacity"')
+
+
+def _surface_files(sharded_multi_src=None, cap="4096", statics=None):
+    statics = statics or _FULL_STATICS
+    files = {
+        "srnn_tpu/soup.py": _SURFACE_TEMPLATE.format(
+            fn="_evolve", head="config, state, record=False, ",
+            cap="4096", plain="evolve", donated="evolve_donated",
+            statics=statics + ', "record"'),
+        "srnn_tpu/multisoup.py": _SURFACE_TEMPLATE.format(
+            fn="_evolve_multi", head="config, state, ", cap=cap,
+            plain="evolve_multi", donated="evolve_multi_donated",
+            statics=statics),
+        "srnn_tpu/parallel/sharded_soup.py": _SURFACE_TEMPLATE.format(
+            fn="_sharded_evolve", head="config, mesh, state, ", cap="4096",
+            plain="sharded_evolve", donated="sharded_evolve_donated",
+            statics=statics + ', "mesh"'),
+        "srnn_tpu/parallel/sharded_multisoup.py": sharded_multi_src
+        or _SURFACE_TEMPLATE.format(
+            fn="_sharded_evolve_multi", head="config, mesh, state, ",
+            cap="4096", plain="sharded_evolve_multi",
+            donated="sharded_evolve_multi_donated",
+            statics=statics + ', "mesh"'),
+        "srnn_tpu/utils/aot.py": _AOT_FIXTURE,
+    }
+    return files
+
+
+_AOT_FIXTURE = """
+    def _soup_entries(config, generations, donate):
+        yield ("soup.evolve", None, (config,), {})
+        yield ("soup.evolve.metered", None, (config,),
+               {"generations": 1, "metrics": True})
+        yield ("soup.evolve.metered.health", None, (config,),
+               {"metrics": True, "health": True})
+
+    def _multi_entries(config, generations, donate):
+        yield ("multisoup.evolve_multi", None, (config,), {})
+        yield ("multisoup.evolve_multi.metered", None, (config,),
+               {"metrics": True})
+
+    def _sharded_entries(config, mesh, generations, donate):
+        yield ("parallel.sharded_evolve", None, (config,), {})
+        yield ("parallel.sharded_evolve.metered", None, (config,),
+               {"metrics": True})
+
+    def _sharded_multi_entries(config, mesh, generations, donate):
+        yield ("parallel.sharded_evolve_multi", None, (config,), {})
+        yield ("parallel.sharded_evolve_multi.metered", None, (config,),
+               {"metrics": True})
+    """
+
+
+def test_flag_parity_clean_fixture(tmp_path):
+    ctx = make_repo(tmp_path, _surface_files())
+    assert [f for f in run_pass(ctx, "flag-parity")
+            if f.severity == ERROR] == []
+
+
+def test_flag_parity_missing_flag_on_one_surface(tmp_path):
+    bad = """
+        import jax
+
+        def _sharded_evolve_multi(config, mesh, state, generations=1,
+                                  metrics=False, lineage=False,
+                                  lineage_state=None, lineage_capacity=4096):
+            return 0
+
+        sharded_evolve_multi = jax.jit(_sharded_evolve_multi,
+            static_argnames=("config", "mesh", "generations", "metrics",
+                             "lineage", "lineage_capacity"))
+        sharded_evolve_multi_donated = jax.jit(_sharded_evolve_multi,
+            static_argnames=("config", "mesh", "generations", "metrics",
+                             "lineage", "lineage_capacity"),
+            donate_argnums=(2,))
+        """
+    ctx = make_repo(tmp_path, _surface_files(sharded_multi_src=bad))
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F001"]
+    assert len(found) == 1
+    assert "health" in found[0].message
+    assert found[0].path == "srnn_tpu/parallel/sharded_multisoup.py"
+
+
+def test_flag_parity_default_mismatch(tmp_path):
+    ctx = make_repo(tmp_path, _surface_files(cap="2048"))
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F002"]
+    assert found and "lineage_capacity" in found[0].message
+
+
+def test_flag_parity_static_argnames(tmp_path):
+    slim = _FULL_STATICS.replace(', "lineage_capacity"', '') \
+        + ', "lineage_state"'
+    ctx = make_repo(tmp_path, _surface_files(statics=slim))
+    found = run_pass(ctx, "flag-parity")
+    assert "F003" in codes(found)   # lineage_capacity not static
+    assert "F004" in codes(found)   # lineage_state wrongly static
+
+
+def test_flag_parity_warmup_gap(tmp_path):
+    files = _surface_files()
+    files["srnn_tpu/setups/mega.py"] = """
+        def loop(cfg, state, lineage_on):
+            kw = {"generations": 5, "metrics": True}
+            if lineage_on:
+                kw["lineage"] = True
+            out = evolve_donated(cfg, state, **kw)
+            state = out[0]
+            return state
+        """
+    ctx = make_repo(tmp_path, files)
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F010"]
+    assert len(found) == 1
+    assert ".metered.lineage" in found[0].message
+    assert found[0].path == "srnn_tpu/setups/mega.py"
+
+
+def test_flag_parity_same_named_dicts_stay_scoped(tmp_path):
+    """Two functions both calling their flag dict ``kw`` must resolve
+    against their OWN definition — the module-wide table is only a
+    fallback for helper parameters, never a shadow."""
+    files = _surface_files()
+    files["srnn_tpu/setups/mega.py"] = """
+        def a(cfg, state):
+            kw = {"metrics": True, "lineage": True}
+            return evolve_donated(cfg, state, **kw)
+
+        def b(cfg, state):
+            kw = {"metrics": True}
+            return evolve_donated(cfg, state, **kw)
+        """
+    ctx = make_repo(tmp_path, files)
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F010"]
+    # only a()'s dispatch reaches the unwarmed .metered.lineage combo;
+    # b() resolving against a()'s dict would double-report (or, with the
+    # definitions swapped, silently miss a()'s gap)
+    assert len(found) == 1
+    assert found[0].line == 4
+
+
+def test_flag_parity_helper_param_falls_back_to_module(tmp_path):
+    """The mega_multisoup idiom: a flag dict built in the outer loop and
+    passed into a local helper as a parameter still resolves."""
+    files = _surface_files()
+    files["srnn_tpu/setups/mega.py"] = """
+        def run(cfg, state, lineage_on):
+            def _evolve(s, lkw):
+                return evolve_multi_donated(cfg, s, metrics=True, **lkw)
+
+            lkw = {"lineage": True} if lineage_on else {}
+            return _evolve(state, lkw)
+        """
+    ctx = make_repo(tmp_path, files)
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F010"]
+    assert len(found) == 1 and ".metered.lineage" in found[0].message
+
+
+def test_flag_parity_conditional_reassign_keeps_both_combos(tmp_path):
+    """A branch-local ``kw = {...}`` re-init must not wipe the base
+    combo: both the taken and untaken paths stay checked."""
+    files = _surface_files()
+    files["srnn_tpu/setups/mega.py"] = """
+        def loop(cfg, state, lineage_on):
+            kw = {"metrics": True}
+            if lineage_on:
+                kw = {"metrics": True, "lineage": True}
+            return evolve_donated(cfg, state, **kw)
+        """
+    ctx = make_repo(tmp_path, files)
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F010"]
+    # .metered is warmed; .metered.lineage is not — exactly the lattice
+    assert len(found) == 1 and ".metered.lineage" in found[0].message
+
+
+def test_flag_parity_variable_valued_flag_is_optional(tmp_path):
+    """``kw["health"] = health_flag`` (runtime value) must generate BOTH
+    the with- and without-health combos, exactly like ``health=flag``
+    passed directly."""
+    files = _surface_files()
+    files["srnn_tpu/setups/mega.py"] = """
+        def loop(cfg, state, health_flag):
+            kw = {"metrics": True}
+            kw["health"] = health_flag
+            return evolve_donated(cfg, state, **kw)
+        """
+    ctx = make_repo(tmp_path, files)
+    # .metered and .metered.health are both warmed: no findings — but
+    # only if the no-health combo was actually generated and checked
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F010"]
+    assert found == []
+    files["srnn_tpu/setups/mega.py"] = """
+        def loop(cfg, state, lineage_flag):
+            kw = {"metrics": True}
+            kw["lineage"] = lineage_flag
+            return evolve_donated(cfg, state, **kw)
+        """
+    ctx = make_repo(tmp_path / "b", files)
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F010"]
+    assert len(found) == 1 and ".metered.lineage" in found[0].message
+
+
+def test_flag_parity_unresolvable_dispatch_warns(tmp_path):
+    files = _surface_files()
+    files["srnn_tpu/setups/mega.py"] = """
+        def loop(cfg, state, kwargs):
+            return evolve_donated(cfg, state, **kwargs)
+        """
+    ctx = make_repo(tmp_path, files)
+    found = [f for f in run_pass(ctx, "flag-parity") if f.code == "F012"]
+    assert len(found) == 1 and found[0].severity == WARNING
+
+
+def test_flag_parity_stale_registry_is_loud(tmp_path):
+    """A vanished entries generator reports F011 — and a live setups
+    dispatch of that surface must not crash the rest of the scan (the
+    exact shape ROADMAP item 1's refactor will produce mid-rename)."""
+    files = _surface_files()
+    files["srnn_tpu/utils/aot.py"] = _AOT_FIXTURE.replace(
+        "def _soup_entries", "def _renamed_soup_entries")
+    files["srnn_tpu/setups/mega.py"] = """
+        def loop(cfg, state):
+            out = evolve_donated(cfg, state, metrics=True)
+            state = out[0]
+            return state
+        """
+    ctx = make_repo(tmp_path, files)
+    found = run_pass(ctx, "flag-parity")
+    assert "F011" in codes(found)
+    assert not [f for f in found if f.code == "F010"]
+
+
+# ---------------------------------------------------------------------------
+# jit purity
+# ---------------------------------------------------------------------------
+
+
+def test_jit_purity_time_in_scanned_body(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        import time
+        import jax
+
+        def step(carry, _):
+            t = time.time()
+            return carry + t, None
+
+        def run(state):
+            return jax.lax.scan(step, state, None, length=10)
+        """})
+    found = run_pass(ctx, "jit-purity")
+    assert codes(found) == ["J002"]
+
+
+def test_jit_purity_decorated_and_wrapped(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        import functools
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def f(x):
+            print("tracing", x)
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            with open("/tmp/x") as fh:
+                fh.read()
+            return x
+
+        def _h(x):
+            global COUNT
+            COUNT += 1
+            return x + np.random.rand()
+
+        h = jax.jit(_h, donate_argnums=(0,))
+        """})
+    assert codes(run_pass(ctx, "jit-purity")) == \
+        ["J001", "J003", "J004", "J005"]
+
+
+def test_jit_purity_jax_random_spelling_is_clean(tmp_path):
+    """``from jax import random`` inside traced code is the trace-safe
+    spelling and must not be flagged; stdlib ``import random`` must."""
+    ctx = make_repo(tmp_path, {
+        "srnn_tpu/good.py": """
+            import jax
+            from jax import random
+
+            @jax.jit
+            def f(key):
+                return random.normal(random.split(key)[0], (3,))
+            """,
+        "srnn_tpu/bad.py": """
+            import random
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x + random.random()
+            """})
+    found = run_pass(ctx, "jit-purity")
+    assert codes(found) == ["J003"]
+    assert found[0].path == "srnn_tpu/bad.py"
+    # numpy's module-level random import is a host RNG too
+    numpy_ctx = make_repo(tmp_path / "np", {"srnn_tpu/mod.py": """
+        import jax
+        from numpy import random
+
+        @jax.jit
+        def f(x):
+            return x + random.rand()
+        """})
+    assert codes(run_pass(numpy_ctx, "jit-purity")) == ["J003"]
+
+
+def test_jit_purity_kernel_and_clean_host_code(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        import time
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def kernel(w_ref, o_ref):
+            o_ref[...] = w_ref[...] * np.random.rand()
+
+        def call(w):
+            return pl.pallas_call(kernel, out_shape=None)(w)
+
+        def host_loop(run_dir):
+            t0 = time.time()                 # host code: fine
+            print("starting", run_dir)       # host code: not this pass
+            return time.time() - t0
+        """})
+    assert codes(run_pass(ctx, "jit-purity")) == ["J003"]
+
+
+# ---------------------------------------------------------------------------
+# fault taxonomy
+# ---------------------------------------------------------------------------
+
+
+_SUPERVISOR_OK = """
+    import re
+
+    EXIT_RECOVERED = 3
+    EXIT_RETRIES_EXHAUSTED = 69
+    EXIT_PREEMPTED_CLEAN = 75
+
+    _DETERMINISTIC_XLA_RE = re.compile(
+        r"RESOURCE_EXHAUSTED|INVALID_ARGUMENT")
+
+    class Preempted(Exception):
+        pass
+
+    def classify_fault(exc):
+        from ..utils.pipeline import StallError, WriterError
+        if isinstance(exc, Preempted):
+            return "preempt"
+        if isinstance(exc, StallError):
+            return "stall"
+        if isinstance(exc, WriterError):
+            return "io"
+        if _DETERMINISTIC_XLA_RE.search(str(exc)):
+            return "fatal"
+        return "fatal"
+    """
+
+_WATCH_OK = """\
+case "$rc" in
+    0) echo ok ;;
+    3) echo recovered ;;
+    75) echo preempted ;;
+    69) echo exhausted ;;
+    *) echo wedge ;;
+esac
+"""
+
+_MAIN_OK = "# exit vocabulary: 0 clean, 3 recovered, 69 exhausted, 75 preempted\n"
+
+
+def _taxonomy_files(supervisor=_SUPERVISOR_OK, watch=_WATCH_OK,
+                    window=_WATCH_OK, main=_MAIN_OK):
+    return {
+        "srnn_tpu/resilience/supervisor.py": supervisor,
+        "srnn_tpu/setups/__main__.py": main,
+        "srnn_tpu/utils/pipeline.py": """
+            class StallError(Exception):
+                pass
+
+            class WriterError(Exception):
+                pass
+
+            def f(job):
+                raise WriterError("job died")
+
+            def g():
+                raise StallError("deadline")
+            """,
+        "scripts/tpu_watch.sh": watch,
+        "scripts/tpu_window.sh": window,
+    }
+
+
+def test_fault_taxonomy_clean_fixture(tmp_path):
+    ctx = make_repo(tmp_path, _taxonomy_files())
+    assert run_pass(ctx, "fault-taxonomy") == []
+
+
+def test_fault_taxonomy_unclassified_raise(tmp_path):
+    sup = _SUPERVISOR_OK.replace(
+        '        if isinstance(exc, WriterError):\n'
+        '            return "io"\n', '')
+    ctx = make_repo(tmp_path, _taxonomy_files(supervisor=sup))
+    found = [f for f in run_pass(ctx, "fault-taxonomy")
+             if f.code == "T001"]
+    assert len(found) == 1
+    assert "WriterError" in found[0].message
+    assert found[0].path == "srnn_tpu/utils/pipeline.py"
+
+
+def test_fault_taxonomy_bogus_status_and_dead_regex(tmp_path):
+    sup = _SUPERVISOR_OK.replace("RESOURCE_EXHAUSTED", "RESOURCE_EXHASTED")
+    sup += "\n    _DEAD_RE = re.compile(r'DATA_LOSS')\n"
+    ctx = make_repo(tmp_path, _taxonomy_files(supervisor=sup))
+    found = run_pass(ctx, "fault-taxonomy")
+    assert "T002" in codes(found) and "T003" in codes(found)
+    assert any("RESOURCE_EXHASTED" in f.message for f in found)
+
+
+def test_fault_taxonomy_stale_exit_codes(tmp_path):
+    watch = _WATCH_OK.replace("    75) echo preempted ;;\n", "")
+    window = _WATCH_OK + "\nexit 3\n"
+    main = "# exit vocabulary: 0 clean, 3 recovered, 69 exhausted\n"
+    ctx = make_repo(tmp_path, _taxonomy_files(watch=watch, window=window,
+                                              main=main))
+    found = run_pass(ctx, "fault-taxonomy")
+    got = codes(found)
+    assert "T004" in got    # 75 not named in setups/__main__.py
+    assert "T005" in got    # no case arm for 75 in tpu_watch.sh
+    assert "T006" in got    # tpu_window.sh claims exit 3 for itself
+    # comments never trip the collision check, and an earlier comment
+    # must not skew the reported line of a real collision below it
+    commented = make_repo(tmp_path / "c", _taxonomy_files(
+        window=_WATCH_OK + "\n# a comment naming exit 75 is fine\n"))
+    assert "T006" not in codes(run_pass(commented, "fault-taxonomy"))
+    skewed = make_repo(tmp_path / "s", _taxonomy_files(
+        window=_WATCH_OK + "\n# long comment before the bug\nexit 69\n"))
+    hits = [f for f in run_pass(skewed, "fault-taxonomy")
+            if f.code == "T006"]
+    assert len(hits) == 1
+    assert hits[0].line == len(_WATCH_OK.splitlines()) + 3
+
+
+# ---------------------------------------------------------------------------
+# migrated hygiene passes still fire
+# ---------------------------------------------------------------------------
+
+
+def test_stray_prints_fires_and_allows_stderr(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        import sys
+
+        def f():
+            print("to stdout")
+            print("diag", file=sys.stderr)
+        """})
+    found = run_pass(ctx, "stray-prints")
+    assert codes(found) == ["P001"] and found[0].line == 5
+
+
+def test_thread_hygiene_fires(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        import threading
+        from .utils.pipeline import spawn_thread
+
+        def f(target):
+            t = threading.Thread(target=target)
+            s = spawn_thread(target, daemon=True)
+            return t, s
+        """})
+    assert codes(run_pass(ctx, "thread-hygiene")) == ["H001", "H002"]
+
+
+def test_thread_hygiene_second_daemon_in_whitelisted_file(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/telemetry/flightrec.py": """
+        from ..utils.pipeline import spawn_thread
+
+        def a(x):
+            return spawn_thread(x, daemon=True)
+
+        def b(x):
+            return spawn_thread(x, daemon=True)
+        """})
+    assert codes(run_pass(ctx, "thread-hygiene")) == ["H003"]
+
+
+def test_metric_names_fires_on_unknown_and_miskinded(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        def f(registry):
+            registry.counter("totally_bogus_metric_total").inc(1)
+            registry.gauge("soup_generations_total").set(1)
+        """})
+    found = [f for f in run_pass(ctx, "metric-names")
+             if f.code in ("M001", "M002")]
+    assert codes(found) == ["M001", "M002"]
+
+
+# ---------------------------------------------------------------------------
+# waivers / baseline machinery
+# ---------------------------------------------------------------------------
+
+
+def test_waiver_suppresses_and_stale_waiver_reported(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        def f():
+            print("oops")
+        """})
+    waivers = tmp_path / "waivers.txt"
+    waivers.write_text(
+        "stray-prints srnn_tpu/mod.py P001 demo print, removed next PR\n"
+        "thread-hygiene srnn_tpu/gone.py H001 covered a deleted file\n")
+    result = run_analysis(ctx, select(["stray-prints", "thread-hygiene"]),
+                          waiver_file=str(waivers))
+    assert not result.errors
+    assert len(result.waived) == 1
+    stale = [f for f in result.findings if f.code == "W002"]
+    assert len(stale) == 1 and stale[0].severity == WARNING
+    # a single-pass run must NOT judge the other pass's waiver stale
+    solo = run_analysis(ctx, select(["stray-prints"]),
+                        waiver_file=str(waivers))
+    assert not [f for f in solo.findings if f.code == "W002"]
+
+
+def test_waiver_match_substring_narrows(tmp_path):
+    """A match="..." waiver covers only findings whose message contains
+    the substring — a second distinct finding of the same code in the
+    same file still surfaces (the baseline cannot grow a blanket hole)."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        import sys
+
+        def f():
+            print("one")
+
+        def g(x):
+            print("two", x)
+        """})
+    waivers = tmp_path / "waivers.txt"
+    waivers.write_text('stray-prints srnn_tpu/mod.py P001 '
+                       'match="no such text" demo narrow waiver\n')
+    result = run_analysis(ctx, select(["stray-prints"]),
+                          waiver_file=str(waivers))
+    # the substring matches neither finding: both surface, waiver stale
+    assert len([f for f in result.errors if f.code == "P001"]) == 2
+    assert [f.code for f in result.findings if f.pass_id == "waivers"] \
+        == ["W002"]
+
+
+def test_reasonless_waiver_is_a_finding(tmp_path):
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": "X = 1\n"})
+    waivers = tmp_path / "waivers.txt"
+    waivers.write_text("stray-prints srnn_tpu/mod.py P001\n")
+    loaded, problems = load_waivers(str(waivers))
+    assert not loaded
+    assert len(problems) == 1 and problems[0].code == "W001"
+    result = run_analysis(ctx, select(["stray-prints"]),
+                          waiver_file=str(waivers))
+    assert result.exit_code == 1
+
+
+def test_unparseable_file_is_a_finding_not_a_blind_spot(tmp_path):
+    """A file the compiler rejects must surface as core/E001 — the old
+    walkers crashed loudly on it; silently analyzing an empty AST would
+    disable every gate for that file."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/broken.py": """
+        def f(:
+            print("never parsed")
+        """})
+    assert ctx.parse_errors and ctx.parse_errors[0].code == "E001"
+    result = run_analysis(ctx, select(["stray-prints"]),
+                          waiver_file=str(tmp_path / "none.txt"))
+    assert result.exit_code == 1
+    assert [f.code for f in result.errors] == ["E001"]
+
+
+def test_cli_internal_error_exits_three(tmp_path, capsys, monkeypatch):
+    """An analyzer crash must exit 3, never the findings code 1 — the
+    bench preflight records 3 as inconclusive instead of blocking."""
+    from srnn_tpu.analysis import __main__ as cli
+
+    def boom(*a, **k):
+        raise RuntimeError("analyzer bug")
+
+    monkeypatch.setattr(cli, "run_analysis", boom)
+    assert cli.main([]) == 3
+    capsys.readouterr()
+
+
+def test_analyzer_is_fast(repo_ctx):
+    """The acceptance bound: the full analyzer (context already built)
+    must stay far under the 30s CI budget — warn well before the cliff."""
+    import time
+
+    t0 = time.monotonic()
+    run_analysis(repo_ctx, select(None))
+    assert time.monotonic() - t0 < 15.0

@@ -1,86 +1,22 @@
-"""Metric-name gate: every metric registered anywhere under ``srnn_tpu/``
-must be declared in the canonical table (``telemetry.names``) with the
-right kind and follow the naming convention — the collection-time
-tripwire for the next ``zweo``-style drift.
+"""Thin wrapper: the metric-name gate (AST registration scan against the
+canonical ``telemetry.names`` table + convention check) now lives in the
+srnnlint framework (``srnn_tpu/analysis/passes/metric_names.py``).  The
+runtime halves — the ``EVENT_COUNTERS`` table and the ``ACTION_NAMES``
+spelling that motivated the gate — stay here, since they only exist as
+imported objects."""
 
-Two halves:
-
-  * **AST** — walk the package for ``.counter("…")`` / ``.gauge("…")`` /
-    ``.histogram("…")`` calls with a literal name, including the
-    ``g = registry.gauge; g("…")`` aliasing idiom the hot paths use.
-  * **Registry** — the names that only exist as table entries
-    (``soup_metrics.EVENT_COUNTERS``) are checked by importing the table.
-"""
-
-import ast
 import os
 
-from srnn_tpu.telemetry.names import CANONICAL_METRICS, check_name
+from srnn_tpu.analysis import AnalysisContext, run_analysis, select
+from srnn_tpu.telemetry.names import CANONICAL_METRICS
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "srnn_tpu")
-
-_KINDS = ("counter", "gauge", "histogram")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _registrations(tree):
-    """(kind, name, lineno) for every literal metric registration in one
-    module, resolving single-letter aliases like ``g = registry.gauge``."""
-    aliases = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Attribute) \
-                and node.value.attr in _KINDS:
-            aliases[node.targets[0].id] = node.value.attr
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        arg0 = node.args[0]
-        if not (isinstance(arg0, ast.Constant) and isinstance(arg0.value, str)):
-            continue
-        f = node.func
-        kind = None
-        if isinstance(f, ast.Attribute) and f.attr in _KINDS:
-            kind = f.attr
-        elif isinstance(f, ast.Name) and f.id in aliases:
-            kind = aliases[f.id]
-        if kind is not None:
-            yield kind, arg0.value, node.lineno
-
-
-def _package_registrations():
-    for root, _dirs, files in os.walk(PKG):
-        if "__pycache__" in root:
-            continue
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, PKG).replace(os.sep, "/")
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=rel)
-            for kind, name, lineno in _registrations(tree):
-                yield rel, lineno, kind, name
-
-
-def test_every_registered_name_is_canonical():
-    problems = []
-    seen = set()
-    for rel, lineno, kind, name in _package_registrations():
-        seen.add(name)
-        declared = CANONICAL_METRICS.get(name)
-        if declared is None:
-            problems.append(
-                f"{rel}:{lineno}: metric {name!r} not in "
-                "telemetry.names.CANONICAL_METRICS — declare it (and check "
-                "the spelling: this gate exists because of 'zweo_dead')")
-        elif declared != kind:
-            problems.append(
-                f"{rel}:{lineno}: metric {name!r} registered as {kind}, "
-                f"declared as {declared}")
-    assert seen, "AST scan found no registrations — the gate is broken"
-    assert not problems, "\n".join(problems)
+def test_metric_names_gate():
+    ctx = AnalysisContext.from_root(REPO_ROOT)
+    result = run_analysis(ctx, select(["metric-names"]))
+    assert not result.errors, "\n".join(f.render() for f in result.errors)
 
 
 def test_event_counter_table_is_canonical():
@@ -91,14 +27,6 @@ def test_event_counter_table_is_canonical():
             f"EVENT_COUNTERS[{action!r}] -> {name!r} missing from the " \
             "canonical table"
         assert "zweo" not in action and "zweo" not in name
-
-
-def test_canonical_names_follow_convention():
-    problems = []
-    for name, kind in CANONICAL_METRICS.items():
-        assert kind in _KINDS, f"{name}: unknown kind {kind!r}"
-        problems.extend(check_name(name, kind))
-    assert not problems, "\n".join(problems)
 
 
 def test_action_names_spelling():

@@ -1,57 +1,16 @@
-"""Static observability gate: runtime output must route through
-``Experiment.log`` / the telemetry sinks, never bare ``print()``.
+"""Thin wrapper: the stray-print gate now lives in the srnnlint
+framework (``srnn_tpu/analysis/passes/prints.py``).  This file keeps the
+historical CI entry point; the walker itself is shared with the CLI
+(``python -m srnn_tpu.analysis stray-prints``)."""
 
-Walks the ``srnn_tpu/`` package AST and fails on any ``print(...)`` call
-that (a) lives outside the sanctioned modules — the reference
-``PrintingObject`` shim, ``experiment.py`` (whose ``log``/``__enter__``
-ARE the human stdout channel), and the CLI entry points — and (b) does
-not explicitly route via a ``file=`` keyword (diagnostics deliberately
-sent to stderr, e.g. backend-init retries, stay legal everywhere).
-"""
-
-import ast
 import os
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "srnn_tpu")
+from srnn_tpu.analysis import AnalysisContext, run_analysis, select
 
-#: modules whose stdout prints ARE their contract (relative to srnn_tpu/)
-ALLOWED_FILES = {
-    "utils/printing.py",     # the reference PrintingObject parity shim
-    "experiment.py",         # Experiment.log is the human stdout channel
-    "precompile.py",         # CLI: prints its one JSON result line
-    "viz.py",                # CLI: run-dir walker output
-    "telemetry/report.py",   # CLI: renders the telemetry summary
-}
-#: CLI entry-point trees (every setup is a __main__-dispatched script)
-ALLOWED_DIRS = ("setups/",)
-
-
-def _stray_prints(path: str, rel: str):
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=rel)
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            continue
-        if any(kw.arg == "file" for kw in node.keywords):
-            continue  # explicitly routed (stderr diagnostics)
-        yield f"{rel}:{node.lineno}"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_no_stray_prints():
-    offenders = []
-    for root, _dirs, files in os.walk(PKG):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, PKG).replace(os.sep, "/")
-            if rel in ALLOWED_FILES or rel.startswith(ALLOWED_DIRS):
-                continue
-            offenders.extend(_stray_prints(path, rel))
-    assert not offenders, (
-        "bare print() outside the sanctioned output channels — route "
-        "through Experiment.log / telemetry sinks, or print(..., "
-        f"file=sys.stderr) for diagnostics: {offenders}")
+    ctx = AnalysisContext.from_root(REPO_ROOT)
+    result = run_analysis(ctx, select(["stray-prints"]))
+    assert not result.errors, "\n".join(f.render() for f in result.errors)

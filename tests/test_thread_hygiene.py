@@ -1,92 +1,19 @@
-"""Static thread-hygiene gate: every thread started under ``srnn_tpu/``
-must go through ``utils.pipeline.spawn_thread`` — the package's thread
-factory — so it is (a) registered with the join-on-exit registry that the
-shutdown tests audit (``pipeline.live_threads()``) and (b) non-daemon
-unless explicitly opted out, so interpreter exit can never strand
-buffered I/O (a daemon writer dying mid-fsync is a silent data-loss
-path).
+"""Thin wrapper: the thread-hygiene gate (direct ``Thread()`` ban, daemon
+whitelist + max-one-per-file rule) now lives in the srnnlint framework
+(``srnn_tpu/analysis/passes/threads.py``).  The factory's RUNTIME half of
+the invariant stays here — static analysis cannot watch a thread join."""
 
-Walks the package AST and fails on any direct ``threading.Thread(...)``
-/ ``Thread(...)`` construction outside ``utils/pipeline.py`` itself (the
-factory's own call site), and on any ``spawn_thread(..., daemon=True)``
-whose literal True sneaks a daemon in without the factory's audit trail —
-daemon-ness must be a reviewed, named decision at the factory.
-"""
-
-import ast
 import os
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "srnn_tpu")
+from srnn_tpu.analysis import AnalysisContext, run_analysis, select
 
-#: the factory's own home — the one sanctioned Thread() call site
-FACTORY_FILE = "utils/pipeline.py"
-
-#: reviewed daemon-thread call sites (file -> justification), ONE per
-#: file — a second daemon call in a whitelisted file still fails the
-#: gate, so the BackgroundWriter (buffered I/O, same file as the
-#: ChunkDriver) can never silently go daemon.  Both sites are
-#: deliberately NOT joinable: they exist to escape/observe a thread that
-#: is presumed wedged below Python, own no buffered I/O, and a non-daemon
-#: spelling would hang interpreter exit on the very wedge they watch for.
-DAEMON_WHITELIST = {
-    "utils/pipeline.py":
-        "ChunkDriver stall deadline: the watched finisher thread IS the "
-        "presumed-wedged thread",
-    "telemetry/flightrec.py":
-        "StallSentinel dead-man's switch: fires while the main thread "
-        "hangs in a dead backend call",
-}
-
-
-def _is_thread_ctor(node: ast.Call) -> bool:
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr == "Thread":
-        return True  # threading.Thread(...), x.Thread(...)
-    return isinstance(f, ast.Name) and f.id == "Thread"
-
-
-def _offenders(path: str, rel: str):
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=rel)
-    daemon_sites = 0
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _is_thread_ctor(node) and rel != FACTORY_FILE:
-            yield (f"{rel}:{node.lineno}: direct Thread() — use "
-                   "utils.pipeline.spawn_thread (join-on-exit registry)")
-        if (isinstance(node.func, (ast.Name, ast.Attribute))
-                and (getattr(node.func, "id", None) == "spawn_thread"
-                     or getattr(node.func, "attr", None) == "spawn_thread")):
-            for kw in node.keywords:
-                if (kw.arg == "daemon"
-                        and isinstance(kw.value, ast.Constant)
-                        and kw.value.value is True):
-                    daemon_sites += 1
-                    if rel not in DAEMON_WHITELIST:
-                        yield (f"{rel}:{node.lineno}: "
-                               "spawn_thread(daemon=True) — daemon threads "
-                               "can strand buffered I/O at interpreter "
-                               "exit; justify and whitelist here if truly "
-                               "needed")
-                    elif daemon_sites > 1:
-                        yield (f"{rel}:{node.lineno}: second "
-                               "spawn_thread(daemon=True) in a whitelisted "
-                               "file — the whitelist covers ONE reviewed "
-                               "site per file; review this one separately")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_no_unregistered_threads():
-    offenders = []
-    for root, _dirs, files in os.walk(PKG):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, PKG).replace(os.sep, "/")
-            offenders.extend(_offenders(path, rel))
-    assert not offenders, "\n".join(offenders)
+    ctx = AnalysisContext.from_root(REPO_ROOT)
+    result = run_analysis(ctx, select(["thread-hygiene"]))
+    assert not result.errors, "\n".join(f.render() for f in result.errors)
 
 
 def test_factory_registers_and_joins():
