@@ -80,6 +80,12 @@ FULL_ATTEMPTS = 2
 # (without it, 3x420 + 2x650 > 1400 and a persistently wedged tunnel starves
 # the rescue — reproducing the r3 value=0 scorecard)
 RESCUE_RESERVE_S = 330.0
+# the multi-tenant experiment-service load leg (srnn_tpu.serve): runs
+# FIRST (host-CPU pinned — a wedged tunnel cannot eat it) and reports
+# requests/sec at measured p50/p95 plus the 8-concurrent-sweeps vs
+# 8-solo-processes comparison.  0 disables (the bench e2e tests pin tiny
+# deadlines and must not inherit a multi-minute extra stage).
+SERVE_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_SERVE_TIMEOUT_S", "420"))
 
 _SENTINEL = "@@BENCH_RESULT "
 #: child-side heartbeat lines: milestone rows on the piped stdout, so a
@@ -257,6 +263,178 @@ def _precompile(topo, shapes):
     return rows
 
 
+def _serve_leg() -> dict:
+    """The experiment-service load benchmark (one in-process service +
+    Unix-socket clients, host CPU):
+
+      * ``sweeps``: N concurrent fixpoint-density sweeps through the
+        service (stacked into one tenant-axis dispatch) vs N SEQUENTIAL
+        solo processes of the same sweep — aggregate wall-clock speedup,
+        compile count during serving, and a per-tenant bitwise parity
+        check against the solo processes' saved artifacts.
+      * ``load``: closed-loop requests/sec at measured p50/p95 latency
+        (C client threads submitting tiny sweeps for a fixed window).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from srnn_tpu.serve import ExperimentService
+    from srnn_tpu.serve.client import ServiceClient
+    from srnn_tpu.serve.server import ServiceServer
+    from srnn_tpu.telemetry.metrics import quantile_from_times
+    from srnn_tpu.utils.pipeline import spawn_thread
+
+    sweeps = int(os.environ.get("SRNN_BENCH_SERVE_SWEEPS", "8"))
+    trials = int(os.environ.get("SRNN_BENCH_SERVE_TRIALS", "2048"))
+    batch = int(os.environ.get("SRNN_BENCH_SERVE_BATCH", "512"))
+    load_s = float(os.environ.get("SRNN_BENCH_SERVE_LOAD_S", "8"))
+    load_clients = int(os.environ.get("SRNN_BENCH_SERVE_CLIENTS", "4"))
+    load_trials = 64
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    root = tempfile.mkdtemp(prefix="srnn_serve_bench_")
+    out = {"sweeps": sweeps, "trials": trials, "batch": batch}
+    svc = server_thread = None
+    try:
+        svc = ExperimentService(os.path.join(root, "svc"),
+                                max_stack=sweeps)
+        _hb("serve", "warmup")
+        svc.warm("fixpoint_density", {"trials": trials, "batch": batch})
+        svc.warm("fixpoint_density",
+                 {"trials": load_trials, "batch": load_trials},
+                 widths=(load_clients, 1))
+        sock = os.path.join(root, "serve.sock")
+        server = ServiceServer(svc, sock, batch_window_s=0.25)
+        server_thread = spawn_thread(server.serve_until_shutdown,
+                                     name="bench-serve-server")
+        client = ServiceClient(sock)
+        client.wait_until_up(30)
+
+        # -- solo baseline: N sequential fresh processes (each pays its
+        # own interpreter + jax import + dispatch; they share the
+        # persistent compile cache, so this is the steady-state floor,
+        # not a cold-compile strawman)
+        _hb("serve", "solo_processes")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SRNN_SETUPS_PLATFORM"] = "cpu"
+        env.pop("PYTHONPATH", None)   # never dial the axon tunnel
+        solo_root = os.path.join(root, "solo")
+        t0 = time.monotonic()
+        for i in range(sweeps):
+            subprocess.run(
+                [sys.executable, "-m", "srnn_tpu.setups",
+                 "fixpoint_density", "--trials", str(trials), "--batch",
+                 str(batch), "--seed", str(i), "--root", solo_root],
+                cwd=repo, env=env, check=True, timeout=240,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            _hb("serve", "solo_done", i=i + 1)
+        solo_wall = time.monotonic() - t0
+
+        # -- N concurrent sweeps through the warm service
+        _hb("serve", "service_sweeps")
+        programs_before = client.stats()["distinct_programs"]
+        results = [None] * sweeps
+
+        def one(i):
+            results[i] = client.request(
+                "fixpoint_density",
+                {"seed": i, "trials": trials, "batch": batch},
+                tenant=f"sweep{i}", timeout_s=300)
+
+        t0 = time.monotonic()
+        threads = [spawn_thread(one, name=f"bench-serve-c{i}", args=(i,))
+                   for i in range(sweeps)]
+        for t in threads:
+            t.join()
+        service_wall = time.monotonic() - t0
+        stats = client.stats()
+
+        # per-tenant bitwise parity vs the solo processes' artifacts
+        # (one seed -> run-dir map; not a per-sweep re-walk)
+        by_seed = {}
+        for d in os.listdir(solo_root):
+            if d.startswith("exp-"):
+                with open(os.path.join(solo_root, d, "meta.json")) as f:
+                    by_seed[json.load(f).get("seed")] = d
+        parity = True
+        for i in range(sweeps):
+            match = by_seed.get(i)
+            if match is None:
+                parity = False
+                continue
+            with np.load(os.path.join(solo_root, match,
+                                      "all_counters.npz")) as z:
+                solo_counters = z[z.files[0]]
+            if not np.array_equal(np.asarray(results[i]["counters"],
+                                             np.int64),
+                                  np.asarray(solo_counters, np.int64)):
+                parity = False
+        out["sweeps_solo_wall_s"] = round(solo_wall, 2)
+        out["sweeps_service_wall_s"] = round(service_wall, 2)
+        out["sweeps_speedup_x"] = round(solo_wall
+                                        / max(service_wall, 1e-9), 2)
+        out["sweeps_compiles_during_serving"] = (
+            stats["distinct_programs"] - programs_before)
+        out["sweeps_bitwise_equal_to_solo"] = parity
+        out["dispatch_modes"] = {
+            k.split("mode=")[-1].strip('"}'): v
+            for k, v in stats["metrics"].items()
+            if k.startswith("srnn_serve_dispatches_total")}
+
+        # -- closed-loop load: C clients hammering tiny sweeps
+        _hb("serve", "load", seconds=load_s, clients=load_clients)
+        stop_at = time.monotonic() + load_s
+        lat_lists = [[] for _ in range(load_clients)]
+
+        def loader(lats, seed):
+            while time.monotonic() < stop_at:
+                t1 = time.monotonic()
+                client.request("fixpoint_density",
+                               {"seed": seed, "trials": load_trials,
+                                "batch": load_trials},
+                               tenant=f"load{seed}", timeout_s=60)
+                lats.append(time.monotonic() - t1)
+
+        t0 = time.monotonic()
+        threads = [spawn_thread(loader, name=f"bench-serve-load{i}",
+                                args=(lat_lists[i], i))
+                   for i in range(load_clients)]
+        for t in threads:
+            t.join()
+        load_wall = time.monotonic() - t0
+        lats = [x for lst in lat_lists for x in lst]
+        out["load"] = {
+            "clients": load_clients,
+            "window_s": round(load_wall, 2),
+            "requests": len(lats),
+            "requests_per_sec": round(len(lats) / max(load_wall, 1e-9), 2),
+            "p50_ms": round(1e3 * quantile_from_times(lats, 0.5), 1),
+            "p95_ms": round(1e3 * quantile_from_times(lats, 0.95), 1),
+        }
+    finally:
+        # teardown runs on EVERY path: an exception above must not leave
+        # the non-daemon server/writer threads alive (the child would
+        # burn the whole stage timeout instead of failing fast) or rmtree
+        # the root out from under a live server
+        if server_thread is not None:
+            try:
+                ServiceClient(sock).shutdown()
+            except Exception:
+                pass
+            server_thread.join(timeout=30)
+        if svc is not None:
+            try:
+                svc.close()
+            except Exception:
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _child_stage(stage: str) -> None:
     """Run one stage and print its result on a sentinel stdout line."""
     # the dead-man's switch arms BEFORE the simulated/real wedge windows
@@ -288,6 +466,15 @@ def _child_stage(stage: str) -> None:
     # turns the machinery on for this child
     ensure_compilation_cache()
 
+    if stage == "serve":
+        # the experiment-service load leg (host CPU by construction: the
+        # parent pins SRNN_BENCH_PLATFORM=cpu so a wedged tunnel cannot
+        # eat the only leg that always lands)
+        out = {"serve": _serve_leg(), "device_count": jax.device_count(),
+               "backend": platform + ("-forced" if forced_cpu else "")}
+        print(_SENTINEL + json.dumps(out), flush=True)
+        sys.stdout.flush()
+        os._exit(0)
     topo = Topology("weightwise", width=2, depth=2)  # science-default f32
     on_cpu = platform == "cpu"  # fallback OR a genuinely CPU-default host
     if stage == "precompile":
@@ -634,6 +821,25 @@ def _orchestrate(result):
         cpu_env.pop("SRNN_BENCH_TEST_HANG", None)
         return run_stage("full", 1, 300.0, stage_env=cpu_env,
                          tag="cpu-rescue")
+
+    # experiment-service load leg FIRST: CPU-pinned (immune to the
+    # tunnel), bounded, and the round's BENCH row for the serve subsystem
+    # — running it up front guarantees it lands even when every
+    # accelerator attempt later eats its full timeout.  Reserves the
+    # rescue slice so a slow serve leg cannot starve the one
+    # accelerator-value guarantee.
+    if SERVE_TIMEOUT_S > 0:
+        serve_env = dict(env)
+        serve_env["SRNN_BENCH_PLATFORM"] = "cpu"
+        serve_env.pop("SRNN_BENCH_TEST_HANG", None)  # CPU leg never dials
+        srv = run_stage("serve", 1,
+                        min(SERVE_TIMEOUT_S,
+                            max(60.0, remaining() - RESCUE_RESERVE_S
+                                - 420)),
+                        stage_env=serve_env, reserve=RESCUE_RESERVE_S,
+                        tag="serve")
+        if srv is not None and "serve" in srv:
+            result["serve"] = srv["serve"]
 
     # compile-only warm-up: one bounded child fills the shared persistent
     # cache (ramp + full shapes), so the measurement children below

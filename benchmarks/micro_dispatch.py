@@ -1,9 +1,11 @@
 """Micro-benchmark: compile-time, dispatch-overhead, and peak-memory rows
 for the soup hot path, before/after the AOT + donation subsystem.
 
-One JSON line of rows (plus ``telemetry``/``health``: the in-scan metrics
-and health-sentinel carries' dispatch overhead, interleaved
-median-of-medians — see their docstrings):
+One JSON line of rows (plus ``telemetry``/``health``/``lineage``/
+``fused``: the in-scan carries' dispatch overhead, and ``stacked``: the
+serve tenant-axis amortization — K=8 stacked dispatch vs 8 solo
+dispatches — all on the shared interleaved median-of-medians protocol;
+see their docstrings):
 
   * ``compile``: wall time of the soup hot path's BACKEND COMPILE (the
     generation step + the 100-generation chunk run, full dynamics) in a
@@ -218,8 +220,10 @@ def _interleaved_medians(fns, calls=20, passes=3):
 
 def _overhead_row(row, fns, base, feature, calls=20, passes=3, extra=None):
     """One overhead row: every variant in ``fns`` measured interleaved
-    (ALWAYS including 'plain' — the unmetered chunk — as the in-row
-    session baseline); ``overhead_pct`` compares ``feature`` vs ``base``."""
+    (the carry-overhead rows ALWAYS include 'plain' — the unmetered chunk
+    — as the in-row session baseline; the ``stacked`` row's in-row
+    baseline is its solo8 variant); ``overhead_pct`` compares ``feature``
+    vs ``base``."""
     res = _interleaved_medians(fns, calls, passes)
     out = {"row": row, "n": TELEMETRY_N, "generations": TELEMETRY_GENS,
            "calls": calls, "passes": passes}
@@ -324,6 +328,62 @@ def row_fused() -> dict:
         extra={"mosaic_kernel": native_mosaic_backend()})
 
 
+STACKED_K = 8
+#: tiny-population shape, deliberately: the service's clientele is the
+#: paper's experiment suite (soups of 10-20), where per-dispatch overhead
+#: is a first-order cost — at mega shapes the stacked win trends to 1x
+#: (compute dominates) and the interesting amortization (process startup
+#: + compile) is bench.py's serve leg, not this row
+STACKED_N = 64
+STACKED_GENS = 20
+
+
+def row_stacked() -> dict:
+    """K=8 tenant-stacked dispatch (``serve.tenant.evolve_stacked``) vs 8
+    sequential solo dispatches of the same 8 soups — the experiment
+    service's amortization win, measured on the shared interleaved-medians
+    protocol.  Row-major config (the tenant axis's bitwise envelope);
+    ``per_tenant`` numbers are the amortized cost of one tenant's chunk
+    under each regime."""
+    import jax
+    import jax.numpy as jnp
+
+    from srnn_tpu.serve.tenant import evolve_stacked, stack_tenants
+    from srnn_tpu.soup import SoupConfig, evolve, seed
+    from srnn_tpu.topology import Topology
+
+    cfg = SoupConfig(
+        topo=Topology("weightwise", width=2, depth=2), size=STACKED_N,
+        attacking_rate=0.1, remove_divergent=True, remove_zero=True)
+    states = [seed(cfg, jax.random.key(t)) for t in range(STACKED_K)]
+    stacked = stack_tenants(states)
+
+    def solo8():
+        acc = 0.0
+        for st in states:
+            s = evolve(cfg, st, generations=STACKED_GENS)
+            acc += float(s.next_uid)
+        return acc
+
+    def stacked8():
+        s = evolve_stacked(cfg, stacked, generations=STACKED_GENS)
+        return float(jnp.sum(s.next_uid))
+
+    out = _overhead_row("stacked", {"solo8": solo8, "stacked": stacked8},
+                        base="solo8", feature="stacked",
+                        extra={"k": STACKED_K})
+    out["n"] = STACKED_N
+    out["generations"] = STACKED_GENS
+    out["solo_per_tenant_ms"] = round(out["solo8_ms_per_chunk"]
+                                      / STACKED_K, 3)
+    out["stacked_per_tenant_ms"] = round(out["stacked_ms_per_chunk"]
+                                         / STACKED_K, 3)
+    out["amortization_x"] = round(out["solo8_ms_per_chunk"]
+                                  / max(out["stacked_ms_per_chunk"], 1e-9),
+                                  2)
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--stage", default=None, help=argparse.SUPPRESS)
@@ -338,11 +398,12 @@ def main(argv=None) -> int:
         return 0
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
-            row_telemetry(), row_health(), row_lineage(), row_fused()]
+            row_telemetry(), row_health(), row_lineage(), row_fused(),
+            row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h, l, fu = rows
+        c, d, m, t, h, l, fu, sk = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -372,6 +433,12 @@ def main(argv=None) -> int:
               f"{fu['plain_ms_per_chunk']:.1f}ms per chunk "
               f"({fu['overhead_pct']:+.1f}%, "
               f"mosaic_kernel={fu['mosaic_kernel']})", file=sys.stderr)
+        print(f"# stacked(K={sk['k']}, N={sk['n']}, G={sk['generations']}): "
+              f"one stacked dispatch {sk['stacked_ms_per_chunk']:.1f}ms vs "
+              f"8 solo dispatches {sk['solo8_ms_per_chunk']:.1f}ms "
+              f"({sk['amortization_x']}x; per tenant "
+              f"{sk['stacked_per_tenant_ms']:.2f}ms vs "
+              f"{sk['solo_per_tenant_ms']:.2f}ms)", file=sys.stderr)
     return 0
 
 

@@ -75,6 +75,66 @@ fi
 rm -rf "$smoke_root"
 summary+=$(printf '%-34s %-4s %4ss' "chaos_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Fast experiment-service smoke (srnn_tpu/serve/): a real service process
+# on a Unix socket, two fixpoint-density smokes submitted concurrently
+# (same shapes -> ONE stacked dispatch) plus one odd-shaped run (solo
+# fallback).  All three clients must complete and metrics.prom must show
+# exactly one stacked + one solo dispatch — the scheduler's grouping and
+# fallback drilled on every suite run.
+t0=$SECONDS
+serve_root=$(mktemp -d)
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve --root "$serve_root/svc" \
+    --batch-window-s 2 > "$serve_root/serve.log" 2>&1 &
+serve_pid=$!
+serve_ok=1
+up=0
+for _ in $(seq 1 150); do
+    if SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+            --socket "$serve_root/svc/serve.sock" --ping 2>/dev/null; then
+        up=1; break
+    fi
+    sleep 0.2
+done
+if [ "$up" -eq 1 ]; then
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups fixpoint_density \
+        --smoke --seed 0 --root "$serve_root/exp" \
+        --service "$serve_root/svc/serve.sock" \
+        >> "$serve_root/serve.log" 2>&1 &
+    c1=$!
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups fixpoint_density \
+        --smoke --seed 1 --root "$serve_root/exp" \
+        --service "$serve_root/svc/serve.sock" \
+        >> "$serve_root/serve.log" 2>&1 &
+    c2=$!
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups fixpoint_density \
+        --trials 48 --batch 24 --seed 2 --root "$serve_root/exp" \
+        --service "$serve_root/svc/serve.sock" \
+        >> "$serve_root/serve.log" 2>&1 &
+    c3=$!
+    wait $c1 || serve_ok=0
+    wait $c2 || serve_ok=0
+    wait $c3 || serve_ok=0
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+        --socket "$serve_root/svc/serve.sock" --shutdown \
+        >> "$serve_root/serve.log" 2>&1 || serve_ok=0
+    wait $serve_pid || serve_ok=0
+    grep -q 'srnn_serve_dispatches_total{kind="fixpoint_density",mode="stacked"} 1' \
+        "$serve_root/svc/metrics.prom" || serve_ok=0
+    grep -q 'srnn_serve_dispatches_total{kind="fixpoint_density",mode="solo"} 1' \
+        "$serve_root/svc/metrics.prom" || serve_ok=0
+else
+    serve_ok=0
+    kill "$serve_pid" 2>/dev/null
+fi
+if [ "$serve_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("service_smoke")
+    tail -n 40 "$serve_root/serve.log"
+fi
+rm -rf "$serve_root"
+summary+=$(printf '%-34s %-4s %4ss' "service_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 echo
 echo "=== run_tests.sh summary ==="
 printf '%s' "$summary"

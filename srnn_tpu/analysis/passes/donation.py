@@ -57,6 +57,10 @@ DONATED_POSITIONS: Dict[str, Tuple[int, ...]] = {
     "run_fixpoint_donated": (1,),
     "run_mixed_fixpoint_donated": (1,),
     "run_training_donated": (1,),
+    "run_fixpoint_stacked_donated": (1,),
+    "evolve_stacked_donated": (1,),
+    "evolve_stacked_step_donated": (1,),
+    "evolve_multi_stacked_donated": (1,),
 }
 
 #: names whose call reads a tree for the async pre-donation copy

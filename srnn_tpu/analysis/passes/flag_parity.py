@@ -75,6 +75,19 @@ SURFACES = {
         ("sharded_evolve_multi", "sharded_evolve_multi_donated"),
         "_sharded_multi_entries",
         frozenset()),
+    # the serve tenant-axis surfaces (PR 10) hold the SAME flag contract:
+    # a carry flag that skips them silently desynchronizes the stacked
+    # spelling from the solo one it must stay bitwise-equal to
+    "serve.evolve_stacked": (
+        "srnn_tpu/serve/tenant.py", "_evolve_stacked",
+        ("evolve_stacked", "evolve_stacked_donated"), "_stacked_entries",
+        # record rides the stacked surface exactly like soup.evolve's
+        frozenset({"record"})),
+    "serve.evolve_multi_stacked": (
+        "srnn_tpu/serve/tenant.py", "_evolve_multi_stacked",
+        ("evolve_multi_stacked", "evolve_multi_stacked_donated"),
+        "_stacked_multi_entries",
+        frozenset()),
 }
 
 #: dispatch callee name -> surface id (what the setups call)
@@ -87,7 +100,10 @@ for _sid, (_, _, _wrappers, _, _) in SURFACES.items():
 TRACED_FLAGS = frozenset({"lineage_state"})
 
 AOT_REL = "srnn_tpu/utils/aot.py"
-SETUPS_PREFIX = "srnn_tpu/setups/"
+#: modules whose dispatches the warmup-coverage check walks: the setups
+#: (production entry points) and the experiment service (its executors
+#: dispatch the same surfaces plus the stacked twins)
+DISPATCH_PREFIXES = ("srnn_tpu/setups/", "srnn_tpu/serve/")
 
 
 def _find_def(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:
@@ -402,7 +418,7 @@ def _warmup_coverage(ctx: AnalysisContext):
     if not warmed:
         return
     setups = [m for m in ctx.package_modules()
-              if m.rel.startswith(SETUPS_PREFIX)]
+              if m.rel.startswith(DISPATCH_PREFIXES)]
     for mod in setups:
         scopes = [mod.tree.body] + [
             n.body for n in ast.walk(mod.tree)

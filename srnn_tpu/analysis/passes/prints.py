@@ -25,6 +25,7 @@ ALLOWED_FILES = {
     "viz.py",                # CLI: run-dir walker output
     "telemetry/report.py",   # CLI: renders the telemetry summary
     "analysis/__main__.py",  # CLI: this analyzer's own report output
+    "serve/__main__.py",     # CLI: service startup line + stats JSON
 }
 #: CLI entry-point trees (every setup is a __main__-dispatched script)
 ALLOWED_DIRS = ("setups/",)

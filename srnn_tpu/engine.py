@@ -251,8 +251,57 @@ def run_known_fixpoint_variation(
     return VariationResult(t_some, t_fix)
 
 
-@functools.partial(jax.jit, static_argnames=("topo",))
-def fixpoint_density(topo: Topology, pop: jnp.ndarray, epsilon: float = DEFAULT_EPSILON) -> jnp.ndarray:
+def _fixpoint_density(topo: Topology, pop: jnp.ndarray,
+                      epsilon: float = DEFAULT_EPSILON) -> jnp.ndarray:
     """Immediate classification of freshly-initialized nets, no dynamics
     (``fixpoint-density.py``). Returns the (5,) class histogram."""
     return count_classes(classify_batch(topo, pop, epsilon))
+
+
+fixpoint_density = jax.jit(_fixpoint_density, static_argnames=("topo",))
+
+
+# ---------------------------------------------------------------------------
+# tenant-stacked twins (srnn_tpu.serve): K independent experiment configs
+# dispatched as ONE (K, N, ...) program.  epsilon is a traced (K,) vector —
+# tenants may differ in it without selecting a new program — and every
+# tenant's row is BITWISE-equal to its solo dispatch (the per-row lane
+# programs are unchanged under the leading vmap axis; tests assert it).
+# ---------------------------------------------------------------------------
+
+
+def _fixpoint_density_stacked(topo: Topology, pops: jnp.ndarray,
+                              epsilons: jnp.ndarray) -> jnp.ndarray:
+    """(K, N, P) populations + (K,) epsilons -> (K, 5) class histograms,
+    one vmapped dispatch for K tenants' ``fixpoint_density`` sweeps."""
+    return jax.vmap(lambda p, e: _fixpoint_density(topo, p, e))(
+        pops, epsilons)
+
+
+fixpoint_density_stacked = jax.jit(_fixpoint_density_stacked,
+                                   static_argnames=("topo",))
+
+
+def _run_fixpoint_stacked(topo: Topology, pops: jnp.ndarray,
+                          step_limit: int = 100,
+                          epsilons: jnp.ndarray = None,
+                          record: bool = False):
+    """Tenant-stacked ``run_fixpoint``: (K, N, P) populations, per-tenant
+    traced epsilons (a (K,) vector — REQUIRED; the stacked spelling has
+    no scalar fallback), one dispatch; each tenant's
+    ``FixpointRunResult`` rides a leading K axis."""
+    if epsilons is None:
+        raise TypeError(
+            "run_fixpoint_stacked needs epsilons= (a (K,) per-tenant "
+            "vector; vmap over None would fail deep inside jit)")
+    return jax.vmap(
+        lambda p, e: _run_fixpoint(topo, p, step_limit, e, record))(
+            pops, epsilons)
+
+
+_STACKED_FIX_STATICS = ("topo", "step_limit", "record")
+run_fixpoint_stacked = jax.jit(_run_fixpoint_stacked,
+                               static_argnames=_STACKED_FIX_STATICS)
+run_fixpoint_stacked_donated = jax.jit(_run_fixpoint_stacked,
+                                       static_argnames=_STACKED_FIX_STATICS,
+                                       donate_argnums=(1,))

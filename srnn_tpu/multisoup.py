@@ -216,6 +216,26 @@ def fused_supported_multi(config: MultiSoupConfig) -> bool:
     return True
 
 
+def check_tenant_stackable_multi(config: MultiSoupConfig) -> None:
+    """Validate that ``config`` may ride the serve tenant axis (see
+    ``soup.check_tenant_stackable`` — same contract, heterogeneous twin):
+    parallel row-major only, bitwise-equal per tenant to the solo run."""
+    if config.layout != "rowmajor":
+        raise ValueError(
+            "tenant stacking requires layout='rowmajor': the popmajor "
+            "lane layout's reductions reassociate under the tenant vmap "
+            "axis, breaking the bitwise-equal-to-solo contract")
+
+
+def tenant_stackable_multi(config: MultiSoupConfig) -> bool:
+    """Would this mixed config's evolve ride the serve tenant axis?"""
+    try:
+        check_tenant_stackable_multi(config)
+    except ValueError:
+        return False
+    return True
+
+
 def resolved_generation_impl(config: MultiSoupConfig,
                              topo: Topology) -> str:
     """The generation impl this type will ACTUALLY run: 'fused' only

@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sharded", action="store_true",
                    help="also warm the sharded steps over all visible "
                         "devices")
+    p.add_argument("--stacked", type=int, default=0, metavar="K",
+                   help="also warm the serve tenant-axis spellings at "
+                        "stack width K (srnn_tpu.serve; skipped for "
+                        "configs that cannot stack — popmajor/sequential)")
     p.add_argument("--no-donate", action="store_true",
                    help="warm the value-preserving spellings instead of "
                         "the buffer-donating production ones")
@@ -154,7 +158,8 @@ def run(args) -> dict:
     for donate in donate_modes:
         rows += aot.warmup(cfg, multi=multi, mesh=mesh,
                            generations=args.generations, donate=donate,
-                           engine=args.engine, verbose=not args.json)
+                           engine=args.engine, stacked=args.stacked,
+                           verbose=not args.json)
     return {
         "backend": jax.default_backend(),
         "device_count": jax.device_count(),

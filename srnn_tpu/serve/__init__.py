@@ -1,0 +1,47 @@
+"""Multi-tenant batched experiment service ("soup of soups").
+
+The paper's experiment suite is dozens of tiny-population runs that each
+paid their own process startup, compile, and per-batch dispatch.  This
+package serves them instead (ROADMAP item 4):
+
+  * ``serve.tenant`` — the TENANT AXIS: K independent experiment configs
+    (same statics, different seeds) stacked into one ``(K, N, ...)``
+    vmapped dispatch, every tenant bitwise-equal to its solo run.
+  * ``serve.scheduler`` — group requests by static spelling; stacked
+    dispatch for matching groups, solo fallback for odd configs.
+  * ``serve.service`` — the long-lived core: warmed AOT executables held
+    across requests, ``srnn_serve_*`` queue/latency/throughput metrics,
+    tenant-labeled telemetry and lineage rows on the BackgroundWriter.
+  * ``serve.server`` / ``serve.client`` — Unix-socket JSON-lines
+    transport; ``python -m srnn_tpu.serve`` runs the server, the setups'
+    ``--service`` flag makes them clients.
+"""
+
+from .client import ServiceClient, ServiceError
+from .scheduler import DEFAULT_MAX_STACK, Request, plan_dispatches
+from .service import ExperimentService
+from .tenant import (evolve_multi_stacked, evolve_multi_stacked_donated,
+                     evolve_stacked, evolve_stacked_captured,
+                     evolve_stacked_donated, evolve_stacked_step,
+                     evolve_stacked_step_donated, init_population_stacked,
+                     seed_stacked, stack_tenants, unstack_tenants)
+
+__all__ = [
+    "DEFAULT_MAX_STACK",
+    "ExperimentService",
+    "Request",
+    "ServiceClient",
+    "ServiceError",
+    "evolve_multi_stacked",
+    "evolve_multi_stacked_donated",
+    "evolve_stacked",
+    "evolve_stacked_captured",
+    "evolve_stacked_donated",
+    "evolve_stacked_step",
+    "evolve_stacked_step_donated",
+    "init_population_stacked",
+    "plan_dispatches",
+    "seed_stacked",
+    "stack_tenants",
+    "unstack_tenants",
+]

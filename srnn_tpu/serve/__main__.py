@@ -1,0 +1,94 @@
+"""``python -m srnn_tpu.serve`` — run (or talk to) the experiment service.
+
+Server mode (default): bind the Unix socket, warm any requested
+spellings, and serve until a ``shutdown`` op or SIGTERM.  Client mode
+(``--shutdown`` / ``--stats`` / ``--ping``) talks to a RUNNING service on
+the same socket — the smoke scripts use it for clean teardown.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--root", default="serve_root",
+                   help="service directory (events.jsonl, lineage.jsonl, "
+                        "metrics.prom; default socket lives here too)")
+    p.add_argument("--socket", default=None,
+                   help="Unix socket path (default <root>/serve.sock)")
+    p.add_argument("--max-stack", type=int, default=8, metavar="K",
+                   help="most tenants per stacked dispatch")
+    p.add_argument("--batch-window-s", type=float, default=0.25, metavar="S",
+                   help="requests arriving within S seconds of each other "
+                        "are scheduled together (the stacking window)")
+    p.add_argument("--warm-fixpoint-density", default=None,
+                   metavar="TRIALS,BATCH",
+                   help="pre-dispatch the fixpoint-density executor at "
+                        "these shapes (stacked at --max-stack AND solo) "
+                        "before accepting traffic")
+    p.add_argument("--ping", action="store_true",
+                   help="client mode: exit 0 iff a service answers")
+    p.add_argument("--stats", action="store_true",
+                   help="client mode: print a running service's stats JSON")
+    p.add_argument("--shutdown", action="store_true",
+                   help="client mode: ask a running service to exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    sock = args.socket or os.path.join(args.root, "serve.sock")
+
+    if args.ping or args.stats or args.shutdown:
+        from .client import ServiceClient, ServiceError
+
+        client = ServiceClient(sock)
+        try:
+            if args.ping:
+                return 0 if client.ping() else 1
+            if args.stats:
+                print(json.dumps(client.stats(), indent=1, default=str))
+                return 0
+            client.shutdown()
+            return 0
+        except (OSError, ServiceError) as e:
+            print(f"serve client: {e}", file=sys.stderr)
+            return 1
+
+    if os.environ.get("SRNN_SETUPS_PLATFORM") == "cpu":
+        # config-level CPU pin for subprocess callers (tests, CI) — the
+        # same escape hatch as setups/__main__
+        from ..utils.backend import force_cpu
+
+        force_cpu()
+    from ..utils.aot import ensure_compilation_cache
+    from .server import ServiceServer
+    from .service import ExperimentService
+
+    ensure_compilation_cache()
+    os.makedirs(args.root, exist_ok=True)
+    service = ExperimentService(args.root, max_stack=args.max_stack)
+    if args.warm_fixpoint_density:
+        trials, batch = (int(x) for x in
+                         args.warm_fixpoint_density.split(","))
+        service.warm("fixpoint_density", {"trials": trials, "batch": batch})
+    server = ServiceServer(service, sock,
+                           batch_window_s=args.batch_window_s)
+    prev = signal.signal(signal.SIGTERM, lambda *_: server.stop())
+    print(f"serve: listening on {sock} (root={args.root}, "
+          f"max_stack={args.max_stack}, "
+          f"batch_window_s={args.batch_window_s})", flush=True)
+    try:
+        server.serve_until_shutdown()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        service.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Client for the experiment service (``serve.server`` transport).
+
+One connection per op — the ops are tiny JSON lines and the service is
+local (Unix socket), so connection reuse buys nothing and per-op sockets
+keep the client trivially thread-safe (the bench's load generators run
+many client threads).
+"""
+
+import json
+import socket
+import time
+from typing import Optional
+
+
+class ServiceError(RuntimeError):
+    """The service answered ``ok: false`` (bad request, failed dispatch)."""
+
+
+class ServiceClient:
+    def __init__(self, socket_path: str, timeout_s: float = 600.0):
+        self.socket_path = socket_path
+        self.timeout_s = timeout_s
+
+    def _op(self, msg: dict, timeout_s: Optional[float] = None) -> dict:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(timeout_s or self.timeout_s)
+            s.connect(self.socket_path)
+            s.sendall((json.dumps(msg) + "\n").encode())
+            line = s.makefile("rb").readline()
+        if not line:
+            raise ServiceError("service closed the connection mid-op")
+        resp = json.loads(line.decode("utf-8", "replace"))
+        if not resp.get("ok"):
+            raise ServiceError(resp.get("error")
+                               or f"request failed: {resp}")
+        return resp
+
+    def ping(self, timeout_s: float = 5.0) -> bool:
+        try:
+            self._op({"op": "ping"}, timeout_s=timeout_s)
+            return True
+        except (OSError, ServiceError):
+            return False
+
+    def wait_until_up(self, timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.ping(timeout_s=2.0):
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"no experiment service answering on {self.socket_path} "
+            f"after {timeout_s}s")
+
+    def submit(self, kind: str, params: dict,
+               tenant: Optional[str] = None) -> str:
+        return self._op({"op": "submit", "kind": kind, "params": params,
+                         "tenant": tenant})["ticket"]
+
+    def wait(self, ticket: str, timeout_s: Optional[float] = None) -> dict:
+        t = timeout_s if timeout_s is not None else self.timeout_s
+        # socket deadline sits OUTSIDE the service-side wait timeout so the
+        # service's own TimeoutError (a clean ok:false) arrives first
+        return self._op({"op": "wait", "ticket": ticket, "timeout_s": t},
+                        timeout_s=t + 10.0)["result"]
+
+    def request(self, kind: str, params: dict,
+                tenant: Optional[str] = None,
+                timeout_s: Optional[float] = None) -> dict:
+        """Submit + wait in one op (the setups' submit mode)."""
+        t = timeout_s if timeout_s is not None else self.timeout_s
+        return self._op({"op": "request", "kind": kind, "params": params,
+                         "tenant": tenant, "timeout_s": t},
+                        timeout_s=t + 10.0)["result"]
+
+    def stats(self) -> dict:
+        return self._op({"op": "stats"}, timeout_s=10.0)["stats"]
+
+    def shutdown(self) -> None:
+        self._op({"op": "shutdown"}, timeout_s=10.0)

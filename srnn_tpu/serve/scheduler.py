@@ -1,0 +1,82 @@
+"""Batching scheduler: group compatible requests into stacked dispatches.
+
+The grouping key is the request's STATIC spelling — everything that
+selects a compiled program (experiment kind, topology, the full
+``SoupConfig`` statics, generation count, dispatch shapes).  Requests
+whose keys match are interchangeable up to traced values (seeds,
+epsilons), so K of them stack into one ``(K, ...)`` tenant-axis dispatch
+(``serve.tenant``); an odd-one-out key falls back to SOLO dispatch — the
+exact per-tenant program, so fallback never changes results, only
+amortization.
+
+Pure host logic, no jax: the service owns execution; this module only
+decides who rides together.
+"""
+
+from typing import Dict, Hashable, List, NamedTuple, Sequence
+
+#: default cap on tenants per stacked dispatch (K): past this the stacked
+#: program's own compile becomes a new spelling per K — the service warms
+#: a fixed K and chunks bigger groups into full stacks + a remainder
+DEFAULT_MAX_STACK = 8
+
+
+class Request(NamedTuple):
+    """One queued experiment request."""
+    ticket: str           # unique id, assigned by the service
+    kind: str             # executor name ('fixpoint_density', 'soup', ...)
+    params: dict          # kind-specific payload (seeds, shapes, knobs)
+    tenant: str           # tenant label for telemetry/lineage rows
+    submitted_s: float    # monotonic submit stamp (latency accounting)
+
+
+class Dispatch(NamedTuple):
+    """One planned dispatch: ``requests`` ride together iff ``stacked``."""
+    kind: str
+    key: Hashable
+    requests: List[Request]
+
+    @property
+    def stacked(self) -> bool:
+        return len(self.requests) > 1
+
+
+def plan_dispatches(requests: Sequence[Request], group_keys: Dict[str, "callable"],
+                    max_stack: int = DEFAULT_MAX_STACK) -> List[Dispatch]:
+    """Group ``requests`` into stacked/solo dispatches.
+
+    ``group_keys`` maps kind -> key function over params; a kind without
+    one (or a key function returning ``None``) never stacks.  Groups keep
+    submission order, chunk at ``max_stack``, and a chunk of one is a
+    solo dispatch by construction.  The returned plan preserves
+    first-submission order across groups (fairness: an early solo request
+    is not starved behind later stackable traffic).
+    """
+    groups: Dict = {}
+    order: List = []
+    for i, req in enumerate(requests):
+        keyfn = group_keys.get(req.kind)
+        try:
+            key = keyfn(req.params) if keyfn is not None else None
+        except Exception:
+            # malformed params must not take down the scheduling round:
+            # route the request solo so its executor raises inside the
+            # per-dispatch error wall and fails ONLY this request
+            key = None
+        if key is None:
+            gid = ("solo", i)      # unstackable: its own group
+            full_key = None
+        else:
+            gid = (req.kind, key)
+            full_key = key
+        if gid not in groups:
+            groups[gid] = (full_key, [])
+            order.append(gid)
+        groups[gid][1].append(req)
+    plan: List[Dispatch] = []
+    for gid in order:
+        key, members = groups[gid]
+        for lo in range(0, len(members), max(1, max_stack)):
+            plan.append(Dispatch(kind=members[0].kind, key=key,
+                                 requests=members[lo:lo + max_stack]))
+    return plan

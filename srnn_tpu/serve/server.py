@@ -1,0 +1,178 @@
+"""Unix-socket JSON-lines transport around :class:`ExperimentService`.
+
+One request per line, one response per line:
+
+  {"op": "ping"}                               -> {"ok": true}
+  {"op": "submit", "kind": K, "params": {...},
+   "tenant": "name"}                           -> {"ok": true, "ticket": id}
+  {"op": "wait", "ticket": id, "timeout_s": S} -> {"ok": true, "status":
+                                                   "done", "result": {...}}
+  {"op": "request", ...submit fields...}       -> submit + wait in one line
+  {"op": "stats"}                              -> {"ok": true, "stats": ...}
+  {"op": "shutdown"}                           -> {"ok": true} and the
+                                                  server stops
+
+Connection handling rides ``socketserver.ThreadingMixIn`` (per-connection
+threads, joined on ``server_close``); the DISPATCH loop is one dedicated
+thread (``pipeline.spawn_thread``) draining the service queue with a
+batching window, so jax dispatch stays single-threaded no matter how many
+clients connect.  The batching window is the stacking knob: requests
+arriving within ``batch_window_s`` of each other are scheduled together
+and stack when their static spellings match.
+"""
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Optional
+
+from ..utils.pipeline import spawn_thread
+from .service import ExperimentService
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        server: "ServiceServer" = self.server  # type: ignore[assignment]
+        for raw in self.rfile:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line:
+                continue
+            try:
+                resp = server.handle_op(json.loads(line))
+            except Exception as e:
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+            if resp.get("bye"):
+                break
+
+
+class ServiceServer(socketserver.ThreadingMixIn,
+                    socketserver.UnixStreamServer):
+    """The long-lived server: socket accept loop + one dispatch thread."""
+
+    daemon_threads = False   # joined on server_close: no stranded handlers
+    allow_reuse_address = True
+
+    def __init__(self, service: ExperimentService, socket_path: str,
+                 batch_window_s: float = 0.25):
+        if os.path.exists(socket_path):
+            # only a STALE socket (killed server) may be reclaimed — a
+            # live server answering ping must not have its socket stolen
+            # out from under its clients by a second instance
+            if wait_for_socket(socket_path, timeout_s=0.0):
+                raise RuntimeError(
+                    f"a live experiment service already answers on "
+                    f"{socket_path}; refusing to steal its socket")
+            os.unlink(socket_path)
+        super().__init__(socket_path, _Handler)
+        self.service = service
+        self.socket_path = socket_path
+        self.batch_window_s = batch_window_s
+        self._stop = threading.Event()
+        self._dispatcher = None
+
+    # -- ops -------------------------------------------------------------
+
+    def handle_op(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True}
+        if op == "submit":
+            if self._stop.is_set():
+                return {"ok": False, "error": "service shutting down"}
+            ticket = self.service.submit(msg["kind"], msg.get("params", {}),
+                                         tenant=msg.get("tenant"))
+            return {"ok": True, "ticket": ticket}
+        if op in ("wait", "request"):
+            if op == "request":
+                if self._stop.is_set():
+                    return {"ok": False, "error": "service shutting down"}
+                ticket = self.service.submit(msg["kind"],
+                                             msg.get("params", {}),
+                                             tenant=msg.get("tenant"))
+            else:
+                ticket = msg["ticket"]
+            entry = self.service.wait(ticket,
+                                      timeout_s=float(msg.get("timeout_s",
+                                                              600.0)))
+            out = {"ok": entry["status"] == "done", "ticket": ticket}
+            out.update(entry)
+            return out
+        if op == "stats":
+            return {"ok": True, "stats": self.service.stats()}
+        if op == "shutdown":
+            self._stop.set()
+            # unblock serve_forever from a handler thread without joining
+            # ourselves: shutdown() must run off the serve_forever thread
+            spawn_thread(self.shutdown, name="serve-shutdown")
+            return {"ok": True, "bye": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        """Single-threaded jax dispatch: wait for traffic, give the
+        batching window a chance to aggregate, drain."""
+        while not self._stop.is_set():
+            if self.service.queue_depth() == 0:
+                time.sleep(min(0.05, self.batch_window_s or 0.05))
+                continue
+            if self.batch_window_s > 0:
+                time.sleep(self.batch_window_s)
+            self.service.run_pending()
+        # drain whatever raced the stop (handle_op rejects new traffic
+        # once _stop is set, so this converges)
+        while self.service.queue_depth() > 0:
+            self.service.run_pending()
+
+    def serve_until_shutdown(self) -> None:
+        """Run the accept loop on THIS thread and the dispatch loop on a
+        spawned one; returns after a ``shutdown`` op (or ``stop()``)."""
+        self._dispatcher = spawn_thread(self._dispatch_loop,
+                                        name="serve-dispatch")
+        try:
+            self.serve_forever(poll_interval=0.1)
+        finally:
+            self._stop.set()
+            self._dispatcher.join()
+            # a submit that slipped between the stop-check and the
+            # dispatcher's final drain must not leave its handler thread
+            # blocked in wait() — server_close() JOINS handler threads,
+            # so a stranded waiter would hang shutdown for its timeout
+            self.service.fail_pending("service shut down before dispatch")
+            self.server_close()
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        """Signal-safe stop (the SIGTERM path): ``shutdown()`` blocks
+        until ``serve_forever`` exits, and a signal handler runs ON the
+        thread inside ``serve_forever`` — calling it synchronously there
+        deadlocks, so it moves to a helper thread like the shutdown op."""
+        self._stop.set()
+        spawn_thread(self.shutdown, name="serve-stop")
+
+
+def wait_for_socket(path: str, timeout_s: float = 30.0) -> bool:
+    """Readiness probe: can we connect and ping?  Always probes at least
+    once, so ``timeout_s=0`` is a one-shot liveness check."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.settimeout(2.0)
+                s.connect(path)
+                s.sendall(b'{"op": "ping"}\n')
+                if b'"ok": true' in s.makefile("rb").readline():
+                    return True
+        except OSError:
+            pass
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.1)

@@ -1,0 +1,518 @@
+"""The multi-tenant experiment service core.
+
+A long-lived :class:`ExperimentService` owns the warmed AOT executables,
+a request queue, and the batching scheduler: submitted experiment
+requests are grouped by static spelling (``serve.scheduler``), compatible
+groups dispatch STACKED on the tenant axis (``serve.tenant``), odd
+configs fall back to solo dispatch — per-tenant results are bitwise-equal
+either way, so batching is purely an amortization decision.
+
+Telemetry: queue-depth / latency / throughput ride the PR 2 registry as
+``srnn_serve_*`` metrics (``telemetry/names.py``), every dispatch and
+every per-tenant completion appends a labeled row to the service's
+``events.jsonl`` through the existing ``BackgroundWriter``, and soup
+requests with ``lineage: true`` stream per-tenant replication-dynamics
+window rows (tenant-labeled) into ``lineage.jsonl`` — one I/O thread, the
+same submission-order guarantees as the mega loops.
+
+Transport lives elsewhere (``serve.server`` wraps this in a Unix-socket
+JSON-lines server; in-process callers — tests, the bench load leg — drive
+it directly).
+"""
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry.metrics import MetricsRegistry
+from .scheduler import (DEFAULT_MAX_STACK, Dispatch, Request,
+                        plan_dispatches)
+
+#: request latency / dispatch wall buckets: 1ms .. 2 min
+_LATENCY_BUCKETS = (1e-3, 5e-3, 2e-2, 0.1, 0.5, 2.0, 8.0, 30.0, 120.0)
+
+
+def _soup_config_from_params(params: dict):
+    """Build the STATIC ``SoupConfig`` a soup request selects (the group
+    key: tenants stack iff this — plus the generation count and lineage
+    flag — matches exactly)."""
+    from ..soup import SoupConfig
+    from ..topology import Topology
+
+    topo_kw = {"width": int(params.get("width", 2)),
+               "depth": int(params.get("depth", 2))}
+    if params.get("aggregates") is not None:
+        # only when stated: Topology has its own default, and overriding
+        # it with None would select a different static config (and jit
+        # cache entry) than the solo process builds
+        topo_kw["aggregates"] = int(params["aggregates"])
+    topo = Topology(params.get("variant", "weightwise"), **topo_kw)
+    base = SoupConfig(topo=topo, size=int(params["size"]))
+    # unstated knobs take SoupConfig's OWN defaults (DEFAULT_LR etc.):
+    # a drifted default here would silently run tenants with different
+    # dynamics than the solo process they must stay bitwise-equal to
+    return base._replace(
+        attacking_rate=float(params.get("attacking_rate",
+                                        base.attacking_rate)),
+        learn_from_rate=float(params.get("learn_from_rate",
+                                         base.learn_from_rate)),
+        train=int(params.get("train", base.train)),
+        learn_from_severity=int(params.get("learn_from_severity",
+                                           base.learn_from_severity)),
+        remove_divergent=bool(params.get("remove_divergent",
+                                         base.remove_divergent)),
+        remove_zero=bool(params.get("remove_zero", base.remove_zero)),
+        epsilon=float(params.get("epsilon", base.epsilon)),
+        lr=float(params.get("lr", base.lr)),
+        train_mode=params.get("train_mode", base.train_mode),
+        mode=params.get("mode", base.mode),
+        layout=params.get("layout", base.layout),
+        respawn_draws=params.get("respawn_draws", base.respawn_draws))
+
+
+def _fixpoint_density_key(params: dict):
+    """Tenants stack iff the dispatch SHAPES match; seed and epsilon are
+    traced per tenant."""
+    return (int(params["trials"]), int(params["batch"]))
+
+
+def _soup_key(params: dict):
+    """Full static spelling: config + generations (+ lineage, which picks
+    a different program).  Non-stackable configs return None -> solo."""
+    from ..soup import tenant_stackable
+
+    cfg = _soup_config_from_params(params)
+    if not tenant_stackable(cfg):
+        return None
+    return (cfg, int(params.get("generations", 10)),
+            bool(params.get("lineage", False)))
+
+
+GROUP_KEYS = {
+    "fixpoint_density": _fixpoint_density_key,
+    "soup": _soup_key,
+}
+
+
+#: completed results kept for ``poll`` readers; ``wait`` CONSUMES its
+#: entry, so this bound only matters for fire-and-forget submitters —
+#: past it the oldest un-waited results evict (a long-lived service must
+#: not grow without bound; soup results can embed whole final states)
+RESULT_RETENTION = 4096
+
+
+class ExperimentService:
+    """Queue + scheduler + executors + telemetry; one instance per
+    service process.  Thread-safe: any thread may ``submit``/``wait``;
+    execution happens on whichever thread calls ``run_pending`` (the
+    socket server runs one dispatch thread)."""
+
+    def __init__(self, root: str, max_stack: int = DEFAULT_MAX_STACK,
+                 registry: Optional[MetricsRegistry] = None,
+                 writer=None):
+        from ..utils.pipeline import BackgroundWriter
+
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        self.max_stack = max_stack
+        self.registry = registry or MetricsRegistry()
+        self._own_writer = writer is None
+        self.writer = writer or BackgroundWriter(name="serve-io")
+        self._events = open(os.path.join(root, "events.jsonl"), "a")
+        self._lineage = None  # opened lazily on the first lineage row
+        self._lock = threading.Lock()
+        self._done = threading.Condition(self._lock)
+        self._pending: List[Request] = []
+        self._results: Dict[str, dict] = {}
+        self._completed = 0   # monotone; _results is consume-on-wait
+        self._draining = False   # set by fail_pending: no more submits
+        self._warming = False    # warm() dispatches skip telemetry rows
+        self._tickets = itertools.count(1)
+        self._programs = set()   # distinct (kind, key, shape) signatures
+        self._closed = False
+        self._t0 = time.monotonic()
+
+    # -- submission / results -------------------------------------------
+
+    def submit(self, kind: str, params: dict,
+               tenant: Optional[str] = None) -> str:
+        """Queue one request; returns its ticket id."""
+        if kind not in GROUP_KEYS:
+            raise ValueError(f"unknown request kind {kind!r}; "
+                             f"expected one of {sorted(GROUP_KEYS)}")
+        with self._lock:
+            if self._draining:
+                # closes the shutdown race for good: fail_pending flips
+                # this under the SAME lock, so a submit that slipped past
+                # the transport's stop check cannot strand its waiter
+                raise RuntimeError("service shutting down")
+            ticket = f"t{next(self._tickets):06d}"
+            req = Request(ticket=ticket, kind=kind, params=dict(params),
+                          tenant=tenant or ticket,
+                          submitted_s=time.monotonic())
+            self._pending.append(req)
+            depth = len(self._pending)
+        self.registry.counter("serve_requests_total",
+                              help="experiment requests accepted").inc(
+                                  1, kind=kind)
+        self.registry.gauge("serve_queue_depth",
+                            help="requests queued, not yet dispatched").set(
+                                depth)
+        return ticket
+
+    def poll(self, ticket: str) -> Optional[dict]:
+        """Completed entry for ``ticket`` ({'status', 'result'|'error'}),
+        or None while pending."""
+        with self._lock:
+            return self._results.get(ticket)
+
+    def wait(self, ticket: str, timeout_s: float = 600.0) -> dict:
+        """Block until ``ticket`` completes (or fail after ``timeout_s``).
+        CONSUMES the entry — each result is delivered to exactly one
+        waiter, and the results table stays bounded under load."""
+        deadline = time.monotonic() + timeout_s
+        with self._done:
+            while ticket not in self._results:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"request {ticket} still pending "
+                                       f"after {timeout_s}s")
+                self._done.wait(timeout=left)
+            return self._results.pop(ticket)
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- execution -------------------------------------------------------
+
+    def run_pending(self) -> int:
+        """Drain the queue through the scheduler: plan stacked/solo
+        dispatches, execute them, publish results.  Returns the number of
+        requests completed."""
+        with self._lock:
+            batch, self._pending = self._pending, []
+        if not batch:
+            return 0
+        self.registry.gauge("serve_queue_depth",
+                            help="requests queued, not yet dispatched").set(
+                                self.queue_depth())
+        plan = plan_dispatches(batch, GROUP_KEYS, self.max_stack)
+        for dispatch in plan:
+            self._run_dispatch(dispatch)
+        self.write_metrics()
+        return len(batch)
+
+    def _run_dispatch(self, dispatch: Dispatch) -> None:
+        mode = "stacked" if dispatch.stacked else "solo"
+        t0 = time.monotonic()
+        try:
+            if dispatch.kind == "fixpoint_density":
+                results = self._exec_fixpoint_density(dispatch)
+            elif dispatch.kind == "soup":
+                results = self._exec_soup(dispatch)
+            else:  # pragma: no cover - submit() already validates
+                raise ValueError(f"unknown kind {dispatch.kind!r}")
+            error = None
+        except Exception as e:  # a bad request must not kill the service
+            results, error = None, f"{type(e).__name__}: {e}"
+        wall = time.monotonic() - t0
+        self.registry.counter(
+            "serve_dispatches_total",
+            help="scheduler dispatch groups executed").inc(
+                1, kind=dispatch.kind, mode=mode)
+        self.registry.counter(
+            "serve_dispatch_tenants_total",
+            help="tenant slots executed across all dispatches").inc(
+                len(dispatch.requests), mode=mode)
+        self.registry.histogram(
+            "serve_dispatch_seconds", help="dispatch group wall seconds",
+            unit="seconds", buckets=_LATENCY_BUCKETS).observe(
+                wall, kind=dispatch.kind, mode=mode)
+        self._event_row(kind="serve_dispatch", request_kind=dispatch.kind,
+                        mode=mode, tenants=[r.tenant for r in
+                                            dispatch.requests],
+                        wall_s=round(wall, 4),
+                        error=error)
+        now = time.monotonic()
+        with self._done:
+            for i, req in enumerate(dispatch.requests):
+                if error is None:
+                    entry = {"status": "done", "result": results[i],
+                             "mode": mode}
+                else:
+                    entry = {"status": "failed", "error": error,
+                             "mode": mode}
+                self._results[req.ticket] = entry
+                self._completed += 1
+                self.registry.histogram(
+                    "serve_request_seconds",
+                    help="submit-to-completion latency", unit="seconds",
+                    buckets=_LATENCY_BUCKETS).observe(
+                        now - req.submitted_s, kind=req.kind)
+                if error is not None:
+                    self.registry.counter(
+                        "serve_requests_failed_total",
+                        help="requests whose dispatch raised").inc(
+                            1, kind=req.kind)
+                self._event_row(kind="serve_tenant", ticket=req.ticket,
+                                tenant=req.tenant, request_kind=req.kind,
+                                mode=mode,
+                                latency_s=round(now - req.submitted_s, 4),
+                                error=error)
+            # bound the table for fire-and-forget submitters (waiters
+            # consume their own entries): evict oldest-first
+            while len(self._results) > RESULT_RETENTION:
+                self._results.pop(next(iter(self._results)))
+            self._done.notify_all()
+
+    # -- executors -------------------------------------------------------
+
+    def _note_program(self, kind: str, signature) -> None:
+        self._programs.add((kind,) + tuple(signature))
+
+    def _exec_fixpoint_density(self, dispatch: Dispatch) -> List[dict]:
+        """The fixpoint-density sweep (``setups/fixpoint_density.py``'s
+        compute) for 1..K tenants: same per-batch PRNG keying as the solo
+        script, stacked across tenants on the leading axis."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine import fixpoint_density, fixpoint_density_stacked
+        from ..init import init_population
+        from ..setups.common import STANDARD_VARIANTS
+        from .tenant import init_population_stacked
+
+        reqs = dispatch.requests
+        k = len(reqs)
+        trials = int(reqs[0].params["trials"])
+        batch = int(reqs[0].params["batch"])
+        keys = [jax.random.key(int(r.params.get("seed", 0))) for r in reqs]
+        eps = jnp.asarray([float(r.params.get("epsilon", 1e-4))
+                           for r in reqs], jnp.float32)
+        variants = STANDARD_VARIANTS[:2]  # WW + Agg, like the reference
+        per_variant = []
+        for i, (_name, topo) in enumerate(variants):
+            totals = jnp.zeros((k, 5), jnp.int32)
+            done = 0
+            while done < trials:
+                n = min(batch, trials - done)
+                bkeys = [jax.random.fold_in(jax.random.fold_in(kk, i), done)
+                         for kk in keys]
+                if k > 1:
+                    pops = init_population_stacked(topo, jnp.stack(bkeys), n)
+                    totals = totals + fixpoint_density_stacked(topo, pops,
+                                                               eps)
+                else:
+                    # the python-float epsilon keeps the solo fallback on
+                    # the EXACT program the setups dispatch (a weak-typed
+                    # scalar), so it shares their warm cache entries
+                    pop = init_population(topo, bkeys[0], n)
+                    totals = totals + fixpoint_density(
+                        topo, pop,
+                        float(reqs[0].params.get("epsilon", 1e-4)))[None]
+                self._note_program(dispatch.kind, (str(topo), k, n))
+                done += n
+            per_variant.append(np.asarray(totals))
+        names = [name for name, _ in variants]
+        return [{"variant_names": names,
+                 "counters": [v[t].tolist() for v in per_variant]}
+                for t in range(k)]
+
+    def _exec_soup(self, dispatch: Dispatch) -> List[dict]:
+        """A homogeneous soup run (seed -> evolve -> count) for 1..K
+        tenants; the stacked spelling dispatches ``serve.tenant``'s
+        vmapped twins and streams per-tenant telemetry/lineage rows."""
+        import jax
+
+        from ..soup import count, evolve, seed
+        from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY, seed_lineage
+        from .tenant import (evolve_stacked_donated, seed_stacked,
+                             stack_tenants, unstack_tenants)
+
+        reqs = dispatch.requests
+        k = len(reqs)
+        params0 = reqs[0].params
+        cfg = _soup_config_from_params(params0)
+        gens = int(params0.get("generations", 10))
+        lineage = bool(params0.get("lineage", False))
+        keys = [jax.random.key(int(r.params.get("seed", 0))) for r in reqs]
+        if k > 1:
+            import jax.numpy as jnp
+
+            states = seed_stacked(cfg, jnp.stack(keys))
+            kw = {"generations": gens, "metrics": True}
+            if lineage:
+                kw["lineage"] = True
+                kw["lineage_state"] = stack_tenants(
+                    [seed_lineage(cfg.size) for _ in range(k)])
+                kw["lineage_capacity"] = DEFAULT_EDGE_CAPACITY
+            out = evolve_stacked_donated(cfg, states, **kw)
+            finals = unstack_tenants(out[0], k)
+            metrics = unstack_tenants(out[1], k)
+            ltriples = (unstack_tenants(out[2], k) if lineage else
+                        [None] * k)
+        else:
+            kw = {"generations": gens, "metrics": True}
+            if lineage:
+                kw["lineage"] = True
+                kw["lineage_state"] = seed_lineage(cfg.size)
+                kw["lineage_capacity"] = DEFAULT_EDGE_CAPACITY
+            out = evolve(cfg, seed(cfg, keys[0]), **kw)
+            finals, metrics = [out[0]], [out[1]]
+            ltriples = [out[2]] if lineage else [None]
+        self._note_program(dispatch.kind,
+                           (repr(cfg), gens, lineage, k))
+        results = []
+        for t, req in enumerate(reqs):
+            counts = np.asarray(count(cfg, finals[t]))
+            m = metrics[t]
+            row = {"counters": counts.tolist(),
+                   "final_time": int(np.asarray(finals[t].time)),
+                   "next_uid": int(np.asarray(finals[t].next_uid)),
+                   "metrics": {
+                       "generations": int(np.asarray(m.generations)),
+                       "actions": np.asarray(m.actions).tolist(),
+                       "loss_sum": float(np.asarray(m.loss_sum))}}
+            if bool(req.params.get("return_state", True)) \
+                    and cfg.size * cfg.topo.num_weights <= 262144:
+                row["weights"] = np.asarray(finals[t].weights).tolist()
+                row["uids"] = np.asarray(finals[t].uids).tolist()
+            if lineage:
+                self._lineage_row(req, cfg, gens, ltriples[t])
+            results.append(row)
+        return results
+
+    def _lineage_row(self, req: Request, cfg, gens: int, ltriple) -> None:
+        """Per-tenant replication-dynamics window row, tenant-labeled,
+        appended to the service's lineage.jsonl through the writer."""
+        if self._warming:
+            return   # throwaway warm tenants must not pollute the stream
+        from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY, window_record
+
+        lin, win, stats = ltriple
+        row = window_record(0, gens, jax_device_get(win),
+                            jax_device_get(stats), DEFAULT_EDGE_CAPACITY,
+                            next_pid=int(np.asarray(lin.next_pid)))
+        row["tenant"] = req.tenant
+        row["ticket"] = req.ticket
+
+        def append():
+            if self._lineage is None:
+                self._lineage = open(os.path.join(self.root,
+                                                  "lineage.jsonl"), "a")
+            self._lineage.write(json.dumps(row) + "\n")
+            self._lineage.flush()
+
+        self.writer.submit(append)
+
+    def warm(self, kind: str, params: dict,
+             widths: Optional[Sequence[int]] = None) -> None:
+        """Pre-dispatch (compile or cache-deserialize) the executor for
+        ``(kind, params)`` at each stack width in ``widths`` (default: the
+        service's ``max_stack`` and solo) with throwaway seeds, so the
+        first real tenants of that spelling only execute.  Warm dispatches
+        do not touch the serve metrics; they DO count into
+        ``distinct_programs`` (the load bench snapshots around its serving
+        phase)."""
+        widths = sorted(set(widths or (self.max_stack, 1)))
+        self._warming = True   # no lineage/event rows for warm tenants
+        try:
+            for k in widths:
+                reqs = [Request(ticket=f"warm{i:03d}", kind=kind,
+                                params=dict(params), tenant=f"warm{i:03d}",
+                                submitted_s=time.monotonic())
+                        for i in range(k)]
+                d = Dispatch(kind=kind, key=("warm",), requests=reqs)
+                if kind == "fixpoint_density":
+                    self._exec_fixpoint_density(d)
+                elif kind == "soup":
+                    self._exec_soup(d)
+                else:
+                    raise ValueError(f"unknown request kind {kind!r}")
+        finally:
+            self._warming = False
+
+    # -- telemetry sinks -------------------------------------------------
+
+    def _event_row(self, **fields) -> None:
+        fields.setdefault("t", round(time.monotonic() - self._t0, 4))
+        fields = {k: v for k, v in fields.items() if v is not None}
+
+        def append():
+            self._events.write(json.dumps(fields) + "\n")
+            self._events.flush()
+
+        self.writer.submit(append)
+
+    def write_metrics(self) -> str:
+        """Atomically publish metrics.prom in the service root (riding the
+        writer so it lands after the rows it summarizes)."""
+        path = os.path.join(self.root, "metrics.prom")
+        self.writer.submit(self.registry.write_textfile, path)
+        return path
+
+    def stats(self) -> dict:
+        """Host-side snapshot for the ``stats`` op / load bench."""
+        with self._lock:
+            done = self._completed
+            depth = len(self._pending)
+            programs = len(self._programs)
+        return {"completed": done, "queue_depth": depth,
+                "distinct_programs": programs,
+                "uptime_s": round(time.monotonic() - self._t0, 2),
+                "metrics": self.registry.rows()}
+
+    def fail_pending(self, reason: str) -> int:
+        """Resolve every still-queued request as failed (shutdown path:
+        a submit that raced the dispatcher's final drain must not leave
+        its waiter blocked until timeout).  Returns how many."""
+        with self._done:
+            self._draining = True   # submit() refuses from here on
+            stranded, self._pending = self._pending, []
+            for req in stranded:
+                self._results[req.ticket] = {"status": "failed",
+                                             "error": reason,
+                                             "mode": "none"}
+                self.registry.counter(
+                    "serve_requests_failed_total",
+                    help="requests whose dispatch raised").inc(
+                        1, kind=req.kind)
+            self._done.notify_all()
+            return len(stranded)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.registry.write_textfile(os.path.join(self.root,
+                                                  "metrics.prom"))
+        if self._own_writer:
+            self.writer.close()
+        else:
+            # a SHARED writer stays open for its other producers, but any
+            # queued row jobs reference the files closed below — drain
+            # them first or they would latch a WriterError on everyone
+            self.writer.flush()
+        self._events.close()
+        if self._lineage is not None:
+            self._lineage.close()
+
+    def __enter__(self) -> "ExperimentService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def jax_device_get(tree):
+    """Small alias so executor rows pull device values exactly once."""
+    import jax
+
+    return jax.device_get(tree)

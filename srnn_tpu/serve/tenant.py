@@ -1,0 +1,242 @@
+"""The tenant axis: K independent experiment configs as ONE dispatch.
+
+The paper's experiment suite is dozens of tiny-population runs; under the
+multi-tenant service each request would still pay its own per-dispatch
+overhead one level up.  This module removes that: K tenants whose configs
+share the same STATIC spelling (same topology, same ``SoupConfig`` —
+tenants differ in seeds and traced values only) stack their states on a
+leading tenant axis and evolve as one ``(K, N, P)`` vmapped program.
+
+The load-bearing contract is **bitwise equality to solo**: every tenant's
+slice of a stacked dispatch carries exactly the bits its solo run would
+have produced — weights, uids, PRNG keys, event records, the
+metrics/health carries and the lineage pids/edges (tests recount all of
+them).  That holds on the parallel ROW-MAJOR path only (the per-row lane
+programs are unchanged under a leading vmap axis); the popmajor lane
+layout's reductions reassociate under vmap, so ``soup.tenant_stackable``
+gates stacking and the scheduler falls back to solo dispatch for
+everything else.
+
+Entries mirror the soup/multisoup surfaces flag-for-flag (the srnnlint
+``flag-parity`` pass holds them to the same contract as the four evolve
+surfaces) and ship ``_donated`` twins for the service hot loop.
+"""
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..init import init_population
+from ..multisoup import (MultiSoupConfig, _evolve_multi,
+                         check_tenant_stackable_multi)
+from ..soup import (SoupConfig, SoupState, _evolve, _evolve_step,
+                    check_tenant_stackable, seed)
+from ..topology import Topology
+
+
+# ---------------------------------------------------------------------------
+# stacking / unstacking pytrees of per-tenant states
+# ---------------------------------------------------------------------------
+
+
+def stack_tenants(items: Sequence):
+    """Stack K same-shaped pytrees (states, lineage carries, ...) on a new
+    leading tenant axis.  Typed PRNG-key leaves stack like any array."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *items)
+
+
+def unstack_tenants(tree, k: int) -> List:
+    """Split a stacked result back into K per-tenant pytrees (slices — no
+    copy; callers that outlive the stacked buffer should device_get)."""
+    return [jax.tree.map(lambda x: x[i], tree) for i in range(k)]
+
+
+def _seed_stacked(config: SoupConfig, keys: jax.Array) -> SoupState:
+    return jax.vmap(lambda k: seed(config, k))(keys)
+
+
+#: seed K tenant soups from a (K,) key vector — tenant i's state is
+#: bitwise ``seed(config, keys[i])``
+seed_stacked = jax.jit(_seed_stacked, static_argnames=("config",))
+
+
+def _init_population_stacked(topo: Topology, keys: jax.Array,
+                             n: int) -> jnp.ndarray:
+    return jax.vmap(lambda k: init_population(topo, k, n))(keys)
+
+
+#: (K,) keys -> (K, n, P) fresh populations, tenant i bitwise
+#: ``init_population(topo, keys[i], n)`` (the fixpoint-density executor's
+#: per-batch draw)
+init_population_stacked = jax.jit(_init_population_stacked,
+                                  static_argnames=("topo", "n"))
+
+
+# ---------------------------------------------------------------------------
+# the stacked evolve surfaces
+# ---------------------------------------------------------------------------
+
+
+def _evolve_stacked(
+    config: SoupConfig,
+    states: SoupState,
+    generations: int = 1,
+    record: bool = False,
+    metrics: bool = False,
+    health: bool = False,
+    lineage: bool = False,
+    lineage_state=None,
+    lineage_capacity: int = 4096,
+):
+    """Tenant-stacked ``soup.evolve``: ``states`` carries a leading K axis
+    on every leaf; returns ``soup._evolve``'s result pytree with the same
+    leading axis (final state, then recs/metrics/health/lineage per the
+    flags).  ``lineage_state`` is a stacked ``LineageState`` carry."""
+    check_tenant_stackable(config)
+    if lineage:
+        return jax.vmap(
+            lambda s, l: _evolve(config, s, generations=generations,
+                                 record=record, metrics=metrics,
+                                 health=health, lineage=True,
+                                 lineage_state=l,
+                                 lineage_capacity=lineage_capacity)
+        )(states, lineage_state)
+    return jax.vmap(
+        lambda s: _evolve(config, s, generations=generations, record=record,
+                          metrics=metrics, health=health))(states)
+
+
+#: jitted stacked run + the buffer-donating twin (the service's hot loop
+#: always rebinds, so generation N+1 rewrites the stacked population in
+#: place exactly like the solo mega loops).  static_argnames stay inline
+#: literals: the srnnlint flag-parity pass reads them off the AST.
+evolve_stacked = jax.jit(_evolve_stacked,
+                         static_argnames=("config", "generations", "record",
+                                          "metrics", "health", "lineage",
+                                          "lineage_capacity"))
+evolve_stacked_donated = jax.jit(_evolve_stacked,
+                                 static_argnames=("config", "generations",
+                                                  "record", "metrics",
+                                                  "health", "lineage",
+                                                  "lineage_capacity"),
+                                 donate_argnums=(1,))
+
+
+def _evolve_stacked_step(config: SoupConfig, states: SoupState):
+    """Tenant-stacked single generation (``soup.evolve_step``'s twin) —
+    the stacked capture loop's frame step, so a stacked ``.traj`` stream
+    is built from the same per-generation program as the solo one."""
+    check_tenant_stackable(config)
+    return jax.vmap(lambda s: _evolve_step(config, s))(states)
+
+
+evolve_stacked_step = jax.jit(_evolve_stacked_step,
+                              static_argnames=("config",))
+evolve_stacked_step_donated = jax.jit(_evolve_stacked_step,
+                                      static_argnames=("config",),
+                                      donate_argnums=(1,))
+
+
+def _evolve_multi_stacked(
+    config: MultiSoupConfig,
+    states,
+    generations: int = 1,
+    metrics: bool = False,
+    health: bool = False,
+    lineage: bool = False,
+    lineage_state=None,
+    lineage_capacity: int = 4096,
+):
+    """Tenant-stacked ``multisoup.evolve_multi`` (``lineage_state`` = the
+    per-type tuple of stacked ``LineageState`` carries)."""
+    check_tenant_stackable_multi(config)
+    if lineage:
+        return jax.vmap(
+            lambda s, l: _evolve_multi(config, s, generations=generations,
+                                       metrics=metrics, health=health,
+                                       lineage=True, lineage_state=l,
+                                       lineage_capacity=lineage_capacity)
+        )(states, lineage_state)
+    return jax.vmap(
+        lambda s: _evolve_multi(config, s, generations=generations,
+                                metrics=metrics, health=health))(states)
+
+
+evolve_multi_stacked = jax.jit(_evolve_multi_stacked,
+                               static_argnames=("config", "generations",
+                                                "metrics", "health",
+                                                "lineage",
+                                                "lineage_capacity"))
+evolve_multi_stacked_donated = jax.jit(_evolve_multi_stacked,
+                                       static_argnames=("config",
+                                                        "generations",
+                                                        "metrics", "health",
+                                                        "lineage",
+                                                        "lineage_capacity"),
+                                       donate_argnums=(1,))
+
+
+# ---------------------------------------------------------------------------
+# stacked trajectory capture
+# ---------------------------------------------------------------------------
+
+
+def evolve_stacked_captured(
+    config: SoupConfig,
+    states: SoupState,
+    generations: int,
+    stores: Sequence,
+    every: int = 1,
+    owned: bool = False,
+    writer: Optional[object] = None,
+) -> SoupState:
+    """Stacked twin of ``utils.capture.evolve_captured``: evolve K stacked
+    tenants in device-resident chunks of ``every`` generations and append
+    each tenant's captured frame to ITS OWN ``TrajStore`` in ``stores``.
+
+    The internal stream is all-donated (chunk run + frame step), mirroring
+    the solo capture loop dispatch-for-dispatch, so every tenant's
+    ``.traj`` stream is BITWISE-equal to its solo
+    ``evolve_captured(..., every=every)`` stream (tested).  With
+    ``writer`` (a ``pipeline.BackgroundWriter``) the frame pulls are
+    snapshot-resolved off-thread like the solo pipelined path; without
+    one the loop blocks per frame.
+    """
+    from ..utils.aot import own_pytree
+    from ..utils.pipeline import resolve, snapshot
+
+    if generations % every != 0:
+        raise ValueError(
+            f"generations={generations} not divisible by every={every}")
+    if not owned:
+        states = own_pytree(states)
+
+    def append_frames(frame):
+        t, w, uids, action, counterpart, loss = \
+            resolve(frame) if writer is not None else frame
+        for i, store in enumerate(stores):
+            store.append(int(t[i]), w[i], uids[i], action[i],
+                         counterpart[i], loss[i])
+
+    for _ in range(generations // every):
+        if every > 1:
+            states = evolve_stacked_donated(config, states,
+                                            generations=every - 1)
+        states, events = evolve_stacked_step_donated(config, states)
+        frame = (states.time, states.weights, states.uids, events.action,
+                 events.counterpart, events.loss)
+        if writer is not None:
+            # snapshot BEFORE the next iteration donates the buffers; the
+            # append job resolves the in-flight transfer off-thread
+            writer.submit(append_frames, snapshot(frame))
+        else:
+            append_frames(jax.device_get(frame))
+    flush_jobs = [store.flush for store in stores]
+    if writer is not None:
+        for job in flush_jobs:
+            writer.submit(job)
+    else:
+        for job in flush_jobs:
+            job()
+    return states

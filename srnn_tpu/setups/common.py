@@ -53,7 +53,36 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                    help="fixpoint epsilon (every reference experiment uses 1e-4)")
     p.add_argument("--smoke", action="store_true",
                    help="shrink all knobs to a seconds-scale sanity run")
+    p.add_argument("--service", default=None, metavar="SOCKET",
+                   help="submit this experiment to a running experiment "
+                        "service (python -m srnn_tpu.serve) on the given "
+                        "Unix socket instead of dispatching locally — the "
+                        "service may stack it with other tenants' requests "
+                        "(bitwise-equal results either way); setups that "
+                        "do not support submit mode ignore this")
+    p.add_argument("--service-timeout-s", type=float, default=600.0,
+                   metavar="S", help="client-side wait budget in submit mode")
     return p
+
+
+def execution_mode(args) -> str:
+    """How this run's compute was dispatched — recorded in config.json so
+    artifact readers (``examples/natural_cycles.py``, ``--resume``) can
+    tell service-stacked runs from solo-process runs."""
+    return "service" if getattr(args, "service", None) else "process"
+
+
+def submit_to_service(args, kind: str, params: dict, tenant: str = None):
+    """Submit one experiment request to the service named by
+    ``args.service`` and block for its result (the setups' submit mode)."""
+    from ..serve.client import ServiceClient
+
+    client = ServiceClient(args.service,
+                           timeout_s=getattr(args, "service_timeout_s",
+                                             600.0))
+    return client.request(kind, params, tenant=tenant,
+                          timeout_s=getattr(args, "service_timeout_s",
+                                            600.0))
 
 
 def evolve_trials(cfg: SoupConfig, key: jax.Array, trials: int,
@@ -141,12 +170,15 @@ def save_run_config(run_dir: str, args, fields, extra=None) -> None:
     """Persist the run's dynamics knobs (and optional ``extra`` derived
     metadata, e.g. per-type names for the viz layer) as config.json —
     atomically, because ``--resume`` (and every supervised restart) reads
-    this file first."""
+    this file first.  Every config additionally records the
+    ``execution_mode`` ("process" | "service") so artifact readers can
+    tell a service-stacked run's outputs from a solo process's."""
     import json as _json
 
     from ..utils.atomicio import atomic_write_text
 
     doc = {k: getattr(args, k) for k in fields}
+    doc.setdefault("execution_mode", execution_mode(args))
     doc.update(extra or {})
     atomic_write_text(os.path.join(run_dir, "config.json"),
                       _json.dumps(doc, indent=1))

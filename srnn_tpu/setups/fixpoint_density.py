@@ -15,7 +15,7 @@ from ..engine import fixpoint_density
 from ..experiment import Experiment
 from ..init import init_population
 from .common import (STANDARD_VARIANTS, base_parser, log_counters, register,
-                     save_run_config)
+                     save_run_config, submit_to_service)
 
 
 def build_parser():
@@ -29,13 +29,31 @@ def build_parser():
 def run(args):
     if args.smoke:
         args.trials, args.batch = 64, 32
-    key = jax.random.key(args.seed)
     variants = STANDARD_VARIANTS[:2]  # WW + Agg, like the reference (:42-43)
     with Experiment("fixpoint_density", root=args.root, seed=args.seed) as exp:
         # the PRNG stream is keyed per batch on the cumulative sample count,
         # so reproducing/rescanning a run needs trials AND batch — record
-        # the invocation (examples/natural_cycles.py reads this)
+        # the invocation (examples/natural_cycles.py reads this; the
+        # execution_mode field says whether a service computed it)
         save_run_config(exp.dir, args, ("trials", "batch", "epsilon"))
+        if args.service:
+            # submit mode: the service runs the same sweep (stacked with
+            # other tenants when shapes match — bitwise-equal results)
+            # and this process only logs/saves the artifacts
+            result = submit_to_service(
+                args, "fixpoint_density",
+                {"seed": args.seed, "trials": args.trials,
+                 "batch": args.batch, "epsilon": args.epsilon},
+                tenant=f"fixpoint_density-seed{args.seed}")
+            all_names = result["variant_names"]
+            all_counters = [jax.numpy.asarray(c, jax.numpy.int32)
+                            for c in result["counters"]]
+            for name, total in zip(all_names, all_counters):
+                log_counters(exp, name, total)
+            exp.save(all_counters=jax.numpy.stack(all_counters),
+                     all_names=all_names)
+            return exp.dir
+        key = jax.random.key(args.seed)
         all_counters, all_names = [], []
         for i, (name, topo) in enumerate(variants):
             total = jax.numpy.zeros(5, jax.numpy.int32)

@@ -13,7 +13,7 @@ import numpy as np
 from ..experiment import Experiment, format_counters, save_checkpoint
 from ..soup import ACTION_NAMES, SoupConfig, count, evolve, seed
 from ..topology import Topology
-from .common import base_parser, register
+from .common import base_parser, register, save_run_config, submit_to_service
 
 
 def build_parser():
@@ -45,6 +45,38 @@ def run(args):
         remove_divergent=True, remove_zero=True,
         epsilon=args.epsilon, train_mode=args.train_mode)
     with Experiment("soup", root=args.root, seed=args.seed) as exp:
+        if args.service and not args.store:
+            # submit mode: the service evolves this soup (stacked with
+            # matching tenants — bitwise-equal to the local run) and
+            # returns counters + final state.  The dense per-generation
+            # history is NOT batched: runs that need it (--store or the
+            # record path below) dispatch locally.
+            save_run_config(exp.dir, args,
+                            ("soup_size", "generations", "train",
+                             "attacking_rate", "epsilon", "train_mode"))
+            result = submit_to_service(
+                args, "soup",
+                {"seed": args.seed, "size": args.soup_size,
+                 "generations": args.generations, "train": args.train,
+                 "attacking_rate": args.attacking_rate,
+                 "learn_from_rate": -1.0, "remove_divergent": True,
+                 "remove_zero": True, "epsilon": args.epsilon,
+                 "train_mode": args.train_mode},
+                tenant=f"soup-seed{args.seed}")
+            counts = np.asarray(result["counters"])
+            exp.log(format_counters(counts), counts=counts)
+            exp.save(action_names=list(ACTION_NAMES), all_counters=counts)
+            # the final state goes under its OWN artifact name: "soup" is
+            # the (G, N, P) per-generation history below, and readers
+            # (viz) take weights.shape[0] as the time axis — a final
+            # (N, P) state under that key would render silently wrong.
+            # The service omits the state above a size ceiling; counters
+            # and the log line are the run's record either way.
+            if "weights" in result:
+                exp.save(soup_final={
+                    "weights": np.asarray(result["weights"], np.float32),
+                    "uids": np.asarray(result["uids"], np.int32)})
+            return exp.dir
         state = seed(cfg, jax.random.key(args.seed))
         if args.store:
             from ..utils import TrajStore, evolve_captured

@@ -745,6 +745,41 @@ def _check_popmajor(config: SoupConfig) -> None:
                 f"P={config.topo.num_weights}) needs train_impl='xla'")
 
 
+def check_tenant_stackable(config: SoupConfig) -> None:
+    """Validate that ``config`` may ride the SERVE TENANT AXIS
+    (``srnn_tpu.serve.tenant``): K independent soups with this config —
+    same statics, different seeds — stacked into one ``(K, N, P)``
+    population-major dispatch via vmap, with every tenant's outputs
+    BITWISE-equal to its solo run.
+
+    Only the parallel row-major path qualifies: the popmajor lane layout's
+    reductions reassociate under a leading vmap axis (measured: the
+    stacked weights drift from solo by float noise), and the sequential
+    strict-parity scan is a per-particle validation mode with nothing to
+    amortize.  The serve scheduler falls back to solo dispatch for
+    configs that fail this check.
+    """
+    if config.mode != "parallel":
+        raise ValueError(
+            "tenant stacking rides the parallel step; "
+            f"mode={config.mode!r} is unsupported (solo dispatch only)")
+    if config.layout != "rowmajor":
+        raise ValueError(
+            "tenant stacking requires layout='rowmajor': the popmajor "
+            "lane layout's reductions reassociate under the tenant vmap "
+            "axis, breaking the bitwise-equal-to-solo contract")
+
+
+def tenant_stackable(config: SoupConfig) -> bool:
+    """Would this config's evolve ride the serve tenant axis?  (AOT warmup
+    uses this to decide whether the stacked spellings exist for it.)"""
+    try:
+        check_tenant_stackable(config)
+    except ValueError:
+        return False
+    return True
+
+
 def fused_supported(config: SoupConfig) -> bool:
     """Would ``generation_impl='fused'`` be a valid spelling of this
     config?  (AOT warmup uses this to decide whether to pre-build the

@@ -67,6 +67,14 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_restarts_total": "counter",
     "soup_topology_reramps_total": "counter",
     "soup_recovery_seconds": "histogram",
+    # -- experiment service (srnn_tpu.serve) -----------------------------
+    "serve_requests_total": "counter",
+    "serve_requests_failed_total": "counter",
+    "serve_dispatches_total": "counter",
+    "serve_dispatch_tenants_total": "counter",
+    "serve_queue_depth": "gauge",
+    "serve_request_seconds": "histogram",
+    "serve_dispatch_seconds": "histogram",
     # -- heartbeats (telemetry.heartbeat) --------------------------------
     "heartbeat_generation": "gauge",
     "gens_per_sec": "gauge",

@@ -248,6 +248,29 @@ def abstract_multi_state(config, mesh=None) -> "Any":
     return _with_shardings(st, _mstate_specs(len(config.topos)), mesh)
 
 
+def _stack_abstract(tree, k: int):
+    """Prepend a tenant axis of width ``k`` to every array leaf of an
+    abstract state (the serve tenant-stacked spellings' input skeleton)."""
+    return jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct((k,) + tuple(l.shape), l.dtype)
+        if isinstance(l, jax.ShapeDtypeStruct) else l, tree)
+
+
+def abstract_stacked_soup_state(config, k: int) -> "Any":
+    """(K, ...) tenant-stacked ``SoupState`` skeleton (``serve.tenant``)."""
+    return _stack_abstract(abstract_soup_state(config), k)
+
+
+def abstract_stacked_multi_state(config, k: int) -> "Any":
+    """(K, ...) tenant-stacked ``MultiSoupState`` skeleton."""
+    return _stack_abstract(abstract_multi_state(config), k)
+
+
+def abstract_stacked_lineage_state(n: int, k: int) -> "Any":
+    """(K, ...) tenant-stacked ``LineageState`` skeleton."""
+    return _stack_abstract(abstract_lineage_state(n), k)
+
+
 def aot_compile(name: str, jitted, args: Tuple, kwargs: Optional[dict] = None,
                 persistent: bool = True) -> CompiledEntry:
     """Lower + compile ``jitted`` against ``args``/``kwargs`` ahead of time.
@@ -390,6 +413,14 @@ def _soup_entries(config, generations: int, donate: bool):
             "lineage": True, "lineage_state": abstract_lineage_state(
                 config.size),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    # --lineage --no-health (the .metered.lineage diagnostic spelling) is
+    # setups-reachable too; warming it keeps the flag-parity baseline at
+    # ZERO waivers (it was the repo's only waived F010 finding)
+    yield (f"soup.evolve{tag}.metered.lineage", run, (config, st),
+           {"generations": generations, "metrics": True,
+            "lineage": True, "lineage_state": abstract_lineage_state(
+                config.size),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
     # the fused-megakernel spellings (generation_impl='fused') are their
     # own programs — warm them for every fused-eligible popmajor config so
     # a `--generation-impl fused` run's first chunk deserializes instead
@@ -426,6 +457,12 @@ def _multi_entries(config, generations: int, donate: bool):
     yield (f"multisoup.evolve_multi{tag}.metered.health.lineage", run,
            (config, st),
            {"generations": generations, "metrics": True, "health": True,
+            "lineage": True, "lineage_state": tuple(
+                abstract_lineage_state(n) for n in config.sizes),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    yield (f"multisoup.evolve_multi{tag}.metered.lineage", run,
+           (config, st),
+           {"generations": generations, "metrics": True,
             "lineage": True, "lineage_state": tuple(
                 abstract_lineage_state(n) for n in config.sizes),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
@@ -487,6 +524,12 @@ def _sharded_entries(config, mesh, generations: int, donate: bool):
             "lineage": True, "lineage_state": abstract_lineage_state(
                 config.size, mesh=mesh),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    yield (f"parallel.sharded_evolve{tag}.metered.lineage", run,
+           (config, mesh, st),
+           {"generations": generations, "metrics": True,
+            "lineage": True, "lineage_state": abstract_lineage_state(
+                config.size, mesh=mesh),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
     from ..soup import fused_supported
 
     if config.generation_impl != "fused" and fused_supported(config):
@@ -531,6 +574,13 @@ def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
                 abstract_lineage_state(n, mesh=mesh)
                 for n in config.sizes),
             "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    yield (f"parallel.sharded_evolve_multi{tag}.metered.lineage", run,
+           (config, mesh, st),
+           {"generations": generations, "metrics": True,
+            "lineage": True, "lineage_state": tuple(
+                abstract_lineage_state(n, mesh=mesh)
+                for n in config.sizes),
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
     from ..multisoup import fused_supported_multi
 
     if config.generation_impl != "fused" and fused_supported_multi(config):
@@ -544,18 +594,96 @@ def _sharded_multi_entries(config, mesh, generations: int, donate: bool):
                {"generations": generations, "metrics": True, "health": True})
 
 
+def _stacked_entries(config, k: int, generations: int, donate: bool):
+    """The serve tenant-axis spellings (``serve.tenant.evolve_stacked``)
+    for a K-tenant stack of ``config`` — the experiment service warms
+    these so a stacked dispatch's first tenants only execute.  Covers the
+    full carry lattice the service (and its clients) can dispatch:
+    metrics alone, metrics+lineage, and the health twins."""
+    from ..soup import tenant_stackable
+
+    if not tenant_stackable(config):
+        return
+    from ..serve import tenant as serve_tenant
+
+    st = abstract_stacked_soup_state(config, k)
+    run = serve_tenant.evolve_stacked_donated if donate \
+        else serve_tenant.evolve_stacked
+    step = serve_tenant.evolve_stacked_step_donated if donate \
+        else serve_tenant.evolve_stacked_step
+    tag = ".donated" if donate else ""
+    from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY
+
+    lin = abstract_stacked_lineage_state(config.size, k)
+    yield (f"serve.evolve_stacked_step{tag}", step, (config, st), {})
+    yield (f"serve.evolve_stacked{tag}", run, (config, st),
+           {"generations": generations})
+    yield (f"serve.evolve_stacked{tag}.metered", run, (config, st),
+           {"generations": generations, "metrics": True})
+    yield (f"serve.evolve_stacked{tag}.metered.health", run, (config, st),
+           {"generations": generations, "metrics": True, "health": True})
+    yield (f"serve.evolve_stacked{tag}.metered.health.lineage", run,
+           (config, st),
+           {"generations": generations, "metrics": True, "health": True,
+            "lineage": True, "lineage_state": lin,
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    yield (f"serve.evolve_stacked{tag}.metered.lineage", run, (config, st),
+           {"generations": generations, "metrics": True,
+            "lineage": True, "lineage_state": lin,
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+
+
+def _stacked_multi_entries(config, k: int, generations: int, donate: bool):
+    """Tenant-stacked spellings of the heterogeneous surface
+    (``serve.tenant.evolve_multi_stacked``)."""
+    from ..multisoup import tenant_stackable_multi
+
+    if not tenant_stackable_multi(config):
+        return
+    from ..serve import tenant as serve_tenant
+
+    st = abstract_stacked_multi_state(config, k)
+    run = serve_tenant.evolve_multi_stacked_donated if donate \
+        else serve_tenant.evolve_multi_stacked
+    tag = ".donated" if donate else ""
+    from ..telemetry.dynamics import DEFAULT_EDGE_CAPACITY
+
+    lin = tuple(abstract_stacked_lineage_state(n, k) for n in config.sizes)
+    yield (f"serve.evolve_multi_stacked{tag}", run, (config, st),
+           {"generations": generations})
+    yield (f"serve.evolve_multi_stacked{tag}.metered", run, (config, st),
+           {"generations": generations, "metrics": True})
+    yield (f"serve.evolve_multi_stacked{tag}.metered.health", run,
+           (config, st),
+           {"generations": generations, "metrics": True, "health": True})
+    yield (f"serve.evolve_multi_stacked{tag}.metered.health.lineage", run,
+           (config, st),
+           {"generations": generations, "metrics": True, "health": True,
+            "lineage": True, "lineage_state": lin,
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+    yield (f"serve.evolve_multi_stacked{tag}.metered.lineage", run,
+           (config, st),
+           {"generations": generations, "metrics": True,
+            "lineage": True, "lineage_state": lin,
+            "lineage_capacity": DEFAULT_EDGE_CAPACITY})
+
+
 def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,
            donate: bool = True, engine: bool = False, step_limit: int = 100,
-           epochs: int = 100, verbose: bool = False) -> "list[dict]":
+           epochs: int = 100, stacked: int = 0,
+           verbose: bool = False) -> "list[dict]":
     """AOT-compile the hot entry points so later dispatches only execute.
 
     ``config`` (a ``SoupConfig``) warms the homogeneous soup step/run;
     ``multi`` (a ``MultiSoupConfig``) the heterogeneous twins; ``mesh``
     additionally warms the sharded steps for whichever of the two configs
     are given; ``engine=True`` adds the fixpoint/training engines sized
-    from ``config`` (or ``multi``'s per-type topos).  ``donate`` picks the
-    buffer-donating production spellings (default) — pass ``False`` to warm
-    the value-preserving ones used by parity tooling.
+    from ``config`` (or ``multi``'s per-type topos).  ``stacked=K`` (>0)
+    additionally warms the serve TENANT-AXIS spellings at stack width K
+    (``serve.tenant`` — skipped silently for configs that cannot stack).
+    ``donate`` picks the buffer-donating production spellings (default) —
+    pass ``False`` to warm the value-preserving ones used by parity
+    tooling.
 
     Returns one row per entry: ``{"entry", "cached", "lower_s",
     "compile_s", "backend"}`` — ``cached`` meaning served from the
@@ -567,10 +695,16 @@ def warmup(config=None, *, multi=None, mesh=None, generations: int = 100,
         jobs += list(_soup_entries(config, generations, donate))
         if mesh is not None:
             jobs += list(_sharded_entries(config, mesh, generations, donate))
+        if stacked > 0:
+            jobs += list(_stacked_entries(config, stacked, generations,
+                                          donate))
     if multi is not None:
         jobs += list(_multi_entries(multi, generations, donate))
         if mesh is not None:
             jobs += list(_sharded_multi_entries(multi, mesh, generations,
+                                                donate))
+        if stacked > 0:
+            jobs += list(_stacked_multi_entries(multi, stacked, generations,
                                                 donate))
     if engine:
         # each topo keeps ITS config's train_mode — it is a static arg, so

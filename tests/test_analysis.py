@@ -78,14 +78,15 @@ def test_cli_unknown_pass_is_usage_error(capsys):
     capsys.readouterr()
 
 
-def test_shipped_waivers_all_used_with_reasons(repo_ctx):
-    """The checked-in baseline cannot rot: every line matches a live
-    finding and carries a reason (W001/W002 would be findings)."""
+def test_shipped_baseline_is_clean_and_empty(repo_ctx):
+    """The repo analyzes clean with an EMPTY waiver baseline (the
+    .metered.lineage F010 waivers retired when aot.py started warming
+    that spelling; a stale leftover line would be a W002 finding)."""
     result = run_analysis(repo_ctx, select(None))
     assert not result.findings, \
         "\n".join(f.render() for f in result.findings)
-    assert result.waived, "expected the documented F010 waivers to be live"
-    assert all(w.reason for _f, w in result.waived)
+    assert not result.waived, "the shipped baseline should waive nothing"
+    assert not result.unused_waivers
 
 
 def test_walk_roots_shared_config(repo_ctx):
@@ -268,6 +269,15 @@ def _surface_files(sharded_multi_src=None, cap="4096", statics=None):
             cap="4096", plain="sharded_evolve_multi",
             donated="sharded_evolve_multi_donated",
             statics=statics + ', "mesh"'),
+        # the serve tenant-axis surfaces hold the same contract (PR 10)
+        "srnn_tpu/serve/tenant.py": _SURFACE_TEMPLATE.format(
+            fn="_evolve_stacked", head="config, states, record=False, ",
+            cap="4096", plain="evolve_stacked",
+            donated="evolve_stacked_donated",
+            statics=statics + ', "record"') + _SURFACE_TEMPLATE.format(
+            fn="_evolve_multi_stacked", head="config, states, ",
+            cap="4096", plain="evolve_multi_stacked",
+            donated="evolve_multi_stacked_donated", statics=statics),
         "srnn_tpu/utils/aot.py": _AOT_FIXTURE,
     }
     return files
@@ -294,6 +304,16 @@ _AOT_FIXTURE = """
     def _sharded_multi_entries(config, mesh, generations, donate):
         yield ("parallel.sharded_evolve_multi", None, (config,), {})
         yield ("parallel.sharded_evolve_multi.metered", None, (config,),
+               {"metrics": True})
+
+    def _stacked_entries(config, k, generations, donate):
+        yield ("serve.evolve_stacked", None, (config,), {})
+        yield ("serve.evolve_stacked.metered", None, (config,),
+               {"metrics": True})
+
+    def _stacked_multi_entries(config, k, generations, donate):
+        yield ("serve.evolve_multi_stacked", None, (config,), {})
+        yield ("serve.evolve_multi_stacked.metered", None, (config,),
                {"metrics": True})
     """
 

@@ -189,7 +189,8 @@ def test_engine_and_multi_warmup_entries():
     plain = aot.warmup(cfg, generations=2, donate=False)
     assert {r["entry"] for r in plain} == {
         "soup.evolve_step", "soup.evolve", "soup.evolve.metered",
-        "soup.evolve.metered.health", "soup.evolve.metered.health.lineage"}
+        "soup.evolve.metered.health", "soup.evolve.metered.health.lineage",
+        "soup.evolve.metered.lineage"}
     assert not any(r["cached"] for r in plain)
 
 
@@ -207,6 +208,7 @@ def test_warmup_fused_spellings_for_popmajor_configs():
     assert {r["entry"] for r in rows} == {
         "soup.evolve_step", "soup.evolve", "soup.evolve.metered",
         "soup.evolve.metered.health", "soup.evolve.metered.health.lineage",
+        "soup.evolve.metered.lineage",
         "soup.evolve_step.fused", "soup.evolve.fused",
         "soup.evolve.fused.metered.health"}
     # a config that is ALREADY fused warms its own (fused) programs under

@@ -1,0 +1,464 @@
+"""Multi-tenant experiment service (srnn_tpu.serve).
+
+The load-bearing contract is STACKED-VS-SOLO BITWISE PARITY: every tenant
+slice of a stacked dispatch must carry exactly the bits its solo run
+produces — weights, uids, PRNG keys, metrics/health carries, lineage
+pids/edges, and captured ``.traj`` streams.  Plus the scheduler's
+grouping/fallback semantics, the service end-to-end (one stacked + one
+solo dispatch, per-tenant results equal to solo computes), and the
+socket transport round trip.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from srnn_tpu.multisoup import MultiSoupConfig, evolve_multi, seed_multi
+from srnn_tpu.serve import (ExperimentService, plan_dispatches,
+                            stack_tenants, unstack_tenants)
+from srnn_tpu.serve.scheduler import Request
+from srnn_tpu.serve.service import GROUP_KEYS
+from srnn_tpu.serve.tenant import (evolve_multi_stacked, evolve_stacked,
+                                   evolve_stacked_captured, seed_stacked)
+from srnn_tpu.soup import SoupConfig, evolve, seed, tenant_stackable
+from srnn_tpu.topology import Topology
+
+WW = Topology("weightwise", width=2, depth=2)
+AGG = Topology("aggregating", width=2, depth=2, aggregates=4)
+
+CFG = SoupConfig(topo=WW, size=16, attacking_rate=0.25, learn_from_rate=0.25,
+                 train=2, remove_divergent=True, remove_zero=True)
+K = 4
+
+
+def _keyless(x):
+    if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype,
+                                                     jax.dtypes.prng_key):
+        return jax.random.key_data(x)
+    return x
+
+
+def _assert_bits_equal(a, b, what=""):
+    """Bitwise equality across a pytree (NaN-safe: compares bit patterns,
+    not float values)."""
+    la = jax.tree.leaves(jax.tree.map(_keyless, a))
+    lb = jax.tree.leaves(jax.tree.map(_keyless, b))
+    assert len(la) == len(lb), what
+    for i, (x, y) in enumerate(zip(la, lb)):
+        x = np.atleast_1d(np.asarray(x))
+        y = np.atleast_1d(np.asarray(y))
+        assert x.dtype == y.dtype and x.shape == y.shape, \
+            f"{what} leaf {i}: {x.dtype}{x.shape} vs {y.dtype}{y.shape}"
+        np.testing.assert_array_equal(x.view(np.uint8), y.view(np.uint8),
+                                      err_msg=f"{what} leaf {i}")
+
+
+def _tenant_states(cfg, k=K):
+    return [seed(cfg, jax.random.key(t)) for t in range(k)]
+
+
+# ---------------------------------------------------------------------------
+# stacked-vs-solo bitwise parity
+# ---------------------------------------------------------------------------
+
+
+def test_stacked_soup_full_carry_parity():
+    """K=4 stacked run with metrics+health+lineage on == 4 solo runs,
+    bit for bit: state (incl. PRNG key), carries, lineage pids/edges."""
+    from srnn_tpu.telemetry.dynamics import seed_lineage
+
+    states = _tenant_states(CFG)
+    lins = [seed_lineage(CFG.size) for _ in range(K)]
+    solo = [evolve(CFG, s, generations=5, metrics=True, health=True,
+                   lineage=True, lineage_state=l, lineage_capacity=256)
+            for s, l in zip(states, lins)]
+    out = evolve_stacked(CFG, stack_tenants(states), generations=5,
+                         metrics=True, health=True, lineage=True,
+                         lineage_state=stack_tenants(lins),
+                         lineage_capacity=256)
+    for t, got in enumerate(unstack_tenants(out, K)):
+        _assert_bits_equal(solo[t], got, what=f"tenant {t}")
+
+
+def test_stacked_soup_seed_and_events_parity():
+    """seed_stacked == per-tenant seed; the recorded per-generation event
+    streams (action/counterpart/loss) match too."""
+    keys = jnp.stack([jax.random.key(t) for t in range(K)])
+    stacked = seed_stacked(CFG, keys)
+    for t, got in enumerate(unstack_tenants(stacked, K)):
+        _assert_bits_equal(seed(CFG, jax.random.key(t)), got,
+                           what=f"seed tenant {t}")
+    states = _tenant_states(CFG)
+    solo = [evolve(CFG, s, generations=4, record=True) for s in states]
+    out = evolve_stacked(CFG, stack_tenants(states), generations=4,
+                         record=True)
+    for t in range(K):
+        _assert_bits_equal(solo[t][1], jax.tree.map(lambda x: x[t], out[1]),
+                           what=f"events tenant {t}")
+
+
+def test_stacked_multisoup_parity():
+    mcfg = MultiSoupConfig(topos=(WW, AGG), sizes=(8, 8),
+                           attacking_rate=0.25, learn_from_rate=0.25,
+                           train=1, remove_divergent=True, remove_zero=True)
+    from srnn_tpu.telemetry.dynamics import seed_lineage_blocks
+
+    states = [seed_multi(mcfg, jax.random.key(t)) for t in range(K)]
+    lins = [seed_lineage_blocks(mcfg.sizes) for _ in range(K)]
+    solo = [evolve_multi(mcfg, s, generations=4, metrics=True, health=True,
+                         lineage=True, lineage_state=l,
+                         lineage_capacity=256)
+            for s, l in zip(states, lins)]
+    out = evolve_multi_stacked(mcfg, stack_tenants(states), generations=4,
+                               metrics=True, health=True, lineage=True,
+                               lineage_state=stack_tenants(lins),
+                               lineage_capacity=256)
+    for t in range(K):
+        _assert_bits_equal(solo[t], jax.tree.map(lambda x: x[t], out),
+                           what=f"multi tenant {t}")
+
+
+def test_stacked_traj_capture_parity(tmp_path):
+    """Per-tenant ``.traj`` streams from one stacked captured run equal
+    the solo ``evolve_captured`` streams (same stride, same donated
+    dispatch order), frame for frame."""
+    from srnn_tpu.utils import TrajStore, evolve_captured
+    from srnn_tpu.utils.trajstore import read_store
+
+    gens, every = 6, 2
+    states = _tenant_states(CFG)
+    for t, st in enumerate(states):
+        with TrajStore(str(tmp_path / f"solo{t}.traj"), CFG.size,
+                       CFG.topo.num_weights) as store:
+            evolve_captured(CFG, st, gens, store, every=every)
+    stores = [TrajStore(str(tmp_path / f"stk{t}.traj"), CFG.size,
+                        CFG.topo.num_weights) for t in range(K)]
+    try:
+        evolve_stacked_captured(CFG, stack_tenants(states), gens, stores,
+                                every=every)
+    finally:
+        for s in stores:
+            s.close()
+    for t in range(K):
+        ref = read_store(str(tmp_path / f"solo{t}.traj"))
+        got = read_store(str(tmp_path / f"stk{t}.traj"))
+        _assert_bits_equal(ref, got, what=f"traj tenant {t}")
+
+
+def test_stackability_gate():
+    assert tenant_stackable(CFG)
+    pm = CFG._replace(layout="popmajor", respawn_draws="fused")
+    assert not tenant_stackable(pm)
+    with pytest.raises(ValueError, match="rowmajor"):
+        evolve_stacked(pm, stack_tenants(_tenant_states(CFG)),
+                       generations=1)
+    assert not tenant_stackable(CFG._replace(mode="sequential"))
+
+
+def test_engine_stacked_parity():
+    from srnn_tpu.engine import (fixpoint_density, fixpoint_density_stacked,
+                                 run_fixpoint, run_fixpoint_stacked)
+    from srnn_tpu.init import init_population
+
+    pops = [init_population(WW, jax.random.key(t), 32) for t in range(K)]
+    eps = jnp.asarray([1e-4, 1e-3, 1e-4, 1e-5], jnp.float32)
+    stacked = fixpoint_density_stacked(WW, jnp.stack(pops), eps)
+    for t in range(K):
+        np.testing.assert_array_equal(
+            np.asarray(fixpoint_density(WW, pops[t], float(eps[t]))),
+            np.asarray(stacked[t]))
+    st = run_fixpoint_stacked(WW, jnp.stack(pops), step_limit=8,
+                              epsilons=eps)
+    for t in range(K):
+        solo = run_fixpoint(WW, pops[t], step_limit=8,
+                            epsilon=float(eps[t]))
+        _assert_bits_equal([solo.weights, solo.steps, solo.classes,
+                            solo.counts],
+                           [st.weights[t], st.steps[t], st.classes[t],
+                            st.counts[t]], what=f"fixpoint tenant {t}")
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+def _req(i, kind, **params):
+    return Request(ticket=f"t{i}", kind=kind, params=params,
+                   tenant=f"t{i}", submitted_s=0.0)
+
+
+def test_scheduler_groups_and_falls_back():
+    reqs = [
+        _req(0, "fixpoint_density", trials=64, batch=32, seed=0),
+        _req(1, "fixpoint_density", trials=64, batch=32, seed=1),
+        _req(2, "fixpoint_density", trials=48, batch=24, seed=2),  # odd
+        _req(3, "soup", size=8, generations=4, seed=0),
+        _req(4, "soup", size=8, generations=4, seed=1),
+        _req(5, "soup", size=8, generations=4, seed=2,
+             layout="popmajor"),  # unstackable config -> solo
+    ]
+    # the popmajor request's key function must return None (solo)
+    assert GROUP_KEYS["soup"](reqs[5].params) is None
+    plan = plan_dispatches(reqs, GROUP_KEYS, max_stack=8)
+    modes = [(d.kind, len(d.requests)) for d in plan]
+    assert ("fixpoint_density", 2) in modes
+    assert ("fixpoint_density", 1) in modes
+    assert ("soup", 2) in modes
+    assert ("soup", 1) in modes
+    # chunking: 5 same-key requests at max_stack=2 -> 2+2+1
+    many = [_req(i, "fixpoint_density", trials=64, batch=32, seed=i)
+            for i in range(5)]
+    sizes = [len(d.requests) for d in
+             plan_dispatches(many, GROUP_KEYS, max_stack=2)]
+    assert sizes == [2, 2, 1]
+
+
+# ---------------------------------------------------------------------------
+# service end-to-end (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_service_stacks_matching_and_solos_odd(tmp_path):
+    svc = ExperimentService(str(tmp_path / "svc"), max_stack=8)
+    with svc:
+        t1 = svc.submit("fixpoint_density",
+                        {"seed": 0, "trials": 64, "batch": 32}, tenant="a")
+        t2 = svc.submit("fixpoint_density",
+                        {"seed": 1, "trials": 64, "batch": 32}, tenant="b")
+        t3 = svc.submit("fixpoint_density",
+                        {"seed": 2, "trials": 48, "batch": 24}, tenant="c")
+        assert svc.run_pending() == 3
+        e1, e2, e3 = (svc.poll(t) for t in (t1, t2, t3))
+        assert (e1["mode"], e2["mode"], e3["mode"]) == \
+            ("stacked", "stacked", "solo")
+        # per-tenant results == the solo compute of the same sweep
+        from srnn_tpu.engine import fixpoint_density
+        from srnn_tpu.init import init_population
+        from srnn_tpu.setups.common import STANDARD_VARIANTS
+
+        for entry, seed_, trials, batch in ((e1, 0, 64, 32),
+                                            (e2, 1, 64, 32),
+                                            (e3, 2, 48, 24)):
+            key = jax.random.key(seed_)
+            for v, (_name, topo) in enumerate(STANDARD_VARIANTS[:2]):
+                total = jnp.zeros(5, jnp.int32)
+                done = 0
+                while done < trials:
+                    n = min(batch, trials - done)
+                    pop = init_population(
+                        topo,
+                        jax.random.fold_in(jax.random.fold_in(key, v),
+                                           done), n)
+                    total = total + fixpoint_density(topo, pop, 1e-4)
+                    done += n
+                assert entry["result"]["counters"][v] == \
+                    np.asarray(total).tolist()
+        reg = svc.registry
+        assert reg.counter("serve_dispatches_total").value(
+            kind="fixpoint_density", mode="stacked") == 1
+        assert reg.counter("serve_dispatches_total").value(
+            kind="fixpoint_density", mode="solo") == 1
+        svc.writer.flush()
+    prom = (tmp_path / "svc" / "metrics.prom").read_text()
+    assert 'srnn_serve_dispatches_total{kind="fixpoint_density",' \
+           'mode="stacked"} 1' in prom
+
+
+def test_service_soup_matches_solo_and_streams_lineage(tmp_path):
+    svc = ExperimentService(str(tmp_path / "svc"), max_stack=8)
+    with svc:
+        params = {"size": 12, "generations": 4, "train": 1,
+                  "attacking_rate": 0.25, "remove_divergent": True,
+                  "remove_zero": True, "lineage": True}
+        tickets = [svc.submit("soup", dict(params, seed=i),
+                              tenant=f"tenant{i}") for i in range(3)]
+        svc.run_pending()
+        entries = [svc.poll(t) for t in tickets]
+        assert all(e["mode"] == "stacked" for e in entries)
+        # oracle: the solo run of tenant 1
+        from srnn_tpu.serve.service import _soup_config_from_params
+        from srnn_tpu.soup import count
+
+        cfg = _soup_config_from_params(params)
+        final = evolve(cfg, seed(cfg, jax.random.key(1)), generations=4)
+        assert entries[1]["result"]["counters"] == \
+            np.asarray(count(cfg, final)).tolist()
+        np.testing.assert_array_equal(
+            np.asarray(entries[1]["result"]["weights"], np.float32),
+            np.asarray(final.weights))
+        svc.writer.flush()
+        rows = [json.loads(l) for l in
+                open(os.path.join(svc.root, "lineage.jsonl"))]
+        assert [r["tenant"] for r in rows] == ["tenant0", "tenant1",
+                                               "tenant2"]
+        assert all(r["kind"] == "window" for r in rows)
+    # events.jsonl carries tenant-labeled rows through the writer
+    events = [json.loads(l) for l in
+              open(os.path.join(str(tmp_path / "svc"), "events.jsonl"))]
+    tenant_rows = [e for e in events if e.get("kind") == "serve_tenant"]
+    assert {e["tenant"] for e in tenant_rows} == {"tenant0", "tenant1",
+                                                  "tenant2"}
+
+
+def test_soup_request_schema_defaults_match_soupconfig():
+    """Unstated request knobs must take SoupConfig's OWN defaults — a
+    drifted default here once ran service tenants at lr=0.1 against solo
+    processes at DEFAULT_LR=0.01 (caught as a weights mismatch)."""
+    from srnn_tpu.serve.service import _soup_config_from_params
+
+    assert _soup_config_from_params({"size": 8}) == \
+        SoupConfig(topo=WW, size=8)
+
+
+def test_service_failed_request_reports_error(tmp_path):
+    svc = ExperimentService(str(tmp_path / "svc"))
+    with svc:
+        with pytest.raises(ValueError):
+            svc.submit("no_such_kind", {})
+        # a soup request with an invalid config fails its dispatch but
+        # leaves the service serving
+        t1 = svc.submit("soup", {"size": 8, "generations": 2,
+                                 "train_mode": "bogus"})
+        # malformed params whose GROUP-KEY computation raises (no "size")
+        # must fail only their own request, not the scheduling round
+        t0 = svc.submit("soup", {"generations": 2})
+        t2 = svc.submit("fixpoint_density",
+                        {"seed": 0, "trials": 32, "batch": 32})
+        svc.run_pending()
+        assert svc.poll(t1)["status"] == "failed"
+        assert "bogus" in svc.poll(t1)["error"]
+        assert svc.poll(t0)["status"] == "failed"
+        assert svc.poll(t2)["status"] == "done"
+        assert svc.registry.counter("serve_requests_failed_total").value(
+            kind="soup") == 2
+        # wait() CONSUMES its entry (bounded results table under load)
+        assert svc.wait(t2, timeout_s=5)["status"] == "done"
+        assert svc.poll(t2) is None
+
+
+# ---------------------------------------------------------------------------
+# socket transport
+# ---------------------------------------------------------------------------
+
+
+def test_socket_server_round_trip(tmp_path):
+    from srnn_tpu.serve.client import ServiceClient, ServiceError
+    from srnn_tpu.serve.server import ServiceServer
+    from srnn_tpu.utils.pipeline import spawn_thread
+
+    svc = ExperimentService(str(tmp_path / "svc"), max_stack=4)
+    sock = str(tmp_path / "serve.sock")
+    server = ServiceServer(svc, sock, batch_window_s=0.05)
+    thread = spawn_thread(server.serve_until_shutdown, name="test-serve")
+    try:
+        client = ServiceClient(sock)
+        client.wait_until_up(30)
+        result = client.request(
+            "fixpoint_density", {"seed": 3, "trials": 32, "batch": 32},
+            tenant="sock", timeout_s=120)
+        assert len(result["counters"]) == 2
+        assert client.stats()["completed"] == 1
+        with pytest.raises(ServiceError, match="unknown"):
+            client._op({"op": "nope"})
+    finally:
+        ServiceClient(sock).shutdown()
+        thread.join(timeout=30)
+        svc.close()
+    assert not thread.is_alive()
+    assert not os.path.exists(sock)
+
+
+@pytest.mark.slow
+def test_service_process_end_to_end(tmp_path):
+    """Real service PROCESS on a Unix socket; two same-shape setups
+    clients stack, an odd one solos, artifacts bitwise-match local runs,
+    metrics.prom records the dispatch modes, clean --shutdown."""
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["SRNN_SETUPS_PLATFORM"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    root = str(tmp_path / "svc")
+    sock = os.path.join(root, "serve.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "srnn_tpu.serve", "--root", root,
+         "--batch-window-s", "2"], cwd=repo, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if subprocess.run(
+                    [sys.executable, "-m", "srnn_tpu.serve", "--socket",
+                     sock, "--ping"], cwd=repo, env=env).returncode == 0:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("service never answered ping")
+
+        def client(seed, extra):
+            return subprocess.Popen(
+                [sys.executable, "-m", "srnn_tpu.setups",
+                 "fixpoint_density", "--seed", str(seed), "--root",
+                 str(tmp_path / "exp"), "--service", sock] + extra,
+                cwd=repo, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT)
+
+        clients = [client(0, ["--smoke"]), client(1, ["--smoke"]),
+                   client(2, ["--trials", "48", "--batch", "24"])]
+        for c in clients:
+            assert c.wait(timeout=240) == 0
+        assert subprocess.run(
+            [sys.executable, "-m", "srnn_tpu.serve", "--socket", sock,
+             "--shutdown"], cwd=repo, env=env).returncode == 0
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    prom = open(os.path.join(root, "metrics.prom")).read()
+    assert 'mode="stacked"} 1' in prom and 'mode="solo"} 1' in prom
+    # tenant 1's artifacts == a local (process-mode) run of the same sweep
+    local = subprocess.run(
+        [sys.executable, "-m", "srnn_tpu.setups", "fixpoint_density",
+         "--seed", "1", "--smoke", "--root", str(tmp_path / "local")],
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, timeout=240)
+    local_dir = local.stdout.decode().strip().splitlines()[-1]
+    import glob
+
+    svc_runs = glob.glob(str(tmp_path / "exp" / "exp-*"))
+    match = [d for d in svc_runs
+             if json.load(open(os.path.join(d, "meta.json")))["seed"] == 1]
+    a = np.load(os.path.join(match[0], "all_counters.npz"))
+    b = np.load(os.path.join(local_dir, "all_counters.npz"))
+    np.testing.assert_array_equal(a[a.files[0]], b[b.files[0]])
+    assert json.load(open(os.path.join(
+        match[0], "config.json")))["execution_mode"] == "service"
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup spellings
+# ---------------------------------------------------------------------------
+
+
+def test_stacked_warmup_entry_names():
+    """The stacked spelling zoo exists for stackable configs (names only —
+    compiles are covered by warmup tests in test_aot) and is empty for
+    popmajor ones."""
+    from srnn_tpu.utils import aot
+
+    names = [j[0] for j in aot._stacked_entries(CFG, 4, 2, donate=True)]
+    assert "serve.evolve_stacked.donated.metered" in names
+    assert "serve.evolve_stacked.donated.metered.lineage" in names
+    pm = CFG._replace(layout="popmajor", respawn_draws="fused")
+    assert list(aot._stacked_entries(pm, 4, 2, donate=True)) == []
+    mcfg = MultiSoupConfig(topos=(WW, AGG), sizes=(8, 8))
+    mnames = [j[0] for j in aot._stacked_multi_entries(mcfg, 4, 2, False)]
+    assert "serve.evolve_multi_stacked.metered.lineage" in mnames
