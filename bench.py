@@ -86,6 +86,14 @@ RESCUE_RESERVE_S = 330.0
 # 8-solo-processes comparison.  0 disables (the bench e2e tests pin tiny
 # deadlines and must not inherit a multi-minute extra stage).
 SERVE_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_SERVE_TIMEOUT_S", "420"))
+# the distributed-tier leg (srnn_tpu.distributed): a 2-process CPU-mesh
+# mega_soup through the launcher vs the single-process run of the same
+# config — proves the multi-host plumbing end to end on this host
+# (bitwise-verified) and records the DCN-tax of the CPU spelling.  The
+# TPU-pod row stays wired-not-measured until the next TPU window.  0
+# disables (bench e2e tests pin tiny deadlines).
+MULTIHOST_TIMEOUT_S = float(
+    os.environ.get("SRNN_BENCH_MULTIHOST_TIMEOUT_S", "420"))
 
 _SENTINEL = "@@BENCH_RESULT "
 #: child-side heartbeat lines: milestone rows on the piped stdout, so a
@@ -435,6 +443,86 @@ def _serve_leg() -> dict:
     return out
 
 
+def _multihost_leg() -> dict:
+    """The distributed-tier benchmark (host CPU, 2 processes over a gloo
+    CPU mesh): ONE mega_soup config run twice — single-process sharded,
+    then through ``python -m srnn_tpu.distributed.launch --processes 2``
+    — wall-clocked end to end (compile served by the shared persistent
+    cache) with the final checkpoints compared BITWISE.  On this host
+    the multi-process spelling pays the gloo/process tax (the honest
+    number this leg exists to record); the TPU-pod row is wired for the
+    next TPU window (``scripts/tpu_window.sh`` + the supervisor's
+    ``--stall-timeout-s`` triage path) rather than faked here."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    size = int(os.environ.get("SRNN_BENCH_MULTIHOST_N", "4096"))
+    gens = int(os.environ.get("SRNN_BENCH_MULTIHOST_GENS", "24"))
+    procs = int(os.environ.get("SRNN_BENCH_MULTIHOST_PROCS", "2"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    root = tempfile.mkdtemp(prefix="srnn_multihost_bench_")
+    cfg = ["mega_soup", "--size", str(size), "--generations", str(gens),
+           "--checkpoint-every", str(max(1, gens // 3)), "--seed", "29",
+           "--sharded"]
+    env = dict(os.environ)
+    env["SRNN_SETUPS_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    out = {"size": size, "generations": gens, "processes": procs,
+           "tpu_pod": "wired, pending next TPU window: drive via "
+                      "scripts/tpu_window.sh with --stall-timeout-s so a "
+                      "wedge yields a triage bundle, not a dead row"}
+    try:
+        _hb("multihost", "solo", size=size, gens=gens)
+        t0 = time.monotonic()
+        solo = subprocess.run(
+            [sys.executable, "-m", "srnn_tpu.setups", *cfg,
+             "--root", os.path.join(root, "solo")],
+            env=env, cwd=repo, capture_output=True, text=True)
+        out["solo_wall_s"] = round(time.monotonic() - t0, 2)
+        if solo.returncode != 0:
+            # tracebacks and launcher diagnostics land on stderr; the
+            # stdout tail alone is progress chatter
+            out["error"] = f"solo leg rc={solo.returncode}: " \
+                + (solo.stderr[-400:] or solo.stdout[-400:])
+            return out
+        _hb("multihost", "launcher", processes=procs)
+        t0 = time.monotonic()
+        multi = subprocess.run(
+            [sys.executable, "-m", "srnn_tpu.distributed.launch",
+             "--processes", str(procs), "--", *cfg,
+             "--root", os.path.join(root, "dist")],
+            env=env, cwd=repo, capture_output=True, text=True)
+        out["multi_wall_s"] = round(time.monotonic() - t0, 2)
+        if multi.returncode != 0:
+            out["error"] = f"launcher leg rc={multi.returncode}: " \
+                + (multi.stderr[-400:] or multi.stdout[-400:])
+            return out
+        out["solo_gens_per_sec"] = round(gens / out["solo_wall_s"], 3)
+        out["multi_gens_per_sec"] = round(gens / out["multi_wall_s"], 3)
+        out["process_tax"] = round(out["multi_wall_s"]
+                                   / out["solo_wall_s"], 2)
+        import glob as _glob
+
+        from srnn_tpu.experiment import restore_checkpoint
+
+        a = restore_checkpoint(
+            _glob.glob(os.path.join(root, "solo", "exp-*"))[0]
+            + f"/ckpt-gen{gens:08d}")
+        b = restore_checkpoint(
+            _glob.glob(os.path.join(root, "dist", "exp-*"))[0]
+            + f"/ckpt-gen{gens:08d}")
+        out["bitwise_equal"] = bool(
+            np.array_equal(np.asarray(a.weights), np.asarray(b.weights))
+            and np.array_equal(np.asarray(a.uids), np.asarray(b.uids)))
+        _hb("multihost", "done", bitwise=out["bitwise_equal"])
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def _child_stage(stage: str) -> None:
     """Run one stage and print its result on a sentinel stdout line."""
     # the dead-man's switch arms BEFORE the simulated/real wedge windows
@@ -471,6 +559,15 @@ def _child_stage(stage: str) -> None:
         # parent pins SRNN_BENCH_PLATFORM=cpu so a wedged tunnel cannot
         # eat the only leg that always lands)
         out = {"serve": _serve_leg(), "device_count": jax.device_count(),
+               "backend": platform + ("-forced" if forced_cpu else "")}
+        print(_SENTINEL + json.dumps(out), flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+    if stage == "multihost":
+        # the distributed-tier leg (host CPU, subprocess workers — this
+        # child only orchestrates and verifies)
+        out = {"multihost": _multihost_leg(),
+               "device_count": jax.device_count(),
                "backend": platform + ("-forced" if forced_cpu else "")}
         print(_SENTINEL + json.dumps(out), flush=True)
         sys.stdout.flush()
@@ -840,6 +937,23 @@ def _orchestrate(result):
                         tag="serve")
         if srv is not None and "serve" in srv:
             result["serve"] = srv["serve"]
+
+    # distributed-tier leg: CPU-pinned like serve (immune to the tunnel),
+    # bounded, rescue slice reserved — the round's BENCH row for the
+    # multi-host runtime (2-process CPU mesh, bitwise-verified; the TPU
+    # pod row stays wired-not-measured until the next window)
+    if MULTIHOST_TIMEOUT_S > 0:
+        mh_env = dict(env)
+        mh_env["SRNN_BENCH_PLATFORM"] = "cpu"
+        mh_env.pop("SRNN_BENCH_TEST_HANG", None)  # CPU leg never dials
+        mh = run_stage("multihost", 1,
+                       min(MULTIHOST_TIMEOUT_S,
+                           max(60.0, remaining() - RESCUE_RESERVE_S
+                               - 420)),
+                       stage_env=mh_env, reserve=RESCUE_RESERVE_S,
+                       tag="multihost")
+        if mh is not None and "multihost" in mh:
+            result["multihost"] = mh["multihost"]
 
     # compile-only warm-up: one bounded child fills the shared persistent
     # cache (ramp + full shapes), so the measurement children below

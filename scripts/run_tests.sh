@@ -135,6 +135,68 @@ fi
 rm -rf "$serve_root"
 summary+=$(printf '%-34s %-4s %4ss' "service_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Distributed smoke (srnn_tpu/distributed/): a REAL 2-process CPU-mesh
+# launcher run (gloo collectives, process-0-gated host I/O) must end
+# bitwise-equal to the single-process run of the same config, write each
+# run artifact exactly once (workers keep only per-process heartbeats),
+# and a SIGKILLed worker must propagate cleanly as 137 instead of
+# wedging the launcher.
+t0=$SECONDS
+dist_root=$(mktemp -d)
+dist_ok=1
+# share the pytest suite's persistent compile cache: three cold smoke
+# runs (solo + 2x launcher) would otherwise each repay XLA on this host
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_test_cache}"
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups mega_soup --smoke \
+    --seed 23 --root "$dist_root/solo" --lineage \
+    > "$dist_root/out.log" 2>&1 || dist_ok=0
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.distributed.launch \
+    --processes 2 -- mega_soup --smoke --seed 23 --sharded --lineage \
+    --root "$dist_root/dist" >> "$dist_root/out.log" 2>&1 || dist_ok=0
+if [ "$dist_ok" -eq 1 ]; then
+    SRNN_SETUPS_PLATFORM=cpu python - "$dist_root" >> "$dist_root/out.log" 2>&1 <<'PY' || dist_ok=0
+import glob, json, sys
+import numpy as np
+from srnn_tpu.experiment import restore_checkpoint
+root = sys.argv[1]
+solo = glob.glob(root + "/solo/exp-*")[0]
+dist = glob.glob(root + "/dist/exp-*")[0]
+a = restore_checkpoint(solo + "/ckpt-gen00000006")
+b = restore_checkpoint(dist + "/ckpt-gen00000006")
+np.testing.assert_array_equal(np.asarray(a.weights), np.asarray(b.weights))
+np.testing.assert_array_equal(np.asarray(a.uids), np.asarray(b.uids))
+import os
+assert os.path.exists(dist + "/metrics.prom")
+assert os.path.exists(dist + "/events-p1.jsonl")
+wa = [r for r in map(json.loads, open(solo + "/lineage.jsonl")) if r.get("kind") == "window"]
+wb = [r for r in map(json.loads, open(dist + "/lineage.jsonl")) if r.get("kind") == "window"]
+assert len(wa) == len(wb) > 0
+for ra, rb in zip(wa, wb):
+    assert sorted(map(tuple, ra["edges"])) == sorted(map(tuple, rb["edges"]))
+    for k in ("fixpoints", "births_attack", "births_respawn", "next_pid"):
+        assert ra[k] == rb[k], k
+print("distributed_smoke: bitwise parity + process-0 gating OK")
+PY
+fi
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.distributed.launch \
+    --processes 2 --grace-s 5 --max-reramps 0 -- mega_soup --smoke \
+    --seed 23 --sharded --root "$dist_root/kill" --chaos sigkill@2 \
+    >> "$dist_root/out.log" 2>&1
+rc=$?
+if [ "$rc" -ne 137 ]; then
+    echo "distributed_smoke: killed-worker propagation rc=$rc (want 137)" \
+        >> "$dist_root/out.log"
+    dist_ok=0
+fi
+if [ "$dist_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("distributed_smoke")
+    tail -n 40 "$dist_root/out.log"
+fi
+rm -rf "$dist_root"
+summary+=$(printf '%-34s %-4s %4ss' "distributed_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 echo
 echo "=== run_tests.sh summary ==="
 printf '%s' "$summary"

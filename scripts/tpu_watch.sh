@@ -26,6 +26,8 @@ while true; do
         #   0  clean            3  recovered (succeeded after restarts)
         #   75 preempted-clean  (SIGTERM honored; checkpoint resumable)
         #   69 retries-exhausted (recovery budget spent)
+        #   71 host-lost        (a distributed peer/coordinator died and
+        #                        the launcher's re-ramp budget is spent)
         case "$rc" in
             0)  echo "$(date -u +%FT%TZ) tpu_watch: window capture complete" >> "$LOG"
                 exit 0 ;;
@@ -33,6 +35,7 @@ while true; do
                 exit 0 ;;
             75) echo "$(date -u +%FT%TZ) tpu_watch: preempted-clean — resumable checkpoint on disk; watching for the next window" >> "$LOG" ;;
             69) echo "$(date -u +%FT%TZ) tpu_watch: retries exhausted inside the window; watching for the next window" >> "$LOG" ;;
+            71) echo "$(date -u +%FT%TZ) tpu_watch: host lost beyond the launcher's re-ramp budget; checkpoint resumable — watching for the next window" >> "$LOG" ;;
             *)  echo "$(date -u +%FT%TZ) tpu_watch: capture failed (rc=$rc, possible wedge); resuming watch" >> "$LOG" ;;
         esac
     fi

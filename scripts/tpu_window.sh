@@ -85,7 +85,7 @@ rc=$?
 case "$rc" in
     0) ;;
     3) echo "mega_soup recovered after in-run restart(s); run completed" ;;
-    75|69) echo "mega_soup exited $rc (supervisor); rows above still stand"
+    75|69|71) echo "mega_soup exited $rc (supervisor); rows above still stand"
            exit "$rc" ;;
     *) echo "mega_soup failed (rc=$rc); rows above still stand" ;;
 esac

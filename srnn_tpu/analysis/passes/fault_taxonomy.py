@@ -38,7 +38,10 @@ MAIN_REL = "srnn_tpu/setups/__main__.py"
 WATCH_SCRIPTS = ("scripts/tpu_watch.sh", "scripts/tpu_window.sh")
 
 #: the taxonomy exception types whose raise sites must classify
-TAXONOMY_EXCEPTIONS = ("StallError", "WriterError", "Preempted")
+#: (HostLost/CoordinatorTimeout are the distributed tier's host-loss
+#: faults — chaos and bootstrap raise them, classify_fault must map them)
+TAXONOMY_EXCEPTIONS = ("StallError", "WriterError", "Preempted",
+                       "HostLost", "CoordinatorTimeout")
 
 #: the canonical XLA/absl status vocabulary (status.proto)
 XLA_STATUSES = frozenset({

@@ -101,9 +101,13 @@ TRACED_FLAGS = frozenset({"lineage_state"})
 
 AOT_REL = "srnn_tpu/utils/aot.py"
 #: modules whose dispatches the warmup-coverage check walks: the setups
-#: (production entry points) and the experiment service (its executors
-#: dispatch the same surfaces plus the stacked twins)
-DISPATCH_PREFIXES = ("srnn_tpu/setups/", "srnn_tpu/serve/")
+#: (production entry points), the experiment service (its executors
+#: dispatch the same surfaces plus the stacked twins), and the
+#: distributed tier (its entry points ride the same sharded surfaces —
+#: a distributed dispatch that reached an unwarmed spelling would repay
+#: the compile on EVERY process at once)
+DISPATCH_PREFIXES = ("srnn_tpu/setups/", "srnn_tpu/serve/",
+                     "srnn_tpu/distributed/")
 
 
 def _find_def(tree: ast.AST, name: str) -> Optional[ast.FunctionDef]:

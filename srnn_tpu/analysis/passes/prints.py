@@ -26,6 +26,7 @@ ALLOWED_FILES = {
     "telemetry/report.py",   # CLI: renders the telemetry summary
     "analysis/__main__.py",  # CLI: this analyzer's own report output
     "serve/__main__.py",     # CLI: service startup line + stats JSON
+    "distributed/launch.py",  # CLI: worker-output relay IS its stdout job
 }
 #: CLI entry-point trees (every setup is a __main__-dispatched script)
 ALLOWED_DIRS = ("setups/",)

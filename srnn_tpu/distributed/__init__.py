@@ -1,0 +1,29 @@
+"""The distributed runtime tier: multi-host / multislice mega runs.
+
+Three layers (DESIGN §16):
+
+  * ``bootstrap`` — per-process ``jax.distributed`` bring-up from the
+    launcher's env vars or explicit flags; hardened for TPU pods AND
+    multi-process CPU meshes (gloo collectives), idempotent, no-op for
+    single-process runs.
+  * ``hostio`` — the process-0 I/O contract: collective host gathers
+    (``fetch_tree``), the run-dir broadcast, and the ``WorkerLog``
+    Experiment shim non-primary processes log through.
+  * ``launch`` — the process-restart tier: ``python -m
+    srnn_tpu.distributed.launch --processes N -- mega_soup …`` spawns the
+    workers, relays their output, re-ramps on host loss (fewer
+    processes, resumed from the last durable checkpoint) and propagates
+    exit codes cleanly.
+"""
+
+from .bootstrap import (CoordinatorTimeout, DistContext, HostLost,
+                        add_distributed_args, context, ensure_initialized)
+
+__all__ = [
+    "CoordinatorTimeout",
+    "DistContext",
+    "HostLost",
+    "add_distributed_args",
+    "context",
+    "ensure_initialized",
+]

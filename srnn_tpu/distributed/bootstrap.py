@@ -1,0 +1,194 @@
+"""Process bring-up for the distributed runtime tier.
+
+One mega run spanning many processes (TPU pod hosts, or a multi-process
+CPU mesh in CI) needs exactly three facts before jax touches a device:
+where the coordinator lives, how many processes participate, and which
+one this is.  The launcher (``distributed.launch``) exports them as
+``SRNN_DIST_*`` env vars; managed clusters can instead rely on jax's own
+cluster detection; explicit CLI flags (``--dist-coordinator`` etc.) win
+over both.  :func:`ensure_initialized` is the ONE entry every mega loop
+calls first — it is idempotent, a no-op for single-process runs (tests
+and solo runs never pay for it), and hardened for both TPU pods and
+multi-process CPU meshes (where it selects the gloo collectives
+implementation before the backend initializes).
+
+Failure vocabulary (classified by ``resilience.classify_fault``):
+
+  * :class:`CoordinatorTimeout` — the coordinator could not be reached
+    (or bring-up died) within ``SRNN_DIST_TIMEOUT_S``.  A wedged or dead
+    coordinator is indistinguishable from a lost host at this layer, so
+    both classify ``host_loss``.
+  * :class:`HostLost` — a peer process (a slice's host) is gone
+    mid-run.  Raised by the chaos injector's ``host_loss@G`` event and by
+    any runtime detection a backend offers; in a multi-process run the
+    supervisor converts it into :data:`resilience.EXIT_HOST_LOST` so the
+    launcher tier can re-ramp (fewer processes, resumed from the last
+    durable checkpoint — ``jax.distributed`` topology is fixed for a
+    process's lifetime, so in-process recovery is impossible across
+    hosts).  Single-process multislice runs recover in-process like a
+    device loss, re-ramping via ``parallel.reramp_soup_mesh``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+COORD_ENV = "SRNN_DIST_COORD"
+PROCS_ENV = "SRNN_DIST_PROCS"
+PID_ENV = "SRNN_DIST_PID"
+TIMEOUT_ENV = "SRNN_DIST_TIMEOUT_S"
+
+#: default bring-up deadline: long enough for a pod's stragglers, short
+#: enough that CI notices a dead coordinator inside one test timeout
+DEFAULT_TIMEOUT_S = 120.0
+
+
+class CoordinatorTimeout(Exception):
+    """Distributed bring-up failed: the coordinator never answered (or
+    rejected us) within the deadline.  Classified ``host_loss``."""
+
+
+class HostLost(Exception):
+    """A peer process (slice host) is gone mid-run.  Classified
+    ``host_loss``: multi-process runs exit ``EXIT_HOST_LOST`` for the
+    launcher tier to re-ramp; single-process multislice runs re-ramp
+    in-process from the surviving slices."""
+
+
+class DistContext:
+    """What one process knows about the distributed run it belongs to."""
+
+    def __init__(self, active: bool, process_id: int = 0,
+                 num_processes: int = 1,
+                 coordinator: Optional[str] = None):
+        self.active = active
+        self.process_id = int(process_id)
+        self.num_processes = int(num_processes)
+        self.coordinator = coordinator
+
+    @property
+    def primary(self) -> bool:
+        """Process 0 owns ALL host I/O except per-process heartbeats
+        (the process-0 I/O contract, DESIGN §16)."""
+        return self.process_id == 0
+
+    def __repr__(self):
+        return (f"DistContext(active={self.active}, "
+                f"process={self.process_id}/{self.num_processes})")
+
+
+#: the process-wide bring-up result; ``jax.distributed`` can initialize
+#: once per process, so this is initialize-once by construction
+_CONTEXT: Optional[DistContext] = None
+
+_INACTIVE = DistContext(active=False)
+
+
+def _resolve(args) -> "tuple[Optional[str], Optional[int], Optional[int]]":
+    """(coordinator, num_processes, process_id) from CLI flags first
+    (explicit wins), then the launcher's env vars; all-``None`` means
+    single-process."""
+    coord = getattr(args, "dist_coordinator", None) if args is not None \
+        else None
+    nproc = getattr(args, "dist_processes", None) if args is not None \
+        else None
+    pid = getattr(args, "dist_process_id", None) if args is not None \
+        else None
+    if coord is None and nproc is None and pid is None:
+        coord = os.environ.get(COORD_ENV) or None
+        if coord:
+            nproc = int(os.environ.get(PROCS_ENV, "0") or 0) or None
+            pid = int(os.environ.get(PID_ENV, "-1"))
+            pid = pid if pid >= 0 else None
+    return coord, nproc, pid
+
+
+def _cpu_backend_selected() -> bool:
+    """Will jax resolve to the CPU backend?  Checked WITHOUT touching
+    devices (bring-up must precede the first device probe).  The setups'
+    config-level pin (``SRNN_SETUPS_PLATFORM``/``force_cpu``) and the
+    env-level pin both count."""
+    if os.environ.get("SRNN_SETUPS_PLATFORM") == "cpu":
+        return True
+    import jax
+
+    cfg = getattr(jax.config, "jax_platforms", None) or ""
+    env = os.environ.get("JAX_PLATFORMS", "")
+    return "cpu" in (cfg or env).split(",")[:1]
+
+
+def ensure_initialized(args=None) -> DistContext:
+    """Idempotent multi-process bring-up; returns the process's
+    :class:`DistContext` (``active=False`` for plain single-process
+    runs).  Must run before anything probes devices."""
+    global _CONTEXT
+    if _CONTEXT is not None:
+        return _CONTEXT
+    coord, nproc, pid = _resolve(args)
+    if coord is None and nproc is None and pid is None:
+        _CONTEXT = _INACTIVE
+        return _CONTEXT
+    if coord is None or nproc is None or pid is None:
+        # a PARTIAL spec must fail loudly: silently running solo would
+        # leave the correctly-configured peers blocking on a coordinator
+        # that never forms until their bring-up timeout
+        raise SystemExit(
+            "distributed bring-up needs all three of coordinator address, "
+            "process count and process id (SRNN_DIST_COORD/_PROCS/_PID or "
+            "--dist-coordinator/--dist-processes/--dist-process-id); got "
+            f"coordinator={coord!r}, processes={nproc!r}, id={pid!r}")
+    if int(nproc) <= 1:
+        # a 1-process "distributed" job (the launcher's re-ramp floor) is
+        # just a solo run — no coordinator needed
+        _CONTEXT = _INACTIVE
+        return _CONTEXT
+    import jax
+
+    if _cpu_backend_selected():
+        # multi-process CPU meshes need a cross-process collectives
+        # implementation; gloo is the one jaxlib ships.  Harmless if the
+        # run later resolves to a non-CPU backend (the option is only
+        # consulted by the CPU client).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # pragma: no cover - older jaxlib without gloo
+            print("distributed: this jaxlib has no CPU collectives "
+                  "implementation; multi-process CPU meshes will fail at "
+                  "the first collective", file=sys.stderr, flush=True)
+    timeout = float(os.environ.get(TIMEOUT_ENV, "") or DEFAULT_TIMEOUT_S)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=int(nproc),
+            process_id=int(pid), initialization_timeout=int(timeout))
+    except Exception as e:
+        raise CoordinatorTimeout(
+            f"distributed bring-up failed for process {pid}/{nproc} "
+            f"(coordinator {coord}, timeout {timeout:g}s): "
+            f"{type(e).__name__}: {e}") from e
+    _CONTEXT = DistContext(active=True, process_id=int(pid),
+                           num_processes=int(nproc), coordinator=coord)
+    print(f"distributed: process {pid}/{nproc} up "
+          f"(coordinator {coord}, {jax.local_device_count()} local / "
+          f"{jax.device_count()} global devices)", file=sys.stderr,
+          flush=True)
+    return _CONTEXT
+
+
+def context() -> DistContext:
+    """The bring-up result so far (inactive when nothing initialized)."""
+    return _CONTEXT if _CONTEXT is not None else _INACTIVE
+
+
+def add_distributed_args(p):
+    """The explicit-flag spelling of the launcher env vars, for driving a
+    worker by hand (managed clusters usually auto-detect instead)."""
+    p.add_argument("--dist-coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator address (usually set "
+                        "via SRNN_DIST_COORD by distributed.launch)")
+    p.add_argument("--dist-processes", type=int, default=None, metavar="N",
+                   help="total process count of the distributed run")
+    p.add_argument("--dist-process-id", type=int, default=None, metavar="I",
+                   help="this process's id (0 = primary, owns host I/O)")
+    return p

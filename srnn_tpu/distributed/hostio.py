@@ -1,0 +1,135 @@
+"""Process-0-gated host I/O for distributed runs.
+
+The contract (DESIGN §16): a distributed mega run produces EXACTLY the
+artifact set a single-process run produces — one ``log.txt``, one
+``events.jsonl``, one ``metrics.prom``, one ``lineage.jsonl``, one
+checkpoint stream — written by process 0 alone.  Every other process
+contributes through the device-side psum/gather shard boundaries the
+sharded evolve paths already have, plus the host-side collective gathers
+here; the only per-process files are heartbeats (``events-p<i>.jsonl``,
+so the watch tier can tell a wedged worker from a wedged coordinator)
+and the capture store's per-process ``.traj`` shards (merged offline,
+pre-existing contract).
+
+Collective discipline: :func:`fetch_tree` dispatches cross-process
+gathers, so every process MUST call it at the same point of the loop in
+the same order — the mega loops call it synchronously on the loop thread
+(never from the background writer, whose thread would interleave
+collectives differently per process and deadlock the mesh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+#: fixed broadcast frame for the run-dir announcement (paths longer than
+#: this are refused at broadcast time, not corrupted)
+_RUN_DIR_FRAME = 1024
+
+
+def fetch_tree(tree):
+    """Materialize a (possibly multi-process-sharded) pytree on host.
+
+    Replicated leaves resolve locally; particle-sharded leaves gather via
+    ``multihost_utils.process_allgather`` (a collective — see the module
+    docstring for the ordering contract).  Typed PRNG keys (always
+    replicated) round-trip through their raw key data so the returned
+    tree still checkpoint-saves like a live state.  Single-process trees
+    pass through as plain numpy, so callers need no mode split."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    def one(x):
+        if not isinstance(x, jax.Array):
+            return x
+        if jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            data = one(jax.random.key_data(x))
+            return jax.random.wrap_key_data(
+                np.asarray(data), impl=str(jax.random.key_impl(x)))
+        if x.is_fully_addressable or x.sharding.is_fully_replicated:
+            return np.asarray(x)
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    return jax.tree.map(one, tree)
+
+
+def broadcast_run_dir(run_dir) -> str:
+    """Announce the primary's run directory to every process (process 0
+    passes the path, everyone else ``None``) — the one piece of host
+    state workers need that only process 0 can mint (the Experiment dir
+    name embeds a timestamp)."""
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(_RUN_DIR_FRAME, np.uint8)
+    if run_dir:
+        raw = os.path.abspath(run_dir).encode()
+        if len(raw) > _RUN_DIR_FRAME:
+            raise ValueError(f"run dir path over {_RUN_DIR_FRAME} bytes: "
+                             f"{run_dir!r}")
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    # the broadcast is a psum under the hood and may promote the dtype
+    # (uint8 -> int32 observed); cast back before reading the bytes
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf)).astype(
+        np.uint8)
+    path = bytes(out).rstrip(b"\x00").decode()
+    if not path:
+        raise RuntimeError("run-dir broadcast produced an empty path "
+                           "(primary announced before creating its "
+                           "Experiment?)")
+    return path
+
+
+class WorkerLog:
+    """Experiment-shaped sink for NON-primary processes.
+
+    ``log()`` prints to stderr with a ``[p<i>]`` prefix (the launcher
+    already prefixes each worker's stream, so a worker's narration stays
+    attributable without duplicating the run log), and ``event()``
+    appends to the per-process ``events-p<i>.jsonl`` — which is where
+    this process's heartbeats land.  Everything else an Experiment offers
+    (artifact saves, the exit-time ``log.txt``/``meta.json``) is the
+    primary's job and no-ops here."""
+
+    def __init__(self, run_dir: str, process_id: int):
+        self.dir = run_dir
+        self.process_id = int(process_id)
+        self.seed = None
+        self._t0 = time.time()
+        self._lock = threading.Lock()
+        self._events = open(
+            os.path.join(run_dir, f"events-p{self.process_id}.jsonl"), "a")
+
+    # -- Experiment surface used by the mega loops -----------------------
+
+    def log(self, message, **event_fields):
+        print(f"[p{self.process_id}] {message}", file=sys.stderr, flush=True)
+        if event_fields:
+            self.event(message=str(message), **event_fields)
+
+    def event(self, _fsync: bool = False, **fields):
+        fields.setdefault("t", time.time() - self._t0)
+        fields.setdefault("process", self.process_id)
+        with self._lock:
+            self._events.write(json.dumps(fields, default=str) + "\n")
+            self._events.flush()
+            if _fsync:
+                os.fsync(self._events.fileno())
+
+    def save(self, **kwargs):
+        return {}
+
+    def save_log(self, log_name: str = "log"):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        self._events.close()
+        return False

@@ -334,16 +334,24 @@ def _finalize_checkpoint(path: str, time_value) -> None:
                       json.dumps({"time": int(time_value)}) + "\n")
 
 
-def save_checkpoint(path: str, state: SoupState) -> str:
+def save_checkpoint(path: str, state: SoupState, primary: bool = True) -> str:
     """Write a resumable checkpoint of a soup (weights + uids + PRNG key +
     generation counter) at ``path`` (a directory, created fresh), then
-    publish its completion marker (write-tmp + fsync + atomic rename)."""
+    publish its completion marker (write-tmp + fsync + atomic rename).
+
+    In a multi-process run EVERY process must call this with the same
+    (host-gathered) state and at the same point of its loop: orbax's
+    multihost machinery barriers across processes and writes each array
+    once.  ``primary=False`` marks the non-0 processes, which then skip
+    the completion marker (one marker, written by the process that owns
+    run-dir I/O)."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, _soup_state_to_pytree(state), force=True)
-    _finalize_checkpoint(path, state.time)
+    if primary:
+        _finalize_checkpoint(path, state.time)
     return path
 
 
@@ -358,9 +366,10 @@ def restore_checkpoint(path: str) -> SoupState:
     return _soup_state_from_pytree(tree)
 
 
-def save_multi_checkpoint(path: str, state) -> str:
+def save_multi_checkpoint(path: str, state, primary: bool = True) -> str:
     """Resumable checkpoint of a heterogeneous (``MultiSoupState``) soup:
-    per-type weights/uids lists + scalars + raw PRNG key data."""
+    per-type weights/uids lists + scalars + raw PRNG key data.  The
+    multi-process contract matches :func:`save_checkpoint`."""
     import orbax.checkpoint as ocp
 
     tree = {
@@ -374,7 +383,8 @@ def save_multi_checkpoint(path: str, state) -> str:
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, tree, force=True)
-    _finalize_checkpoint(path, state.time)
+    if primary:
+        _finalize_checkpoint(path, state.time)
     return path
 
 

@@ -1,5 +1,6 @@
 from .mesh import (soup_mesh, shard_population, replicate,
-                   initialize_distributed, probe_devices)
+                   initialize_distributed, probe_devices,
+                   global_device_put)
 from .sharded_soup import (
     make_sharded_state,
     place_sharded_state,
@@ -36,6 +37,7 @@ __all__ = [
     "reramp_soup_mesh",
     "slice_groups",
     "soup_mesh",
+    "global_device_put",
     "shard_population",
     "replicate",
     "initialize_distributed",

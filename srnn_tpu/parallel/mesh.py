@@ -55,21 +55,52 @@ def probe_devices(verify: bool = False):
     return alive
 
 
+def global_device_put(x, sharding):
+    """``jax.device_put`` that also works when ``sharding`` spans devices
+    owned by OTHER processes (a multi-host mesh).
+
+    The distributed contract that makes this correct: every process holds
+    the same full host value ``x`` (seeds are deterministic functions of
+    the replicated PRNG key; checkpoint restores read the same files), so
+    each process contributes exactly its addressable shards via
+    ``jax.make_array_from_callback`` and no data ever crosses DCN for
+    placement.  Typed PRNG keys round-trip through their raw key data —
+    they are only ever replicated (spec ``P()``), which holds for any
+    rank, so the same sharding places the ``(… , impl)`` data array."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+            x.dtype, jax.dtypes.prng_key):
+        data = np.asarray(jax.random.key_data(x))
+        impl = str(jax.random.key_impl(x))
+        g = jax.make_array_from_callback(data.shape, sharding,
+                                         lambda idx: data[idx])
+        return jax.random.wrap_key_data(g, impl=impl)
+    host = np.asarray(x)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
 def shard_population(mesh: Mesh, pop: jax.Array) -> jax.Array:
     """Place a (N, ...) population with the leading axis sharded over the mesh."""
-    return jax.device_put(pop, NamedSharding(mesh, P(SOUP_AXIS)))
+    return global_device_put(pop, NamedSharding(mesh, P(SOUP_AXIS)))
 
 
 def replicate(mesh: Mesh, x) -> jax.Array:
     """Place a value fully replicated over the mesh (e.g. the shared
     ``self_flat`` argument of ``ring_rnn_apply``)."""
-    return jax.device_put(x, NamedSharding(mesh, P()))
+    return global_device_put(x, NamedSharding(mesh, P()))
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> bool:
     """Multi-host bring-up (DCN): wraps ``jax.distributed.initialize``.
+
+    Legacy auto-detect spelling; production bring-up is
+    ``distributed.bootstrap.ensure_initialized`` (idempotent, launcher
+    env vars, gloo CPU collectives, host-loss fault typing) — the mega
+    loops go through that path.
 
     No-op (returns False) when neither explicit arguments nor cluster env
     vars (``JAX_COORDINATOR_ADDRESS`` / TPU pod metadata) are present, so

@@ -1,14 +1,23 @@
 """Multi-slice mesh construction (DCN tier of SURVEY §2.5).
 
 Single-slice soups scale over ICI via ``sharded_soup`` + ``soup_mesh``;
-process bring-up is ``mesh.initialize_distributed``.  Beyond one slice
-(multi-pod), the mesh needs an outer axis spanning slices over DCN with the
-inner axis staying on ICI.  The collectives in ``sharded_soup`` are
-axis-name-agnostic, so the same ``shard_map`` body runs unchanged on these
-meshes — the all-gather of a mega-soup's weight matrix is the only
+process bring-up is ``distributed.bootstrap`` (wrapping
+``jax.distributed``).  Beyond one slice (multi-pod, or a multi-process
+CPU mesh in CI), the mesh needs an outer axis spanning slices over DCN
+with the inner axis staying on ICI.  The collectives in ``sharded_soup``
+are axis-name-agnostic, so the same ``shard_map`` body runs unchanged on
+these meshes — the all-gather of a mega-soup's weight matrix is the only
 DCN-crossing traffic, one fused collective per generation.
+
+Since the distributed tier landed, :func:`reramp_soup_mesh` is the LIVE
+mesh builder for every multislice run (``setups.common.build_soup_mesh``
+routes through it at bring-up AND after a loss), not just recovery
+documentation: the mega loops publish their population sizes and this
+module picks the largest regular mesh the survivors support whose device
+count divides every published shard.
 """
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -18,6 +27,10 @@ from jax.sharding import Mesh
 from .mesh import SOUP_AXIS
 
 DCN_AXIS = "slices"
+
+#: CI/bring-up override: partition an otherwise-flat topology into N
+#: equal contiguous slice groups (see :func:`slice_groups`)
+FORCE_SLICES_ENV = "SRNN_FORCE_SLICES"
 
 
 def multislice_soup_mesh(num_slices: int,
@@ -34,38 +47,83 @@ def multislice_soup_mesh(num_slices: int,
     return Mesh(grid, (DCN_AXIS, SOUP_AXIS))
 
 
-def slice_groups(devices) -> "list[list]":
+def slice_groups(devices, force_slices: Optional[int] = None) -> "list[list]":
     """Partition devices by the slice they live on, parsed from whatever
     topology attributes the platform exposes (``slice_index`` on TPU,
     ``process_index`` as the multi-host fallback, one group when neither
     varies) — the mesh-from-topology idiom: derive placement from the
     devices actually present instead of from a config that described the
-    hardware the run *used to* have."""
+    hardware the run *used to* have.
+
+    ``force_slices`` (or env ``SRNN_FORCE_SLICES``) splits an
+    otherwise-FLAT topology into N equal contiguous groups — the
+    CI/bring-up knob that lets the multislice tier (2-D mesh, host-loss
+    re-ramp) run on a single process whose devices expose no slice
+    structure.  A real topology (distinct slice/process indices) always
+    wins over the override, and an override that does not divide the
+    device count is ignored (a ragged forced grid would only fail later
+    in mesh construction)."""
+    devices = list(devices)
+    # slice_index wins when it actually VARIES; a constant value (CPU
+    # devices expose slice_index=0 on every process) carries no topology
+    # information and would hide the per-process structure a multi-host
+    # CPU mesh does have
+    slice_keys = [getattr(d, "slice_index", None) for d in devices]
+    use_slice = not any(k is None for k in slice_keys) \
+        and len(set(slice_keys)) > 1
     groups: "dict[int, list]" = {}
     for d in devices:
-        key = getattr(d, "slice_index", None)
+        key = getattr(d, "slice_index", None) if use_slice else None
         if key is None:
             key = getattr(d, "process_index", 0) or 0
         groups.setdefault(int(key), []).append(d)
-    return [groups[k] for k in sorted(groups)]
+    out = [groups[k] for k in sorted(groups)]
+    if len(out) == 1:
+        if force_slices is None:
+            force_slices = int(os.environ.get(FORCE_SLICES_ENV, "0") or 0)
+        flat = out[0]
+        if force_slices > 1 and len(flat) >= force_slices \
+                and len(flat) % force_slices == 0:
+            per = len(flat) // force_slices
+            out = [flat[i * per:(i + 1) * per] for i in range(force_slices)]
+    return out
 
 
-def reramp_soup_mesh(devices=None) -> Mesh:
-    """Rebuild the largest *regular* mesh the SURVIVING devices support —
-    the topology re-ramp step after a partial loss (a preempted slice, a
-    dead host).  Slices that kept their full (modal) chip count form the
-    DCN axis of a fresh ``(slices, soup)`` mesh; when fewer than two
-    whole slices survive — or the survivors are ragged — the largest
-    single intact group becomes a 1-D soup mesh, ICI-only.  Raises
-    ``ValueError`` when nothing survives (the supervisor then degrades
-    to the process-restart tier, ``scripts/tpu_watch.sh``)."""
+def reramp_soup_mesh(devices=None, shard_sizes: Sequence[int] = ()) -> Mesh:
+    """Build the largest *regular* mesh the given devices support — the
+    live mesh builder for multislice runs, at bring-up and after a
+    partial loss (a preempted slice, a dead host).
+
+    Slices that kept their full (modal) chip count form the DCN axis of a
+    ``(slices, soup)`` mesh; when fewer than two whole slices remain — or
+    the survivors are ragged — the largest single intact group becomes a
+    1-D soup mesh, ICI-only.  ``shard_sizes`` (the population sizes the
+    loops publish) constrains the choice to device counts the shards
+    actually divide over: trailing whole slices are dropped first (a
+    2-slice grid whose total does not divide snaps to fewer slices before
+    giving up regularity), then the 1-D fallback shrinks its device
+    count — the same divisor snap ``AttemptContext.mesh_devices`` applies
+    to 1-D budgets, made slice-aware.  Raises ``ValueError`` when nothing
+    survives (the supervisor then degrades to the process-restart tier,
+    ``scripts/tpu_watch.sh`` / the ``distributed.launch`` re-ramp)."""
     devs = list(devices if devices is not None else jax.devices())
     if not devs:
         raise ValueError("no surviving devices to re-ramp onto")
+    sizes = tuple(int(s) for s in shard_sizes)
+
+    def divides(n: int) -> bool:
+        return n > 0 and not any(s % n for s in sizes)
+
     groups = slice_groups(devs)
-    sizes = [len(g) for g in groups]
-    modal = max(set(sizes), key=lambda s: (sizes.count(s), s))
+    lens = [len(g) for g in groups]
+    modal = max(set(lens), key=lambda s: (lens.count(s), s))
     whole = [g for g in groups if len(g) == modal]
+    while len(whole) >= 2 and not divides(len(whole) * modal):
+        whole.pop()
     if len(whole) >= 2:
         return Mesh(np.asarray(whole), (DCN_AXIS, SOUP_AXIS))
-    return Mesh(np.asarray(max(groups, key=len)), (SOUP_AXIS,))
+    best = max(groups, key=len)
+    n = len(best)
+    while n > 1 and not divides(n):
+        n -= 1
+    return Mesh(np.asarray(best[:n]), (SOUP_AXIS,))

@@ -709,9 +709,10 @@ def place_sharded_multi_state(mesh: Mesh, state: MultiSoupState
                 f"type-{t} population {w.shape[0]} must be divisible by the "
                 f"mesh's {n_dev} devices (each device owns an equal shard "
                 "per type)")
+    from .mesh import global_device_put
     specs = _mstate_specs(len(state.weights))
     return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        lambda x, spec: global_device_put(x, NamedSharding(mesh, spec)),
         state, specs)
 
 

@@ -775,9 +775,11 @@ def place_sharded_state(mesh: Mesh, state: SoupState) -> SoupState:
         raise ValueError(
             f"soup size {n} must be divisible by the mesh's {n_dev} devices "
             f"(each device owns an equal shard)")
+    from .mesh import global_device_put
     specs = _state_specs(_soup_axes(mesh))
     return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), state, specs)
+        lambda x, spec: global_device_put(x, NamedSharding(mesh, spec)),
+        state, specs)
 
 
 def make_sharded_state(config: SoupConfig, mesh: Mesh, key: jax.Array) -> SoupState:

@@ -3,8 +3,9 @@ re-ramp and the deterministic chaos harness (see ``supervisor`` and
 ``chaos`` module docstrings, and DESIGN.md §13)."""
 
 from .chaos import ChaosEvent, ChaosMonkey, parse_schedule
-from .supervisor import (DEVICE_LOSS, EXIT_CODE_NAMES, EXIT_PREEMPTED_CLEAN,
-                         EXIT_RECOVERED, EXIT_RETRIES_EXHAUSTED, FATAL, IO,
+from .supervisor import (DEVICE_LOSS, EXIT_CODE_NAMES, EXIT_HOST_LOST,
+                         EXIT_PREEMPTED_CLEAN, EXIT_RECOVERED,
+                         EXIT_RETRIES_EXHAUSTED, FATAL, HOST_LOSS, IO,
                          PREEMPT, RETRYABLE, STALL, AttemptContext,
                          BackoffPolicy, Preempted, Supervisor,
                          classify_fault, exit_code_for_report,

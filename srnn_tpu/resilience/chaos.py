@@ -22,6 +22,21 @@ exact, reproducible points of a mega run:
     writer (1-based, counted per attempt) with a permanent ``EIO``:
     exercises the writer's first-error latch, the job-naming error
     message, and the supervisor's ``io`` retry.
+  * ``host_loss@G[:H]`` — raise :class:`~srnn_tpu.distributed.HostLost`
+    at the top of the chunk starting at generation ``G``, routed through
+    the production classifier (kind ``host_loss``).  ``H`` names the
+    slice group that "died" (0-based, ``parallel.slice_groups`` order;
+    default: the last group): the supervisor's survivor probe then
+    reports every device EXCEPT that group's, which is how a whole-slice
+    loss — and the re-ramp onto the largest regular surviving mesh — is
+    drilled on a host whose slices cannot actually die.  In a
+    multi-process run the supervisor instead exits ``EXIT_HOST_LOST``
+    and the launcher tier re-ramps (fewer processes).
+  * ``coordinator_timeout@G`` — raise
+    :class:`~srnn_tpu.distributed.CoordinatorTimeout` at the chunk
+    boundary: same classifier kind (a dead coordinator is a lost host as
+    far as recovery goes), no survivor override — the probe sees the
+    real topology.
   * ``sigterm@G`` — ``kill(self, SIGTERM)`` at the chunk boundary: the
     real signal, the real handler, the graceful-preemption drain.
   * ``sigkill@G`` — ``kill(self, SIGKILL)``: no cleanup of any kind —
@@ -42,7 +57,8 @@ import signal
 import threading
 from typing import Callable, List, Optional
 
-KINDS = ("device_loss", "stall", "writer", "sigterm", "sigkill")
+KINDS = ("device_loss", "host_loss", "coordinator_timeout", "stall",
+         "writer", "sigterm", "sigkill")
 
 #: how long a condemned finisher holds before giving up on an abort (the
 #: supervisor aborts it within one backoff; this is the safety net)
@@ -91,9 +107,61 @@ def parse_schedule(spec: str) -> List[ChaosEvent]:
             raise ValueError(
                 f"writer job ordinals are 1-based: {entry!r} would never "
                 "fire (the first submitted job is writer@1)")
+        if kind == "host_loss" and arg is not None and arg != int(arg):
+            raise ValueError(
+                f"host_loss slice-group ordinal must be an integer: "
+                f"{entry!r}")
+        if kind == "coordinator_timeout" and arg is not None:
+            raise ValueError(
+                f"coordinator_timeout takes no argument: {entry!r} (there "
+                "is no survivor override — the probe sees the real "
+                "topology)")
         events.append(ChaosEvent(kind, at, arg))
     events.sort(key=lambda e: e.at)
     return events
+
+
+def _surviving_after_group_loss(group: Optional[int]) -> "tuple[list, int]":
+    """(surviving devices, lost-group ordinal) after slice group
+    ``group`` (default: the last) of the CURRENT topology dies — the
+    forced-survivor list the supervisor's probe consumes.  A spec that
+    cannot fire as written (ordinal past the live groups, or a topology
+    with nothing left to survive) fails LOUDLY — the ordinal cannot be
+    validated at parse time because the group count is only known at
+    fire time, so this is where the writer@0-style strictness lives."""
+    import jax
+
+    from ..parallel.multihost import slice_groups
+
+    groups = slice_groups(jax.devices())
+    g = len(groups) - 1 if group is None else int(group)
+    if g >= len(groups):
+        raise ValueError(
+            f"--chaos host_loss slice-group ordinal {g} is out of range: "
+            f"the live topology has {len(groups)} slice group(s)")
+    if len(groups) <= 1:
+        raise ValueError(
+            "--chaos host_loss would leave no surviving slice (the live "
+            "topology has a single group); use device_loss@G[:S], or "
+            "shape the topology with SRNN_FORCE_SLICES")
+    return [d for i, grp in enumerate(groups) if i != g for d in grp], g
+
+
+def _raise_host_loss(gen: int, group: Optional[int]) -> None:
+    """Raise the typed host-loss fault the distributed runtime raises, so
+    the classifier's production ``host_loss`` branch routes it."""
+    from ..distributed import HostLost
+
+    raise HostLost(
+        f"chaos: simulated host/slice loss at generation {gen}"
+        + (f" (slice group {group} lost)" if group is not None else ""))
+
+
+def _raise_coordinator_timeout(gen: int) -> None:
+    from ..distributed import CoordinatorTimeout
+
+    raise CoordinatorTimeout(
+        f"chaos: simulated coordinator timeout at generation {gen}")
 
 
 def _raise_device_loss(gen: int, survivors: Optional[int]) -> None:
@@ -120,6 +188,10 @@ class ChaosMonkey:
         #: ``take_forced_live`` so only the event that set it is
         #: simulated — later losses probe for real)
         self.forced_live = 0
+        #: surviving-device LIST after a host_loss event (None = no
+        #: override; consumed by ``take_forced_survivors`` — one probe
+        #: per event, like ``forced_live``)
+        self.forced_survivors: Optional[list] = None
         # one release event PER condemned finisher: a global flag would
         # stay set after the first recovery and make every later stall
         # event skip its finisher silently instead of stalling
@@ -161,6 +233,21 @@ class ChaosMonkey:
                 if ev.arg:
                     self.forced_live = int(ev.arg)
                 _raise_device_loss(gen, int(ev.arg) if ev.arg else None)
+            elif ev.kind == "host_loss":
+                from ..distributed import context
+
+                if context().active:
+                    # multi-process: the survivor override is moot — the
+                    # supervisor exits EXIT_HOST_LOST without probing and
+                    # the LAUNCHER re-ramps the topology
+                    _raise_host_loss(gen, int(ev.arg)
+                                     if ev.arg is not None else None)
+                survivors, lost = _surviving_after_group_loss(
+                    int(ev.arg) if ev.arg is not None else None)
+                self.forced_survivors = survivors
+                _raise_host_loss(gen, lost)
+            elif ev.kind == "coordinator_timeout":
+                _raise_coordinator_timeout(gen)
             elif ev.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
             elif ev.kind == "sigkill":  # pragma: no cover - kills the proc
@@ -228,6 +315,13 @@ class ChaosMonkey:
             holds, self._holds = self._holds, []
         for h in holds:
             h.set()
+
+    def take_forced_survivors(self) -> Optional[list]:
+        """Consume the simulated surviving-device list (None = none
+        pending): each ``host_loss@G[:H]`` overrides exactly ONE recovery
+        probe, so a later un-annotated loss probes the real topology."""
+        forced, self.forced_survivors = self.forced_survivors, None
+        return forced
 
     def take_forced_live(self) -> int:
         """Consume the simulated survivor count (0 = none pending): each

@@ -24,6 +24,17 @@ Fault taxonomy (:func:`classify_fault`):
     onto it.  TPU→CPU degradation needs a fresh process (a jax backend
     cannot be re-initialized in-process) — that tier is
     ``scripts/tpu_watch.sh``'s, driven by this module's exit codes.
+  * ``host_loss`` — :class:`~srnn_tpu.distributed.HostLost` (a peer
+    process / slice host is gone) or
+    :class:`~srnn_tpu.distributed.CoordinatorTimeout` (bring-up or a
+    barrier never reached the coordinator).  A single-process multislice
+    run recovers in-process like a device loss — the re-ramp rebuilds
+    the largest regular multislice mesh from the surviving slices
+    (``parallel.reramp_soup_mesh``).  A MULTI-process run cannot change
+    its ``jax.distributed`` membership in-process, so it exits
+    :data:`EXIT_HOST_LOST` and the launcher tier
+    (``distributed.launch``) re-ramps: fewer processes, resumed from the
+    last durable checkpoint.
   * ``stall`` — :class:`~srnn_tpu.utils.pipeline.StallError` from the
     ``ChunkDriver`` finisher deadline (device results never landed).
   * ``io`` — :class:`~srnn_tpu.utils.pipeline.WriterError` (a
@@ -56,6 +67,9 @@ Exit-code vocabulary (consumed by ``scripts/tpu_watch.sh`` and named by
     (CLI only; the Python API returns the run dir either way).
   * :data:`EXIT_RETRIES_EXHAUSTED` (69, ``EX_UNAVAILABLE``) — the
     retry budget is spent; the last traceback was printed.
+  * :data:`EXIT_HOST_LOST` (71, ``EX_OSERR``) — a multi-process run
+    lost a peer (or its coordinator); the launcher tier re-ramps with
+    fewer processes from the last durable checkpoint.
   * :data:`EXIT_PREEMPTED_CLEAN` (75, ``EX_TEMPFAIL``) — SIGTERM was
     honored with a graceful final checkpoint; resume when hardware
     returns.
@@ -75,23 +89,31 @@ from typing import Any, Callable, List, Optional
 # -- fault taxonomy ---------------------------------------------------------
 
 DEVICE_LOSS = "device_loss"
+HOST_LOSS = "host_loss"
 STALL = "stall"
 IO = "io"
 PREEMPT = "preempt"
 FATAL = "fatal"
 
 #: retryable faults (everything except PREEMPT, which exits clean, and
-#: FATAL, which re-raises)
-RETRYABLE = (DEVICE_LOSS, STALL, IO)
+#: FATAL, which re-raises).  HOST_LOSS is retryable only in-process for
+#: single-process runs (multislice CPU/TPU topologies re-ramp onto the
+#: surviving slices); a MULTI-process run cannot change its
+#: ``jax.distributed`` membership in-process, so HOST_LOSS there exits
+#: :data:`EXIT_HOST_LOST` for the launcher tier
+#: (``distributed.launch``) to re-ramp.
+RETRYABLE = (DEVICE_LOSS, HOST_LOSS, STALL, IO)
 
 # CLI exit codes (sysexits.h where one fits); see module docstring
 EXIT_RECOVERED = 3
 EXIT_RETRIES_EXHAUSTED = 69   # EX_UNAVAILABLE
+EXIT_HOST_LOST = 71           # EX_OSERR: a peer process/slice is gone
 EXIT_PREEMPTED_CLEAN = 75     # EX_TEMPFAIL
 
 EXIT_CODE_NAMES = {
     EXIT_RECOVERED: "recovered",
     EXIT_RETRIES_EXHAUSTED: "retries-exhausted",
+    EXIT_HOST_LOST: "host-lost",
     EXIT_PREEMPTED_CLEAN: "preempted-clean",
 }
 
@@ -125,6 +147,16 @@ _DETERMINISTIC_XLA_RE = re.compile(
     r"RESOURCE_EXHAUSTED|INVALID_ARGUMENT|FAILED_PRECONDITION"
     r"|UNIMPLEMENTED|OUT_OF_RANGE", re.IGNORECASE)
 
+# a cross-process collective dying because its PEER went away (observed
+# spelling: "FAILED_PRECONDITION: ... Gloo all-reduce failed: ...
+# Connection closed by peer") — checked BEFORE the deterministic-status
+# table, because the wrapping status is FAILED_PRECONDITION even though
+# the fault is a lost host, not a program error
+_PEER_LOSS_RE = re.compile(
+    r"gloo.*(connection closed|connection reset|connect failure"
+    r"|timed out)|connection closed by peer|distributed runtime"
+    r".*(unavailable|shut ?down)", re.IGNORECASE)
+
 # OSError errnos worth retrying (transient by nature); everything else —
 # ENOENT, EACCES, EISDIR… — is a user/programming error a retry repeats
 _RETRYABLE_ERRNOS = frozenset({
@@ -154,12 +186,19 @@ def _xla_error_types() -> tuple:
 
 def classify_fault(exc: BaseException) -> str:
     """Map an exception to the fault taxonomy (module docstring)."""
+    from ..distributed import CoordinatorTimeout, HostLost
     from ..utils.pipeline import StallError, WriterError
 
     if isinstance(exc, Preempted):
         return PREEMPT
     if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
         return FATAL
+    if isinstance(exc, (HostLost, CoordinatorTimeout)):
+        # a peer process/slice is gone, or the coordinator never answered
+        # (indistinguishable at this layer): single-process runs re-ramp
+        # onto surviving slices in-process; multi-process runs exit
+        # EXIT_HOST_LOST for the launcher tier (see Supervisor.run)
+        return HOST_LOSS
     if isinstance(exc, StallError):
         return STALL
     if isinstance(exc, WriterError):
@@ -175,6 +214,8 @@ def classify_fault(exc: BaseException) -> str:
         return inner if inner in (IO, DEVICE_LOSS) else FATAL
     xla_types = _xla_error_types()
     if xla_types and isinstance(exc, xla_types):
+        if _PEER_LOSS_RE.search(str(exc)):
+            return HOST_LOSS
         return FATAL if _DETERMINISTIC_XLA_RE.search(str(exc)) \
             else DEVICE_LOSS
     if isinstance(exc, OSError):
@@ -182,6 +223,15 @@ def classify_fault(exc: BaseException) -> str:
     if isinstance(exc, RuntimeError) and _DEVICE_LOSS_RE.search(str(exc)):
         return DEVICE_LOSS
     return FATAL
+
+
+def _in_multiprocess_run() -> bool:
+    """Is this process one of several in a ``jax.distributed`` job?
+    Consults the bootstrap context (never probes devices — the caller may
+    be handling the very fault that makes probing hang)."""
+    from ..distributed import context
+
+    return context().active
 
 
 # -- SIGTERM / preemption machinery -----------------------------------------
@@ -283,13 +333,17 @@ class AttemptContext:
         self.shard_sizes: "tuple[int, ...]" = ()
         self.recoveries: List[dict] = []
 
-    def mesh_devices(self) -> Optional[list]:
+    def mesh_devices(self, snap: bool = True) -> Optional[list]:
         """Devices the next mesh should ride (None = all visible): the
         verified survivors of the last re-ramp when there are any,
         intersected with what exists now, clamped to the budget, and
         snapped DOWN to a count that divides every published shard size
         — so a stale budget can fail neither ``soup_mesh``'s fail-fast
-        check nor the sharded state placement."""
+        check nor the sharded state placement.  ``snap=False`` skips the
+        1-D divisor snap: the multislice mesh builder
+        (``parallel.reramp_soup_mesh``) applies its own slice-aware snap
+        — dropping whole slices before shaving devices — so snapping
+        here first could needlessly break a slice boundary."""
         if self.device_budget is None and self.survivor_devices is None:
             return None
         import jax
@@ -299,10 +353,11 @@ class AttemptContext:
                 if d in visible] or list(visible)
         if self.device_budget is not None:
             devs = devs[:max(1, min(self.device_budget, len(devs)))]
-        n = len(devs)
-        while n > 1 and any(s % n for s in self.shard_sizes):
-            n -= 1
-        devs = devs[:n]
+        if snap:
+            n = len(devs)
+            while n > 1 and any(s % n for s in self.shard_sizes):
+                n -= 1
+            devs = devs[:n]
         self.last_seen_devices = len(devs)
         return devs
 
@@ -325,12 +380,18 @@ class Supervisor:
     # -- device enumeration / topology re-ramp --------------------------
 
     def _probe_survivors(self) -> "tuple[Optional[int], Optional[list]]":
-        """(count, devices) of what survived — the chaos override first
-        (consumed per event; CPU CI simulates shrink by count, the first
-        N visible devices standing in for the survivors), then a
-        verifying re-enumeration that keeps device IDENTITIES (slicing a
-        count off ``jax.devices()`` could re-adopt the dead chip).
+        """(count, devices) of what survived — the chaos overrides first
+        (consumed per event: ``host_loss@G[:H]`` forces the surviving
+        device LIST — a whole slice group dropped — while
+        ``device_loss@G:S`` simulates shrink by count, the first N
+        visible devices standing in for the survivors), then a verifying
+        re-enumeration that keeps device IDENTITIES (slicing a count off
+        ``jax.devices()`` could re-adopt the dead chip).
         ``(None, None)`` when the backend cannot even be asked."""
+        if self.chaos is not None:
+            forced_devs = self.chaos.take_forced_survivors()
+            if forced_devs is not None:
+                return (len(forced_devs) or None), (forced_devs or None)
         forced = self.chaos.take_forced_live() if self.chaos is not None \
             else 0
         if forced:
@@ -367,7 +428,7 @@ class Supervisor:
             # next mesh must never re-adopt the chip that just died
             ctx.survivor_devices = survivors
         repeat = bool(ctx.recoveries) \
-            and ctx.recoveries[-1]["kind"] == DEVICE_LOSS
+            and ctx.recoveries[-1]["kind"] in (DEVICE_LOSS, HOST_LOSS)
         if live is not None and live < prev:
             new = live
         elif repeat:
@@ -395,7 +456,7 @@ class Supervisor:
             # attempt's pipeline cannot leak into the next one
             self.chaos.abort_pending()
         reramped = False
-        if kind == DEVICE_LOSS:
+        if kind in (DEVICE_LOSS, HOST_LOSS):
             reramped = self._reramp()
             if reramped:
                 self._log(f"topology re-ramp: next attempt on "
@@ -457,6 +518,32 @@ class Supervisor:
                         self._log(f"{e} — exiting "
                                   f"{EXIT_PREEMPTED_CLEAN} (preempted-clean)")
                         raise SystemExit(EXIT_PREEMPTED_CLEAN) from e
+                    if kind in RETRYABLE and _in_multiprocess_run():
+                        # NO in-process restart in a multi-process run —
+                        # not just for host loss: a one-sided restart
+                        # (an IO fault on one process's writer, a
+                        # transient XLA error on one host) would replay
+                        # collectives from the checkpoint while peers
+                        # block mid-schedule, desynchronizing the gloo
+                        # sequence and wedging the whole mesh.  The
+                        # process leaves the job (peers' collectives
+                        # then fail over to host_loss themselves) and
+                        # the launcher tier relaunches the survivors
+                        # from the last durable checkpoint.
+                        LAST_REPORT = self.report("host-lost")
+                        self._log(
+                            f"{kind} fault in a multi-process run "
+                            f"({type(e).__name__}: {e}) — in-process "
+                            "restart would desync the mesh; exiting "
+                            f"{EXIT_HOST_LOST} (host-lost) for the "
+                            "launcher tier to relaunch")
+                        # setups/__main__ converts this to os._exit for
+                        # real multi-process workers (the interpreter's
+                        # atexit jax shutdown barrier would block on
+                        # peers mid-collective and then ABORT, destroying
+                        # this code); in-process callers (tests) see the
+                        # ordinary SystemExit
+                        raise SystemExit(EXIT_HOST_LOST) from e
                     if kind == FATAL or self.policy.max_restarts <= 0:
                         # unsupervised (or unclassifiable) failures keep
                         # their original type — tooling that matches on

@@ -19,14 +19,32 @@ def main(argv=None):
         force_cpu()
     # supervised mega runs speak a CLI exit-code vocabulary (0 clean,
     # 3 recovered; the raising outcomes — 75 preempted-clean, 69
-    # retries-exhausted — exit via SystemExit from the run): tpu_watch.sh
-    # keys on these instead of treating every nonzero exit as a wedge.
+    # retries-exhausted, 71 host-lost (a distributed peer/coordinator is
+    # gone; distributed.launch re-ramps) — exit via SystemExit from the
+    # run): tpu_watch.sh keys on these instead of treating every nonzero
+    # exit as a wedge.
     # Reset first: a command that never enters Supervisor.run must not
     # inherit the previous command's report in a long-lived process.
     from ..resilience import exit_code_for_report, supervisor
 
     supervisor.LAST_REPORT = None
-    out = REGISTRY[argv[0]](argv[1:])
+    try:
+        out = REGISTRY[argv[0]](argv[1:])
+    except SystemExit as e:
+        from ..distributed import context
+
+        if context().active and isinstance(e.code, int) and e.code:
+            # a multi-process worker's failing exit code must SURVIVE:
+            # normal interpreter teardown runs jax.distributed's atexit
+            # shutdown barrier, which blocks on peers still
+            # mid-collective and then ABORTS the process (SIGABRT 134),
+            # destroying the code the launcher tier keys on.  Everything
+            # durable (checkpoint, writer drain, meta.json) already
+            # happened in the run's own finally blocks.
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(e.code)
+        raise
     if isinstance(out, str):
         print(out)  # the run directory — scriptable like the run() API
     return exit_code_for_report(supervisor.LAST_REPORT)

@@ -238,6 +238,117 @@ def make_pipeline(args, registry, stage: str):
     return pipelined, writer, meter, driver
 
 
+# ---- distributed-tier plumbing (mega_soup / mega_multisoup) ----------------
+
+
+def init_distributed(args):
+    """Multi-process bring-up for a mega loop (``distributed.bootstrap``):
+    idempotent, inactive for plain runs.  Must run before anything probes
+    devices.  A multi-process run without ``--sharded`` would leave every
+    non-primary process's devices outside the population mesh, so it is
+    refused up front."""
+    from ..distributed import ensure_initialized
+
+    dist = ensure_initialized(args)
+    if dist.active and not getattr(args, "sharded", False):
+        raise SystemExit("distributed runs need --sharded (the population "
+                         "mesh must span every process's devices)")
+    return dist
+
+
+def build_soup_mesh(ctx, shard_sizes):
+    """The mega loops' ONE mesh builder.  When the (surviving) devices
+    span several slice groups — a TPU multislice topology, a multi-process
+    CPU mesh (one group per process), or a forced CI split
+    (``SRNN_FORCE_SLICES``) — the mesh comes from
+    ``parallel.reramp_soup_mesh``: the largest regular ``(slices, soup)``
+    grid whose device count divides every published shard size, which
+    makes the re-ramp builder the LIVE bring-up path rather than recovery
+    documentation.  Flat topologies keep the 1-D ``soup_mesh`` with the
+    supervisor's count-snap."""
+    from ..parallel import reramp_soup_mesh, slice_groups, soup_mesh
+
+    devs = ctx.mesh_devices(snap=False) if ctx is not None else None
+    actual = devs if devs is not None else list(jax.devices())
+    if len(slice_groups(actual)) >= 2:
+        mesh = reramp_soup_mesh(actual, shard_sizes=shard_sizes)
+    else:
+        mesh = soup_mesh(devices=ctx.mesh_devices()
+                         if ctx is not None else None)
+    if ctx is not None:
+        ctx.last_seen_devices = int(mesh.devices.size)
+    return mesh
+
+
+def open_run(args, name, dist=None, resume=None):
+    """Create/attach this run's Experiment under the process-0 I/O
+    contract (DESIGN §16).  Single-process (or primary): the real
+    Experiment — and in a distributed run the primary broadcasts its run
+    dir.  Non-primary processes get a ``distributed.hostio.WorkerLog``
+    bound to the broadcast dir: their narration goes to stderr, their
+    heartbeats to ``events-p<i>.jsonl``, and every run artifact
+    (log.txt/events.jsonl/metrics.prom/lineage.jsonl/checkpoints) is
+    written exactly once, by process 0."""
+    active = dist is not None and dist.active
+    if not active or dist.primary:
+        exp = Experiment.attach(resume) if resume \
+            else Experiment(name, root=args.root, seed=args.seed).__enter__()
+        if active:
+            from ..distributed.hostio import broadcast_run_dir
+
+            broadcast_run_dir(exp.dir)
+        return exp
+    from ..distributed.hostio import WorkerLog, broadcast_run_dir
+
+    return WorkerLog(broadcast_run_dir(None), dist.process_id)
+
+
+def stage_label(stage: str, dist=None) -> str:
+    """Heartbeat stage label: per-process in distributed runs
+    (``mega_soup@p1/2``) so the watch tier can tell a wedged worker from
+    a wedged coordinator by WHICH heartbeat file went quiet."""
+    if dist is None or not dist.active:
+        return stage
+    return f"{stage}@p{dist.process_id}/{dist.num_processes}"
+
+
+def set_distributed_gauges(registry, dist, mesh) -> None:
+    """The ``soup_distributed_*`` shape-of-the-run gauges (names.py)."""
+    from ..parallel import slice_groups
+
+    registry.gauge("soup_distributed_processes",
+                   help="jax.distributed process count of this run").set(
+        dist.num_processes if (dist is not None and dist.active) else 1)
+    if mesh is not None:
+        registry.gauge("soup_distributed_slices",
+                       help="slice groups of the population mesh").set(
+            len(slice_groups(list(mesh.devices.flat))))
+
+
+def fetch_for_checkpoint(state, dist, meter, registry):
+    """A distributed chunk's checkpoint source: ONE synchronous
+    collective gather of the sharded state onto every host (the
+    process-0 writer then persists it).  Must run on the loop thread —
+    collectives from the background writer would interleave differently
+    per process and deadlock the mesh — and BEFORE the next chunk's
+    donating dispatch (it blocks until the bytes land, so donation
+    safety comes for free).  Single-process runs never call this."""
+    import time as _time
+
+    from ..distributed.hostio import fetch_tree
+
+    t0 = _time.perf_counter()
+    with meter.waiting():
+        host = fetch_tree(state)
+    if registry is not None:
+        registry.histogram("soup_distributed_gather_seconds",
+                           help="per-chunk state gather (checkpoint "
+                                "source) wall time",
+                           unit="seconds").observe(
+            _time.perf_counter() - t0)
+    return host
+
+
 # ---- elastic-supervisor plumbing (mega_soup / mega_multisoup) --------------
 
 
@@ -335,9 +446,13 @@ def add_dynamics_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
 
 
 def make_lineage(args, exp_dir: str, *, sizes, start_gen: int,
-                 resume: bool, mesh=None, type_names=None):
+                 resume: bool, mesh=None, type_names=None,
+                 primary: bool = True):
     """Build the mega loops' lineage trio ``(state, writer, capacity)`` —
-    ``(None, None, 0)`` without ``--lineage``.
+    ``(None, None, 0)`` without ``--lineage``.  ``primary=False`` (a
+    distributed run's non-0 processes) builds the device carry WITHOUT a
+    ``LineageWriter``: every process computes the same lineage, process 0
+    alone streams lineage.jsonl and rolls the resume sidecar.
 
     On ``--resume`` the carry restores from the ``lineage_state.npz``
     sidecar when its generation stamp matches the checkpoint (the stream
@@ -364,9 +479,12 @@ def make_lineage(args, exp_dir: str, *, sizes, start_gen: int,
     meta = {"start_gen": start_gen, "sizes": list(sizes)}
     if type_names is not None:
         meta["type_names"] = list(type_names)
-    writer = LineageWriter(exp_dir, n=sum(sizes),
-                           capacity=args.lineage_edges, epsilon=args.epsilon,
-                           resume=resume, continue_epoch=restored, meta=meta)
+    writer = None
+    if primary:
+        writer = LineageWriter(exp_dir, n=sum(sizes),
+                               capacity=args.lineage_edges,
+                               epsilon=args.epsilon, resume=resume,
+                               continue_epoch=restored, meta=meta)
     return lin, writer, args.lineage_edges
 
 

@@ -27,8 +27,7 @@ import sys
 import jax
 import numpy as np
 
-from ..experiment import (Experiment, restore_multi_checkpoint,
-                          save_multi_checkpoint)
+from ..experiment import restore_multi_checkpoint, save_multi_checkpoint
 from ..multisoup import (MultiSoupConfig, count_multi, evolve_multi,
                          evolve_multi_donated, seed_multi)
 from ..soup import ACT_DIV_DEAD, ACT_ZERO_DEAD
@@ -46,13 +45,16 @@ from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
+from ..distributed import add_distributed_args
 from .common import (add_dynamics_args, add_flightrec_args,
                      add_pipeline_args, add_resilience_args, base_parser,
-                     chunk_boundary_faults, finish_pipeline,
+                     build_soup_mesh, chunk_boundary_faults,
+                     fetch_for_checkpoint, finish_pipeline,
                      flush_lineage_probe, flush_lineage_window,
-                     latest_checkpoint, make_flightrec, make_lineage,
-                     make_on_stall, make_pipeline, load_run_config,
-                     note_restart, register, save_run_config,
+                     init_distributed, latest_checkpoint, make_flightrec,
+                     make_lineage, make_on_stall, make_pipeline,
+                     load_run_config, note_restart, open_run, register,
+                     save_run_config, set_distributed_gauges, stage_label,
                      watchdog_chunk)
 
 
@@ -101,6 +103,7 @@ def build_parser():
     add_flightrec_args(p)
     add_dynamics_args(p)
     add_resilience_args(p)
+    add_distributed_args(p)
     return p
 
 
@@ -158,6 +161,10 @@ def run(args):
 
 def _run_once(args, ctx=None):
     chaos = ctx.chaos if ctx is not None else None
+    # multi-process bring-up FIRST (before anything probes devices); see
+    # mega_soup — `primary` gates all host I/O but heartbeats
+    dist = init_distributed(args)
+    primary = dist.primary if dist.active else True
     if args.smoke:
         args.size = 48 if args.size == 1_000_000 else args.size
         args.generations = 6 if args.generations == 1000 else args.generations
@@ -195,20 +202,17 @@ def _run_once(args, ctx=None):
     mesh = None
     n_dev = 1
     if args.sharded:
-        from ..parallel import soup_mesh
         # device budget (--max-devices, shrunk by a topology re-ramp to
         # the verified survivors, by identity).  The total size is
         # published so a re-ramp snaps to a device count it divides;
         # per-type checkpoint sizes are re-validated after restore (the
         # adoption branch below) — a residual mismatch there still exits,
-        # by design.
+        # by design.  build_soup_mesh routes multislice topologies
+        # through reramp_soup_mesh (the live 2-D path), like mega_soup.
         if ctx is not None:
             ctx.shard_sizes = (args.size,)
-        mesh = soup_mesh(devices=ctx.mesh_devices()
-                         if ctx is not None else None)
+        mesh = build_soup_mesh(ctx, (args.size,))  # sets last_seen_devices
         n_dev = mesh.devices.size
-        if ctx is not None:
-            ctx.last_seen_devices = int(n_dev)
         if args.size % n_dev:
             raise SystemExit(
                 f"--sharded needs --size divisible by the {n_dev} visible "
@@ -223,7 +227,7 @@ def _run_once(args, ctx=None):
     ensure_compilation_cache()  # warm-start executables across processes
 
     if args.resume:
-        exp = Experiment.attach(args.resume)
+        exp = open_run(args, "mega-multisoup", dist, resume=args.resume)
         state = restore_multi_checkpoint(ckpt)
         got = tuple(w.shape[0] for w in state.weights)
         if got != cfg.sizes:
@@ -254,11 +258,11 @@ def _run_once(args, ctx=None):
         exp.log(f"resumed from {os.path.basename(ckpt)} "
                 f"at generation {int(state.time)}")
     else:
-        exp = Experiment("mega-multisoup", root=args.root,
-                         seed=args.seed).__enter__()
-        save_run_config(exp.dir, args, _CONFIG_FIELDS,
-                        extra={"type_names": [t.variant
-                                              for t in cfg.topos]})
+        exp = open_run(args, "mega-multisoup", dist)
+        if primary:
+            save_run_config(exp.dir, args, _CONFIG_FIELDS,
+                            extra={"type_names": [t.variant
+                                                  for t in cfg.topos]})
         if mesh is not None:
             from ..parallel import make_sharded_multi_state
             state = make_sharded_multi_state(cfg, mesh, jax.random.key(args.seed))
@@ -304,6 +308,7 @@ def _run_once(args, ctx=None):
     # flushed every chunk to events.jsonl and metrics.prom
     registry = MetricsRegistry()
     set_precision_gauges(registry, cfg)
+    set_distributed_gauges(registry, dist, mesh)
     if cfg.generation_impl == "fused":
         from ..multisoup import resolved_generation_impl
         exp.log("generation_impl=fused: " + ",".join(
@@ -313,6 +318,10 @@ def _run_once(args, ctx=None):
     # flight recorder + watchdog (see mega_soup / telemetry.flightrec)
     health_on = not args.no_health
     flightrec, watchdog = make_flightrec(args)
+    if not primary:
+        # triage bundles are run-dir artifacts: process-0-gated (see
+        # mega_soup)
+        watchdog = None
     # restarted attempt: fold the recovery history (counters + ring row)
     record_recovery(registry, flightrec, ctx)
     # replication-dynamics observatory (telemetry.dynamics): per-type
@@ -320,9 +329,10 @@ def _run_once(args, ctx=None):
     tnames = type_names(cfg)
     lins, lin_writer, lincap = make_lineage(
         args, exp.dir, sizes=cfg.sizes, start_gen=int(state.time),
-        resume=bool(args.resume), mesh=mesh, type_names=tnames)
+        resume=bool(args.resume), mesh=mesh, type_names=tnames,
+        primary=primary)
     lineage_on = lins is not None
-    if lineage_on:
+    if lineage_on and lin_writer is not None:
         exp.log(f"lineage: epoch {lin_writer.epoch}, "
                 f"{lincap} edge rows/window -> lineage.jsonl")
     stores = writer = None
@@ -336,8 +346,8 @@ def _run_once(args, ctx=None):
         if chaos is not None and writer is not None:
             chaos.attach_writer(writer)
         driver.on_stall = make_on_stall(exp, flightrec, registry,
-                                        lambda: gen)
-        hb = Heartbeat(exp, stage="mega_multisoup",
+                                        lambda: gen) if primary else None
+        hb = Heartbeat(exp, stage=stage_label("mega_multisoup", dist),
                        total_generations=args.generations,
                        registry=registry,
                        fsync_every=args.heartbeat_fsync_every,
@@ -446,7 +456,7 @@ def _run_once(args, ctx=None):
                         for tname, hsum in by_type.items():
                             submit_or_run(writer, update_health_gauges,
                                           registry, hsum, tname)
-                    if ldata is not None:
+                    if ldata is not None and lin_writer is not None:
                         kind, payload = ldata
                         if kind == "window":
                             flush_lineage_window(
@@ -459,19 +469,30 @@ def _run_once(args, ctx=None):
                                                 payload, type_names=tnames)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
-                    submit_or_run(writer, registry.flush_events, exp)
-                    submit_or_run(writer, registry.write_textfile,
-                                  os.path.join(exp.dir, "metrics.prom"))
-                    submit_or_run(writer, save_multi_checkpoint,
-                                  os.path.join(exp.dir,
-                                               f"ckpt-gen{gen:08d}"),
-                                  ckpt_state)
+                    # run-dir artifacts are process-0-gated (DESIGN §16)
+                    if primary:
+                        submit_or_run(writer, registry.flush_events, exp)
+                        submit_or_run(writer, registry.write_textfile,
+                                      os.path.join(exp.dir, "metrics.prom"))
+                        if not dist.active:
+                            # distributed checkpoints were already saved
+                            # synchronously on the loop thread (orbax
+                            # barriers across processes)
+                            submit_or_run(writer, save_multi_checkpoint,
+                                          os.path.join(
+                                              exp.dir,
+                                              f"ckpt-gen{gen:08d}"),
+                                          ckpt_state)
                 row["pipeline"] = meter.chunk_done(dt)
                 # stamped copy: see mega_soup (gens_regress seq exclusion)
                 row = flightrec.record(row)
+                # distributed runs skip the bundle's state snapshot (its
+                # orbax save would barrier across processes; see mega_soup)
                 watchdog_chunk(watchdog, row, exp=exp, registry=registry,
-                               snapshot_state=ckpt_state,
-                               save_fn=save_multi_checkpoint, gen=gen)
+                               snapshot_state=None if dist.active
+                               else ckpt_state,
+                               save_fn=None if dist.active
+                               else save_multi_checkpoint, gen=gen)
             return finish
 
         preempted = False
@@ -523,7 +544,20 @@ def _run_once(args, ctx=None):
             # (the metrics/health/lineage carries are fresh jit outputs,
             # never donated):
             counts_dev = _count(state)
-            ckpt_state = snapshot(state) if pipelined else state
+            if dist.active:
+                # distributed checkpoint: synchronous gather + orbax
+                # multihost save on EVERY process's loop thread (see
+                # mega_soup — a writer-thread save wedges the mesh)
+                ckpt_state = fetch_for_checkpoint(
+                    state, dist, meter, registry if primary else None)
+                save_multi_checkpoint(os.path.join(exp.dir,
+                                                   f"ckpt-gen{gen:08d}"),
+                                      ckpt_state, primary=primary)
+                if ldata is not None:
+                    from ..distributed.hostio import fetch_tree
+                    ldata = (ldata[0], fetch_tree(ldata[1]))
+            else:
+                ckpt_state = snapshot(state) if pipelined else state
             fin = _finisher(gen, chunk, counts_dev, ckpt_state, ms, hs,
                             ldata)
             if chaos is not None:

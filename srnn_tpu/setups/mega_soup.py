@@ -22,7 +22,7 @@ import sys
 import jax
 import numpy as np
 
-from ..experiment import (Experiment, counters_dict, format_counters,
+from ..experiment import (counters_dict, format_counters,
                           restore_checkpoint, save_checkpoint)
 from ..soup import (ACT_DIV_DEAD, ACT_ZERO_DEAD, SoupConfig, count, evolve,
                     evolve_donated, probe_dynamics, seed)
@@ -37,13 +37,16 @@ from ..telemetry.flightrec import record_recovery
 from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
+from ..distributed import add_distributed_args
 from .common import (add_dynamics_args, add_flightrec_args,
                      add_pipeline_args, add_resilience_args, base_parser,
-                     chunk_boundary_faults, finish_pipeline,
+                     build_soup_mesh, chunk_boundary_faults,
+                     fetch_for_checkpoint, finish_pipeline,
                      flush_lineage_probe, flush_lineage_window,
-                     latest_checkpoint, load_run_config, make_flightrec,
-                     make_lineage, make_on_stall, make_pipeline,
-                     note_restart, register, save_run_config,
+                     init_distributed, latest_checkpoint, load_run_config,
+                     make_flightrec, make_lineage, make_on_stall,
+                     make_pipeline, note_restart, open_run, register,
+                     save_run_config, set_distributed_gauges, stage_label,
                      watchdog_chunk)
 
 
@@ -104,6 +107,7 @@ def build_parser():
     add_flightrec_args(p)
     add_dynamics_args(p)
     add_resilience_args(p)
+    add_distributed_args(p)
     return p
 
 
@@ -126,6 +130,11 @@ def run(args):
 
 def _run_once(args, ctx=None):
     chaos = ctx.chaos if ctx is not None else None
+    # multi-process bring-up FIRST (before anything probes devices);
+    # inactive (free) for plain runs.  `primary` gates all host I/O but
+    # heartbeats — the process-0 contract, DESIGN §16.
+    dist = init_distributed(args)
+    primary = dist.primary if dist.active else True
     if args.smoke:
         # shrink only the knobs left at their defaults, so e.g.
         # `--smoke --generations 4` still means 4 generations
@@ -174,21 +183,22 @@ def _run_once(args, ctx=None):
 
     mesh = None
     if args.sharded:
-        from ..parallel import soup_mesh
         # the supervisor's device budget (initially --max-devices, shrunk
         # by a topology re-ramp) bounds the mesh — by verified-survivor
-        # IDENTITY after a device loss, not just count; None = all
+        # IDENTITY after a device/host loss, not just count; None = all
         # visible.  Publishing the population size first lets a re-ramp
         # snap to a device count the shards actually divide over.
+        # build_soup_mesh routes multislice topologies (TPU pods,
+        # multi-process CPU meshes, SRNN_FORCE_SLICES CI splits) through
+        # reramp_soup_mesh — the live (slices, soup) 2-D path.
         if ctx is not None:
             ctx.shard_sizes = (args.size,)
-        mesh = soup_mesh(devices=ctx.mesh_devices()
-                         if ctx is not None else None)
-        if ctx is not None:
-            ctx.last_seen_devices = int(mesh.devices.size)
+        mesh = build_soup_mesh(ctx, (args.size,))
 
     if args.resume:
-        exp = Experiment.attach(args.resume)
+        exp = open_run(args, "mega-soup", dist, resume=args.resume)
+        # every process restores the same checkpoint files; placement is
+        # multi-process-aware (each contributes its addressable shards)
         state = restore_checkpoint(ckpt)
         if mesh is not None:
             from ..parallel import place_sharded_state
@@ -202,8 +212,9 @@ def _run_once(args, ctx=None):
         exp.log(f"resumed from {os.path.basename(ckpt)} "
                 f"at generation {int(state.time)}")
     else:
-        exp = Experiment("mega-soup", root=args.root, seed=args.seed).__enter__()
-        save_run_config(exp.dir, args, _CONFIG_FIELDS)
+        exp = open_run(args, "mega-soup", dist)
+        if primary:
+            save_run_config(exp.dir, args, _CONFIG_FIELDS)
         if mesh is not None:
             from ..parallel import make_sharded_state
             state = make_sharded_state(cfg, mesh, jax.random.key(args.seed))
@@ -212,7 +223,9 @@ def _run_once(args, ctx=None):
         exp.log(f"mega-soup N={cfg.size} layout={cfg.layout} "
                 f"attack={cfg.attacking_rate} train={cfg.train}/{cfg.train_mode}"
                 + (f" sharded over {mesh.devices.size} devices"
-                   if mesh is not None else ""))
+                   if mesh is not None else "")
+                + (f" across {dist.num_processes} processes"
+                   if dist.active else ""))
     note_restart(exp, ctx)
 
     def _count(s):
@@ -230,6 +243,7 @@ def _run_once(args, ctx=None):
     # so a killed run names its last stage/generation/rate
     registry = MetricsRegistry()
     set_precision_gauges(registry, cfg)
+    set_distributed_gauges(registry, dist, mesh)
     if cfg.generation_impl == "fused":
         from ..soup import _fused_kernel_route
         exp.log("generation_impl=fused: "
@@ -240,6 +254,11 @@ def _run_once(args, ctx=None):
     # watchdog that turns a pathological chunk into a triage bundle
     health_on = not args.no_health
     flightrec, watchdog = make_flightrec(args)
+    if not primary:
+        # triage bundles are run-dir artifacts: process-0-gated like every
+        # other host write (two processes tripping at the same generation
+        # would collide on the bundle dir)
+        watchdog = None
     # a restarted attempt folds its recovery history into THIS attempt's
     # registry + ring (restart counters, recovery-seconds histogram)
     record_recovery(registry, flightrec, ctx)
@@ -247,9 +266,9 @@ def _run_once(args, ctx=None):
     # lineage.jsonl window stream (telemetry.dynamics; --lineage opt-in)
     lin, lin_writer, lincap = make_lineage(
         args, exp.dir, sizes=(cfg.size,), start_gen=int(state.time),
-        resume=bool(args.resume), mesh=mesh)
+        resume=bool(args.resume), mesh=mesh, primary=primary)
     lineage_on = lin is not None
-    if lineage_on:
+    if lineage_on and lin_writer is not None:
         exp.log(f"lineage: epoch {lin_writer.epoch}, "
                 f"{lincap} edge rows/window -> lineage.jsonl")
     store = writer = None
@@ -265,8 +284,8 @@ def _run_once(args, ctx=None):
         if chaos is not None and writer is not None:
             chaos.attach_writer(writer)
         driver.on_stall = make_on_stall(exp, flightrec, registry,
-                                        lambda: gen)
-        hb = Heartbeat(exp, stage="mega_soup",
+                                        lambda: gen) if primary else None
+        hb = Heartbeat(exp, stage=stage_label("mega_soup", dist),
                        total_generations=args.generations,
                        registry=registry,
                        fsync_every=args.heartbeat_fsync_every,
@@ -380,7 +399,7 @@ def _run_once(args, ctx=None):
                     if hsum is not None:
                         submit_or_run(writer, update_health_gauges,
                                       registry, hsum)
-                    if ldata is not None:
+                    if ldata is not None and lin_writer is not None:
                         kind, payload = ldata
                         if kind == "window":
                             flush_lineage_window(
@@ -392,20 +411,35 @@ def _run_once(args, ctx=None):
                                                 payload)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
-                    submit_or_run(writer, registry.flush_events, exp)
-                    submit_or_run(writer, registry.write_textfile,
-                                  os.path.join(exp.dir, "metrics.prom"))
-                    submit_or_run(writer, save_checkpoint,
-                                  os.path.join(exp.dir,
-                                               f"ckpt-gen{gen:08d}"),
-                                  ckpt_state)
+                    # run-dir artifacts are process-0-gated (DESIGN §16):
+                    # workers contribute through the collective shard
+                    # boundaries, never through these sinks
+                    if primary:
+                        submit_or_run(writer, registry.flush_events, exp)
+                        submit_or_run(writer, registry.write_textfile,
+                                      os.path.join(exp.dir, "metrics.prom"))
+                        if not dist.active:
+                            # distributed checkpoints were already saved
+                            # synchronously on the loop thread (orbax
+                            # barriers across processes)
+                            submit_or_run(writer, save_checkpoint,
+                                          os.path.join(
+                                              exp.dir,
+                                              f"ckpt-gen{gen:08d}"),
+                                          ckpt_state)
                 row["pipeline"] = meter.chunk_done(dt)
                 # the stamped copy (seq/t) is what the rules see — the
                 # gens_regress median excludes the current row by seq
                 row = flightrec.record(row)
+                # distributed runs keep the watchdog rules + host-only
+                # bundles but skip the bundle's state snapshot: its orbax
+                # save would barrier across processes from a path only
+                # process 0 takes
                 watchdog_chunk(watchdog, row, exp=exp, registry=registry,
-                               snapshot_state=ckpt_state,
-                               save_fn=save_checkpoint, gen=gen)
+                               snapshot_state=None if dist.active
+                               else ckpt_state,
+                               save_fn=None if dist.active
+                               else save_checkpoint, gen=gen)
             return finish
 
         preempted = False
@@ -479,7 +513,24 @@ def _run_once(args, ctx=None):
             # (the metrics/health/lineage carries are fresh jit outputs,
             # never donated):
             counts_dev = _count(state)
-            ckpt_state = snapshot(state) if pipelined else state
+            if dist.active:
+                # distributed checkpoint: ONE synchronous collective gather
+                # on the loop thread (identical order on every process),
+                # then orbax's multihost save — ALSO on the loop thread of
+                # EVERY process, because orbax barriers across processes
+                # internally (a writer-thread save on process 0 alone
+                # wedges the whole mesh; observed, not hypothetical).  The
+                # lineage flush payload rides the same gather discipline.
+                ckpt_state = fetch_for_checkpoint(
+                    state, dist, meter, registry if primary else None)
+                save_checkpoint(os.path.join(exp.dir,
+                                             f"ckpt-gen{gen:08d}"),
+                                ckpt_state, primary=primary)
+                if ldata is not None:
+                    from ..distributed.hostio import fetch_tree
+                    ldata = (ldata[0], fetch_tree(ldata[1]))
+            else:
+                ckpt_state = snapshot(state) if pipelined else state
             fin = _finisher(gen, chunk, counts_dev, ckpt_state, m, h,
                             ldata)
             if chaos is not None:

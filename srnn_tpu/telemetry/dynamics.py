@@ -427,11 +427,12 @@ def place_lineage(mesh, lin: LineageState) -> LineageState:
     """Place a host-constructed lineage carry with the soup sharding."""
     from jax.sharding import NamedSharding
 
+    from ..parallel.mesh import global_device_put
     from ..parallel.sharded_soup import _soup_axes
 
     specs = lineage_specs(_soup_axes(mesh))
     return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        lambda x, spec: global_device_put(x, NamedSharding(mesh, spec)),
         lin, specs)
 
 

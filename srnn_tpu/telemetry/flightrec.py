@@ -177,6 +177,13 @@ def record_recovery(registry, recorder: "FlightRecorder", ctx) -> None:
         registry.counter("soup_topology_reramps_total",
                          help="mesh rebuilds onto a changed device "
                               "topology").inc(reramps)
+    host_losses = sum(1 for r in ctx.recoveries
+                      if r.get("kind") == "host_loss")
+    if host_losses:
+        registry.counter("soup_distributed_host_losses_total",
+                         help="host/slice losses recovered in-process "
+                              "(multi-process losses exit for the "
+                              "launcher tier instead)").inc(host_losses)
     hist = registry.histogram("soup_recovery_seconds",
                               help="seconds from fault to restarted "
                                    "attempt (incl. backoff)",

@@ -67,6 +67,13 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_restarts_total": "counter",
     "soup_topology_reramps_total": "counter",
     "soup_recovery_seconds": "histogram",
+    # -- distributed runtime tier (srnn_tpu.distributed; set via
+    #    setups.common.set_distributed_gauges / fetch_for_checkpoint,
+    #    host-loss recoveries folded by telemetry.flightrec) -------------
+    "soup_distributed_processes": "gauge",
+    "soup_distributed_slices": "gauge",
+    "soup_distributed_host_losses_total": "counter",
+    "soup_distributed_gather_seconds": "histogram",
     # -- experiment service (srnn_tpu.serve) -----------------------------
     "serve_requests_total": "counter",
     "serve_requests_failed_total": "counter",

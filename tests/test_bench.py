@@ -28,10 +28,12 @@ def _run_bench(extra_env, timeout=600):
     # children must never touch the real (tunneled) backend from the test
     # suite; this pin survives the axon sitecustomize (config-level)
     env["SRNN_BENCH_PLATFORM"] = "cpu"
-    # the serve load leg is its own multi-minute stage (covered by
-    # tests/test_serve.py at smoke scale); these e2es drill the
-    # wedge/rescue machinery against tiny pinned deadlines
+    # the serve and multihost legs are their own multi-minute stages
+    # (covered by tests/test_serve.py and tests/test_distributed.py at
+    # smoke scale); these e2es drill the wedge/rescue machinery against
+    # tiny pinned deadlines
     env.setdefault("SRNN_BENCH_SERVE_TIMEOUT_S", "0")
+    env.setdefault("SRNN_BENCH_MULTIHOST_TIMEOUT_S", "0")
     env.update(extra_env)
     proc = subprocess.run([sys.executable, BENCH], stdout=subprocess.PIPE,
                           stderr=subprocess.PIPE, timeout=timeout, env=env)

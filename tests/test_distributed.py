@@ -1,0 +1,479 @@
+"""The distributed runtime tier (srnn_tpu/distributed/): bootstrap,
+process-0-gated host I/O, the multislice mesh builder as the LIVE path,
+host-loss chaos + classification, and the multi-process CPU launcher.
+
+Parity oracles (DESIGN §16): a multi-process run over D total devices is
+bitwise-equal to the single-host SHARDED run over the same D (the
+sharded suite's own oracle then connects popmajor mega_soup all the way
+to the unsharded single-device run); a chaos-injected slice loss either
+re-ramps in-process (single-process multislice) or exits
+``EXIT_HOST_LOST`` for the launcher tier to re-ramp — both ending
+bitwise-equal to the uninterrupted run.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from srnn_tpu.distributed import (CoordinatorTimeout, HostLost, bootstrap,
+                                  launch)
+from srnn_tpu.distributed.hostio import WorkerLog
+from srnn_tpu.experiment import restore_checkpoint
+from srnn_tpu.resilience import (EXIT_HOST_LOST, EXIT_RECOVERED, HOST_LOSS,
+                                 BackoffPolicy, ChaosMonkey, Supervisor,
+                                 classify_fault, exit_code_for_report,
+                                 parse_schedule, supervisor)
+from srnn_tpu.setups import REGISTRY
+
+FAST = ["--backoff-base-s", "0.01", "--backoff-max-s", "0.05"]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _worker_env(**extra):
+    """Env for launcher subprocesses: CPU-pinned, tunnel-free, sharing
+    the suite's persistent compile cache — and ONE device per worker
+    (the suite's 8-virtual-device forcing is for in-process sharding
+    tests; inheriting it would hand every worker 8 devices and compile a
+    16-way SPMD program per process on this small host)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    env["SRNN_SETUPS_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.update(extra)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# exit-code vocabulary stays mirrored (the launcher must not import the
+# jax-importing resilience layer, so it spells the codes as literals)
+# ---------------------------------------------------------------------------
+
+
+def test_launcher_exit_codes_mirror_supervisor():
+    assert launch.EXIT_HOST_LOST == supervisor.EXIT_HOST_LOST == 71
+    assert launch.EXIT_RECOVERED == supervisor.EXIT_RECOVERED == 3
+    assert supervisor.EXIT_CODE_NAMES[EXIT_HOST_LOST] == "host-lost"
+
+
+# ---------------------------------------------------------------------------
+# bootstrap resolution (no actual jax.distributed bring-up)
+# ---------------------------------------------------------------------------
+
+
+def test_bootstrap_resolve_env_and_flag_priority(monkeypatch):
+    class A:
+        dist_coordinator = None
+        dist_processes = None
+        dist_process_id = None
+
+    monkeypatch.delenv(bootstrap.COORD_ENV, raising=False)
+    assert bootstrap._resolve(A()) == (None, None, None)
+    monkeypatch.setenv(bootstrap.COORD_ENV, "127.0.0.1:9999")
+    monkeypatch.setenv(bootstrap.PROCS_ENV, "2")
+    monkeypatch.setenv(bootstrap.PID_ENV, "1")
+    assert bootstrap._resolve(A()) == ("127.0.0.1:9999", 2, 1)
+    # explicit flags win over env
+    flagged = A()
+    flagged.dist_coordinator = "10.0.0.1:1234"
+    flagged.dist_processes = 4
+    flagged.dist_process_id = 3
+    assert bootstrap._resolve(flagged) == ("10.0.0.1:1234", 4, 3)
+
+
+def test_ensure_initialized_rejects_partial_spec(monkeypatch):
+    """A partial --dist-* spec must fail loudly, not run solo while the
+    correctly-configured peers block on a coordinator that never forms."""
+
+    class A:
+        dist_coordinator = "10.0.0.1:1234"
+        dist_processes = None
+        dist_process_id = 1
+
+    monkeypatch.delenv(bootstrap.COORD_ENV, raising=False)
+    monkeypatch.setattr(bootstrap, "_CONTEXT", None)
+    with pytest.raises(SystemExit, match="all three"):
+        bootstrap.ensure_initialized(A())
+    # a 1-process spec (the launcher's re-ramp floor) is just a solo run
+    class Solo:
+        dist_coordinator = "127.0.0.1:1"
+        dist_processes = 1
+        dist_process_id = 0
+
+    monkeypatch.setattr(bootstrap, "_CONTEXT", None)
+    assert not bootstrap.ensure_initialized(Solo()).active
+    monkeypatch.setattr(bootstrap, "_CONTEXT", None)
+
+
+def test_ensure_initialized_inactive_for_plain_runs(monkeypatch):
+    monkeypatch.delenv(bootstrap.COORD_ENV, raising=False)
+    monkeypatch.setattr(bootstrap, "_CONTEXT", None)
+    ctx = bootstrap.ensure_initialized(None)
+    assert not ctx.active and ctx.primary
+    # idempotent: the second call returns the same context
+    assert bootstrap.ensure_initialized(None) is ctx
+    monkeypatch.setattr(bootstrap, "_CONTEXT", None)
+
+
+# ---------------------------------------------------------------------------
+# WorkerLog: the non-primary Experiment shim
+# ---------------------------------------------------------------------------
+
+
+def test_worker_log_heartbeat_file_and_noop_saves(tmp_path, capsys):
+    with WorkerLog(str(tmp_path), 1) as wl:
+        assert wl.dir == str(tmp_path)
+        wl.log("hello", generation=4)
+        wl.event(_fsync=True, kind="heartbeat", stage="mega_soup@p1/2")
+        assert wl.save(foo=1) == {}
+    rows = [json.loads(line)
+            for line in open(tmp_path / "events-p1.jsonl")]
+    assert [r.get("kind") for r in rows] == [None, "heartbeat"]
+    assert all(r["process"] == 1 for r in rows)
+    assert rows[1]["stage"] == "mega_soup@p1/2"
+    assert "[p1] hello" in capsys.readouterr().err
+    # no primary artifacts were created
+    assert not (tmp_path / "events.jsonl").exists()
+    assert not (tmp_path / "log.txt").exists()
+
+
+# ---------------------------------------------------------------------------
+# slice grouping + the divisor-aware re-ramp ladder (the satellites'
+# edge cases: ragged survivors, single intact group, modal ties, the
+# 1M-on-3-survivors snap interacting with the slice axis)
+# ---------------------------------------------------------------------------
+
+
+class _Dev:
+    def __init__(self, i, s=None, p=0):
+        self.id = i
+        if s is not None:
+            self.slice_index = s
+        self.process_index = p
+
+
+def test_slice_groups_forced_split_and_real_topology_wins(monkeypatch):
+    from srnn_tpu.parallel import slice_groups
+
+    flat = [_Dev(i) for i in range(8)]
+    assert len(slice_groups(flat)) == 1
+    assert [len(g) for g in slice_groups(flat, force_slices=2)] == [4, 4]
+    monkeypatch.setenv("SRNN_FORCE_SLICES", "4")
+    assert [len(g) for g in slice_groups(flat)] == [2, 2, 2, 2]
+    # a non-dividing override is ignored, not ragged
+    assert len(slice_groups(flat, force_slices=3)) == 1
+    # a REAL topology (distinct slice indices) wins over the override
+    real = [_Dev(i, s=i // 4) for i in range(8)]
+    assert [len(g) for g in slice_groups(real)] == [4, 4]
+    monkeypatch.delenv("SRNN_FORCE_SLICES")
+
+
+def test_reramp_mesh_divisor_snap_drops_slices_first():
+    from srnn_tpu.parallel import reramp_soup_mesh
+
+    # 3 whole slices of 4: 1M % 12 != 0 -> drop one slice -> (2, 4)
+    devs = [_Dev(i, s=i // 4) for i in range(12)]
+    m = reramp_soup_mesh(devs, shard_sizes=(1_000_000,))
+    assert m.axis_names == ("slices", "soup") and m.devices.shape == (2, 4)
+    # without the size constraint all three slices ride
+    assert reramp_soup_mesh(devs).devices.shape == (3, 4)
+
+
+def test_reramp_mesh_ragged_survivors_fall_back_to_largest_group():
+    from srnn_tpu.parallel import reramp_soup_mesh
+
+    # ragged: slices of 4, 3, 2 -> single intact group of 4, 1-D
+    devs = [_Dev(i, s=0) for i in range(4)] \
+        + [_Dev(10 + i, s=1) for i in range(3)] \
+        + [_Dev(20 + i, s=2) for i in range(2)]
+    m = reramp_soup_mesh(devs)
+    assert m.axis_names == ("soup",) and m.devices.shape == (4,)
+
+
+def test_reramp_mesh_modal_tie_prefers_larger_slice_size():
+    from srnn_tpu.parallel import reramp_soup_mesh
+
+    # tie: two slices of 2 and two of 4 -> modal resolves to 4 -> (2, 4)
+    devs = [_Dev(i, s=i // 2) for i in range(4)] \
+        + [_Dev(10 + i, s=10 + i // 4) for i in range(8)]
+    m = reramp_soup_mesh(devs)
+    assert m.axis_names == ("slices", "soup") and m.devices.shape == (2, 4)
+
+
+def test_reramp_mesh_one_d_divisor_snap_1m_on_3_survivors():
+    from srnn_tpu.parallel import reramp_soup_mesh
+
+    devs = [_Dev(i, s=0) for i in range(3)]
+    m = reramp_soup_mesh(devs, shard_sizes=(1_000_000,))
+    # 1M % 3 != 0 -> snap DOWN to 2 (the mesh_devices snap, slice-aware)
+    assert m.axis_names == ("soup",) and m.devices.shape == (2,)
+    # divisor snap honors EVERY published shard size
+    m = reramp_soup_mesh([_Dev(i, s=0) for i in range(6)],
+                         shard_sizes=(1_000_000, 300_000))
+    assert m.devices.shape == (5,)  # 6 fails 1M; 5 divides both
+
+
+# ---------------------------------------------------------------------------
+# classification: the new host-loss faults
+# ---------------------------------------------------------------------------
+
+
+def test_classify_host_faults():
+    from jaxlib.xla_extension import XlaRuntimeError
+
+    assert classify_fault(HostLost("slice 1 gone")) == HOST_LOSS
+    assert classify_fault(CoordinatorTimeout("no coordinator")) == HOST_LOSS
+    # a cross-process collective dying because its peer went away wraps
+    # in FAILED_PRECONDITION — it must classify host_loss, not fatal
+    gloo = XlaRuntimeError(
+        "FAILED_PRECONDITION: Buffer Definition Event: Gloo all-reduce "
+        "failed: [external/gloo] Connection closed by peer [127.0.0.1]")
+    assert classify_fault(gloo) == HOST_LOSS
+    # a genuine deterministic FAILED_PRECONDITION stays fatal
+    assert classify_fault(
+        XlaRuntimeError("FAILED_PRECONDITION: bad program")) == "fatal"
+
+
+def test_chaos_parse_validates_new_kinds():
+    evs = parse_schedule("host_loss@4:1,coordinator_timeout@2")
+    assert [(e.kind, e.at) for e in evs] == [("coordinator_timeout", 2),
+                                             ("host_loss", 4)]
+    with pytest.raises(ValueError, match="takes no argument"):
+        parse_schedule("coordinator_timeout@2:5")
+    with pytest.raises(ValueError, match="integer"):
+        parse_schedule("host_loss@2:1.5")
+
+
+def test_chaos_host_loss_rejects_unfirable_specs(monkeypatch):
+    """Fire-time strictness (the group count is unknowable at parse
+    time): an out-of-range ordinal, or a topology with nothing left to
+    survive, fails loudly instead of clamping to a different drill."""
+    monkeypatch.setenv("SRNN_FORCE_SLICES", "2")
+    monkey = ChaosMonkey(parse_schedule("host_loss@1:7"))
+    with pytest.raises(ValueError, match="out of range"):
+        monkey.chunk_start(1)
+    monkeypatch.delenv("SRNN_FORCE_SLICES")
+    # a flat (single-group) topology has nothing left to survive
+    flat = ChaosMonkey(parse_schedule("host_loss@1"))
+    with pytest.raises(ValueError, match="no surviving slice"):
+        flat.chunk_start(1)
+
+
+def test_chaos_host_loss_forces_survivor_list(monkeypatch):
+    import jax
+
+    monkeypatch.setenv("SRNN_FORCE_SLICES", "2")
+    monkey = ChaosMonkey(parse_schedule("host_loss@3:0"))
+    with pytest.raises(HostLost, match="slice group 0 lost"):
+        monkey.chunk_start(3)
+    survivors = monkey.take_forced_survivors()
+    n = len(jax.devices())
+    assert [d.id for d in survivors] == [d.id for d in jax.devices()[n // 2:]]
+    # consumed: a later probe sees the real topology
+    assert monkey.take_forced_survivors() is None
+    # fire-once
+    monkey.chunk_start(5)
+
+
+def test_supervisor_multiprocess_host_loss_exits_71(monkeypatch):
+    # simulate being one process of a jax.distributed job
+    monkeypatch.setattr(bootstrap, "_CONTEXT",
+                        bootstrap.DistContext(active=True, process_id=1,
+                                              num_processes=2))
+    sup = Supervisor(BackoffPolicy(max_restarts=3, base_s=0.0),
+                     log=lambda m: None)
+
+    def run_once(args, ctx):
+        raise HostLost("peer gone")
+
+    with pytest.raises(SystemExit) as e:
+        sup.run(run_once, object())
+    assert e.value.code == EXIT_HOST_LOST
+    assert supervisor.LAST_REPORT["outcome"] == "host-lost"
+    monkeypatch.setattr(bootstrap, "_CONTEXT", None)
+
+
+# ---------------------------------------------------------------------------
+# launcher mechanics (no jax in these paths)
+# ---------------------------------------------------------------------------
+
+
+def test_launcher_strip_flag_and_propagate():
+    argv = ["mega_soup", "--chaos", "host_loss@4", "--smoke",
+            "--chaos=stall@2", "--resume", "old", "--sharded"]
+    out = launch._strip_flag(argv, "--chaos")
+    out = launch._strip_flag(out, "--resume")
+    assert out == ["mega_soup", "--smoke", "--sharded"]
+    assert launch._propagate([0, 0], set()) == 0
+    assert launch._propagate([0, 3], set()) == 3
+    assert launch._propagate([1, EXIT_HOST_LOST], set()) == EXIT_HOST_LOST
+    assert launch._propagate([0, -9], set()) == 137
+    # launcher-reaped workers' codes are consequences, not causes
+    assert launch._propagate([75, -15], {1}) == 75
+    assert launch._propagate([0, -15], {1}) == 1
+
+
+# ---------------------------------------------------------------------------
+# e2e: single-process multislice — reramp_soup_mesh as the LIVE path,
+# in-process slice-loss recovery, exit-3 mapping, bitwise oracle
+# ---------------------------------------------------------------------------
+
+
+def test_multislice_host_loss_reramps_in_process_bitwise(tmp_path,
+                                                        monkeypatch):
+    """The acceptance drill, single-process spelling: a forced 2-slice
+    CPU topology runs mega_soup on a (slices, soup) mesh; chaos kills
+    slice group 1 mid-run; the supervisor re-ramps onto the surviving
+    slice via reramp_soup_mesh and completes — CLI exit 3, final state
+    bitwise-equal to the uninterrupted run."""
+    oracle = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "11", "--root", str(tmp_path / "oracle")])
+    want = restore_checkpoint(os.path.join(oracle, "ckpt-gen00000006"))
+
+    monkeypatch.setenv("SRNN_FORCE_SLICES", "2")
+    d = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "11", "--sharded", "--root",
+         str(tmp_path / "loss"), "--chaos", "host_loss@4:1"] + FAST)
+    monkeypatch.delenv("SRNN_FORCE_SLICES")
+    got = restore_checkpoint(os.path.join(d, "ckpt-gen00000006"))
+    np.testing.assert_array_equal(np.asarray(want.weights),
+                                  np.asarray(got.weights))
+    np.testing.assert_array_equal(np.asarray(want.uids),
+                                  np.asarray(got.uids))
+    assert exit_code_for_report(supervisor.LAST_REPORT) == EXIT_RECOVERED
+    assert supervisor.LAST_REPORT["reramps"] == 1
+    log = open(os.path.join(d, "log.txt")).read()
+    assert "restart 1 after host_loss fault" in log
+    prom = open(os.path.join(d, "metrics.prom")).read()
+    assert "srnn_soup_distributed_host_losses_total 1" in prom
+    assert "srnn_soup_distributed_slices" in prom
+
+
+# ---------------------------------------------------------------------------
+# e2e: the multi-process CPU launcher
+# ---------------------------------------------------------------------------
+
+
+def test_two_process_launcher_bitwise_parity(tmp_path):
+    """The tentpole oracle: a 2-process CPU-mesh mega_soup run is
+    bitwise-equal (weights/uids/PRNG key/lineage) to the single-process
+    run of the same config, with every run artifact written exactly once
+    (process-0 gating) and per-process heartbeats present."""
+    oracle = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "13", "--root", str(tmp_path / "solo"),
+         "--lineage"])
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "srnn_tpu.distributed.launch",
+         "--processes", "2", "--",
+         "mega_soup", "--smoke", "--seed", "13", "--sharded", "--lineage",
+         "--root", str(tmp_path / "dist")],
+        env=_worker_env(), capture_output=True, text=True, timeout=540,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    dist_dir = glob.glob(str(tmp_path / "dist" / "exp-*"))[0]
+
+    import jax
+
+    want = restore_checkpoint(os.path.join(oracle, "ckpt-gen00000006"))
+    got = restore_checkpoint(os.path.join(dist_dir, "ckpt-gen00000006"))
+    np.testing.assert_array_equal(np.asarray(want.weights),
+                                  np.asarray(got.weights))
+    np.testing.assert_array_equal(np.asarray(want.uids),
+                                  np.asarray(got.uids))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(want.key)),
+        np.asarray(jax.random.key_data(got.key)))
+
+    # lineage: same windows, same edge SETS (sharded windows concatenate
+    # per-shard buffers, so within-window order is shard-interleaved —
+    # the same documented property the single-host sharded path has)
+    la = [json.loads(line) for line in open(os.path.join(oracle,
+                                                         "lineage.jsonl"))]
+    lb = [json.loads(line) for line in open(os.path.join(dist_dir,
+                                                         "lineage.jsonl"))]
+    wa = [r for r in la if r.get("kind") == "window"]
+    wb = [r for r in lb if r.get("kind") == "window"]
+    assert len(wa) == len(wb) > 0
+    for ra, rb in zip(wa, wb):
+        assert sorted(map(tuple, ra["edges"])) == sorted(map(tuple,
+                                                             rb["edges"]))
+        for k in ("fixpoints", "births_attack", "births_respawn",
+                  "gen_start", "gen_end", "next_pid"):
+            assert ra[k] == rb[k], k
+
+    # process-0 I/O contract: exactly one of each run artifact, plus the
+    # worker's own heartbeat stream
+    for name in ("metrics.prom", "lineage.jsonl", "log.txt",
+                 "events.jsonl"):
+        assert os.path.exists(os.path.join(dist_dir, name))
+    assert not glob.glob(os.path.join(dist_dir, "metrics*.prom.p*"))
+    assert os.path.exists(os.path.join(dist_dir, "events-p1.jsonl"))
+    hb = [json.loads(line) for line in open(os.path.join(
+        dist_dir, "events-p1.jsonl"))]
+    assert any(r.get("stage") == "mega_soup@p1/2" for r in hb)
+
+
+def test_launcher_propagates_killed_worker_exit_code(tmp_path):
+    """A SIGKILLed worker must surface as 128+9 from the launcher, not
+    hang it (peers are reaped after the grace window)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "srnn_tpu.distributed.launch",
+         "--processes", "2", "--grace-s", "5", "--max-reramps", "0", "--",
+         "mega_soup", "--smoke", "--seed", "17", "--sharded",
+         "--root", str(tmp_path / "kill"), "--chaos", "sigkill@2"],
+        env=_worker_env(), capture_output=True, text=True, timeout=540,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 137, proc.stdout[-3000:] + proc.stderr[-2000:]
+
+
+def test_one_sided_io_fault_escalates_to_launcher(tmp_path):
+    """A retryable fault on ONE process of a multi-process run must NOT
+    restart in-process (a one-sided restart desynchronizes the
+    collective schedule and wedges the mesh): the faulting process exits
+    71, its peer's broken collectives classify host_loss too, and the
+    launcher relaunches — completing recovered (exit 3)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "srnn_tpu.distributed.launch",
+         "--processes", "2", "--grace-s", "15", "--",
+         "mega_soup", "--smoke", "--seed", "31", "--sharded",
+         "--root", str(tmp_path / "io"), "--chaos", "writer@2"] + FAST,
+        env=_worker_env(), capture_output=True, text=True, timeout=540,
+        cwd=REPO_ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == EXIT_RECOVERED, out[-3000:]
+    assert "in-process restart would desync the mesh" in out
+    assert "supervisor: restart" not in out  # never restarted in-process
+
+
+@pytest.mark.slow
+def test_launcher_host_loss_reramp_completes_recovered(tmp_path):
+    """The full launcher-tier re-ramp: chaos host loss mid-run -> every
+    worker exits 71 -> relaunch with one fewer process resuming the run
+    dir -> completion -> launcher exits 3 (recovered), bitwise-equal to
+    the uninterrupted run."""
+    oracle = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "19", "--root", str(tmp_path / "solo")])
+    proc = subprocess.run(
+        [sys.executable, "-m", "srnn_tpu.distributed.launch",
+         "--processes", "2", "--grace-s", "10", "--",
+         "mega_soup", "--smoke", "--seed", "19", "--sharded",
+         "--root", str(tmp_path / "dist"), "--chaos", "host_loss@4"],
+        env=_worker_env(), capture_output=True, text=True, timeout=540,
+        cwd=REPO_ROOT)
+    assert proc.returncode == EXIT_RECOVERED, \
+        proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "re-ramp 1/" in proc.stderr + proc.stdout
+    dist_dir = glob.glob(str(tmp_path / "dist" / "exp-*"))[0]
+    want = restore_checkpoint(os.path.join(oracle, "ckpt-gen00000006"))
+    got = restore_checkpoint(os.path.join(dist_dir, "ckpt-gen00000006"))
+    np.testing.assert_array_equal(np.asarray(want.weights),
+                                  np.asarray(got.weights))
+    np.testing.assert_array_equal(np.asarray(want.uids),
+                                  np.asarray(got.uids))
