@@ -300,6 +300,11 @@ def _serve_leg() -> dict:
     batch = int(os.environ.get("SRNN_BENCH_SERVE_BATCH", "512"))
     load_s = float(os.environ.get("SRNN_BENCH_SERVE_LOAD_S", "8"))
     load_clients = int(os.environ.get("SRNN_BENCH_SERVE_CLIENTS", "4"))
+    # the load leg's latency target: requests slower than this count into
+    # serve_slo_violations_total (the adaptive-window signal); 350ms sits
+    # just above the window-bound p95 ~312ms PR 10 measured, so a healthy
+    # run reads near-zero and a regression reads loud
+    slo_ms = float(os.environ.get("SRNN_BENCH_SERVE_SLO_P95_MS", "350"))
     load_trials = 64
 
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -308,7 +313,7 @@ def _serve_leg() -> dict:
     svc = server_thread = None
     try:
         svc = ExperimentService(os.path.join(root, "svc"),
-                                max_stack=sweeps)
+                                max_stack=sweeps, slo_p95_ms=slo_ms)
         _hb("serve", "warmup")
         svc.warm("fixpoint_density", {"trials": trials, "batch": batch})
         svc.warm("fixpoint_density",
@@ -415,6 +420,7 @@ def _serve_leg() -> dict:
             t.join()
         load_wall = time.monotonic() - t0
         lats = [x for lst in lat_lists for x in lst]
+        slo = client.stats().get("slo") or {}
         out["load"] = {
             "clients": load_clients,
             "window_s": round(load_wall, 2),
@@ -422,6 +428,8 @@ def _serve_leg() -> dict:
             "requests_per_sec": round(len(lats) / max(load_wall, 1e-9), 2),
             "p50_ms": round(1e3 * quantile_from_times(lats, 0.5), 1),
             "p95_ms": round(1e3 * quantile_from_times(lats, 0.95), 1),
+            "slo_target_p95_ms": slo.get("target_p95_ms"),
+            "slo_violations": slo.get("violations"),
         }
     finally:
         # teardown runs on EVERY path: an exception above must not leave

@@ -2,10 +2,11 @@
 for the soup hot path, before/after the AOT + donation subsystem.
 
 One JSON line of rows (plus ``telemetry``/``health``/``lineage``/
-``fused``: the in-scan carries' dispatch overhead, and ``stacked``: the
-serve tenant-axis amortization — K=8 stacked dispatch vs 8 solo
-dispatches — all on the shared interleaved median-of-medians protocol;
-see their docstrings):
+``fused``: the in-scan carries' dispatch overhead, ``spans``: the fleet
+observatory's per-chunk span emission on top of ``metered.health``, and
+``stacked``: the serve tenant-axis amortization — K=8 stacked dispatch
+vs 8 solo dispatches — all on the shared interleaved median-of-medians
+protocol; see their docstrings):
 
   * ``compile``: wall time of the soup hot path's BACKEND COMPILE (the
     generation step + the 100-generation chunk run, full dynamics) in a
@@ -312,6 +313,52 @@ def row_lineage() -> dict:
                          base="health", feature="lineage")
 
 
+def row_spans() -> dict:
+    """Walltime overhead of the fleet observatory's structured span
+    emission on top of the ``metered.health`` chunk (documented bound
+    <= ~5%): the ``spans`` variant runs the SAME chunk program and then
+    emits the per-chunk span family (root + device_wait/host_io
+    children) through a real file-backed event channel — proving
+    ticket/chunk span emission is pure host work off the device hot
+    path.  Plain baseline interleaved per the shared protocol."""
+    import tempfile
+
+    from srnn_tpu.telemetry.tracing import SpanStream
+
+    fns = _chunk_fns()
+    tmp = tempfile.NamedTemporaryFile(  # noqa: SIM115 - closed at exit
+        mode="w", suffix=".jsonl", prefix="srnn_micro_spans_",
+        delete=False)
+
+    class _Events:
+        def event(self, **fields):
+            tmp.write(json.dumps(fields, default=str) + "\n")
+            tmp.flush()
+
+    stream = SpanStream(_Events(), trace_id="micro", process=0)
+    health = fns["health"]
+
+    def spans():
+        value = health()
+        end = stream.now()
+        root = stream.emit("micro.chunk", end - 0.1, 0.1, generation=1,
+                           generations=TELEMETRY_GENS)
+        stream.emit("micro.device_wait", end - 0.1, 0.08, parent=root,
+                    generation=1)
+        stream.emit("micro.host_io", end - 0.02, 0.02, parent=root,
+                    generation=1)
+        return value
+
+    try:
+        return _overhead_row("spans",
+                             {"plain": fns["plain"], "health": health,
+                              "spans": spans},
+                             base="health", feature="spans")
+    finally:
+        tmp.close()
+        os.unlink(tmp.name)
+
+
 def row_fused() -> dict:
     """``generation_impl='fused'`` vs the phase chain at the micro config
     (same dynamics, same draws).  On Mosaic backends this measures the
@@ -398,12 +445,12 @@ def main(argv=None) -> int:
         return 0
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
-            row_telemetry(), row_health(), row_lineage(), row_fused(),
-            row_stacked()]
+            row_telemetry(), row_health(), row_lineage(), row_spans(),
+            row_fused(), row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h, l, fu, sk = rows
+        c, d, m, t, h, l, sp, fu, sk = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -428,6 +475,10 @@ def main(argv=None) -> int:
               f"{l['lineage_ms_per_chunk']:.1f}ms vs metered.health "
               f"{l['health_ms_per_chunk']:.1f}ms per chunk "
               f"({l['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# spans(N={sp['n']}, G={sp['generations']}): +span rows "
+              f"{sp['spans_ms_per_chunk']:.1f}ms vs metered.health "
+              f"{sp['health_ms_per_chunk']:.1f}ms per chunk "
+              f"({sp['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
         print(f"# fused(N={fu['n']}, G={fu['generations']}): "
               f"{fu['fused_ms_per_chunk']:.1f}ms vs phases "
               f"{fu['plain_ms_per_chunk']:.1f}ms per chunk "

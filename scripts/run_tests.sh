@@ -197,6 +197,49 @@ fi
 rm -rf "$dist_root"
 summary+=$(printf '%-34s %-4s %4ss' "distributed_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Observability smoke (srnn_tpu/telemetry/ fleet observatory): a REAL
+# 2-process launcher run must produce ONE merged `report --fleet`
+# timeline rendering BOTH process lanes (straggler attribution included),
+# and `watch --once` must return valid JSON carrying a generation field
+# for every process — the fleet merge + live console drilled on every
+# suite run, not just when the slow e2e is selected.
+t0=$SECONDS
+obs_root=$(mktemp -d)
+obs_ok=1
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.distributed.launch \
+    --processes 2 -- mega_soup --smoke --seed 29 --sharded \
+    --root "$obs_root/run" > "$obs_root/out.log" 2>&1 || obs_ok=0
+if [ "$obs_ok" -eq 1 ]; then
+    obs_dir=$(ls -d "$obs_root"/run/exp-* 2>/dev/null | head -1)
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+        --fleet "$obs_dir" > "$obs_root/fleet.txt" 2>>"$obs_root/out.log" \
+        || obs_ok=0
+    grep -q '^  p0 ' "$obs_root/fleet.txt" || obs_ok=0
+    grep -q '^  p1 ' "$obs_root/fleet.txt" || obs_ok=0
+    grep -q '^straggler: ' "$obs_root/fleet.txt" || obs_ok=0
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.watch \
+        "$obs_dir" --once > "$obs_root/snap.json" 2>>"$obs_root/out.log" \
+        || obs_ok=0
+    python - "$obs_root/snap.json" >> "$obs_root/out.log" 2>&1 <<'PY' || obs_ok=0
+import json, sys
+snap = json.load(open(sys.argv[1]))
+procs = snap["processes"]
+assert set(procs) >= {"0", "1"}, sorted(procs)
+for p, lane in procs.items():
+    assert isinstance(lane.get("generation"), int), (p, lane)
+assert snap["straggler"] is not None
+print("observability_smoke: fleet lanes + watch snapshot OK")
+PY
+fi
+if [ "$obs_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("observability_smoke")
+    tail -n 40 "$obs_root/out.log"; cat "$obs_root/fleet.txt" 2>/dev/null
+fi
+rm -rf "$obs_root"
+summary+=$(printf '%-34s %-4s %4ss' "observability_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 echo
 echo "=== run_tests.sh summary ==="
 printf '%s' "$summary"

@@ -12,9 +12,20 @@ set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${TPU_WATCH_INTERVAL_S:-600}"
 LOG="${TPU_WATCH_LOG:-/tmp/tpu_watch.log}"
+# Opt-in fleet snapshot: point TPU_WATCH_SNAPSHOT_DIR at a run dir and
+# every poll appends one `telemetry.watch --once` JSON snapshot (stage /
+# generation / gens-per-sec / straggler across all processes) to the log
+# — the unattended window's liveness trail without tail-ing heartbeat
+# files by hand.  CPU-pinned and PYTHONPATH-stripped like the probe: the
+# watch is a pure file reader and must never dial the tunnel.
+SNAPSHOT_DIR="${TPU_WATCH_SNAPSHOT_DIR:-}"
 
 echo "$(date -u +%FT%TZ) tpu_watch: probing every ${INTERVAL}s" >> "$LOG"
 while true; do
+    if [ -n "$SNAPSHOT_DIR" ] && [ -d "$SNAPSHOT_DIR" ]; then
+        PYTHONPATH= JAX_PLATFORMS=cpu timeout 60 python -m \
+            srnn_tpu.telemetry.watch "$SNAPSHOT_DIR" --once >> "$LOG" 2>&1
+    fi
     if PYTHONPATH= timeout 280 python benchmarks/opportunistic.py \
             --probe-only >> "$LOG" 2>&1; then
         echo "$(date -u +%FT%TZ) tpu_watch: HEALTHY — running window capture" >> "$LOG"

@@ -24,6 +24,8 @@ ALLOWED_FILES = {
     "precompile.py",         # CLI: prints its one JSON result line
     "viz.py",                # CLI: run-dir walker output
     "telemetry/report.py",   # CLI: renders the telemetry summary
+    "telemetry/watch.py",    # CLI: the live watch console — stdout IS
+                             # its product (snapshots + refresh frames)
     "analysis/__main__.py",  # CLI: this analyzer's own report output
     "serve/__main__.py",     # CLI: service startup line + stats JSON
     "distributed/launch.py",  # CLI: worker-output relay IS its stdout job

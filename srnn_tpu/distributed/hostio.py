@@ -32,6 +32,29 @@ import numpy as np
 #: this are refused at broadcast time, not corrupted)
 _RUN_DIR_FRAME = 1024
 
+#: fleet-observatory hook: when a run installs its ``SpanStream`` here
+#: (``setups.common.make_spans``), every collective in this module times
+#: itself and emits a structured span row — per process, so the merged
+#: timeline shows WHICH process sat in a gather.  ``None`` (the default,
+#: and every non-mega caller's state) is free: one predicate per call.
+_SPAN_SINK = None
+
+
+def set_span_sink(emit) -> None:
+    """Install (or clear, with ``None``) the collective span emitter:
+    a callable ``emit(name, dur_s, **labels)``."""
+    global _SPAN_SINK
+    _SPAN_SINK = emit
+
+
+def _emit_span(name: str, t0: float, **labels) -> None:
+    sink = _SPAN_SINK
+    if sink is not None:
+        try:
+            sink(name, time.monotonic() - t0, **labels)
+        except Exception:
+            pass  # observability must never take down a collective path
+
 
 def fetch_tree(tree):
     """Materialize a (possibly multi-process-sharded) pytree on host.
@@ -45,6 +68,8 @@ def fetch_tree(tree):
     import jax
     from jax.experimental import multihost_utils
 
+    gathers = [0]
+
     def one(x):
         if not isinstance(x, jax.Array):
             return x
@@ -54,9 +79,13 @@ def fetch_tree(tree):
                 np.asarray(data), impl=str(jax.random.key_impl(x)))
         if x.is_fully_addressable or x.sharding.is_fully_replicated:
             return np.asarray(x)
+        gathers[0] += 1
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
-    return jax.tree.map(one, tree)
+    t0 = time.monotonic()
+    out = jax.tree.map(one, tree)
+    _emit_span("hostio.fetch_tree", t0, collectives=gathers[0])
+    return out
 
 
 def broadcast_run_dir(run_dir) -> str:
@@ -75,8 +104,10 @@ def broadcast_run_dir(run_dir) -> str:
         buf[:len(raw)] = np.frombuffer(raw, np.uint8)
     # the broadcast is a psum under the hood and may promote the dtype
     # (uint8 -> int32 observed); cast back before reading the bytes
+    t0 = time.monotonic()
     out = np.asarray(multihost_utils.broadcast_one_to_all(buf)).astype(
         np.uint8)
+    _emit_span("hostio.broadcast_run_dir", t0)
     path = bytes(out).rstrip(b"\x00").decode()
     if not path:
         raise RuntimeError("run-dir broadcast produced an empty path "

@@ -25,6 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-window-s", type=float, default=0.25, metavar="S",
                    help="requests arriving within S seconds of each other "
                         "are scheduled together (the stacking window)")
+    p.add_argument("--slo-p95-ms", type=float, default=0.0, metavar="MS",
+                   help="latency target: each request slower than MS "
+                        "counts into serve_slo_violations_total and the "
+                        "stats/watch SLO view (0 = no target)")
     p.add_argument("--warm-fixpoint-density", default=None,
                    metavar="TRIALS,BATCH",
                    help="pre-dispatch the fixpoint-density executor at "
@@ -71,7 +75,8 @@ def main(argv=None) -> int:
 
     ensure_compilation_cache()
     os.makedirs(args.root, exist_ok=True)
-    service = ExperimentService(args.root, max_stack=args.max_stack)
+    service = ExperimentService(args.root, max_stack=args.max_stack,
+                                slo_p95_ms=args.slo_p95_ms)
     if args.warm_fixpoint_density:
         trials, batch = (int(x) for x in
                          args.warm_fixpoint_density.split(","))

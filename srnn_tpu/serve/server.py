@@ -123,9 +123,11 @@ class ServiceServer(socketserver.ThreadingMixIn,
                 continue
             if self.batch_window_s > 0:
                 time.sleep(self.batch_window_s)
-            self.service.run_pending()
+            # window_s = the sleep just performed: the service splits each
+            # ticket's pre-dispatch wait into queue vs window spans with it
+            self.service.run_pending(window_s=self.batch_window_s)
         # drain whatever raced the stop (handle_op rejects new traffic
-        # once _stop is set, so this converges)
+        # once _stop is set, so this converges; no window sleep here)
         while self.service.queue_depth() > 0:
             self.service.run_pending()
 

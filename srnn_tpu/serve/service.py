@@ -15,6 +15,19 @@ requests with ``lineage: true`` stream per-tenant replication-dynamics
 window rows (tenant-labeled) into ``lineage.jsonl`` — one I/O thread, the
 same submission-order guarantees as the mega loops.
 
+Ticket tracing (the fleet observatory's request-level half): every
+completed ticket emits a structured span family into ``events.jsonl`` —
+a ``serve.ticket`` root whose duration IS the measured request latency,
+with ``queue``/``window``/``dispatch``/``publish`` children that sum to
+it exactly (queue = pre-window backlog wait, window = the share of the
+batching window the ticket actually sat out, dispatch = its group's
+execution wall with the per-tenant amortized cost and stack width K as
+labels, publish = the result-delivery residual).  The breakdown also
+feeds the ``serve_ticket_*_seconds`` histograms, and a request whose
+latency exceeds the ``slo_p95_ms`` target counts into
+``serve_slo_violations_total`` — the signal a future SLO-driven adaptive
+batch window optimizes against (ROADMAP item 3).
+
 Transport lives elsewhere (``serve.server`` wraps this in a Unix-socket
 JSON-lines server; in-process callers — tests, the bench load leg — drive
 it directly).
@@ -114,13 +127,20 @@ class ExperimentService:
 
     def __init__(self, root: str, max_stack: int = DEFAULT_MAX_STACK,
                  registry: Optional[MetricsRegistry] = None,
-                 writer=None):
+                 writer=None, slo_p95_ms: float = 0.0):
         from ..utils.pipeline import BackgroundWriter
 
         os.makedirs(root, exist_ok=True)
         self.root = root
         self.max_stack = max_stack
+        self.slo_p95_ms = float(slo_p95_ms)
         self.registry = registry or MetricsRegistry()
+        # registered eagerly so metrics.prom always exposes the SLO
+        # counter (a clean service shows the 0, not a missing series)
+        self.registry.counter(
+            "serve_slo_violations_total",
+            help="requests whose latency exceeded the --slo-p95-ms "
+                 "target")
         self._own_writer = writer is None
         self.writer = writer or BackgroundWriter(name="serve-io")
         self._events = open(os.path.join(root, "events.jsonl"), "a")
@@ -133,6 +153,7 @@ class ExperimentService:
         self._draining = False   # set by fail_pending: no more submits
         self._warming = False    # warm() dispatches skip telemetry rows
         self._tickets = itertools.count(1)
+        self._span_ids = itertools.count(1)   # ticket-span ids
         self._programs = set()   # distinct (kind, key, shape) signatures
         self._closed = False
         self._t0 = time.monotonic()
@@ -191,10 +212,13 @@ class ExperimentService:
 
     # -- execution -------------------------------------------------------
 
-    def run_pending(self) -> int:
+    def run_pending(self, window_s: float = 0.0) -> int:
         """Drain the queue through the scheduler: plan stacked/solo
         dispatches, execute them, publish results.  Returns the number of
-        requests completed."""
+        requests completed.  ``window_s`` is the batching-window sleep
+        the transport just performed before this drain (the stacking
+        knob) — it attributes each ticket's pre-dispatch wait between
+        queue backlog and window in the ticket-span breakdown."""
         with self._lock:
             batch, self._pending = self._pending, []
         if not batch:
@@ -204,11 +228,12 @@ class ExperimentService:
                                 self.queue_depth())
         plan = plan_dispatches(batch, GROUP_KEYS, self.max_stack)
         for dispatch in plan:
-            self._run_dispatch(dispatch)
+            self._run_dispatch(dispatch, window_s=window_s)
         self.write_metrics()
         return len(batch)
 
-    def _run_dispatch(self, dispatch: Dispatch) -> None:
+    def _run_dispatch(self, dispatch: Dispatch,
+                      window_s: float = 0.0) -> None:
         mode = "stacked" if dispatch.stacked else "solo"
         t0 = time.monotonic()
         try:
@@ -260,6 +285,10 @@ class ExperimentService:
                         "serve_requests_failed_total",
                         help="requests whose dispatch raised").inc(
                             1, kind=req.kind)
+                self._ticket_spans(req, mode=mode,
+                                   stack_k=len(dispatch.requests),
+                                   dispatch_start=t0, wall=wall, now=now,
+                                   window_s=window_s, error=error)
                 self._event_row(kind="serve_tenant", ticket=req.ticket,
                                 tenant=req.tenant, request_kind=req.kind,
                                 mode=mode,
@@ -270,6 +299,65 @@ class ExperimentService:
             while len(self._results) > RESULT_RETENTION:
                 self._results.pop(next(iter(self._results)))
             self._done.notify_all()
+
+    def _ticket_spans(self, req: Request, *, mode: str, stack_k: int,
+                      dispatch_start: float, wall: float, now: float,
+                      window_s: float, error) -> None:
+        """One completed ticket's structured span family + the
+        ``serve_ticket_*`` histograms + the SLO counter.
+
+        Breakdown contract (asserted in ``tests/test_fleet.py``): the
+        root ``serve.ticket`` span's duration is EXACTLY the latency the
+        ``serve_request_seconds`` histogram observed, and the four child
+        durations sum to it — queue (backlog wait before the batching
+        window's share), window (``min(pre-dispatch wait, window_s)`` —
+        a ticket that arrived mid-window only sat out the remainder),
+        dispatch (its group's execution wall), publish (result-delivery
+        residual)."""
+        latency = now - req.submitted_s
+        pre_dispatch = max(0.0, dispatch_start - req.submitted_s)
+        window_wait = min(max(0.0, float(window_s)), pre_dispatch)
+        queue_wait = pre_dispatch - window_wait
+        publish = max(0.0, latency - pre_dispatch - wall)
+        start = req.submitted_s - self._t0
+        root = next(self._span_ids)
+        common = dict(trace_id=req.ticket, process=0, tenant=req.tenant,
+                      request_kind=req.kind)
+        self._event_row(kind="span", span="serve.ticket", span_id=root,
+                        start_s=round(start, 6),
+                        seconds=round(latency, 6), mode=mode,
+                        stack_k=stack_k, error=error, **common)
+        for name, child_start, dur, extra in (
+                ("serve.ticket.queue", start, queue_wait, {}),
+                ("serve.ticket.window", start + queue_wait, window_wait,
+                 {}),
+                ("serve.ticket.dispatch", dispatch_start - self._t0, wall,
+                 {"stack_k": stack_k,
+                  "per_tenant_s": round(wall / max(1, stack_k), 6)}),
+                ("serve.ticket.publish", now - self._t0 - publish, publish,
+                 {})):
+            self._event_row(kind="span", span=name,
+                            span_id=next(self._span_ids), parent=root,
+                            start_s=round(child_start, 6),
+                            seconds=round(dur, 6), **common, **extra)
+        h = self.registry.histogram
+        h("serve_ticket_queue_seconds",
+          help="per-ticket backlog wait before the batching window",
+          unit="seconds", buckets=_LATENCY_BUCKETS).observe(
+            queue_wait, kind=req.kind)
+        h("serve_ticket_window_seconds",
+          help="per-ticket share of the batching window sat out",
+          unit="seconds", buckets=_LATENCY_BUCKETS).observe(
+            window_wait, kind=req.kind)
+        h("serve_ticket_dispatch_seconds",
+          help="per-ticket dispatch-group execution wall",
+          unit="seconds", buckets=_LATENCY_BUCKETS).observe(
+            wall, kind=req.kind)
+        if self.slo_p95_ms > 0 and latency * 1000.0 > self.slo_p95_ms:
+            self.registry.counter(
+                "serve_slo_violations_total",
+                help="requests whose latency exceeded the --slo-p95-ms "
+                     "target").inc(1, kind=req.kind)
 
     # -- executors -------------------------------------------------------
 
@@ -458,14 +546,29 @@ class ExperimentService:
         return path
 
     def stats(self) -> dict:
-        """Host-side snapshot for the ``stats`` op / load bench."""
+        """Host-side snapshot for the ``stats`` op / load bench / watch
+        console; ``slo`` carries the target, the violation count, and a
+        conservative measured p95 (histogram-bucket upper bound)."""
         with self._lock:
             done = self._completed
             depth = len(self._pending)
             programs = len(self._programs)
+        violations = sum(
+            v for _suffix, v in self.registry.counter(
+                "serve_slo_violations_total").samples())
+        p95 = self.registry.histogram(
+            "serve_request_seconds",
+            help="submit-to-completion latency", unit="seconds",
+            buckets=_LATENCY_BUCKETS).quantile(0.95)
         return {"completed": done, "queue_depth": depth,
                 "distinct_programs": programs,
                 "uptime_s": round(time.monotonic() - self._t0, 2),
+                "slo": {
+                    "target_p95_ms": self.slo_p95_ms or None,
+                    "violations": int(violations),
+                    "p95_ms": round(p95 * 1000.0, 3)
+                    if p95 is not None else None,
+                },
                 "metrics": self.registry.rows()}
 
     def fail_pending(self, reason: str) -> int:

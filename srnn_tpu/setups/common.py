@@ -213,6 +213,13 @@ def add_pipeline_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                    help="fsync every N-th heartbeat row (default 1: "
                         "row-by-row kill survival; raise to amortize the "
                         "sync on slow storage)")
+    p.add_argument("--no-spans", action="store_true",
+                   help="drop the fleet observatory's structured span "
+                        "rows (per-chunk dispatch/host-I/O/gather "
+                        "attribution in events*.jsonl); spans are "
+                        "host-only, so results are bit-identical either "
+                        "way — this knob exists as the A/B oracle for "
+                        "exactly that claim")
     return p
 
 
@@ -347,6 +354,81 @@ def fetch_for_checkpoint(state, dist, meter, registry):
                            unit="seconds").observe(
             _time.perf_counter() - t0)
     return host
+
+
+# ---- fleet-observatory plumbing (mega_soup / mega_multisoup) ---------------
+
+
+def make_spans(args, exp, registry, writer, dist, stage: str):
+    """Build the run's structured-span stream (``telemetry.tracing.
+    SpanStream``) and install it as the hostio collective span sink —
+    every process gets one (workers' rows land in their
+    ``events-p<i>.jsonl`` via ``WorkerLog.event``, the fleet merge
+    reassembles them).  ``--no-spans`` returns ``None`` and clears the
+    sink — the bit-identical A/B reference for "observability never
+    perturbs results"."""
+    from ..distributed.hostio import set_span_sink
+
+    if getattr(args, "no_spans", False):
+        set_span_sink(None)
+        return None
+    from ..telemetry.tracing import SpanStream
+
+    active = dist is not None and dist.active
+    spans = SpanStream(exp, trace_id=os.path.basename(exp.dir),
+                       process=dist.process_id if active else 0,
+                       writer=writer, registry=registry)
+
+    def hostio_emit(name, dur_s, **labels):
+        spans.emit(name, spans.now() - dur_s, dur_s, stage=stage, **labels)
+
+    set_span_sink(hostio_emit)
+    return spans
+
+
+def close_spans() -> None:
+    """Uninstall the hostio span sink (run teardown: the sink closes over
+    this attempt's writer, and a supervisor restart builds a fresh one)."""
+    from ..distributed.hostio import set_span_sink
+
+    set_span_sink(None)
+
+
+def emit_chunk_spans(spans, stage: str, gen: int, chunk: int,
+                     pipeline_row: dict) -> None:
+    """One chunk's span family, emitted from the finisher AFTER
+    ``OverlapMeter.chunk_done`` so the attribution is reused, never
+    re-measured: a ``<stage>.chunk`` root spanning the chunk wall, with
+    ``device_wait`` (blocked on device results — the dispatch half) and
+    ``host_io`` (foreground sink writes + background-writer busy delta)
+    children.  The distributed gather's span is emitted separately by the
+    hostio sink at gather time, same trace."""
+    if spans is None:
+        return
+    end = spans.now()
+    wall = float(pipeline_row.get("wall_s", 0.0))
+    start = end - wall
+    root = spans.emit(f"{stage}.chunk", start, wall, generation=gen,
+                      generations=chunk)
+    spans.emit(f"{stage}.device_wait", start,
+               float(pipeline_row.get("device_wait_s", 0.0)), parent=root,
+               generation=gen)
+    spans.emit(f"{stage}.host_io", start,
+               float(pipeline_row.get("host_io_s", 0.0)), parent=root,
+               generation=gen)
+
+
+def update_fleet_gauges(registry, run_dir: str, dist) -> None:
+    """Fold the LIVE straggler attribution into the registry (the
+    ``soup_straggler_*`` gauges) from a bounded tail-read of every
+    process's event file.  Called by the primary's chunk finisher via
+    the background writer — pure file reads, never a collective, so the
+    one no-collectives-on-the-writer rule (DESIGN §16) holds."""
+    from ..telemetry import fleet
+
+    att = fleet.live_attribution(run_dir, dist.num_processes)
+    if att is not None:
+        fleet.update_straggler_gauges(registry, att)
 
 
 # ---- elastic-supervisor plumbing (mega_soup / mega_multisoup) --------------

@@ -48,14 +48,15 @@ from ..topology import Topology
 from ..distributed import add_distributed_args
 from .common import (add_dynamics_args, add_flightrec_args,
                      add_pipeline_args, add_resilience_args, base_parser,
-                     build_soup_mesh, chunk_boundary_faults,
-                     fetch_for_checkpoint, finish_pipeline,
-                     flush_lineage_probe, flush_lineage_window,
-                     init_distributed, latest_checkpoint, make_flightrec,
-                     make_lineage, make_on_stall, make_pipeline,
+                     build_soup_mesh, chunk_boundary_faults, close_spans,
+                     emit_chunk_spans, fetch_for_checkpoint,
+                     finish_pipeline, flush_lineage_probe,
+                     flush_lineage_window, init_distributed,
+                     latest_checkpoint, make_flightrec, make_lineage,
+                     make_on_stall, make_pipeline, make_spans,
                      load_run_config, note_restart, open_run, register,
                      save_run_config, set_distributed_gauges, stage_label,
-                     watchdog_chunk)
+                     update_fleet_gauges, watchdog_chunk)
 
 
 def build_parser():
@@ -347,6 +348,10 @@ def _run_once(args, ctx=None):
             chaos.attach_writer(writer)
         driver.on_stall = make_on_stall(exp, flightrec, registry,
                                         lambda: gen) if primary else None
+        # fleet observatory: structured chunk/gather spans (host-only;
+        # --no-spans is the bit-identical A/B reference)
+        spans = make_spans(args, exp, registry, writer, dist,
+                           "mega_multisoup")
         hb = Heartbeat(exp, stage=stage_label("mega_multisoup", dist),
                        total_generations=args.generations,
                        registry=registry,
@@ -471,6 +476,11 @@ def _run_once(args, ctx=None):
                             chunk_seconds=round(dt, 3))
                     # run-dir artifacts are process-0-gated (DESIGN §16)
                     if primary:
+                        if dist.active:
+                            # live straggler gauges (tail-read on the
+                            # writer — file I/O only, see mega_soup)
+                            submit_or_run(writer, update_fleet_gauges,
+                                          registry, exp.dir, dist)
                         submit_or_run(writer, registry.flush_events, exp)
                         submit_or_run(writer, registry.write_textfile,
                                       os.path.join(exp.dir, "metrics.prom"))
@@ -484,6 +494,9 @@ def _run_once(args, ctx=None):
                                               f"ckpt-gen{gen:08d}"),
                                           ckpt_state)
                 row["pipeline"] = meter.chunk_done(dt)
+                # chunk span family reusing the attribution just computed
+                emit_chunk_spans(spans, "mega_multisoup", gen, chunk,
+                                 row["pipeline"])
                 # stamped copy: see mega_soup (gens_regress seq exclusion)
                 row = flightrec.record(row)
                 # distributed runs skip the bundle's state snapshot (its
@@ -573,6 +586,9 @@ def _run_once(args, ctx=None):
         # meta.json guaranteed
         if watchdog is not None:
             watchdog.stop_trace()
+        # clear the hostio span sink before this attempt's writer goes
+        # down (see mega_soup)
+        close_spans()
         try:
             try:
                 try:

@@ -40,14 +40,15 @@ from ..topology import Topology
 from ..distributed import add_distributed_args
 from .common import (add_dynamics_args, add_flightrec_args,
                      add_pipeline_args, add_resilience_args, base_parser,
-                     build_soup_mesh, chunk_boundary_faults,
-                     fetch_for_checkpoint, finish_pipeline,
-                     flush_lineage_probe, flush_lineage_window,
-                     init_distributed, latest_checkpoint, load_run_config,
-                     make_flightrec, make_lineage, make_on_stall,
-                     make_pipeline, note_restart, open_run, register,
+                     build_soup_mesh, chunk_boundary_faults, close_spans,
+                     emit_chunk_spans, fetch_for_checkpoint,
+                     finish_pipeline, flush_lineage_probe,
+                     flush_lineage_window, init_distributed,
+                     latest_checkpoint, load_run_config, make_flightrec,
+                     make_lineage, make_on_stall, make_pipeline,
+                     make_spans, note_restart, open_run, register,
                      save_run_config, set_distributed_gauges, stage_label,
-                     watchdog_chunk)
+                     update_fleet_gauges, watchdog_chunk)
 
 
 def build_parser():
@@ -285,6 +286,9 @@ def _run_once(args, ctx=None):
             chaos.attach_writer(writer)
         driver.on_stall = make_on_stall(exp, flightrec, registry,
                                         lambda: gen) if primary else None
+        # fleet observatory: structured chunk/gather spans (host-only —
+        # the evolved state is bit-identical with --no-spans, tested)
+        spans = make_spans(args, exp, registry, writer, dist, "mega_soup")
         hb = Heartbeat(exp, stage=stage_label("mega_soup", dist),
                        total_generations=args.generations,
                        registry=registry,
@@ -415,6 +419,14 @@ def _run_once(args, ctx=None):
                     # workers contribute through the collective shard
                     # boundaries, never through these sinks
                     if primary:
+                        if dist.active:
+                            # live straggler gauges: tail-read every
+                            # process's heartbeat file on the writer
+                            # (file I/O only — never a collective) so
+                            # this chunk's metrics row names the current
+                            # fleet straggler
+                            submit_or_run(writer, update_fleet_gauges,
+                                          registry, exp.dir, dist)
                         submit_or_run(writer, registry.flush_events, exp)
                         submit_or_run(writer, registry.write_textfile,
                                       os.path.join(exp.dir, "metrics.prom"))
@@ -428,6 +440,10 @@ def _run_once(args, ctx=None):
                                               f"ckpt-gen{gen:08d}"),
                                           ckpt_state)
                 row["pipeline"] = meter.chunk_done(dt)
+                # chunk span family (root + device_wait/host_io children)
+                # reusing the attribution just computed above
+                emit_chunk_spans(spans, "mega_soup", gen, chunk,
+                                 row["pipeline"])
                 # the stamped copy (seq/t) is what the rules see — the
                 # gens_regress median excludes the current row by seq
                 row = flightrec.record(row)
@@ -552,6 +568,9 @@ def _run_once(args, ctx=None):
         # (e.g. disk full).
         if watchdog is not None:
             watchdog.stop_trace()
+        # the hostio span sink closes over this attempt's writer; clear it
+        # before the writer goes down (a restart installs a fresh one)
+        close_spans()
         try:
             try:
                 try:

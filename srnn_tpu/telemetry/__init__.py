@@ -25,7 +25,7 @@ from .device import (N_ACTIONS, N_HEALTH_BUCKETS, HealthStats, SoupMetrics,
                      probe_health, psum_health, psum_soup_metrics,
                      zero_health, zero_soup_metrics)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, RUNTIME)
-from .tracing import Span, annotate, span, trace
+from .tracing import Span, SpanStream, annotate, span, trace
 from .heartbeat import Heartbeat, device_memory_stats, rss_bytes
 from .soup_metrics import (EVENT_COUNTERS, update_class_gauges,
                            update_multi_registry, update_registry)
@@ -43,7 +43,7 @@ __all__ = [
     "N_HEALTH_BUCKETS", "HealthStats", "accumulate_health", "merge_health",
     "probe_health", "psum_health", "zero_health",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "RUNTIME",
-    "Span", "annotate", "span", "trace",
+    "Span", "SpanStream", "annotate", "span", "trace",
     "Heartbeat", "device_memory_stats", "rss_bytes",
     "EVENT_COUNTERS", "update_class_gauges", "update_multi_registry",
     "update_registry",

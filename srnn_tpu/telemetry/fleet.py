@@ -1,0 +1,340 @@
+"""Fleet aggregation: one ordered timeline from a distributed run dir.
+
+A multi-process run (``distributed.launch``) writes its host artifacts
+through process 0 (DESIGN §16) — but liveness is per process: process 0's
+heartbeats/spans ride ``events.jsonl`` while every worker streams its own
+``events-p<i>.jsonl``.  Until this module, nothing merged them: a run dir
+rendered as a single-process run and straggler questions ("which process
+holds the fleet back, and by how much?") needed hand-`tail`-ing files.
+
+Three jobs, all host-side reads (no jax import, safe from any thread):
+
+  * **Merge** — :func:`merged_timeline` folds process-0 events + all
+    worker event files into ONE ordered timeline.  Ordering rule: rows
+    sort by ``(t, process, file-order)`` where ``t`` is each process's
+    run-relative stamp (processes start within the bring-up window of
+    each other, so cross-process ``t`` is comparable to well under one
+    chunk — good enough for lane views, documented as approximate for
+    anything finer).  Unparseable lines (the torn tail of a killed or
+    still-writing file) are SKIPPED and counted, never fatal.
+  * **Straggler attribution** — per-process gens/sec skew from the
+    heartbeat lanes: who is slowest, how far they trail the leader, and
+    the per-process rates — exported as the ``soup_straggler_*`` gauges
+    (:func:`update_straggler_gauges`; the mega loops fold them live each
+    chunk via :func:`live_attribution`, so ``metrics.prom`` shows the
+    CURRENT straggler during the run, not just post-mortem).
+  * **Summaries** — :func:`fleet_summary` (the ``report --fleet`` and
+    ``telemetry.watch`` backend) with a per-process lane view rendered
+    by :func:`render_fleet`.
+"""
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import quantile_from_times
+
+_WORKER_RE = re.compile(r"^events-p(\d+)\.jsonl$")
+
+#: live_attribution reads only this many trailing bytes per file — the
+#: last few heartbeats are all it needs, and a week-long run's event file
+#: must not be re-read in full every chunk
+_TAIL_BYTES = 32768
+
+
+def worker_event_paths(run_dir: str) -> Dict[int, str]:
+    """``{process_id: path}`` for every ``events-p<i>.jsonl`` present."""
+    out: Dict[int, str] = {}
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return out
+    for name in names:
+        m = _WORKER_RE.match(name)
+        if m:
+            out[int(m.group(1))] = os.path.join(run_dir, name)
+    return out
+
+
+def load_rows(path: str, process: int,
+              tail_bytes: Optional[int] = None) -> Tuple[List[dict], int]:
+    """Parse one jsonl event file into rows tagged with ``process``;
+    returns ``(rows, skipped)`` where ``skipped`` counts unparseable
+    lines (torn tails, mid-write reads).  ``tail_bytes`` reads only the
+    file's end (the live-watch path); the first tail line is dropped as
+    potentially clipped."""
+    rows: List[dict] = []
+    skipped = 0
+    try:
+        with open(path, "rb") as f:
+            if tail_bytes:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                data = f.read()
+                if size > tail_bytes:
+                    data = data.split(b"\n", 1)[-1]
+            else:
+                data = f.read()
+    except OSError:
+        return rows, skipped
+    for line in data.decode("utf-8", "replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            skipped += 1
+            continue
+        if not isinstance(row, dict):
+            skipped += 1
+            continue
+        row.setdefault("process", process)
+        rows.append(row)
+    return rows, skipped
+
+
+def event_paths(run_dir: str) -> Dict[int, str]:
+    """Every process's event file, process 0's ``events.jsonl`` included
+    — the ONE place the fleet's file layout is spelled (merge, live
+    gauges and the watch console all read through this)."""
+    paths = {0: os.path.join(run_dir, "events.jsonl")}
+    paths.update(worker_event_paths(run_dir))
+    return paths
+
+
+def merged_timeline(run_dir: str) -> Tuple[List[dict], int]:
+    """All processes' event rows as one ordered timeline (see the module
+    docstring for the ordering rule); returns ``(rows, skipped)``."""
+    sources = sorted(event_paths(run_dir).items())
+    stamped = []
+    skipped = 0
+    for process, path in sources:
+        rows, bad = load_rows(path, process)
+        skipped += bad
+        for seq, row in enumerate(rows):
+            stamped.append((float(row.get("t", 0.0)),
+                            int(row.get("process", process)), seq, row))
+    stamped.sort(key=lambda item: item[:3])
+    return [row for _t, _p, _s, row in stamped], skipped
+
+
+# ---------------------------------------------------------------------------
+# per-process lanes and straggler attribution
+# ---------------------------------------------------------------------------
+
+
+def _fold_lane(lanes: Dict[int, dict], row: dict) -> None:
+    p = int(row.get("process", 0))
+    lane = lanes.setdefault(p, {"beats": 0, "spans": 0, "restarts": 0,
+                                "rates": []})
+    kind = row.get("kind")
+    if kind == "heartbeat":
+        lane["beats"] += 1
+        lane["stage"] = row.get("stage")
+        lane["last_t"] = row.get("t")
+        if row.get("generation") is not None:
+            lane["generation"] = int(row["generation"])
+        if row.get("total_generations") is not None:
+            lane["total_generations"] = int(row["total_generations"])
+        if row.get("gens_per_sec") is not None:
+            lane["rates"].append(float(row["gens_per_sec"]))
+            lane["gens_per_sec"] = float(row["gens_per_sec"])
+        if row.get("rss_mb") is not None:
+            lane["rss_mb"] = row["rss_mb"]
+    elif kind == "span":
+        lane["spans"] += 1
+    elif kind == "restart":
+        lane["restarts"] += 1
+
+
+def _close_lanes(lanes: Dict[int, dict]) -> Dict[int, dict]:
+    for lane in lanes.values():
+        rates = lane.pop("rates")
+        if rates:
+            lane["gens_per_sec_p50"] = round(
+                quantile_from_times(rates, 0.5), 3)
+            lane["gens_per_sec_min"] = round(min(rates), 3)
+            lane["gens_per_sec_max"] = round(max(rates), 3)
+    return lanes
+
+
+def straggler_attribution(rates: Dict[int, float],
+                          generations: Dict[int, int]) -> Optional[dict]:
+    """Who holds the fleet back: ``rates`` maps process -> gens/sec (the
+    lane's p50 offline, the LAST beat live), ``generations`` maps
+    process -> newest reported generation.  Returns ``None`` when no
+    process has reported a rate yet; single-process runs return a
+    degenerate (skew 1.0) attribution so callers need no mode split."""
+    known = {p: float(r) for p, r in rates.items()
+             if r is not None and float(r) > 0}
+    if not known:
+        return None
+    slow = min(sorted(known), key=lambda p: known[p])
+    fast = max(sorted(known), key=lambda p: known[p])
+    lead = max(generations.values()) if generations else 0
+    return {
+        "straggler_process": slow,
+        "fastest_process": fast,
+        "skew_ratio": round(known[fast] / known[slow], 4),
+        "lag_generations": int(lead - generations.get(slow, lead)),
+        "gens_per_sec": {int(p): round(known[p], 3) for p in sorted(known)},
+    }
+
+
+def update_straggler_gauges(registry, attribution: dict) -> None:
+    """Export one attribution as the ``soup_straggler_*`` gauges
+    (``telemetry/names.py``)."""
+    g = registry.gauge
+    g("soup_straggler_process",
+      help="process id currently slowest by gens/sec").set(
+        attribution["straggler_process"])
+    g("soup_straggler_skew_ratio",
+      help="fastest/slowest per-process gens/sec ratio (1.0 = no "
+           "skew)").set(attribution["skew_ratio"])
+    g("soup_straggler_lag_generations",
+      help="generations the straggler trails the fleet leader").set(
+        attribution["lag_generations"])
+    for p, rate in attribution["gens_per_sec"].items():
+        g("soup_straggler_gens_per_second",
+          help="per-process generation rate from the last heartbeat",
+          unit="1/s").set(rate, process=str(p))
+
+
+def live_attribution(run_dir: str,
+                     num_processes: int) -> Optional[dict]:
+    """Cheap in-run attribution for the chunk finisher: tail-read each
+    process's event file (bounded bytes), take the LAST heartbeat's rate
+    and generation per process.  Pure file reads — safe on the
+    background writer thread, never a collective."""
+    rates: Dict[int, float] = {}
+    gens: Dict[int, int] = {}
+    paths = event_paths(run_dir)
+    for p in range(num_processes):
+        path = paths.get(p)
+        if path is None:
+            continue
+        rows, _bad = load_rows(path, p, tail_bytes=_TAIL_BYTES)
+        for row in reversed(rows):
+            if row.get("kind") == "heartbeat" \
+                    and row.get("gens_per_sec") is not None:
+                rates[p] = float(row["gens_per_sec"])
+                if row.get("generation") is not None:
+                    gens[p] = int(row["generation"])
+                break
+    return straggler_attribution(rates, gens)
+
+
+# ---------------------------------------------------------------------------
+# summaries + renderer (report --fleet / telemetry.watch backends)
+# ---------------------------------------------------------------------------
+
+
+def list_checkpoints(run_dir: str) -> List[str]:
+    return sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(run_dir, "ckpt-gen*"))
+        if p.rsplit("gen", 1)[1].isdigit())
+
+
+def fleet_summary(run_dir: str, timeline_tail: int = 16) -> dict:
+    """Machine-readable fleet view of one run dir (the ``report --fleet
+    --json`` output; :func:`render_fleet` formats it, ``telemetry.watch``
+    refreshes it)."""
+    timeline, skipped = merged_timeline(run_dir)
+    lanes: Dict[int, dict] = {}
+    for row in timeline:
+        _fold_lane(lanes, row)
+    _close_lanes(lanes)
+    rates = {p: lane.get("gens_per_sec_p50", lane.get("gens_per_sec"))
+             for p, lane in lanes.items()}
+    gens = {p: lane["generation"] for p, lane in lanes.items()
+            if "generation" in lane}
+    ckpts = list_checkpoints(run_dir)
+    # timeline_tail=0 means NO tail (the watch snapshot) — a bare [-0:]
+    # would project every row of a long run only to be thrown away
+    tail = [{k: r.get(k) for k in ("t", "process", "kind", "stage",
+                                   "generation", "span", "seconds",
+                                   "message")
+             if r.get(k) is not None}
+            for r in (timeline[-timeline_tail:] if timeline_tail else [])]
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "processes": {str(p): lanes[p] for p in sorted(lanes)},
+        "worker_files": [os.path.basename(p) for _i, p in
+                         sorted(worker_event_paths(run_dir).items())],
+        "straggler": straggler_attribution(rates, gens),
+        "timeline_rows": len(timeline),
+        "skipped_lines": skipped,
+        "checkpoints": len(ckpts),
+        "latest_checkpoint": ckpts[-1] if ckpts else None,
+        "timeline_tail": tail,
+    }
+
+
+def _fmt_rate(lane: dict) -> str:
+    p50 = lane.get("gens_per_sec_p50")
+    if p50 is None:
+        return ""
+    return (f"gens/s p50={p50:.2f} "
+            f"[{lane.get('gens_per_sec_min', 0):.2f}.."
+            f"{lane.get('gens_per_sec_max', 0):.2f}]")
+
+
+def render_fleet(s: dict, out) -> None:
+    """The per-process lane view of one :func:`fleet_summary`."""
+    w = out.write
+    nproc = len(s["processes"])
+    w(f"fleet: {s['run_dir']}\n")
+    w(f"  {nproc} process lane(s), {s['timeline_rows']} merged timeline "
+      f"rows"
+      + (f", {s['skipped_lines']} unparseable line(s) skipped"
+         if s["skipped_lines"] else "")
+      + (f"; worker files: {', '.join(s['worker_files'])}"
+         if s["worker_files"] else "; no worker files (single-process "
+                                   "run dir)")
+      + "\n")
+    if s["latest_checkpoint"]:
+        w(f"  checkpoints: {s['checkpoints']} "
+          f"(latest {s['latest_checkpoint']})\n")
+    w("lanes:\n")
+    for pid, lane in sorted(s["processes"].items(), key=lambda kv:
+                            int(kv[0])):
+        gen = lane.get("generation")
+        tot = lane.get("total_generations")
+        where = f"gen {gen}/{tot}" if gen is not None and tot \
+            else (f"gen {gen}" if gen is not None else "(no heartbeat)")
+        bits = [f"{lane.get('stage') or '?':<22}", f"{where:<12}",
+                _fmt_rate(lane), f"beats={lane['beats']}"]
+        if lane.get("spans"):
+            bits.append(f"spans={lane['spans']}")
+        if lane.get("restarts"):
+            bits.append(f"restarts={lane['restarts']}")
+        if lane.get("rss_mb") is not None:
+            bits.append(f"rss={lane['rss_mb']}MB")
+        w(f"  p{pid}  " + "  ".join(b for b in bits if b) + "\n")
+    att = s.get("straggler")
+    if att and len(s["processes"]) > 1:
+        rates = "  ".join(f"p{p}={r:.2f}"
+                          for p, r in att["gens_per_sec"].items())
+        w(f"straggler: p{att['straggler_process']} — skew "
+          f"{att['skew_ratio']}x vs p{att['fastest_process']}, trailing "
+          f"{att['lag_generations']} generation(s)  ({rates} gens/s)\n")
+    if s["timeline_tail"]:
+        w("timeline tail (merged):\n")
+        for r in s["timeline_tail"]:
+            t = r.get("t")
+            stamp = f"{t:8.2f}s" if isinstance(t, (int, float)) else "       ?"
+            body = r.get("kind", "log")
+            if r.get("span"):
+                body += f" {r['span']} {r.get('seconds', 0):.4f}s"
+            elif r.get("stage"):
+                body += f" {r['stage']}"
+            if r.get("generation") is not None:
+                body += f" gen={r['generation']}"
+            if r.get("message") and body == "log":
+                body = str(r["message"])[:60]
+            w(f"  [{stamp} p{r.get('process', 0)}] {body}\n")

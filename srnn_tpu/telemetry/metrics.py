@@ -154,6 +154,26 @@ class Histogram(_Metric):
             h = self._hist.get(_label_key(labels))
             return int(h[len(self.buckets)]) if h else 0
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper-bound q-quantile across ALL label sets (bucket merge):
+        the smallest bucket bound whose cumulative count covers ``q`` of
+        the observations, or ``None`` while empty / when the quantile
+        falls in the ``+Inf`` bucket.  Conservative by construction —
+        the serve SLO view wants "p95 is at most X", not an
+        interpolated guess."""
+        with self._lock:
+            hists = list(self._hist.values())
+        if not hists:
+            return None
+        total = sum(h[len(self.buckets)] for h in hists)
+        if total <= 0:
+            return None
+        need = q * total
+        for i, b in enumerate(self.buckets):
+            if sum(h[i] for h in hists) >= need:
+                return float(b)
+        return None
+
     def sum(self, **labels) -> float:
         with self._lock:
             h = self._hist.get(_label_key(labels))

@@ -82,6 +82,18 @@ CANONICAL_METRICS: Dict[str, str] = {
     "serve_queue_depth": "gauge",
     "serve_request_seconds": "histogram",
     "serve_dispatch_seconds": "histogram",
+    # -- serve ticket tracing + SLO (fleet observatory; per-ticket
+    #    queue/window/dispatch breakdown, serve/service.py) ---------------
+    "serve_ticket_queue_seconds": "histogram",
+    "serve_ticket_window_seconds": "histogram",
+    "serve_ticket_dispatch_seconds": "histogram",
+    "serve_slo_violations_total": "counter",
+    # -- fleet observatory (telemetry.fleet: per-process gens/sec skew,
+    #    folded live each chunk by the primary's finisher) ----------------
+    "soup_straggler_process": "gauge",
+    "soup_straggler_skew_ratio": "gauge",
+    "soup_straggler_lag_generations": "gauge",
+    "soup_straggler_gens_per_second": "gauge",
     # -- heartbeats (telemetry.heartbeat) --------------------------------
     "heartbeat_generation": "gauge",
     "gens_per_sec": "gauge",

@@ -1,6 +1,7 @@
 """Summarize a run directory's telemetry trail — or a triage bundle.
 
     python -m srnn_tpu.telemetry.report <run_dir> [--json]
+    python -m srnn_tpu.telemetry.report --fleet <run_dir> [--json]
     python -m srnn_tpu.telemetry.report --triage <bundle_dir> [--json]
     python -m srnn_tpu.telemetry.report --dynamics <run_dir> [--json]
 
@@ -10,7 +11,15 @@ renders what a post-mortem needs first: did the run finish, where was it
 last alive (stage / generation / gens-per-sec / memory), what do the
 final cumulative metrics say, and where did the wall time go (spans).
 Works on killed runs — heartbeat rows are fsync'd, and cumulative metric
-snapshots mean the last row is the whole story.
+snapshots mean the last row is the whole story.  Distributed run dirs
+additionally fold every worker's ``events-p<i>.jsonl`` heartbeat lane in
+(stage labels like ``mega_soup@p1/2``), so a multi-process run no longer
+renders as a single-process one.
+
+``--fleet`` renders the full fleet observatory view instead
+(``telemetry.fleet``): ONE merged cross-process timeline, a per-process
+lane table, and the straggler attribution (who is slowest, skew ratio,
+generations of lag).
 
 ``--triage`` renders a flight-recorder bundle (``telemetry.flightrec``):
 the trip reason and thresholds, the ring tail, the health trajectory
@@ -86,8 +95,22 @@ def summarize(run_dir: str) -> dict:
     for e in events:
         by_kind.setdefault(str(e.get("kind", "log")), []).append(e)
 
+    # distributed run dirs: fold every worker's heartbeat lane in — their
+    # stage labels are per-process (mega_soup@p1/2), so the stages stay
+    # distinct rows instead of mixing into the primary's
+    from .fleet import load_rows, worker_event_paths
+
+    worker_files = sorted(worker_event_paths(run_dir).items())
+    worker_beats = []
+    for process, path in worker_files:
+        rows, _skipped = load_rows(path, process)
+        worker_beats.extend(r for r in rows if r.get("kind") == "heartbeat")
+    # order by run-relative stamp so "last" really is the latest beat
+    # even when a worker file's tail was rewritten out of order
+    worker_beats.sort(key=lambda r: float(r.get("t", 0.0)))
+
     heartbeats: Dict[str, dict] = {}
-    for hb in by_kind.get("heartbeat", []):
+    for hb in by_kind.get("heartbeat", []) + worker_beats:
         stage = str(hb.get("stage", "?"))
         s = heartbeats.setdefault(stage, {"beats": 0, "gens_per_sec": []})
         s["beats"] += 1
@@ -122,6 +145,7 @@ def summarize(run_dir: str) -> dict:
         "meta": meta,
         "config": config,
         "event_counts": {k: len(v) for k, v in sorted(by_kind.items())},
+        "worker_files": [os.path.basename(p) for _i, p in worker_files],
         "heartbeats": heartbeats,
         "spans": spans,
         "metrics": final_metrics,
@@ -149,6 +173,10 @@ def _render(s: dict, out) -> None:
     if s["event_counts"]:
         w("  events: " + "  ".join(f"{k}={n}" for k, n
                                    in s["event_counts"].items()) + "\n")
+    if s.get("worker_files"):
+        w(f"  worker event files ({len(s['worker_files'])}, heartbeat "
+          "lanes folded below; full timeline: report --fleet): "
+          + ", ".join(s["worker_files"]) + "\n")
 
     if s["heartbeats"]:
         w("heartbeats:\n")
@@ -413,6 +441,10 @@ def main(argv=None) -> int:
                                    "triage bundle with --triage)")
     p.add_argument("--triage", action="store_true",
                    help="treat run_dir as a flight-recorder triage bundle")
+    p.add_argument("--fleet", action="store_true",
+                   help="render the fleet observatory view: merged "
+                        "cross-process timeline, per-process lanes, "
+                        "straggler attribution (telemetry.fleet)")
     p.add_argument("--dynamics", action="store_true",
                    help="render the run's replication-dynamics trail "
                         "(lineage.jsonl via telemetry.genealogy)")
@@ -422,6 +454,15 @@ def main(argv=None) -> int:
     if not os.path.isdir(args.run_dir):
         print(f"report: {args.run_dir}: not a directory", file=sys.stderr)
         return 2
+    if args.fleet:
+        from .fleet import fleet_summary, render_fleet
+
+        s = fleet_summary(args.run_dir)
+        if args.json:
+            print(json.dumps(s, indent=1, default=str))
+        else:
+            render_fleet(s, sys.stdout)
+        return 0
     if args.triage:
         s = summarize_triage(args.run_dir)
         if args.json:

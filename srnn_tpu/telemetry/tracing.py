@@ -1,7 +1,7 @@
 """Span tracing: wall-clock phase timing layered on ``jax.named_scope`` +
-``jax.profiler``.
+``jax.profiler``, plus the fleet observatory's structured span records.
 
-Three layers, cheapest first:
+Four layers, cheapest first:
 
   * :func:`annotate` (= ``jax.named_scope``) — zero-cost trace-time
     annotation: phases show up as named scopes in HLO metadata and
@@ -13,12 +13,21 @@ Three layers, cheapest first:
     readback (``Span.sync``), not ``block_until_ready`` — on the tunneled
     axon platform the latter does not actually wait (the caveat
     documented in ``utils/profiling.py`` and ``bench.py``).
+  * :class:`SpanStream` — run-scoped STRUCTURED span records
+    (``trace_id``/``span_id``/``parent``, run-relative monotonic start +
+    duration, emitting process) appended as ``{"kind": "span", ...}``
+    rows through the run's event channel, riding the
+    ``BackgroundWriter`` when one is attached so emission costs an
+    enqueue on the hot path, never an fsync.  These are what
+    ``telemetry.fleet`` merges into the cross-process run timeline.
   * ``trace`` (re-exported from ``utils.profiling``) — a full
     ``jax.profiler`` device/host trace into a TensorBoard-loadable
     directory, for when a span points at a phase worth opening up.
 """
 
 import contextlib
+import itertools
+import threading
 import time
 from typing import Any, Optional
 
@@ -93,3 +102,86 @@ def span(name: str, registry: Optional[MetricsRegistry] = None,
             if exp is not None:
                 exp.event(kind="span", span=name,
                           seconds=round(s.seconds, 6), **labels)
+
+
+class SpanStream:
+    """Run-scoped structured span emitter for the fleet observatory.
+
+    Where :func:`span` records an anonymous wall-clock histogram sample,
+    a :class:`SpanStream` row is a first-class trace record:
+
+      * ``trace_id`` — stable for the whole run (the run-dir basename for
+        mega runs, the ticket id for serve requests), so every process's
+        rows correlate after the fleet merge;
+      * ``span_id`` — monotone per (process, stream); ``parent`` links
+        children (e.g. a chunk's ``device_wait``/``host_io`` halves) to
+        their enclosing span;
+      * ``start_s``/``seconds`` — run-relative MONOTONIC start and
+        duration (``time.monotonic`` deltas, immune to wall-clock steps);
+      * ``process`` — the emitting process, so a worker's rows (written
+        to its ``events-p<i>.jsonl`` via ``WorkerLog``) stay attributable
+        in the merged timeline.
+
+    Rows ride ``exp.event`` (``Experiment`` or ``WorkerLog`` — both take
+    ``kind=``/fields), optionally through a ``BackgroundWriter`` so the
+    producing thread only enqueues; the ``span_seconds`` histogram
+    (label ``span=name``) is folded on the same job.  Emission is
+    host-only by construction — a stream never touches device values, so
+    spans can NEVER perturb run results (asserted in
+    ``tests/test_fleet.py``).
+    """
+
+    def __init__(self, exp, trace_id: str, process: int = 0,
+                 writer=None, registry: Optional[MetricsRegistry] = None):
+        self.exp = exp
+        self.trace_id = str(trace_id)
+        self.process = int(process)
+        self.writer = writer
+        self.registry = registry
+        self._t0 = time.monotonic()
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        """Run-relative monotonic seconds (the ``start_s`` clock)."""
+        return time.monotonic() - self._t0
+
+    def emit(self, name: str, start_s: float, dur_s: float,
+             parent: Optional[int] = None, **labels) -> int:
+        """Record one finished span; returns its ``span_id`` (for use as
+        a later child's ``parent``).  All values are precomputed here —
+        the sink job only appends."""
+        with self._lock:
+            sid = next(self._ids)
+        row = dict(span=name, trace_id=self.trace_id, span_id=sid,
+                   process=self.process, start_s=round(float(start_s), 6),
+                   seconds=round(float(dur_s), 6), **labels)
+        if parent is not None:
+            row["parent"] = int(parent)
+
+        def sink():
+            self.exp.event(kind="span", **row)
+            if self.registry is not None:
+                self.registry.histogram(
+                    "span_seconds",
+                    help="wall-clock seconds of telemetry.span blocks",
+                    unit="seconds").observe(row["seconds"], span=name)
+
+        if self.writer is not None:
+            self.writer.submit(sink)
+        else:
+            sink()
+        return sid
+
+    @contextlib.contextmanager
+    def timed(self, name: str, parent: Optional[int] = None, **labels):
+        """Context-manager spelling of :meth:`emit` for host code whose
+        bounds are the block itself (collective gathers, store flushes).
+        Yields a dict the block may add labels to."""
+        start = self.now()
+        extra: dict = {}
+        try:
+            yield extra
+        finally:
+            self.emit(name, start, self.now() - start, parent=parent,
+                      **{**labels, **extra})

@@ -1,0 +1,180 @@
+"""Live watch console for runs and services.
+
+    python -m srnn_tpu.telemetry.watch <run_dir> [--interval S] [--once]
+    python -m srnn_tpu.telemetry.watch --service SOCKET [--once]
+
+The operator view `tail`-ing heartbeat files by hand used to
+approximate: one refresh-loop screen of stage, generation, gens/sec,
+health, restarts and last checkpoint across ALL processes of a run
+(``telemetry.fleet``'s merged lanes), or — with ``--service`` — a
+running experiment service's queue/throughput/SLO state.  ``--once``
+prints a single machine-readable JSON snapshot instead (the CI
+``observability_smoke`` group and ``scripts/tpu_watch.sh``'s opt-in
+poll hook consume it).
+
+Pure reader: file tails and one ``stats`` socket op — attaching a watch
+to a live run can never perturb it.  Stdout is this module's product
+(it is on the srnnlint prints allowlist).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .fleet import event_paths, fleet_summary, load_rows
+
+_HEALTH_PREFIX = "srnn_soup_health_"
+
+#: the health scan only needs the LAST metrics row, which sits within a
+#: handful of rows of the file's end — a bounded tail read keeps the
+#: refresh loop off a week-long run's full events.jsonl
+_HEALTH_TAIL_BYTES = 262144
+
+
+def snapshot(run_dir: str) -> dict:
+    """One machine-readable fleet snapshot: the merged per-process lanes
+    plus liveness (seconds since ANY process wrote an event) and the
+    last flushed health gauges.  Cost note: the lane summary reads every
+    event file in full (beats/p50 are whole-run statistics); only the
+    health scan is tail-bounded."""
+    s = fleet_summary(run_dir, timeline_tail=0)
+    s.pop("timeline_tail", None)
+    mtimes = []
+    for path in sorted(event_paths(run_dir).values()):
+        try:
+            mtimes.append(os.path.getmtime(path))
+        except OSError:
+            pass
+    s["last_event_age_s"] = round(time.time() - max(mtimes), 1) \
+        if mtimes else None
+    rows, _bad = load_rows(os.path.join(run_dir, "events.jsonl"), 0,
+                           tail_bytes=_HEALTH_TAIL_BYTES)
+    s["health"] = None
+    for row in reversed(rows):
+        if row.get("kind") == "metrics":
+            health = {k[len(_HEALTH_PREFIX):]: v
+                      for k, v in (row.get("metrics") or {}).items()
+                      if k.startswith(_HEALTH_PREFIX)}
+            if health:
+                s["health"] = health
+            break
+    return s
+
+
+def render(s: dict, out) -> None:
+    from .fleet import render_fleet
+
+    age = s.get("last_event_age_s")
+    out.write(time.strftime("-- watch %H:%M:%S ")
+              + (f"(last event {age}s ago)" if age is not None
+                 else "(no events yet)") + "\n")
+    body = dict(s, timeline_tail=[])
+    render_fleet(body, out)
+    health = s.get("health")
+    if health:
+        cells = "  ".join(f"{k}={v}" for k, v in sorted(health.items()))
+        out.write(f"health: {cells}\n")
+
+
+# ---------------------------------------------------------------------------
+# service mode
+# ---------------------------------------------------------------------------
+
+
+def service_snapshot(socket_path: str) -> dict:
+    """One ``stats`` round trip to a running experiment service."""
+    from ..serve.client import ServiceClient
+
+    stats = ServiceClient(socket_path, timeout_s=10.0).stats()
+    out = {"socket": socket_path,
+           "completed": stats.get("completed"),
+           "queue_depth": stats.get("queue_depth"),
+           "distinct_programs": stats.get("distinct_programs"),
+           "uptime_s": stats.get("uptime_s"),
+           "slo": stats.get("slo")}
+    uptime = stats.get("uptime_s") or 0
+    out["requests_per_sec"] = round(stats.get("completed", 0) / uptime, 3) \
+        if uptime > 0 else 0.0
+    return out
+
+
+def render_service(s: dict, out) -> None:
+    out.write(time.strftime("-- watch %H:%M:%S ")
+              + f"service {s['socket']}\n")
+    out.write(f"  completed={s['completed']}  queue={s['queue_depth']}  "
+              f"{s['requests_per_sec']} req/s over {s['uptime_s']}s  "
+              f"programs={s['distinct_programs']}\n")
+    slo = s.get("slo")
+    if slo:
+        target = slo.get("target_p95_ms")
+        p95 = slo.get("p95_ms")
+        out.write("  SLO: "
+                  + (f"p95<={target}ms target, " if target else "no target, ")
+                  + (f"measured p95~{p95}ms, " if p95 is not None else "")
+                  + f"{slo.get('violations', 0)} violation(s)\n")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="an Experiment run directory (fleet lanes view)")
+    p.add_argument("--service", default=None, metavar="SOCKET",
+                   help="watch a running experiment service's stats/"
+                        "queue/SLO state instead of (or as well as) a "
+                        "run dir")
+    p.add_argument("--interval", type=float, default=5.0, metavar="S",
+                   help="refresh period of the watch loop")
+    p.add_argument("--once", action="store_true",
+                   help="print one JSON snapshot and exit (machine-"
+                        "readable; what the CI smoke and the tpu_watch "
+                        "poll hook consume)")
+    args = p.parse_args(argv)
+    if not args.run_dir and not args.service:
+        p.error("give a run_dir, --service SOCKET, or both")
+    if args.run_dir and not os.path.isdir(args.run_dir):
+        print(f"watch: {args.run_dir}: not a directory", file=sys.stderr)
+        return 2
+
+    def take():
+        snap = {}
+        if args.run_dir:
+            snap = snapshot(args.run_dir)
+        if args.service:
+            try:
+                snap["service"] = service_snapshot(args.service)
+            except Exception as e:
+                snap["service"] = {"socket": args.service,
+                                   "error": f"{type(e).__name__}: {e}"}
+        return snap
+
+    if args.once:
+        print(json.dumps(take(), indent=1, default=str))
+        return 0
+    try:
+        while True:
+            snap = take()
+            if args.run_dir:
+                render(snap, sys.stdout)
+            svc = snap.get("service")
+            if svc:
+                if "error" in svc:
+                    print(f"service: {svc['error']}")
+                else:
+                    render_service(svc, sys.stdout)
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

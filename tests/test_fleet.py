@@ -1,0 +1,424 @@
+"""The fleet observatory (PR 12): structured spans, the cross-process
+timeline merge + straggler attribution, the live watch console, and the
+serve ticket-span breakdown.
+
+The load-bearing contract drilled here: observability NEVER perturbs
+results — a run with spans is bitwise-identical to the same run with
+``--no-spans`` (the spans are host-only rows), and every reader layer
+(merge, report, watch) is a pure file consumer that tolerates torn
+files from killed or still-writing processes.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from srnn_tpu.distributed.hostio import WorkerLog, fetch_tree, set_span_sink
+from srnn_tpu.experiment import restore_checkpoint
+from srnn_tpu.setups import REGISTRY
+from srnn_tpu.telemetry import fleet, watch
+from srnn_tpu.telemetry.metrics import MetricsRegistry
+from srnn_tpu.telemetry.report import summarize
+from srnn_tpu.telemetry.tracing import SpanStream
+from srnn_tpu.utils.pipeline import BackgroundWriter
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# structured spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_stream_round_trip_through_writer(tmp_path):
+    """Spans ride the BackgroundWriter into a real event file and come
+    back with ids/parent/clock intact — through the same WorkerLog
+    channel a distributed worker uses."""
+    with BackgroundWriter(name="test-span-io") as writer:
+        with WorkerLog(str(tmp_path), 1) as log:
+            stream = SpanStream(log, trace_id="run-x", process=1,
+                                writer=writer)
+            root = stream.emit("chunk", 1.0, 0.5, generation=100)
+            child = stream.emit("chunk.host_io", 1.1, 0.2, parent=root)
+            assert child == root + 1  # monotone ids
+            writer.flush()
+    rows = [json.loads(l) for l in
+            open(tmp_path / "events-p1.jsonl")]
+    assert [r["kind"] for r in rows] == ["span", "span"]
+    r0, r1 = rows
+    assert r0["span"] == "chunk" and r0["trace_id"] == "run-x"
+    assert r0["span_id"] == root and "parent" not in r0
+    assert r0["start_s"] == 1.0 and r0["seconds"] == 0.5
+    assert r0["generation"] == 100 and r0["process"] == 1
+    assert r1["parent"] == root
+
+
+def test_span_stream_timed_and_registry(tmp_path):
+    class Events:
+        rows = []
+
+        def event(self, **kw):
+            self.rows.append(kw)
+
+    reg = MetricsRegistry()
+    stream = SpanStream(Events(), trace_id="t", registry=reg)
+    with stream.timed("gather", collectives=3) as extra:
+        extra["note"] = "ok"
+    (row,) = Events.rows
+    assert row["span"] == "gather" and row["collectives"] == 3
+    assert row["note"] == "ok" and row["seconds"] >= 0
+    assert reg.histogram("span_seconds").count(span="gather") == 1
+
+
+def test_hostio_span_sink_times_fetch_tree():
+    """The collective span sink: fetch_tree emits one structured row per
+    call while installed, and clearing it makes emission free again."""
+    got = []
+    set_span_sink(lambda name, dur, **kw: got.append((name, dur, kw)))
+    try:
+        out = fetch_tree({"a": np.arange(3)})
+    finally:
+        set_span_sink(None)
+    np.testing.assert_array_equal(out["a"], np.arange(3))
+    (name, dur, kw), = got
+    assert name == "hostio.fetch_tree" and dur >= 0
+    assert kw == {"collectives": 0}  # single-process: local resolve only
+    got.clear()
+    fetch_tree({"a": np.arange(3)})
+    assert not got
+
+
+# ---------------------------------------------------------------------------
+# timeline merge + straggler attribution
+# ---------------------------------------------------------------------------
+
+
+def _craft_run_dir(tmp_path):
+    """A 3-process run dir: p0 events (with heartbeats + a metrics row),
+    p1 out-of-order heartbeats, p2 TRUNCATED mid-row (a killed worker)."""
+    run = tmp_path / "run"
+    run.mkdir()
+
+    def hb(t, gen, rate, stage):
+        return {"t": t, "kind": "heartbeat", "stage": stage,
+                "generation": gen, "total_generations": 8,
+                "gens_per_sec": rate}
+
+    with open(run / "events.jsonl", "w") as f:
+        for row in (hb(1.0, 2, 4.0, "mega_soup@p0/3"),
+                    hb(2.0, 4, 4.0, "mega_soup@p0/3"),
+                    {"t": 2.1, "kind": "metrics",
+                     "metrics": {"srnn_soup_health_nan_frac": 0.0}},
+                    {"t": 2.2, "kind": "span", "span": "mega_soup.chunk",
+                     "span_id": 1, "trace_id": "r", "start_s": 1.0,
+                     "seconds": 1.0}):
+            f.write(json.dumps(row) + "\n")
+    with open(run / "events-p1.jsonl", "w") as f:
+        # out of order on purpose: the merge must sort, not trust file order
+        f.write(json.dumps(dict(hb(1.9, 4, 2.0, "mega_soup@p1/3"),
+                                process=1)) + "\n")
+        f.write(json.dumps(dict(hb(0.9, 2, 2.0, "mega_soup@p1/3"),
+                                process=1)) + "\n")
+    with open(run / "events-p2.jsonl", "w") as f:
+        f.write(json.dumps(dict(hb(1.1, 2, 3.0, "mega_soup@p2/3"),
+                                process=2)) + "\n")
+        f.write('{"t": 1.8, "kind": "heartbeat", "generation": 4, "trunc')
+    (run / "ckpt-gen00000004").mkdir()
+    return run
+
+
+def test_merged_timeline_orders_and_skips_torn(tmp_path):
+    run = _craft_run_dir(tmp_path)
+    rows, skipped = fleet.merged_timeline(str(run))
+    assert skipped == 1  # p2's torn tail dropped, not fatal
+    ts = [r["t"] for r in rows]
+    assert ts == sorted(ts)
+    assert [r["process"] for r in rows if r["kind"] == "heartbeat"] == \
+        [1, 0, 2, 1, 0]
+
+
+def test_fleet_summary_lanes_and_straggler_vs_numpy(tmp_path):
+    run = _craft_run_dir(tmp_path)
+    s = fleet.fleet_summary(str(run))
+    assert set(s["processes"]) == {"0", "1", "2"}
+    assert s["worker_files"] == ["events-p1.jsonl", "events-p2.jsonl"]
+    assert s["processes"]["0"]["generation"] == 4
+    assert s["processes"]["0"]["stage"] == "mega_soup@p0/3"
+    assert s["processes"]["2"]["beats"] == 1
+    assert s["latest_checkpoint"] == "ckpt-gen00000004"
+    # straggler math against a NumPy recount of the crafted rates
+    rates = {0: np.median([4.0, 4.0]), 1: np.median([2.0, 2.0]),
+             2: np.median([3.0])}
+    att = s["straggler"]
+    slow = min(rates, key=rates.get)
+    assert att["straggler_process"] == slow == 1
+    assert att["fastest_process"] == 0
+    assert att["skew_ratio"] == pytest.approx(
+        max(rates.values()) / min(rates.values()))
+    # lag: leader at gen 4, straggler p1 last reported gen 4 -> 0; p2
+    # whose parsed rows stop at gen 2 would trail by 2 if slowest
+    assert att["lag_generations"] == 4 - 4
+    assert att["gens_per_sec"] == {0: 4.0, 1: 2.0, 2: 3.0}
+
+
+def test_straggler_attribution_edge_cases():
+    assert fleet.straggler_attribution({}, {}) is None
+    att = fleet.straggler_attribution({0: 5.0}, {0: 7})
+    assert att["skew_ratio"] == 1.0 and att["lag_generations"] == 0
+    att = fleet.straggler_attribution({0: 5.0, 1: 2.5}, {0: 8, 1: 6})
+    assert (att["straggler_process"], att["skew_ratio"],
+            att["lag_generations"]) == (1, 2.0, 2)
+
+
+def test_straggler_gauges_and_live_attribution(tmp_path):
+    run = _craft_run_dir(tmp_path)
+    att = fleet.live_attribution(str(run), 3)
+    # live attribution takes the LAST heartbeat per process
+    assert att["gens_per_sec"] == {0: 4.0, 1: 2.0, 2: 3.0}
+    assert att["straggler_process"] == 1
+    reg = MetricsRegistry()
+    fleet.update_straggler_gauges(reg, att)
+    rows = reg.rows()
+    assert rows["srnn_soup_straggler_process"] == 1
+    assert rows["srnn_soup_straggler_skew_ratio"] == 2.0
+    assert rows['srnn_soup_straggler_gens_per_second{process="2"}'] == 3.0
+    prom = reg.to_prometheus()
+    assert "srnn_soup_straggler_lag_generations" in prom
+
+
+# ---------------------------------------------------------------------------
+# watch console + report fold
+# ---------------------------------------------------------------------------
+
+
+def test_watch_once_snapshot_schema(tmp_path, capsys):
+    run = _craft_run_dir(tmp_path)
+    assert watch.main([str(run), "--once"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert set(snap["processes"]) == {"0", "1", "2"}
+    for lane in snap["processes"].values():
+        assert isinstance(lane["generation"], int)
+    assert snap["straggler"]["straggler_process"] == 1
+    assert snap["health"] == {"nan_frac": 0.0}
+    assert snap["last_event_age_s"] is not None
+    assert snap["latest_checkpoint"] == "ckpt-gen00000004"
+
+
+def test_watch_rejects_bad_args(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        watch.main(["--once"])          # neither run_dir nor --service
+    assert watch.main([str(tmp_path / "nope"), "--once"]) == 2
+
+
+def test_watch_service_render():
+    out = []
+
+    class Out:
+        write = staticmethod(out.append)
+
+    watch.render_service({"socket": "/tmp/s.sock", "completed": 10,
+                          "queue_depth": 2, "requests_per_sec": 3.2,
+                          "uptime_s": 12.5, "distinct_programs": 4,
+                          "slo": {"target_p95_ms": 350.0, "p95_ms": 500.0,
+                                  "violations": 7}}, Out())
+    text = "".join(out)
+    assert "3.2 req/s" in text and "p95<=350.0ms" in text
+    assert "7 violation(s)" in text
+
+
+def test_plain_report_folds_worker_heartbeat_lanes(tmp_path):
+    run = _craft_run_dir(tmp_path)
+    s = summarize(str(run))
+    assert s["worker_files"] == ["events-p1.jsonl", "events-p2.jsonl"]
+    # each process's stage label is its own lane, workers included
+    assert set(s["heartbeats"]) == {"mega_soup@p0/3", "mega_soup@p1/3",
+                                    "mega_soup@p2/3"}
+    assert s["heartbeats"]["mega_soup@p1/3"]["beats"] == 2
+    assert s["heartbeats"]["mega_soup@p1/3"]["last"]["generation"] == 4
+
+
+def test_histogram_quantile_bucket_upper_bound():
+    from srnn_tpu.telemetry.metrics import Histogram
+
+    h = Histogram("t", buckets=(0.1, 0.5, 2.0))
+    assert h.quantile(0.95) is None
+    for v in [0.05] * 90 + [0.3] * 9:
+        h.observe(v, kind="a")
+    h.observe(1.0, kind="b")   # label sets merge
+    assert h.quantile(0.5) == 0.1
+    assert h.quantile(0.95) == 0.5
+    assert h.quantile(1.0) == 2.0
+    h.observe(100.0, kind="a")
+    assert h.quantile(1.0) is None  # falls in +Inf: unknown bound
+
+
+# ---------------------------------------------------------------------------
+# serve: ticket spans + SLO
+# ---------------------------------------------------------------------------
+
+
+def test_serve_ticket_spans_breakdown_and_slo(tmp_path):
+    """Every ticket's span family: root duration == the measured
+    serve_request_seconds observation, children sum to the root, the
+    dispatch child carries stack width + per-tenant amortized cost, and
+    a sub-target SLO turns requests into serve_slo_violations_total."""
+    from srnn_tpu.serve.service import ExperimentService
+
+    root = str(tmp_path / "svc")
+    svc = ExperimentService(root, max_stack=8, slo_p95_ms=0.001)
+    with svc:
+        t1 = svc.submit("fixpoint_density",
+                        {"seed": 0, "trials": 64, "batch": 32}, tenant="a")
+        t2 = svc.submit("fixpoint_density",
+                        {"seed": 1, "trials": 64, "batch": 32}, tenant="b")
+        assert svc.run_pending(window_s=0.05) == 2
+        assert svc.wait(t1)["status"] == "done"
+        assert svc.wait(t2)["status"] == "done"
+        stats = svc.stats()
+        reg = svc.registry
+        svc.writer.flush()
+    rows = [json.loads(l) for l in open(os.path.join(root, "events.jsonl"))]
+    spans = [r for r in rows if r.get("kind") == "span"]
+    roots = {r["trace_id"]: r for r in spans if r["span"] == "serve.ticket"}
+    assert set(roots) == {t1, t2}
+    hist_sum = reg.histogram("serve_request_seconds").sum(
+        kind="fixpoint_density")
+    assert sum(r["seconds"] for r in roots.values()) == \
+        pytest.approx(hist_sum, abs=1e-4)
+    for ticket, root_row in roots.items():
+        assert root_row["stack_k"] == 2 and root_row["mode"] == "stacked"
+        children = [r for r in spans
+                    if r.get("parent") == root_row["span_id"]
+                    and r["trace_id"] == ticket]
+        assert [c["span"] for c in children] == \
+            ["serve.ticket.queue", "serve.ticket.window",
+             "serve.ticket.dispatch", "serve.ticket.publish"]
+        assert sum(c["seconds"] for c in children) == \
+            pytest.approx(root_row["seconds"], abs=1e-4)
+        dispatch = children[2]
+        assert dispatch["per_tenant_s"] == \
+            pytest.approx(dispatch["seconds"] / 2, abs=1e-5)
+        # the window child is bounded by the window the transport slept
+        assert children[1]["seconds"] <= 0.05 + 1e-6
+    # SLO: 1 microsecond target -> both requests violate; stats + prom
+    assert stats["slo"]["target_p95_ms"] == 0.001
+    assert stats["slo"]["violations"] == 2
+    assert stats["slo"]["p95_ms"] is not None
+    assert reg.counter("serve_slo_violations_total").value(
+        kind="fixpoint_density") == 2
+    prom = open(os.path.join(root, "metrics.prom")).read()
+    assert "srnn_serve_slo_violations_total" in prom
+    assert 'srnn_serve_ticket_queue_seconds_count{kind="fixpoint_density"}' \
+        in prom
+    assert "srnn_serve_ticket_window_seconds" in prom
+    assert "srnn_serve_ticket_dispatch_seconds" in prom
+
+
+def test_serve_slo_counter_present_even_without_target(tmp_path):
+    """A clean service exposes the SLO counter series eagerly (the load
+    bench greps metrics.prom for it), and no target means no violations."""
+    from srnn_tpu.serve.service import ExperimentService
+
+    root = str(tmp_path / "svc")
+    with ExperimentService(root) as svc:
+        assert svc.stats()["slo"] == {"target_p95_ms": None,
+                                      "violations": 0, "p95_ms": None}
+    assert "srnn_serve_slo_violations_total" in \
+        open(os.path.join(root, "metrics.prom")).read()
+
+
+# ---------------------------------------------------------------------------
+# the invariant: observability never perturbs results
+# ---------------------------------------------------------------------------
+
+
+def test_spans_do_not_perturb_results(tmp_path):
+    """mega_soup with spans (default) vs --no-spans: weights/uids/PRNG
+    bitwise-identical; span rows present only in the default run."""
+    import jax
+
+    with_spans = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "41", "--root", str(tmp_path / "a")])
+    without = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "41", "--no-spans",
+         "--root", str(tmp_path / "b")])
+    a = restore_checkpoint(os.path.join(with_spans, "ckpt-gen00000006"))
+    b = restore_checkpoint(os.path.join(without, "ckpt-gen00000006"))
+    np.testing.assert_array_equal(np.asarray(a.weights),
+                                  np.asarray(b.weights))
+    np.testing.assert_array_equal(np.asarray(a.uids), np.asarray(b.uids))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(a.key)),
+        np.asarray(jax.random.key_data(b.key)))
+
+    def span_rows(d):
+        return [json.loads(l) for l in
+                open(os.path.join(d, "events.jsonl"))
+                if '"kind": "span"' in l]
+
+    with_rows = span_rows(with_spans)
+    assert with_rows and not span_rows(without)
+    # chunk roots + their device_wait/host_io children, linked by parent
+    roots = [r for r in with_rows if r["span"] == "mega_soup.chunk"]
+    assert len(roots) == 3   # 6 generations / checkpoint-every 2
+    for root in roots:
+        kids = {r["span"] for r in with_rows
+                if r.get("parent") == root["span_id"]}
+        assert kids == {"mega_soup.device_wait", "mega_soup.host_io"}
+    # and the fleet summary reads the same run dir without distress
+    s = fleet.fleet_summary(with_spans)
+    assert s["processes"]["0"]["spans"] == len(with_rows)
+
+
+# ---------------------------------------------------------------------------
+# the full fleet e2e (heavy: 2-process launcher run)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_e2e_two_process_launcher(tmp_path):
+    """The acceptance oracle: a 2-process CPU-mesh launcher run produces
+    ONE merged report --fleet timeline with both process lanes and a
+    nonzero straggler attribution, watch --once returns per-process
+    generations, and the live soup_straggler_* gauges land in
+    metrics.prom."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    env["SRNN_SETUPS_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "srnn_tpu.distributed.launch",
+         "--processes", "2", "--",
+         "mega_soup", "--smoke", "--seed", "43", "--sharded",
+         "--root", str(tmp_path / "dist")],
+        env=env, capture_output=True, text=True, timeout=540,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    run_dir = glob.glob(str(tmp_path / "dist" / "exp-*"))[0]
+
+    s = fleet.fleet_summary(run_dir)
+    assert set(s["processes"]) == {"0", "1"}
+    for lane in s["processes"].values():
+        assert lane["generation"] == 6 and lane["beats"] > 0
+        assert lane["spans"] > 0    # workers emit spans too
+    att = s["straggler"]
+    assert att is not None and att["skew_ratio"] >= 1.0
+    assert set(att["gens_per_sec"]) == {0, 1}
+
+    snap = watch.snapshot(run_dir)
+    assert {p: lane["generation"] for p, lane in
+            snap["processes"].items()} == {"0": 6, "1": 6}
+
+    prom = open(os.path.join(run_dir, "metrics.prom")).read()
+    assert "srnn_soup_straggler_skew_ratio" in prom
+    assert 'srnn_soup_straggler_gens_per_second{process="1"}' in prom
+    # both processes' gather spans made it into the merged timeline
+    gathers = [row for row in fleet.merged_timeline(run_dir)[0]
+               if row.get("span") == "hostio.fetch_tree"]
+    assert {g["process"] for g in gathers} == {0, 1}
