@@ -305,6 +305,10 @@ def _serve_leg() -> dict:
     # just above the window-bound p95 ~312ms PR 10 measured, so a healthy
     # run reads near-zero and a regression reads loud
     slo_ms = float(os.environ.get("SRNN_BENCH_SERVE_SLO_P95_MS", "350"))
+    # admission control: a BOUNDED queue keeps the saturation story
+    # honest — past it the service pushes back with typed overload
+    # rejections (counted below) instead of hiding load in the queue
+    max_queue = int(os.environ.get("SRNN_BENCH_SERVE_MAX_QUEUE", "64"))
     load_trials = 64
 
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -313,7 +317,8 @@ def _serve_leg() -> dict:
     svc = server_thread = None
     try:
         svc = ExperimentService(os.path.join(root, "svc"),
-                                max_stack=sweeps, slo_p95_ms=slo_ms)
+                                max_stack=sweeps, slo_p95_ms=slo_ms,
+                                max_queue=max_queue)
         _hb("serve", "warmup")
         svc.warm("fixpoint_density", {"trials": trials, "batch": batch})
         svc.warm("fixpoint_density",
@@ -398,18 +403,30 @@ def _serve_leg() -> dict:
             for k, v in stats["metrics"].items()
             if k.startswith("srnn_serve_dispatches_total")}
 
-        # -- closed-loop load: C clients hammering tiny sweeps
+        # -- closed-loop load: C clients hammering tiny sweeps (each with
+        # its own seeded-backoff client, so an overload rejection backs
+        # off deterministically instead of hammering the full queue)
         _hb("serve", "load", seconds=load_s, clients=load_clients)
+        rejections_before = (client.stats().get("self_healing") or {}).get(
+            "overload_rejections", 0)
         stop_at = time.monotonic() + load_s
         lat_lists = [[] for _ in range(load_clients)]
 
         def loader(lats, seed):
+            c = ServiceClient(sock, retries=6, backoff_base_s=0.05,
+                              seed=seed)
+            n = 0
             while time.monotonic() < stop_at:
                 t1 = time.monotonic()
-                client.request("fixpoint_density",
-                               {"seed": seed, "trials": load_trials,
-                                "batch": load_trials},
-                               tenant=f"load{seed}", timeout_s=60)
+                n += 1
+                # per-request idempotency key: makes the client's
+                # mid-op-disconnect retry safe (a keyless request is
+                # deliberately NOT retried after delivery risk)
+                c.request("fixpoint_density",
+                          {"seed": seed, "trials": load_trials,
+                           "batch": load_trials},
+                          tenant=f"load{seed}", timeout_s=60,
+                          idempotency_key=f"load{seed}-{n}")
                 lats.append(time.monotonic() - t1)
 
         t0 = time.monotonic()
@@ -420,7 +437,11 @@ def _serve_leg() -> dict:
             t.join()
         load_wall = time.monotonic() - t0
         lats = [x for lst in lat_lists for x in lst]
-        slo = client.stats().get("slo") or {}
+        load_stats = client.stats()
+        slo = load_stats.get("slo") or {}
+        sh = load_stats.get("self_healing") or {}
+        rejected = (sh.get("overload_rejections", 0) or 0) \
+            - (rejections_before or 0)
         out["load"] = {
             "clients": load_clients,
             "window_s": round(load_wall, 2),
@@ -430,6 +451,13 @@ def _serve_leg() -> dict:
             "p95_ms": round(1e3 * quantile_from_times(lats, 0.95), 1),
             "slo_target_p95_ms": slo.get("target_p95_ms"),
             "slo_violations": slo.get("violations"),
+            # admitted counts COMPLETED closed-loop requests; rejected is
+            # the overload pushback during the window — together they are
+            # the honest saturation story (a rejected submit retried and
+            # eventually admitted still counts once in each)
+            "max_queue": max_queue,
+            "admitted": len(lats),
+            "rejected": rejected,
         }
     finally:
         # teardown runs on EVERY path: an exception above must not leave

@@ -135,6 +135,116 @@ fi
 rm -rf "$serve_root"
 summary+=$(printf '%-34s %-4s %4ss' "service_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Self-healing service chaos smoke (srnn_tpu/serve journal + supervised
+# dispatch): a service armed with serve_kill@1 SIGKILLs ITSELF (through
+# the production dispatch path) with 8 admitted tickets journaled but
+# unfinished; the restart — armed with serve_poison_tenant@1 — must
+# REPLAY all 8 under their original ids, bisect-quarantine the poisoned
+# one while the other 7 complete, dedupe an idempotent resubmit against
+# the journal, render the self-heal stats in `watch --service --once`,
+# and leave metrics.prom showing the replay + quarantine counters.
+t0=$SECONDS
+sc_root=$(mktemp -d)
+sc_ok=1
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve --root "$sc_root/svc" \
+    --batch-window-s 1.5 --chaos serve_kill@1 > "$sc_root/serve.log" 2>&1 &
+sc_pid=$!
+up=0
+for _ in $(seq 1 150); do
+    if SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+            --socket "$sc_root/svc/serve.sock" --ping 2>/dev/null; then
+        up=1; break
+    fi
+    sleep 0.2
+done
+if [ "$up" -eq 1 ]; then
+    SRNN_SETUPS_PLATFORM=cpu python - "$sc_root/svc/serve.sock" \
+        >> "$sc_root/serve.log" 2>&1 <<'PY' || sc_ok=0
+import sys
+from srnn_tpu.serve.client import ServiceClient
+c = ServiceClient(sys.argv[1])
+for i in range(8):
+    t = c.submit("fixpoint_density", {"seed": i, "trials": 32, "batch": 32},
+                 tenant=f"chaos{i}", idempotency_key=f"smoke-{i}")
+    assert t == f"t{i + 1:06d}", t
+PY
+    wait "$sc_pid"
+    rc=$?
+    if [ "$rc" -ne 137 ]; then
+        echo "serve_chaos_smoke: serve_kill rc=$rc (want 137)" \
+            >> "$sc_root/serve.log"
+        sc_ok=0
+    fi
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve --root "$sc_root/svc" \
+        --batch-window-s 0.2 --chaos serve_poison_tenant@1 \
+        >> "$sc_root/serve.log" 2>&1 &
+    sc_pid=$!
+    up=0
+    for _ in $(seq 1 150); do
+        if SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+                --socket "$sc_root/svc/serve.sock" --ping 2>/dev/null; then
+            up=1; break
+        fi
+        sleep 0.2
+    done
+    if [ "$up" -eq 1 ]; then
+        SRNN_SETUPS_PLATFORM=cpu python - "$sc_root/svc/serve.sock" \
+            >> "$sc_root/serve.log" 2>&1 <<'PY' || sc_ok=0
+import sys
+from srnn_tpu.serve.client import ServiceClient
+from srnn_tpu.serve.client import ServiceError
+c = ServiceClient(sys.argv[1], retries=3, backoff_base_s=0.2)
+# resubmit-after-restart dedupes against the journal: same ticket back
+assert c.submit("fixpoint_density", {"seed": 3, "trials": 32, "batch": 32},
+                idempotency_key="smoke-3") == "t000004"
+# the poisoned ticket (first admitted = first replayed) fails quarantined;
+# its 7 innocent groupmates complete
+try:
+    c.wait("t000001", timeout_s=180)
+    raise AssertionError("poisoned ticket completed")
+except ServiceError as e:
+    assert "poisoned" in str(e), e
+for i in range(1, 8):
+    result = c.wait(f"t{i + 1:06d}", timeout_s=180)
+    assert result["counters"], result
+stats = c.stats()["self_healing"]
+assert stats["replayed"] == 8 and stats["quarantined"] == 1, stats
+PY
+        SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.watch \
+            --service "$sc_root/svc/serve.sock" --once \
+            > "$sc_root/watch.json" 2>>"$sc_root/serve.log" || sc_ok=0
+        python - "$sc_root/watch.json" >> "$sc_root/serve.log" 2>&1 <<'PY' || sc_ok=0
+import json, sys
+sh = json.load(open(sys.argv[1]))["service"]["self_healing"]
+assert sh["replayed"] == 8 and sh["quarantined"] == 1, sh
+assert "overload_rejections" in sh and "deadline_expirations" in sh
+print("serve_chaos_smoke: watch --service self-heal stats OK")
+PY
+        SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+            --socket "$sc_root/svc/serve.sock" --shutdown \
+            >> "$sc_root/serve.log" 2>&1 || sc_ok=0
+        wait "$sc_pid" || sc_ok=0
+        grep -q 'srnn_serve_journal_replays_total 8' \
+            "$sc_root/svc/metrics.prom" || sc_ok=0
+        grep -Eq 'srnn_serve_quarantined_tenants_total\{[^}]*\} 1' \
+            "$sc_root/svc/metrics.prom" || sc_ok=0
+    else
+        sc_ok=0
+        kill -9 "$sc_pid" 2>/dev/null
+    fi
+else
+    sc_ok=0
+    kill -9 "$sc_pid" 2>/dev/null
+fi
+if [ "$sc_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("serve_chaos_smoke")
+    tail -n 40 "$sc_root/serve.log"
+fi
+rm -rf "$sc_root"
+summary+=$(printf '%-34s %-4s %4ss' "serve_chaos_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 # Distributed smoke (srnn_tpu/distributed/): a REAL 2-process CPU-mesh
 # launcher run (gloo collectives, process-0-gated host I/O) must end
 # bitwise-equal to the single-process run of the same config, write each

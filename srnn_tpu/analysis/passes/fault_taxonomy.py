@@ -21,9 +21,17 @@ checks, statically:
     ``setups/__main__.py`` (``T004``) and handled by a ``case`` arm in
     each watch script (``T005``, textual), and no script claims a
     supervisor code for its own ``exit`` (``T006`` — the PR 7 collision,
-    machine-checked).
+    machine-checked);
+  * the experiment service's dispatch-thread retry menu
+    (``serve/service.py``'s ``DISPATCH_RETRYABLE``) names only kinds the
+    supervisor's ``RETRYABLE`` tuple declares retryable (``T008`` — a
+    drifted member would retry a fault the taxonomy calls fatal, or
+    vice versa), and the serve chaos fault menu
+    (``resilience/chaos.py``'s ``SERVE_FAULT_KINDS``) names only
+    retryable kind VALUES (``T009`` — the injector must drill the retry
+    ladder, not silently exercise the fatal path).
 
-Codes: ``T001``–``T006`` above; ``T007`` when the supervisor module or
+Codes: ``T001``–``T009`` above; ``T007`` when the supervisor module or
 ``classify_fault`` itself cannot be located (stale registry).
 """
 
@@ -35,6 +43,8 @@ from ..core import AnalysisContext, Finding, PassSpec, dotted_name
 
 SUPERVISOR_REL = "srnn_tpu/resilience/supervisor.py"
 MAIN_REL = "srnn_tpu/setups/__main__.py"
+SERVICE_REL = "srnn_tpu/serve/service.py"
+CHAOS_REL = "srnn_tpu/resilience/chaos.py"
 WATCH_SCRIPTS = ("scripts/tpu_watch.sh", "scripts/tpu_window.sh")
 
 #: the taxonomy exception types whose raise sites must classify
@@ -110,6 +120,48 @@ def _regex_literals(tree: ast.AST) -> Dict[str, "tuple[int, str]"]:
                 and isinstance(node.value.args[0].value, str):
             out[node.targets[0].id] = (node.lineno, node.value.args[0].value)
     return out
+
+
+def _module_tuple(tree: ast.AST, target: str,
+                  extract) -> Optional["tuple[int, list]"]:
+    """Module-level ``TARGET = (...)`` -> (line, [extract(elt) != None])."""
+    for node in tree.body if hasattr(tree, "body") else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == target \
+                and isinstance(node.value, ast.Tuple):
+            vals = [v for v in map(extract, node.value.elts)
+                    if v is not None]
+            return node.lineno, vals
+    return None
+
+
+def _name_tuple(tree: ast.AST, target: str) -> Optional["tuple[int, list]"]:
+    """Module-level ``TARGET = (A, B, ...)`` of Names -> (line, [names])."""
+    return _module_tuple(
+        tree, target,
+        lambda e: e.id if isinstance(e, ast.Name) else None)
+
+
+def _string_tuple(tree: ast.AST, target: str) -> Optional["tuple[int, list]"]:
+    """Module-level ``TARGET = ("a", "b", ...)`` -> (line, [strings])."""
+    return _module_tuple(
+        tree, target,
+        lambda e: e.value if isinstance(e, ast.Constant)
+        and isinstance(e.value, str) else None)
+
+
+def _kind_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = "snake_value"`` fault-kind constants."""
+    consts: Dict[str, str] = {}
+    for node in tree.body if hasattr(tree, "body") else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.isupper() \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    return consts
 
 
 def run(ctx: AnalysisContext):
@@ -228,6 +280,66 @@ def run(ctx: AnalysisContext):
                             f"{code} means {const} in the supervisor "
                             "vocabulary — pick an unclaimed code (the "
                             "PR 7 accelerator-gate collision)")
+
+    # T008/T009: the serve tier's fault menus stay inside the
+    # supervisor's RETRYABLE taxonomy (the service retries and the chaos
+    # injector drills exactly — only — what the taxonomy calls transient)
+    retryable = _name_tuple(sup.tree, "RETRYABLE")
+    kind_consts = _kind_constants(sup.tree)
+    svc = ctx.module(SERVICE_REL)
+    if svc is not None and retryable is not None:
+        tup = _name_tuple(svc.tree, "DISPATCH_RETRYABLE")
+        if tup is None:
+            yield Finding(
+                pass_id=PASS.id, code="T008", path=svc.rel, line=1,
+                message="serve/service.py has no module-level "
+                        "DISPATCH_RETRYABLE tuple — the supervised-"
+                        "dispatch retry menu went unscannable; update "
+                        "the fault-taxonomy pass alongside the refactor")
+        else:
+            line, names = tup
+            for name in names:
+                if name not in retryable[1]:
+                    yield Finding(
+                        pass_id=PASS.id, code="T008", path=svc.rel,
+                        line=line,
+                        message=f"DISPATCH_RETRYABLE names {name}, which "
+                                "is not in the supervisor's RETRYABLE "
+                                "tuple — the service would retry a fault "
+                                "the taxonomy classifies fatal")
+    chaos_mod = ctx.module(CHAOS_REL)
+    if chaos_mod is not None and retryable is not None:
+        menu = _string_tuple(chaos_mod.tree, "SERVE_FAULT_KINDS")
+        retry_values = {kind_consts[n] for n in retryable[1]
+                        if n in kind_consts}
+        if menu is None:
+            # a silent skip here is the exact rot this pass exists to
+            # catch: the menu went unscannable, report it like T008 does
+            yield Finding(
+                pass_id=PASS.id, code="T009", path=chaos_mod.rel, line=1,
+                message="resilience/chaos.py has no module-level "
+                        "SERVE_FAULT_KINDS string tuple — the serve "
+                        "chaos fault menu went unscannable; update the "
+                        "fault-taxonomy pass alongside the refactor")
+        elif not retry_values:
+            yield Finding(
+                pass_id=PASS.id, code="T009", path=sup.rel,
+                line=retryable[0],
+                message="no RETRYABLE member resolves to a module-level "
+                        "string kind constant — the serve chaos menu "
+                        "cannot be checked; update the fault-taxonomy "
+                        "pass alongside the refactor")
+        else:
+            for val in menu[1]:
+                if val not in retry_values:
+                    yield Finding(
+                        pass_id=PASS.id, code="T009", path=chaos_mod.rel,
+                        line=menu[0],
+                        message=f"SERVE_FAULT_KINDS names {val!r}, which "
+                                "is not a retryable fault-kind value in "
+                                "the supervisor — serve_dispatch_fault "
+                                "would drill the fatal path, not the "
+                                "retry ladder")
 
 
 PASS = PassSpec(

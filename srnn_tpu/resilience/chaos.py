@@ -43,6 +43,27 @@ exact, reproducible points of a mega run:
     the kill-and-resume e2e runs this in a child process and asserts
     the ``.traj`` stream is bit-identical after resume.
 
+Serve-layer events (PR 13 — the experiment service's recovery ladders,
+``srnn_tpu/serve``; the service arms these via its ``--chaos`` flag and
+calls :meth:`ChaosMonkey.note_submit` / :meth:`ChaosMonkey.serve_dispatch`
+from its production admission/dispatch paths):
+
+  * ``serve_kill@N`` — ``kill(self, SIGKILL)`` at the top of the ``N``-th
+    dispatch execution attempt (1-based): admitted tickets are journaled
+    but unfinished — the kill -9 drill the durable-journal replay e2e
+    restarts from.
+  * ``serve_dispatch_fault@N:kind`` — raise the classified fault ``kind``
+    (one of :data:`SERVE_FAULT_KINDS`: ``device_loss`` as a real
+    ``XlaRuntimeError``, ``io`` as an ``EIO`` ``OSError``, ``stall`` as a
+    real ``StallError``) at the ``N``-th dispatch attempt, routed through
+    the supervisor's production ``classify_fault`` — the service's
+    bounded deterministic-backoff retry path.
+  * ``serve_poison_tenant@N`` — the ``N``-th ADMITTED ticket (1-based;
+    journal replays re-admit in journal order first) is poisoned: every
+    dispatch attempt containing it raises a deterministic (FATAL-class)
+    config error, so retries cannot mask it and the service's bisection
+    must isolate and quarantine it while its groupmates complete.
+
 Every event fires **once per process**; an in-process restart keeps the
 consumed schedule, so recovery cannot loop on its own injector.  The
 schedule string is not persisted into ``config.json`` — a later
@@ -58,7 +79,17 @@ import threading
 from typing import Callable, List, Optional
 
 KINDS = ("device_loss", "host_loss", "coordinator_timeout", "stall",
-         "writer", "sigterm", "sigkill")
+         "writer", "sigterm", "sigkill",
+         "serve_kill", "serve_dispatch_fault", "serve_poison_tenant")
+
+#: the ``serve_dispatch_fault`` menu — retryable kinds by the
+#: supervisor's taxonomy (the fault-taxonomy srnnlint pass checks each
+#: stays one of the supervisor's RETRYABLE kind values, T009)
+SERVE_FAULT_KINDS = ("device_loss", "io", "stall")
+
+#: events whose ordinal ``N`` is 1-based (the first countable thing is 1)
+_ONE_BASED = ("writer", "serve_kill", "serve_dispatch_fault",
+              "serve_poison_tenant")
 
 #: how long a condemned finisher holds before giving up on an abort (the
 #: supervisor aborts it within one backoff; this is the safety net)
@@ -68,15 +99,20 @@ DEFAULT_STALL_HOLD_S = 3600.0
 class ChaosEvent:
     __slots__ = ("kind", "at", "arg", "fired")
 
-    def __init__(self, kind: str, at: int, arg: Optional[float] = None):
+    def __init__(self, kind: str, at: int, arg=None):
         self.kind = kind
-        self.at = int(at)   # generation (writer: 1-based job ordinal)
-        self.arg = arg
+        self.at = int(at)   # generation (writer/serve_*: 1-based ordinal)
+        self.arg = arg      # float, or a fault-kind string (serve menu)
         self.fired = False
 
     def __repr__(self):
-        return (f"ChaosEvent({self.kind}@{self.at}"
-                + (f":{self.arg:g}" if self.arg is not None else "")
+        if isinstance(self.arg, float):
+            suffix = f":{self.arg:g}"
+        elif self.arg is not None:
+            suffix = f":{self.arg}"
+        else:
+            suffix = ""
+        return (f"ChaosEvent({self.kind}@{self.at}{suffix}"
                 + (" fired" if self.fired else "") + ")")
 
 
@@ -90,10 +126,15 @@ def parse_schedule(spec: str) -> List[ChaosEvent]:
             continue
         try:
             kind, rest = entry.split("@", 1)
-            arg: Optional[float] = None
+            arg = None
             if ":" in rest:
                 rest, args_ = rest.split(":", 1)
-                arg = float(args_)
+                try:
+                    arg = float(args_)
+                except ValueError:
+                    # string args are the serve fault menu's spelling
+                    # (serve_dispatch_fault@N:io); validated below
+                    arg = args_
             at = int(rest)
         except ValueError:
             raise ValueError(
@@ -101,12 +142,22 @@ def parse_schedule(spec: str) -> List[ChaosEvent]:
         if kind not in KINDS:
             raise ValueError(
                 f"unknown chaos kind {kind!r} (one of {', '.join(KINDS)})")
-        if at < 0 or (arg is not None and arg < 0):
-            raise ValueError(f"negative value in chaos entry {entry!r}")
-        if kind == "writer" and at < 1:
+        if isinstance(arg, str) and kind != "serve_dispatch_fault":
             raise ValueError(
-                f"writer job ordinals are 1-based: {entry!r} would never "
-                "fire (the first submitted job is writer@1)")
+                f"non-numeric argument in chaos entry {entry!r} (string "
+                "args belong to serve_dispatch_fault@N:kind only)")
+        if kind == "serve_dispatch_fault":
+            arg = "io" if arg is None else arg
+            if not isinstance(arg, str) or arg not in SERVE_FAULT_KINDS:
+                raise ValueError(
+                    f"serve_dispatch_fault kind must be one of "
+                    f"{', '.join(SERVE_FAULT_KINDS)}: {entry!r}")
+        if at < 0 or (isinstance(arg, float) and arg < 0):
+            raise ValueError(f"negative value in chaos entry {entry!r}")
+        if kind in _ONE_BASED and at < 1:
+            raise ValueError(
+                f"{kind} ordinals are 1-based: {entry!r} would never "
+                f"fire (the first countable event is {kind}@1)")
         if kind == "host_loss" and arg is not None and arg != int(arg):
             raise ValueError(
                 f"host_loss slice-group ordinal must be an integer: "
@@ -164,6 +215,22 @@ def _raise_coordinator_timeout(gen: int) -> None:
         f"chaos: simulated coordinator timeout at generation {gen}")
 
 
+def _raise_serve_fault(kind: str, attempt: int) -> None:
+    """Raise the classified fault ``kind`` the way production raises it,
+    so the service's supervised dispatch — via the supervisor's REAL
+    ``classify_fault``, not a test shim — routes the retry."""
+    if kind == "io":
+        raise OSError(errno.EIO,
+                      f"chaos: injected io fault in serve dispatch "
+                      f"attempt {attempt}")
+    if kind == "stall":
+        from ..utils.pipeline import StallError
+
+        raise StallError(f"chaos: injected dispatch stall in serve "
+                         f"dispatch attempt {attempt}")
+    _raise_device_loss(attempt, None)
+
+
 def _raise_device_loss(gen: int, survivors: Optional[int]) -> None:
     """Raise the same exception type a real device loss surfaces as, so
     the classifier's production branch — not a test shim — handles it."""
@@ -197,6 +264,12 @@ class ChaosMonkey:
         # event skip its finisher silently instead of stalling
         self._holds: List[threading.Event] = []
         self._holds_lock = threading.Lock()
+        #: tickets poisoned by serve_poison_tenant@N: the POISON persists
+        #: (unlike the one-shot event that armed it) so retries cannot
+        #: mask it and the service's bisection must isolate it
+        self.poisoned_tickets = set()
+        self._serve_submits = 0    # admitted tickets seen (1-based)
+        self._serve_attempts = 0   # dispatch execution attempts (1-based)
 
     # -- construction -----------------------------------------------------
 
@@ -226,7 +299,10 @@ class ChaosMonkey:
         """Fire every due generation-keyed event; called by the mega loops
         at the top of each chunk iteration, before the chunk's dispatch."""
         for ev in self.events:
-            if ev.fired or ev.kind in ("writer", "stall") or gen < ev.at:
+            if ev.fired or gen < ev.at \
+                    or ev.kind in ("writer", "stall", "serve_kill",
+                                   "serve_dispatch_fault",
+                                   "serve_poison_tenant"):
                 continue
             ev.fired = True
             if ev.kind == "device_loss":
@@ -305,6 +381,42 @@ class ChaosMonkey:
             return orig(fn, *a, **k)
 
         writer.submit = submit
+
+    def note_submit(self, ticket: str) -> None:
+        """Serve admission hook: count admitted tickets (journal replays
+        re-admit first, in journal order) and arm any due
+        ``serve_poison_tenant@N`` on the ``N``-th one."""
+        self._serve_submits += 1
+        for ev in self.events:
+            if ev.kind == "serve_poison_tenant" and not ev.fired \
+                    and self._serve_submits >= ev.at:
+                ev.fired = True
+                self.poisoned_tickets.add(ticket)
+
+    def serve_dispatch(self, requests) -> None:
+        """Serve dispatch hook, called at the top of EVERY dispatch
+        execution attempt (retries and bisection halves included).
+        Poisoned tickets raise a deterministic (FATAL-class) error first
+        — the poison outlives its arming event by design; then the
+        attempt counter advances and any due ``serve_kill`` /
+        ``serve_dispatch_fault`` fires once."""
+        bad = sorted(r.ticket for r in requests
+                     if r.ticket in self.poisoned_tickets)
+        if bad:
+            raise RuntimeError(
+                "chaos: poisoned tenant config for ticket(s) "
+                + ",".join(bad) + " (deterministic; survives retries)")
+        self._serve_attempts += 1
+        for ev in self.events:
+            if ev.fired or ev.kind not in ("serve_kill",
+                                           "serve_dispatch_fault") \
+                    or self._serve_attempts < ev.at:
+                continue
+            ev.fired = True
+            if ev.kind == "serve_kill":  # pragma: no cover - kills us
+                os.kill(os.getpid(), signal.SIGKILL)
+            else:
+                _raise_serve_fault(str(ev.arg), self._serve_attempts)
 
     def abort_pending(self) -> None:
         """Release the currently-condemned finisher threads (recovery

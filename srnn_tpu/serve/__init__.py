@@ -15,11 +15,20 @@ package serves them instead (ROADMAP item 4):
   * ``serve.server`` / ``serve.client`` — Unix-socket JSON-lines
     transport; ``python -m srnn_tpu.serve`` runs the server, the setups'
     ``--service`` flag makes them clients.
+  * ``serve.journal`` — the durable ticket journal (PR 13's self-healing
+    spine): admits are fsynced before acknowledgment, completions are
+    journaled, and a restarted service replays the unfinished rest
+    bitwise-equal to an uninterrupted run.  ``serve.service`` adds the
+    supervised dispatch (classified-fault retries, poison-quarantine
+    bisection), admission control (``max_queue`` ->
+    :class:`OverloadedError`), per-ticket deadlines, and graceful
+    SIGTERM drain around it.
 """
 
-from .client import ServiceClient, ServiceError
+from .client import ServiceClient, ServiceError, ServiceOverloaded
+from .journal import TicketJournal, read_journal
 from .scheduler import DEFAULT_MAX_STACK, Request, plan_dispatches
-from .service import ExperimentService
+from .service import (DeadlineExpired, ExperimentService, OverloadedError)
 from .tenant import (evolve_multi_stacked, evolve_multi_stacked_donated,
                      evolve_stacked, evolve_stacked_captured,
                      evolve_stacked_donated, evolve_stacked_step,
@@ -28,10 +37,15 @@ from .tenant import (evolve_multi_stacked, evolve_multi_stacked_donated,
 
 __all__ = [
     "DEFAULT_MAX_STACK",
+    "DeadlineExpired",
     "ExperimentService",
+    "OverloadedError",
     "Request",
     "ServiceClient",
     "ServiceError",
+    "ServiceOverloaded",
+    "TicketJournal",
+    "read_journal",
     "evolve_multi_stacked",
     "evolve_multi_stacked_donated",
     "evolve_stacked",

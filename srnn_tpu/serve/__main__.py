@@ -1,8 +1,12 @@
 """``python -m srnn_tpu.serve`` — run (or talk to) the experiment service.
 
-Server mode (default): bind the Unix socket, warm any requested
-spellings, and serve until a ``shutdown`` op or SIGTERM.  Client mode
-(``--shutdown`` / ``--stats`` / ``--ping``) talks to a RUNNING service on
+Server mode (default): replay any journaled-unfinished tickets from a
+previous (possibly killed) service on the same ``--root``, bind the Unix
+socket, warm any requested spellings, and serve until a ``shutdown`` op
+or SIGTERM.  SIGTERM drains gracefully: the in-flight dispatch finishes,
+the queued rest stays journaled, and the process exits 0 so a restart
+resumes exactly where it stopped.  Client mode (``--shutdown`` /
+``--drain`` / ``--stats`` / ``--ping``) talks to a RUNNING service on
 the same socket — the smoke scripts use it for clean teardown.
 """
 
@@ -34,10 +38,37 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pre-dispatch the fixpoint-density executor at "
                         "these shapes (stacked at --max-stack AND solo) "
                         "before accepting traffic")
+    p.add_argument("--max-queue", type=int, default=0, metavar="N",
+                   help="admission control: reject submits with a typed "
+                        "'overloaded' response once N tickets are queued "
+                        "(0 = unbounded)")
+    p.add_argument("--results-ttl-s", type=float, default=3600.0,
+                   metavar="S",
+                   help="evict completed-but-never-collected results "
+                        "after S seconds (0 = keep until the retention "
+                        "cap)")
+    p.add_argument("--dispatch-retries", type=int, default=2, metavar="N",
+                   help="bounded retries for transient classified "
+                        "dispatch faults (device_loss/io/stall) before "
+                        "bisection/failure")
+    p.add_argument("--retry-backoff-s", type=float, default=0.05,
+                   metavar="S",
+                   help="base of the deterministic dispatch-retry "
+                        "backoff")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="serve-layer fault injection, e.g. "
+                        "'serve_kill@1,serve_dispatch_fault@2:io,"
+                        "serve_poison_tenant@3' (resilience.chaos "
+                        "schedule syntax; drills the recovery ladders on "
+                        "CPU CI)")
     p.add_argument("--ping", action="store_true",
                    help="client mode: exit 0 iff a service answers")
     p.add_argument("--stats", action="store_true",
                    help="client mode: print a running service's stats JSON")
+    p.add_argument("--drain", action="store_true",
+                   help="client mode: graceful drain — finish in-flight "
+                        "dispatches, keep the queued rest journaled for "
+                        "a restart to replay")
     p.add_argument("--shutdown", action="store_true",
                    help="client mode: ask a running service to exit")
     return p
@@ -47,7 +78,7 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     sock = args.socket or os.path.join(args.root, "serve.sock")
 
-    if args.ping or args.stats or args.shutdown:
+    if args.ping or args.stats or args.shutdown or args.drain:
         from .client import ServiceClient, ServiceError
 
         client = ServiceClient(sock)
@@ -56,6 +87,9 @@ def main(argv=None) -> int:
                 return 0 if client.ping() else 1
             if args.stats:
                 print(json.dumps(client.stats(), indent=1, default=str))
+                return 0
+            if args.drain:
+                client.drain()
                 return 0
             client.shutdown()
             return 0
@@ -75,23 +109,49 @@ def main(argv=None) -> int:
 
     ensure_compilation_cache()
     os.makedirs(args.root, exist_ok=True)
+    chaos = None
+    if args.chaos:
+        from ..resilience.chaos import ChaosMonkey, parse_schedule
+
+        try:
+            chaos = ChaosMonkey(parse_schedule(args.chaos))
+        except ValueError as e:
+            raise SystemExit(f"--chaos: {e}")
     service = ExperimentService(args.root, max_stack=args.max_stack,
-                                slo_p95_ms=args.slo_p95_ms)
+                                slo_p95_ms=args.slo_p95_ms,
+                                max_queue=args.max_queue,
+                                results_ttl_s=args.results_ttl_s,
+                                dispatch_retries=args.dispatch_retries,
+                                retry_backoff_s=args.retry_backoff_s,
+                                chaos=chaos)
+    replayed = service.recover()
+    if replayed:
+        print(f"serve: replayed {replayed} journaled ticket(s) from a "
+              "previous run", flush=True)
     if args.warm_fixpoint_density:
         trials, batch = (int(x) for x in
                          args.warm_fixpoint_density.split(","))
         service.warm("fixpoint_density", {"trials": trials, "batch": batch})
     server = ServiceServer(service, sock,
                            batch_window_s=args.batch_window_s)
-    prev = signal.signal(signal.SIGTERM, lambda *_: server.stop())
+    # SIGTERM is the preemption signal (the supervisor tier's contract):
+    # drain gracefully — finish in flight, journal the rest, exit clean
+    prev = signal.signal(signal.SIGTERM, lambda *_: server.stop(drain=True))
     print(f"serve: listening on {sock} (root={args.root}, "
           f"max_stack={args.max_stack}, "
-          f"batch_window_s={args.batch_window_s})", flush=True)
+          f"batch_window_s={args.batch_window_s}"
+          + (f", max_queue={args.max_queue}" if args.max_queue else "")
+          + (f", chaos={args.chaos}" if args.chaos else "") + ")",
+          flush=True)
     try:
         server.serve_until_shutdown()
     finally:
         signal.signal(signal.SIGTERM, prev)
         service.close()
+    unfinished = service._self_healing_stats()["journal_unfinished"]
+    if unfinished:
+        print(f"serve: exiting with {unfinished} ticket(s) journaled for "
+              "replay on restart", flush=True)
     return 0
 
 

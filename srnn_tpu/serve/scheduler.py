@@ -13,7 +13,7 @@ Pure host logic, no jax: the service owns execution; this module only
 decides who rides together.
 """
 
-from typing import Dict, Hashable, List, NamedTuple, Sequence
+from typing import Dict, Hashable, List, NamedTuple, Optional, Sequence
 
 #: default cap on tenants per stacked dispatch (K): past this the stacked
 #: program's own compile becomes a new spelling per K — the service warms
@@ -28,6 +28,12 @@ class Request(NamedTuple):
     params: dict          # kind-specific payload (seeds, shapes, knobs)
     tenant: str           # tenant label for telemetry/lineage rows
     submitted_s: float    # monotonic submit stamp (latency accounting)
+    #: absolute MONOTONIC deadline stamped at admission (None = no
+    #: deadline); expired tickets fail fast and never occupy a stack slot
+    deadline_mono: Optional[float] = None
+    #: client idempotency key — a resubmit with the same key dedupes
+    #: against the live table / the durable journal instead of re-running
+    idem_key: Optional[str] = None
 
 
 class Dispatch(NamedTuple):

@@ -30,7 +30,7 @@ import time
 from typing import Optional
 
 from ..utils.pipeline import spawn_thread
-from .service import ExperimentService
+from .service import DeadlineExpired, ExperimentService, OverloadedError
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -73,6 +73,10 @@ class ServiceServer(socketserver.ThreadingMixIn,
         self.socket_path = socket_path
         self.batch_window_s = batch_window_s
         self._stop = threading.Event()
+        #: graceful-drain flag (the SIGTERM path): finish the in-flight
+        #: dispatch, do NOT dispatch the remaining queue — those tickets
+        #: stay journaled-unfinished and a restarted service replays them
+        self._drain = threading.Event()
         self._dispatcher = None
 
     # -- ops -------------------------------------------------------------
@@ -84,16 +88,15 @@ class ServiceServer(socketserver.ThreadingMixIn,
         if op == "submit":
             if self._stop.is_set():
                 return {"ok": False, "error": "service shutting down"}
-            ticket = self.service.submit(msg["kind"], msg.get("params", {}),
-                                         tenant=msg.get("tenant"))
-            return {"ok": True, "ticket": ticket}
+            return self._admit(msg)
         if op in ("wait", "request"):
             if op == "request":
                 if self._stop.is_set():
                     return {"ok": False, "error": "service shutting down"}
-                ticket = self.service.submit(msg["kind"],
-                                             msg.get("params", {}),
-                                             tenant=msg.get("tenant"))
+                admitted = self._admit(msg)
+                if not admitted["ok"]:
+                    return admitted
+                ticket = admitted["ticket"]
             else:
                 ticket = msg["ticket"]
             entry = self.service.wait(ticket,
@@ -104,6 +107,10 @@ class ServiceServer(socketserver.ThreadingMixIn,
             return out
         if op == "stats":
             return {"ok": True, "stats": self.service.stats()}
+        if op == "drain":
+            # socket spelling of the SIGTERM drain (tests, orchestrators)
+            self.stop(drain=True)
+            return {"ok": True, "bye": True, "draining": True}
         if op == "shutdown":
             self._stop.set()
             # unblock serve_forever from a handler thread without joining
@@ -111,6 +118,22 @@ class ServiceServer(socketserver.ThreadingMixIn,
             spawn_thread(self.shutdown, name="serve-shutdown")
             return {"ok": True, "bye": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _admit(self, msg: dict) -> dict:
+        """Submit with the typed admission responses: ``overloaded`` and
+        ``deadline_expired`` flags let the client pick the right reaction
+        (back off and resubmit vs give up) without string-matching."""
+        try:
+            ticket = self.service.submit(
+                msg["kind"], msg.get("params", {}),
+                tenant=msg.get("tenant"),
+                deadline_s=msg.get("deadline_s"),
+                idempotency_key=msg.get("idempotency_key"))
+        except OverloadedError as e:
+            return {"ok": False, "error": str(e), "overloaded": True}
+        except DeadlineExpired as e:
+            return {"ok": False, "error": str(e), "deadline_expired": True}
+        return {"ok": True, "ticket": ticket}
 
     # -- lifecycle -------------------------------------------------------
 
@@ -123,9 +146,16 @@ class ServiceServer(socketserver.ThreadingMixIn,
                 continue
             if self.batch_window_s > 0:
                 time.sleep(self.batch_window_s)
+            if self._drain.is_set():
+                # SIGTERM landed during the window: the queued tickets
+                # stay journaled-unfinished for the restart to replay —
+                # dispatching them now is exactly what drain forbids
+                return
             # window_s = the sleep just performed: the service splits each
             # ticket's pre-dispatch wait into queue vs window spans with it
             self.service.run_pending(window_s=self.batch_window_s)
+        if self._drain.is_set():
+            return
         # drain whatever raced the stop (handle_op rejects new traffic
         # once _stop is set, so this converges; no window sleep here)
         while self.service.queue_depth() > 0:
@@ -144,19 +174,32 @@ class ServiceServer(socketserver.ThreadingMixIn,
             # a submit that slipped between the stop-check and the
             # dispatcher's final drain must not leave its handler thread
             # blocked in wait() — server_close() JOINS handler threads,
-            # so a stranded waiter would hang shutdown for its timeout
-            self.service.fail_pending("service shut down before dispatch")
+            # so a stranded waiter would hang shutdown for its timeout.
+            # Either way the stranded tickets stay journaled-unfinished;
+            # the drain spelling says so in the typed response.
+            if self._drain.is_set():
+                self.service.fail_pending(
+                    "service draining; ticket journaled for replay "
+                    "after restart", resumable=True)
+            else:
+                self.service.fail_pending(
+                    "service shut down before dispatch")
             self.server_close()
             try:
                 os.unlink(self.socket_path)
             except OSError:
                 pass
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False) -> None:
         """Signal-safe stop (the SIGTERM path): ``shutdown()`` blocks
         until ``serve_forever`` exits, and a signal handler runs ON the
         thread inside ``serve_forever`` — calling it synchronously there
-        deadlocks, so it moves to a helper thread like the shutdown op."""
+        deadlocks, so it moves to a helper thread like the shutdown op.
+        ``drain=True`` is the graceful-preemption contract: finish the
+        in-flight dispatch, journal (keep) the rest, exit clean so a
+        restart resumes them."""
+        if drain:
+            self._drain.set()
         self._stop.set()
         spawn_thread(self.shutdown, name="serve-stop")
 

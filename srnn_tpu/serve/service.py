@@ -28,6 +28,22 @@ latency exceeds the ``slo_p95_ms`` target counts into
 ``serve_slo_violations_total`` — the signal a future SLO-driven adaptive
 batch window optimizes against (ROADMAP item 3).
 
+Self-healing (the serve tier's PR-7 moment): every admitted submit is
+journaled durably (``serve.journal``, append+fsync) BEFORE its ticket id
+is acknowledged, so a ``kill -9`` loses no admitted work — a restarted
+service calls :meth:`ExperimentService.recover` and replays every
+unfinished ticket with results bitwise-equal to an uninterrupted run.
+Dispatch is SUPERVISED: failures route through the resilience tier's
+``classify_fault`` taxonomy — retryable kinds (:data:`DISPATCH_RETRYABLE`)
+get bounded deterministic-backoff retries, and a persisting stacked-group
+failure BISECTS the group to isolate the poisoned tenant(s), quarantining
+them (failed, with the real error) while the innocent groupmates complete
+solo.  Admission is bounded (``max_queue`` -> typed
+:class:`OverloadedError` the client backs off on), per-ticket deadlines
+are enforced at admission and at dispatch (expired tickets fail fast,
+never occupying a stack slot), and completed-but-never-collected results
+evict on a TTL so a long-lived service cannot leak its results table.
+
 Transport lives elsewhere (``serve.server`` wraps this in a Unix-socket
 JSON-lines server; in-process callers — tests, the bench load leg — drive
 it directly).
@@ -42,12 +58,36 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..resilience.supervisor import (DEVICE_LOSS, IO, STALL, BackoffPolicy,
+                                     classify_fault)
 from ..telemetry.metrics import MetricsRegistry
+from .journal import TicketJournal
 from .scheduler import (DEFAULT_MAX_STACK, Dispatch, Request,
                         plan_dispatches)
 
 #: request latency / dispatch wall buckets: 1ms .. 2 min
 _LATENCY_BUCKETS = (1e-3, 5e-3, 2e-2, 0.1, 0.5, 2.0, 8.0, 30.0, 120.0)
+
+#: dispatch-thread fault kinds the service retries in place (bounded,
+#: deterministic backoff) instead of failing the group: transient by the
+#: supervisor's taxonomy.  Everything else — including the deterministic
+#: config errors a poisoned tenant raises — goes straight to bisection
+#: (stacked) or a failed ticket (solo).  The fault-taxonomy srnnlint pass
+#: checks each member is one of the supervisor's RETRYABLE kinds (T008).
+DISPATCH_RETRYABLE = (DEVICE_LOSS, IO, STALL)
+
+
+class OverloadedError(RuntimeError):
+    """Typed admission rejection: the queue is at ``max_queue``.  The
+    transport maps it to an ``overloaded: true`` response the client
+    backs off on — load past saturation degrades into explicit pushback,
+    never an unbounded queue."""
+
+
+class DeadlineExpired(RuntimeError):
+    """The ticket's ``deadline_s`` was already spent at admission (the
+    dispatch-time expiry path resolves the ticket as failed instead,
+    since it was admitted and journaled)."""
 
 
 def _soup_config_from_params(params: dict):
@@ -127,13 +167,27 @@ class ExperimentService:
 
     def __init__(self, root: str, max_stack: int = DEFAULT_MAX_STACK,
                  registry: Optional[MetricsRegistry] = None,
-                 writer=None, slo_p95_ms: float = 0.0):
+                 writer=None, slo_p95_ms: float = 0.0,
+                 max_queue: int = 0, results_ttl_s: float = 0.0,
+                 dispatch_retries: int = 2, retry_backoff_s: float = 0.05,
+                 chaos=None):
         from ..utils.pipeline import BackgroundWriter
 
         os.makedirs(root, exist_ok=True)
         self.root = root
         self.max_stack = max_stack
         self.slo_p95_ms = float(slo_p95_ms)
+        self.max_queue = max(0, int(max_queue))       # 0 = unbounded
+        self.results_ttl_s = max(0.0, float(results_ttl_s))  # 0 = no TTL
+        self.dispatch_retries = max(0, int(dispatch_retries))
+        #: deterministic retry backoff (seeded like the supervisor's, so
+        #: a chaos-harness run replays the same delay sequence); the base
+        #: is service-scale — a dispatch retry must not stall the queue
+        #: the way a mega-run restart may
+        self._retry_policy = BackoffPolicy(
+            max_restarts=self.dispatch_retries,
+            base_s=max(0.0, float(retry_backoff_s)), max_s=2.0, seed=0)
+        self.chaos = chaos
         self.registry = registry or MetricsRegistry()
         # registered eagerly so metrics.prom always exposes the SLO
         # counter (a clean service shows the 0, not a missing series)
@@ -141,14 +195,41 @@ class ExperimentService:
             "serve_slo_violations_total",
             help="requests whose latency exceeded the --slo-p95-ms "
                  "target")
+        # ... and the self-healing ladder counters, for the same reason:
+        # the watch console / chaos smoke read zeros, not missing series
+        self.registry.counter(
+            "serve_journal_replays_total",
+            help="journaled tickets replayed after a restart")
+        self.registry.counter(
+            "serve_quarantined_tenants_total",
+            help="poisoned tenants isolated by group bisection")
+        self.registry.counter(
+            "serve_overload_rejections_total",
+            help="submits rejected at admission (--max-queue)")
+        self.registry.counter(
+            "serve_deadline_expirations_total",
+            help="tickets expired by their deadline_s (admission or "
+                 "dispatch)")
+        self.registry.counter(
+            "serve_dispatch_retries_total",
+            help="dispatch attempts retried on a transient classified "
+                 "fault")
+        self.registry.counter(
+            "serve_results_evicted_total",
+            help="uncollected results evicted (TTL or retention cap)")
         self._own_writer = writer is None
         self.writer = writer or BackgroundWriter(name="serve-io")
         self._events = open(os.path.join(root, "events.jsonl"), "a")
         self._lineage = None  # opened lazily on the first lineage row
+        self.journal = TicketJournal(root)
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
         self._pending: List[Request] = []
         self._results: Dict[str, dict] = {}
+        self._idem: Dict[str, str] = {}          # idempotency key -> ticket
+        self._idem_by_ticket: Dict[str, str] = {}  # reverse (for cleanup)
+        self._unfinished = set()  # admitted, not yet journaled done
+        self._replayed = 0        # tickets re-admitted by recover()
         self._completed = 0   # monotone; _results is consume-on-wait
         self._draining = False   # set by fail_pending: no more submits
         self._warming = False    # warm() dispatches skip telemetry rows
@@ -161,23 +242,77 @@ class ExperimentService:
     # -- submission / results -------------------------------------------
 
     def submit(self, kind: str, params: dict,
-               tenant: Optional[str] = None) -> str:
-        """Queue one request; returns its ticket id."""
+               tenant: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               idempotency_key: Optional[str] = None) -> str:
+        """Admit one request; returns its ticket id.
+
+        The returned id is DURABLE: the journal append (with fsync)
+        happens under the admission lock, before the id escapes — an
+        acknowledged ticket survives ``kill -9`` and replays on restart.
+        ``idempotency_key`` dedupes: a resubmit with a known key (live
+        table or journal-recovered) returns the existing ticket instead
+        of double-running.  Raises :class:`OverloadedError` past
+        ``max_queue`` and :class:`DeadlineExpired` for a ``deadline_s``
+        that is already spent.
+        """
         if kind not in GROUP_KEYS:
             raise ValueError(f"unknown request kind {kind!r}; "
                              f"expected one of {sorted(GROUP_KEYS)}")
+        if deadline_s is not None and float(deadline_s) <= 0:
+            self.registry.counter(
+                "serve_deadline_expirations_total",
+                help="tickets expired by their deadline_s (admission or "
+                     "dispatch)").inc(1, kind=kind)
+            raise DeadlineExpired(
+                f"deadline_s={deadline_s} is already spent at admission")
         with self._lock:
             if self._draining:
                 # closes the shutdown race for good: fail_pending flips
                 # this under the SAME lock, so a submit that slipped past
                 # the transport's stop check cannot strand its waiter
                 raise RuntimeError("service shutting down")
+            if idempotency_key:
+                known = self._idem.get(idempotency_key)
+                if known is not None:
+                    return known   # admitted once per key; no re-run
+            if self.max_queue and len(self._pending) >= self.max_queue:
+                depth = len(self._pending)
+                self.registry.counter(
+                    "serve_overload_rejections_total",
+                    help="submits rejected at admission "
+                         "(--max-queue)").inc(1, kind=kind)
+                self.registry.gauge(
+                    "serve_queue_rejected_depth",
+                    help="queue depth observed at the last overload "
+                         "rejection").set(depth)
+                raise OverloadedError(
+                    f"queue full ({depth} >= max_queue={self.max_queue}); "
+                    "back off and resubmit")
+            now = time.monotonic()
             ticket = f"t{next(self._tickets):06d}"
             req = Request(ticket=ticket, kind=kind, params=dict(params),
-                          tenant=tenant or ticket,
-                          submitted_s=time.monotonic())
+                          tenant=tenant or ticket, submitted_s=now,
+                          deadline_mono=(now + float(deadline_s)
+                                         if deadline_s is not None
+                                         else None),
+                          idem_key=idempotency_key or None)
+            # durable BEFORE acknowledged: fsync under the admission lock,
+            # so the ticket id never outruns its journal record
+            self.journal.record_submit(
+                ticket=ticket, kind=kind, params=req.params,
+                tenant=req.tenant, key=idempotency_key,
+                deadline_wall=(time.time() + float(deadline_s)
+                               if deadline_s is not None else None),
+                wall=time.time())
             self._pending.append(req)
+            self._unfinished.add(ticket)
+            if idempotency_key:
+                self._idem[idempotency_key] = ticket
+                self._idem_by_ticket[ticket] = idempotency_key
             depth = len(self._pending)
+        if self.chaos is not None:
+            self.chaos.note_submit(ticket)
         self.registry.counter("serve_requests_total",
                               help="experiment requests accepted").inc(
                                   1, kind=kind)
@@ -185,6 +320,62 @@ class ExperimentService:
                             help="requests queued, not yet dispatched").set(
                                 depth)
         return ticket
+
+    def recover(self) -> int:
+        """Replay the journal's unfinished tickets after a restart: each
+        is re-admitted under its ORIGINAL ticket id (clients reconnect
+        and ``wait`` the ids they already hold; idempotent resubmits
+        dedupe onto them), the ticket counter resumes past every id the
+        journal ever issued, and the journal itself is compacted to the
+        unfinished suffix.  Returns the number of replayed tickets."""
+        entries, torn, next_ticket = self.journal.recover()
+        bad = []
+        now = time.monotonic()
+        wall_now = time.time()
+        with self._lock:
+            self._tickets = itertools.count(next_ticket)
+            for e in entries:
+                if e.kind not in GROUP_KEYS:
+                    bad.append(e)     # foreign/forward-version record
+                    continue
+                deadline_mono = None
+                if e.deadline_wall is not None:
+                    # wall-clock deadline re-derived: downtime counts
+                    # against the budget, like any other queueing delay
+                    deadline_mono = now + (float(e.deadline_wall)
+                                           - wall_now)
+                req = Request(ticket=e.ticket, kind=e.kind,
+                              params=dict(e.params), tenant=e.tenant,
+                              submitted_s=now, deadline_mono=deadline_mono,
+                              idem_key=e.key)
+                self._pending.append(req)
+                self._unfinished.add(e.ticket)
+                if e.key:
+                    self._idem[e.key] = e.ticket
+                    self._idem_by_ticket[e.ticket] = e.key
+            replayed = [e for e in entries if e.kind in GROUP_KEYS]
+            self._replayed += len(replayed)
+            depth = len(self._pending)
+        for e in replayed:
+            if self.chaos is not None:
+                self.chaos.note_submit(e.ticket)
+        for e in bad:
+            req = Request(ticket=e.ticket, kind=e.kind, params=e.params,
+                          tenant=e.tenant, submitted_s=now)
+            self._resolve_failed(
+                [req], f"unknown request kind {e.kind!r} in journal")
+        if replayed:
+            self.registry.counter(
+                "serve_journal_replays_total",
+                help="journaled tickets replayed after a restart").inc(
+                    len(replayed))
+            self.registry.gauge(
+                "serve_queue_depth",
+                help="requests queued, not yet dispatched").set(depth)
+            self._event_row(kind="serve_replay",
+                            tickets=[e.ticket for e in replayed],
+                            torn_tail=torn or None)
+        return len(replayed)
 
     def poll(self, ticket: str) -> Optional[dict]:
         """Completed entry for ``ticket`` ({'status', 'result'|'error'}),
@@ -204,7 +395,16 @@ class ExperimentService:
                     raise TimeoutError(f"request {ticket} still pending "
                                        f"after {timeout_s}s")
                 self._done.wait(timeout=left)
+            self._drop_idem_locked(ticket)
             return self._results.pop(ticket)
+
+    def _drop_idem_locked(self, ticket: str) -> None:
+        """A consumed (or evicted) result ends its idempotency window: a
+        later resubmit with the same key is a fresh run, not a dangling
+        pointer at a ticket whose result is gone."""
+        key = self._idem_by_ticket.pop(ticket, None)
+        if key is not None:
+            self._idem.pop(key, None)
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -226,27 +426,111 @@ class ExperimentService:
         self.registry.gauge("serve_queue_depth",
                             help="requests queued, not yet dispatched").set(
                                 self.queue_depth())
+        batch = self._expire_overdue(batch)
         plan = plan_dispatches(batch, GROUP_KEYS, self.max_stack)
         for dispatch in plan:
             self._run_dispatch(dispatch, window_s=window_s)
         self.write_metrics()
         return len(batch)
 
+    def _expire_overdue(self, reqs: Sequence[Request]) -> List[Request]:
+        """Fail every request whose deadline has passed (they never
+        occupy a stack slot) and return the live remainder."""
+        now = time.monotonic()
+        overdue = [r for r in reqs
+                   if r.deadline_mono is not None and now > r.deadline_mono]
+        if overdue:
+            for r in overdue:
+                self.registry.counter(
+                    "serve_deadline_expirations_total",
+                    help="tickets expired by their deadline_s (admission "
+                         "or dispatch)").inc(1, kind=r.kind)
+            self._resolve_failed(overdue,
+                                 "deadline_s expired before dispatch")
+        return [r for r in reqs
+                if r.deadline_mono is None or now <= r.deadline_mono]
+
+    def _execute(self, dispatch: Dispatch) -> List[dict]:
+        """One dispatch execution attempt through the production path
+        (the chaos injector's serve hooks fire here, so every recovery
+        ladder drills the code real traffic runs)."""
+        if self.chaos is not None:
+            self.chaos.serve_dispatch(dispatch.requests)
+        if dispatch.kind == "fixpoint_density":
+            return self._exec_fixpoint_density(dispatch)
+        if dispatch.kind == "soup":
+            return self._exec_soup(dispatch)
+        # pragma: no cover - submit() already validates
+        raise ValueError(f"unknown kind {dispatch.kind!r}")
+
     def _run_dispatch(self, dispatch: Dispatch,
-                      window_s: float = 0.0) -> None:
+                      window_s: float = 0.0, _depth: int = 0) -> None:
+        """Supervised dispatch: execute with bounded deterministic-backoff
+        retries for transient classified faults; on a persisting STACKED
+        failure, bisect the group to isolate the poisoned tenant(s) — the
+        innocents complete solo, the poisoned quarantine (failed with the
+        real error).  ``_depth`` marks bisection recursion: a solo failure
+        under bisection is a quarantine, a top-level solo failure is an
+        ordinary failed request."""
+        # a ticket whose deadline burned away in the queue/backoff must
+        # not occupy a stack slot — re-check at every (sub)dispatch
+        live = self._expire_overdue(dispatch.requests)
+        if not live:
+            return
+        if len(live) != len(dispatch.requests):
+            dispatch = Dispatch(kind=dispatch.kind, key=dispatch.key,
+                                requests=live)
         mode = "stacked" if dispatch.stacked else "solo"
         t0 = time.monotonic()
-        try:
-            if dispatch.kind == "fixpoint_density":
-                results = self._exec_fixpoint_density(dispatch)
-            elif dispatch.kind == "soup":
-                results = self._exec_soup(dispatch)
-            else:  # pragma: no cover - submit() already validates
-                raise ValueError(f"unknown kind {dispatch.kind!r}")
-            error = None
-        except Exception as e:  # a bad request must not kill the service
-            results, error = None, f"{type(e).__name__}: {e}"
+        attempt = 0
+        while True:
+            try:
+                results = self._execute(dispatch)
+                error = fault = None
+                break
+            except Exception as e:  # a bad request must not kill the service
+                fault = classify_fault(e)
+                if fault in DISPATCH_RETRYABLE \
+                        and attempt < self.dispatch_retries:
+                    delay = self._retry_policy.delay(attempt)
+                    attempt += 1
+                    self.registry.counter(
+                        "serve_dispatch_retries_total",
+                        help="dispatch attempts retried on a transient "
+                             "classified fault").inc(
+                            1, kind=dispatch.kind, fault=fault)
+                    self._event_row(kind="serve_retry",
+                                    request_kind=dispatch.kind, fault=fault,
+                                    attempt=attempt,
+                                    backoff_s=round(delay, 4),
+                                    error=f"{type(e).__name__}: {e}")
+                    if delay > 0:
+                        time.sleep(delay)
+                    continue
+                if dispatch.stacked:
+                    # persisting group failure: bisect — one poisoned
+                    # tenant must not take its stacked groupmates down
+                    self._event_row(
+                        kind="serve_bisect", request_kind=dispatch.kind,
+                        tenants=[r.tenant for r in dispatch.requests],
+                        fault=fault, error=f"{type(e).__name__}: {e}")
+                    mid = len(dispatch.requests) // 2
+                    for half in (dispatch.requests[:mid],
+                                 dispatch.requests[mid:]):
+                        self._run_dispatch(
+                            Dispatch(kind=dispatch.kind, key=dispatch.key,
+                                     requests=list(half)),
+                            window_s=window_s, _depth=_depth + 1)
+                    return
+                results, error = None, f"{type(e).__name__}: {e}"
+                break
         wall = time.monotonic() - t0
+        quarantined = error is not None and _depth > 0
+        if quarantined:
+            self.registry.counter(
+                "serve_quarantined_tenants_total",
+                help="poisoned tenants isolated by group bisection").inc(
+                    len(dispatch.requests), kind=dispatch.kind)
         self.registry.counter(
             "serve_dispatches_total",
             help="scheduler dispatch groups executed").inc(
@@ -265,6 +549,11 @@ class ExperimentService:
                         wall_s=round(wall, 4),
                         error=error)
         now = time.monotonic()
+        # journal the completions BEFORE any waiter can observe them: a
+        # kill between delivery and the done-record would otherwise
+        # replay tickets whose results were already collected
+        self._mark_done(dispatch.requests,
+                        "done" if error is None else "failed")
         with self._done:
             for i, req in enumerate(dispatch.requests):
                 if error is None:
@@ -273,6 +562,9 @@ class ExperimentService:
                 else:
                     entry = {"status": "failed", "error": error,
                              "mode": mode}
+                if quarantined:
+                    entry["quarantined"] = True
+                entry["done_s"] = round(now, 4)   # TTL-eviction stamp
                 self._results[req.ticket] = entry
                 self._completed += 1
                 self.registry.histogram(
@@ -291,13 +583,76 @@ class ExperimentService:
                                    window_s=window_s, error=error)
                 self._event_row(kind="serve_tenant", ticket=req.ticket,
                                 tenant=req.tenant, request_kind=req.kind,
-                                mode=mode,
+                                mode=mode, quarantined=quarantined or None,
                                 latency_s=round(now - req.submitted_s, 4),
                                 error=error)
-            # bound the table for fire-and-forget submitters (waiters
-            # consume their own entries): evict oldest-first
-            while len(self._results) > RESULT_RETENTION:
-                self._results.pop(next(iter(self._results)))
+            self._evict_results_locked(now)
+            self._done.notify_all()
+
+    def _mark_done(self, reqs: Sequence[Request], status: str) -> None:
+        """Journal the completions (one fsync for the group) so a restart
+        never re-runs a resolved ticket."""
+        tickets = [r.ticket for r in reqs]
+        self.journal.record_done(tickets, status)
+        with self._lock:
+            self._unfinished.difference_update(tickets)
+
+    def _evict_results_locked(self, now: float) -> None:
+        """Collected-or-TTL retention (caller holds ``self._done``):
+        ``wait`` consumes its own entry; what nobody collects leaves by
+        TTL (``results_ttl_s``) or, as the backstop, by the retention
+        cap — counted, and with the idempotency window closed, so a
+        long-lived service cannot leak its results table."""
+        evicted = 0
+        if self.results_ttl_s > 0:
+            expired = [t for t, e in self._results.items()
+                       if now - e.get("done_s", now) > self.results_ttl_s]
+            for t in expired:
+                self._results.pop(t)
+                self._drop_idem_locked(t)
+                evicted += 1
+        # bound the table for fire-and-forget submitters (waiters
+        # consume their own entries): evict oldest-first
+        while len(self._results) > RESULT_RETENTION:
+            t = next(iter(self._results))
+            self._results.pop(t)
+            self._drop_idem_locked(t)
+            evicted += 1
+        if evicted:
+            self.registry.counter(
+                "serve_results_evicted_total",
+                help="uncollected results evicted (TTL or retention "
+                     "cap)").inc(evicted)
+
+    def _resolve_failed(self, reqs: Sequence[Request], error: str,
+                        journal_done: bool = True,
+                        resumable: bool = False) -> None:
+        """Resolve ``reqs`` as failed WITHOUT executing (deadline expiry,
+        drain, shutdown races).  ``journal_done=False`` leaves the
+        tickets unfinished in the journal — the drain path's contract:
+        the waiter gets a typed resumable failure now, and a restarted
+        service replays the ticket."""
+        now = time.monotonic()
+        if journal_done:
+            # journaled before any waiter observes it, like _run_dispatch
+            self._mark_done(reqs, "failed")
+        with self._done:
+            for req in reqs:
+                entry = {"status": "failed", "error": error, "mode": "none",
+                         "done_s": round(now, 4)}
+                if resumable:
+                    entry["resumable"] = True
+                self._results[req.ticket] = entry
+                self._completed += 1
+                self.registry.counter(
+                    "serve_requests_failed_total",
+                    help="requests whose dispatch raised").inc(
+                        1, kind=req.kind)
+                self._event_row(kind="serve_tenant", ticket=req.ticket,
+                                tenant=req.tenant, request_kind=req.kind,
+                                mode="none", error=error,
+                                resumable=resumable or None)
+            self._evict_results_locked(now)
             self._done.notify_all()
 
     def _ticket_spans(self, req: Request, *, mode: str, stack_k: int,
@@ -569,25 +924,50 @@ class ExperimentService:
                     "p95_ms": round(p95 * 1000.0, 3)
                     if p95 is not None else None,
                 },
+                "self_healing": self._self_healing_stats(),
                 "metrics": self.registry.rows()}
 
-    def fail_pending(self, reason: str) -> int:
-        """Resolve every still-queued request as failed (shutdown path:
-        a submit that raced the dispatcher's final drain must not leave
-        its waiter blocked until timeout).  Returns how many."""
+    def _counter_total(self, name: str) -> int:
+        return int(sum(v for _suffix, v in
+                       self.registry.counter(name).samples()))
+
+    def _self_healing_stats(self) -> dict:
+        """The recovery-ladder snapshot the watch console's ``--service``
+        view renders: journal depth, replay/quarantine/admission
+        counters."""
+        with self._lock:
+            unfinished = len(self._unfinished)
+            replayed = self._replayed
+        return {"journal_unfinished": unfinished,
+                "replayed": replayed,
+                "quarantined": self._counter_total(
+                    "serve_quarantined_tenants_total"),
+                "dispatch_retries": self._counter_total(
+                    "serve_dispatch_retries_total"),
+                "overload_rejections": self._counter_total(
+                    "serve_overload_rejections_total"),
+                "deadline_expirations": self._counter_total(
+                    "serve_deadline_expirations_total"),
+                "results_evicted": self._counter_total(
+                    "serve_results_evicted_total"),
+                "max_queue": self.max_queue or None}
+
+    def fail_pending(self, reason: str, resumable: bool = False) -> int:
+        """Resolve every still-queued request as failed (shutdown/drain
+        path: a submit that raced the dispatcher's final drain must not
+        leave its waiter blocked until timeout).  The tickets stay
+        UNFINISHED in the journal either way — a restarted service
+        replays them; ``resumable=True`` (the SIGTERM drain) says so in
+        the typed response, so the client resubmits-or-waits after the
+        restart instead of treating the failure as final.  Returns how
+        many."""
         with self._done:
             self._draining = True   # submit() refuses from here on
             stranded, self._pending = self._pending, []
-            for req in stranded:
-                self._results[req.ticket] = {"status": "failed",
-                                             "error": reason,
-                                             "mode": "none"}
-                self.registry.counter(
-                    "serve_requests_failed_total",
-                    help="requests whose dispatch raised").inc(
-                        1, kind=req.kind)
-            self._done.notify_all()
-            return len(stranded)
+        if stranded:
+            self._resolve_failed(stranded, reason, journal_done=False,
+                                 resumable=resumable)
+        return len(stranded)
 
     def close(self) -> None:
         if self._closed:
@@ -603,6 +983,7 @@ class ExperimentService:
             # them first or they would latch a WriterError on everyone
             self.writer.flush()
         self._events.close()
+        self.journal.close()
         if self._lineage is not None:
             self._lineage.close()
 

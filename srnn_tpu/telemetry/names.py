@@ -88,6 +88,16 @@ CANONICAL_METRICS: Dict[str, str] = {
     "serve_ticket_window_seconds": "histogram",
     "serve_ticket_dispatch_seconds": "histogram",
     "serve_slo_violations_total": "counter",
+    # -- self-healing service (serve/journal.py durable replay, the
+    #    supervised dispatch's retry/bisect-quarantine ladder, admission
+    #    control, and results-retention eviction; serve/service.py) ------
+    "serve_journal_replays_total": "counter",
+    "serve_quarantined_tenants_total": "counter",
+    "serve_dispatch_retries_total": "counter",
+    "serve_overload_rejections_total": "counter",
+    "serve_deadline_expirations_total": "counter",
+    "serve_queue_rejected_depth": "gauge",
+    "serve_results_evicted_total": "counter",
     # -- fleet observatory (telemetry.fleet: per-process gens/sec skew,
     #    folded live each chunk by the primary's finisher) ----------------
     "soup_straggler_process": "gauge",

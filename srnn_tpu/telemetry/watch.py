@@ -93,7 +93,8 @@ def service_snapshot(socket_path: str) -> dict:
            "queue_depth": stats.get("queue_depth"),
            "distinct_programs": stats.get("distinct_programs"),
            "uptime_s": stats.get("uptime_s"),
-           "slo": stats.get("slo")}
+           "slo": stats.get("slo"),
+           "self_healing": stats.get("self_healing")}
     uptime = stats.get("uptime_s") or 0
     out["requests_per_sec"] = round(stats.get("completed", 0) / uptime, 3) \
         if uptime > 0 else 0.0
@@ -114,6 +115,19 @@ def render_service(s: dict, out) -> None:
                   + (f"p95<={target}ms target, " if target else "no target, ")
                   + (f"measured p95~{p95}ms, " if p95 is not None else "")
                   + f"{slo.get('violations', 0)} violation(s)\n")
+    sh = s.get("self_healing")
+    if sh:
+        mq = sh.get("max_queue")
+        out.write(f"  self-heal: journal={sh.get('journal_unfinished')} "
+                  f"unfinished, {sh.get('replayed')} replayed, "
+                  f"{sh.get('quarantined')} quarantined, "
+                  f"{sh.get('dispatch_retries')} retries\n")
+        out.write(f"  admission: "
+                  + (f"max_queue={mq}, " if mq else "unbounded queue, ")
+                  + f"{sh.get('overload_rejections')} overload "
+                    f"rejection(s), {sh.get('deadline_expirations')} "
+                    f"deadline expiration(s), "
+                    f"{sh.get('results_evicted')} result(s) evicted\n")
 
 
 # ---------------------------------------------------------------------------

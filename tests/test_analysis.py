@@ -716,6 +716,67 @@ def test_fault_taxonomy_stale_exit_codes(tmp_path):
     assert hits[0].line == len(_WATCH_OK.splitlines()) + 3
 
 
+_SUPERVISOR_KINDS = _SUPERVISOR_OK + """
+    DEVICE_LOSS = "device_loss"
+    STALL = "stall"
+    IO = "io"
+    FATAL = "fatal"
+    RETRYABLE = (DEVICE_LOSS, STALL, IO)
+    """
+
+_SERVICE_OK = """
+    from ..resilience.supervisor import DEVICE_LOSS, IO, STALL
+
+    DISPATCH_RETRYABLE = (DEVICE_LOSS, IO, STALL)
+    """
+
+_CHAOS_OK = """
+    SERVE_FAULT_KINDS = ("device_loss", "io", "stall")
+    """
+
+
+def test_fault_taxonomy_serve_menus_clean(tmp_path):
+    files = _taxonomy_files(supervisor=_SUPERVISOR_KINDS)
+    files["srnn_tpu/serve/service.py"] = _SERVICE_OK
+    files["srnn_tpu/resilience/chaos.py"] = _CHAOS_OK
+    ctx = make_repo(tmp_path, files)
+    assert run_pass(ctx, "fault-taxonomy") == []
+
+
+def test_fault_taxonomy_serve_retry_menu_drift(tmp_path):
+    # FATAL in the service's retry menu: retries a fault the taxonomy
+    # calls fatal -> T008; a chaos menu kind outside the retryable
+    # values -> T009; a service module with no menu at all -> T008 stale
+    files = _taxonomy_files(supervisor=_SUPERVISOR_KINDS)
+    files["srnn_tpu/serve/service.py"] = """
+        from ..resilience.supervisor import FATAL, IO
+
+        DISPATCH_RETRYABLE = (FATAL, IO)
+        """
+    files["srnn_tpu/resilience/chaos.py"] = """
+        SERVE_FAULT_KINDS = ("io", "preempt")
+        """
+    found = run_pass(make_repo(tmp_path, files), "fault-taxonomy")
+    got = codes(found)
+    assert "T008" in got and "T009" in got
+    assert any("FATAL" in f.message for f in found)
+    assert any("preempt" in f.message for f in found)
+    stale = _taxonomy_files(supervisor=_SUPERVISOR_KINDS)
+    stale["srnn_tpu/serve/service.py"] = "X = 1\n"
+    found = run_pass(make_repo(tmp_path / "stale", stale),
+                     "fault-taxonomy")
+    assert [f.code for f in found] == ["T008"]
+    assert "DISPATCH_RETRYABLE" in found[0].message
+    # a chaos module whose menu went unscannable reports, never skips
+    nomenu = _taxonomy_files(supervisor=_SUPERVISOR_KINDS)
+    nomenu["srnn_tpu/serve/service.py"] = _SERVICE_OK
+    nomenu["srnn_tpu/resilience/chaos.py"] = "Y = 2\n"
+    found = run_pass(make_repo(tmp_path / "nomenu", nomenu),
+                     "fault-taxonomy")
+    assert [f.code for f in found] == ["T009"]
+    assert "unscannable" in found[0].message
+
+
 # ---------------------------------------------------------------------------
 # migrated hygiene passes still fire
 # ---------------------------------------------------------------------------
