@@ -262,10 +262,21 @@ def _precompile(topo, shapes):
     for n, steps, impl in shapes:
         run = _bench_fn(topo, steps, impl)
         wT = jax.ShapeDtypeStruct((topo.num_weights, n), jnp.float32)
-        e = aot_compile(f"bench.run.{n}x{steps}.{impl}", run, (wT,))
-        rows.append({"n": n, "steps": steps, "impl": impl,
-                     "lower_s": round(e.lower_s, 3),
-                     "compile_s": round(e.compile_s, 3)})
+        name = f"bench.run.{n}x{steps}.{impl}"
+        e = aot_compile(name, run, (wT,))
+        row = {"n": n, "steps": steps, "impl": impl,
+               "lower_s": round(e.lower_s, 3),
+               "compile_s": round(e.compile_s, 3)}
+        # cost-plane attribution (telemetry.costs): the compiled
+        # program's HLO flops, when the backend reports them — the same
+        # numbers land in compile_ledger.jsonl next to the cache
+        try:
+            from srnn_tpu.telemetry import costs
+
+            row["flops"] = costs.entry_flops(name)
+        except Exception:
+            pass
+        rows.append(row)
         _hb("precompile", "compiled", n=n, steps=steps, impl=impl,
             compile_s=round(e.compile_s, 3))
     return rows
@@ -559,6 +570,22 @@ def _multihost_leg() -> dict:
     return out
 
 
+def _emit_result(out: dict) -> None:
+    """Print one sentinel result line, with any cost-ledger write
+    failures attached (``telemetry.costs``): ledger trouble must surface
+    in the parent's stage_log rows, not vanish into child stdout."""
+    try:
+        from srnn_tpu.telemetry import costs
+
+        errs = costs.consume_ledger_errors()
+        if errs:
+            out["ledger_errors"] = list(out.get("ledger_errors", [])) + errs
+    except Exception:
+        pass
+    print(_SENTINEL + json.dumps(out), flush=True)
+    sys.stdout.flush()
+
+
 def _child_stage(stage: str) -> None:
     """Run one stage and print its result on a sentinel stdout line."""
     # the dead-man's switch arms BEFORE the simulated/real wedge windows
@@ -596,8 +623,7 @@ def _child_stage(stage: str) -> None:
         # eat the only leg that always lands)
         out = {"serve": _serve_leg(), "device_count": jax.device_count(),
                "backend": platform + ("-forced" if forced_cpu else "")}
-        print(_SENTINEL + json.dumps(out), flush=True)
-        sys.stdout.flush()
+        _emit_result(out)
         os._exit(0)
     if stage == "multihost":
         # the distributed-tier leg (host CPU, subprocess workers — this
@@ -605,8 +631,7 @@ def _child_stage(stage: str) -> None:
         out = {"multihost": _multihost_leg(),
                "device_count": jax.device_count(),
                "backend": platform + ("-forced" if forced_cpu else "")}
-        print(_SENTINEL + json.dumps(out), flush=True)
-        sys.stdout.flush()
+        _emit_result(out)
         os._exit(0)
     topo = Topology("weightwise", width=2, depth=2)  # science-default f32
     on_cpu = platform == "cpu"  # fallback OR a genuinely CPU-default host
@@ -620,8 +645,7 @@ def _child_stage(stage: str) -> None:
         rows = _precompile(topo, shapes)
         out = {"precompile": rows, "device_count": jax.device_count(),
                "backend": platform}
-        print(_SENTINEL + json.dumps(out), flush=True)
-        sys.stdout.flush()
+        _emit_result(out)
         os._exit(0)
     cpu_degraded = False
     if stage == "ramp":
@@ -646,8 +670,7 @@ def _child_stage(stage: str) -> None:
     # the PRIMARY measurement is delivered before any secondary work: the
     # parent keeps the LAST intact sentinel, so a kill during the
     # comparison below still salvages this line
-    print(_SENTINEL + json.dumps(out), flush=True)
-    sys.stdout.flush()
+    _emit_result(out)
     if cpu_degraded:
         # comparison row: the legacy step-by-step scan at the same shape,
         # so the fused-chain win is visible inside ONE session (this
@@ -656,8 +679,7 @@ def _child_stage(stage: str) -> None:
                                 impl="scan")
         out["impl"] = "fused-chain"
         out["scan_apps_per_chip"] = scan_apps / jax.device_count()
-        print(_SENTINEL + json.dumps(out), flush=True)
-        sys.stdout.flush()
+        _emit_result(out)
     # skip interpreter/backend teardown: a dead tunnel can hang atexit
     # handlers after the measurement is already delivered
     os._exit(0)
@@ -814,6 +836,44 @@ def _lint_preflight(stage_log, errors, env, t_start) -> bool:
     return True
 
 
+REGRESS_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_REGRESS_TIMEOUT_S",
+                                         "60"))
+
+
+def _regress_sentinel(result) -> None:
+    """Advisory perf-regression verdict (``benchmarks/regress.py``): the
+    fresh result vs the committed BENCH_*.json trajectory, embedded as
+    ``result["regression"]`` with its own stage_log row — a throughput
+    regression is flagged in the round that causes it, not three windows
+    later.  Advisory by design: findings never change the bench's exit
+    or its measured values.  Subprocess like every other stage (the
+    parent stays un-wedgeable); pure stdlib child, but bounded anyway."""
+    stage_log = result.setdefault("stage_log", [])
+    att = {"stage": "regress", "attempt": 1}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join("benchmarks", "regress.py"),
+             "-", "--json", "--include-self"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            input=json.dumps(result).encode(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, timeout=REGRESS_TIMEOUT_S)
+        verdict = json.loads(proc.stdout.decode("utf-8", "replace"))
+    except Exception as e:  # advisory: never let the sentinel hurt the row
+        att["outcome"] = f"inconclusive: {type(e).__name__}"
+        stage_log.append(att)
+        return
+    regressions = verdict.get("regressions", [])
+    att["outcome"] = "ok" if not regressions \
+        else f"{len(regressions)} regression(s)"
+    if regressions:
+        # the findings land in the stage_log TOO (the driver's tail
+        # capture reads stage_log rows; result["regression"] carries the
+        # full per-leg table)
+        att["findings"] = [f["message"] for f in regressions]
+    stage_log.append(att)
+    result["regression"] = verdict
+
+
 def main():
     result = {
         "metric": "self-applications/sec/chip",
@@ -829,6 +889,10 @@ def main():
         traceback.print_exc()
         result.setdefault("error", f"parent: {type(e).__name__}: {e}")
     result["vs_baseline"] = round(result["value"] / BASELINE_PER_CHIP, 2)
+    try:
+        _regress_sentinel(result)
+    except Exception:
+        pass  # the one-JSON-line contract always wins
     print(json.dumps(result), flush=True)
 
 
@@ -906,6 +970,13 @@ def _orchestrate(result):
                 # device compute (timed-out attempts carry the same
                 # cumulative numbers on their last_heartbeat)
                 att["pipeline"] = r["pipeline"]
+            if r is not None and r.get("ledger_errors"):
+                # cost-ledger write failures surface HERE (stage_log
+                # discipline, like the multihost error rows) instead of
+                # vanishing into child stdout
+                att["ledger_errors"] = r["ledger_errors"]
+                errors.append(f"{tag or stage}: cost-ledger write "
+                              f"failure(s): {r['ledger_errors'][0]}")
             stage_log.append(att)
             if r is not None:
                 return r

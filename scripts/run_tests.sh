@@ -350,6 +350,60 @@ fi
 rm -rf "$obs_root"
 summary+=$(printf '%-34s %-4s %4ss' "observability_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Cost observatory smoke (srnn_tpu/telemetry/costs + report --trace +
+# benchmarks/regress.py): a tiny warmed mega-soup run must leave a
+# non-empty compile_ledger.jsonl and soup_hlo_flops/soup_hbm_bytes
+# gauges in metrics.prom; `report --trace` must emit a Perfetto-loadable
+# trace.json (ph/ts/pid validated); and the perf-regression sentinel
+# must exit clean against the committed BENCH history while flagging a
+# synthetic -30% row — the advisory gate that catches a throughput
+# regression in the PR that causes it.
+t0=$SECONDS
+cost_root=$(mktemp -d)
+cost_ok=1
+SRNN_SETUPS_PLATFORM=cpu SRNN_COST_LEDGER="$cost_root/ledger.jsonl" \
+    python -m srnn_tpu.setups mega_soup --smoke --seed 31 \
+    --root "$cost_root/run" > "$cost_root/out.log" 2>&1 || cost_ok=0
+if [ "$cost_ok" -eq 1 ]; then
+    cost_dir=$(ls -d "$cost_root"/run/exp-* 2>/dev/null | head -1)
+    [ -s "$cost_root/ledger.jsonl" ] || { echo "cost_smoke: empty ledger" \
+        >> "$cost_root/out.log"; cost_ok=0; }
+    grep -q 'srnn_soup_hlo_flops{entry=' "$cost_dir/metrics.prom" \
+        || cost_ok=0
+    grep -q 'srnn_soup_hbm_bytes{' "$cost_dir/metrics.prom" || cost_ok=0
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+        --trace "$cost_dir" >> "$cost_root/out.log" 2>&1 || cost_ok=0
+    python - "$cost_dir/trace.json" >> "$cost_root/out.log" 2>&1 <<'PY' || cost_ok=0
+import json, sys
+doc = json.load(open(sys.argv[1]))
+evs = doc["traceEvents"]
+assert evs, "no trace events"
+for e in evs:
+    assert "ph" in e and "pid" in e, e
+    if e["ph"] != "M":
+        assert isinstance(e.get("ts"), (int, float)), e
+assert any(e["ph"] == "X" for e in evs), "no span slices"
+assert doc["otherData"]["processes"], "no process lanes"
+print("cost_smoke: Perfetto trace schema OK")
+PY
+fi
+python benchmarks/regress.py BENCH_r06.json --json \
+    > "$cost_root/regress.json" 2>>"$cost_root/out.log" || cost_ok=0
+python benchmarks/regress.py BENCH_r06.json --scale apps_per_chip=0.6 \
+    >> "$cost_root/out.log" 2>&1
+if [ "$?" -ne 1 ]; then
+    echo "cost_smoke: synthetic -30% row not flagged" >> "$cost_root/out.log"
+    cost_ok=0
+fi
+if [ "$cost_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("cost_smoke")
+    tail -n 40 "$cost_root/out.log"
+fi
+rm -rf "$cost_root"
+summary+=$(printf '%-34s %-4s %4ss' "cost_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 echo
 echo "=== run_tests.sh summary ==="
 printf '%s' "$summary"

@@ -17,6 +17,11 @@ Codes:
   * ``M003`` — a canonical name violates the naming convention.
   * ``M004`` — the AST scan found no registrations at all (the pass
     itself would be dead — fail loudly).
+  * ``M005`` — metric LIVENESS: a name declared in the canonical table
+    has no emission site anywhere in the package — neither a literal
+    registration nor the name spelled in a runtime table
+    (``EVENT_COUNTERS``-style dicts, gauge-name loops).  A declared-but-
+    never-emitted metric is dashboard debt; delete it or emit it.
 """
 
 import ast
@@ -50,6 +55,37 @@ def _registrations(tree):
             kind = aliases[f.id]
         if kind is not None:
             yield kind, arg0.value, node.lineno
+
+
+_NAMES_REL = "srnn_tpu/telemetry/names.py"
+
+
+def _emitted_names(ctx: AnalysisContext, canonical) -> set:
+    """Every canonical name with emission EVIDENCE in the package: a
+    literal registration, or the name spelled as a string constant in any
+    module other than the declaration table itself (covers the runtime-
+    table idioms — ``EVENT_COUNTERS`` values, per-gauge name loops —
+    where the registration call's first argument is a variable).
+
+    KNOWN-WEAK by design: *any* string constant counts, so a name spelled
+    in a non-emitting context (a log message, an unused dict, a report
+    field list) keeps a dead metric alive and M005 stays silent.  The
+    gate catches the common failure — a declaration landing with no code
+    at all (it caught ``serve_tenant_flops_total`` during development) —
+    not a determined one; restricting evidence to registration-call
+    argument positions would mean teaching the pass every runtime-table
+    shape, and a false M005 on a live metric costs more than a missed
+    dead one."""
+    emitted = set()
+    for mod in ctx.package_modules():
+        if mod.rel == _NAMES_REL:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in canonical:
+                emitted.add(node.value)
+    return emitted
 
 
 def run(ctx: AnalysisContext):
@@ -91,6 +127,17 @@ def run(ctx: AnalysisContext):
             path="srnn_tpu/telemetry/names.py", line=1,
             message="AST scan found no metric registrations — the "
                     "metric-names pass is broken or the walk roots moved")
+        return
+    # liveness (M005): every declared name needs at least one emission
+    # site in the package — skipped when the registration scan itself is
+    # broken (M004), because then NOTHING would look alive
+    emitted = _emitted_names(ctx, CANONICAL_METRICS)
+    for name in sorted(set(CANONICAL_METRICS) - emitted):
+        yield Finding(
+            pass_id=PASS.id, code="M005", path=names_rel, line=1,
+            message=f"metric {name!r} is declared in CANONICAL_METRICS "
+                    "but has no emission site in the package — delete "
+                    "the declaration or emit it")
 
 
 PASS = PassSpec(

@@ -236,6 +236,7 @@ class ExperimentService:
         self._tickets = itertools.count(1)
         self._span_ids = itertools.count(1)   # ticket-span ids
         self._programs = set()   # distinct (kind, key, shape) signatures
+        self._dispatch_flops = 0.0   # HLO flops of the dispatch in flight
         self._closed = False
         self._t0 = time.monotonic()
 
@@ -456,6 +457,7 @@ class ExperimentService:
         ladder drills the code real traffic runs)."""
         if self.chaos is not None:
             self.chaos.serve_dispatch(dispatch.requests)
+        self._dispatch_flops = 0.0   # executors accumulate per attempt
         if dispatch.kind == "fixpoint_density":
             return self._exec_fixpoint_density(dispatch)
         if dispatch.kind == "soup":
@@ -548,6 +550,10 @@ class ExperimentService:
                                             dispatch.requests],
                         wall_s=round(wall, 4),
                         error=error)
+        if error is None:
+            # per-tenant cost attribution (telemetry.costs): the
+            # dispatched program's HLO flops split across its slots
+            self._attribute_tenant_flops(dispatch.requests, mode)
         now = time.monotonic()
         # journal the completions BEFORE any waiter can observe them: a
         # kill between delivery and the done-record would otherwise
@@ -719,6 +725,44 @@ class ExperimentService:
     def _note_program(self, kind: str, signature) -> None:
         self._programs.add((kind,) + tuple(signature))
 
+    def _probe_flops(self, name: str, jitted, args, kwargs=None) -> float:
+        """HLO flops of one dispatched program (``telemetry.costs`` via
+        the AOT memo: the first probe per program lowers against abstract
+        shapes — served by the persistent cache the real dispatch just
+        filled — later probes are memo hits).  Returns 0.0 when the cost
+        plane is off or the backend reports no flops; fail-soft — cost
+        attribution must never fail a dispatch."""
+        try:
+            from ..telemetry import costs
+
+            if not costs.enabled():
+                return 0.0
+            from ..utils.aot import _abstract, aot_compile
+
+            kwargs = {k: _abstract(v) for k, v in (kwargs or {}).items()}
+            aot_compile(name, jitted, args, kwargs)
+            return costs.entry_flops(name) or 0.0
+        except Exception:
+            return 0.0
+
+    def _attribute_tenant_flops(self, reqs: Sequence["Request"],
+                                mode: str) -> None:
+        """Split the completed dispatch's program flops evenly across its
+        tenant slots (``serve_tenant_flops_total`` — the per-tenant cost
+        view the stats/billing story reads).  A stacked dispatch amortizes
+        ONE program across K tenants, which is exactly the counter's
+        point."""
+        flops, self._dispatch_flops = self._dispatch_flops, 0.0
+        if not flops or self._warming:
+            return
+        per_tenant = flops / max(1, len(reqs))
+        c = self.registry.counter(
+            "serve_tenant_flops_total",
+            help="HLO flops attributed to each tenant (stacked dispatch "
+                 "flops split across its K slots)")
+        for req in reqs:
+            c.inc(per_tenant, tenant=req.tenant, kind=req.kind, mode=mode)
+
     def _exec_fixpoint_density(self, dispatch: Dispatch) -> List[dict]:
         """The fixpoint-density sweep (``setups/fixpoint_density.py``'s
         compute) for 1..K tenants: same per-batch PRNG keying as the solo
@@ -751,14 +795,22 @@ class ExperimentService:
                     pops = init_population_stacked(topo, jnp.stack(bkeys), n)
                     totals = totals + fixpoint_density_stacked(topo, pops,
                                                                eps)
+                    self._dispatch_flops += self._probe_flops(
+                        f"serve.cost.fixpoint_density.{topo.variant}"
+                        f".k{k}.n{n}",
+                        fixpoint_density_stacked, (topo, pops, eps))
                 else:
                     # the python-float epsilon keeps the solo fallback on
                     # the EXACT program the setups dispatch (a weak-typed
                     # scalar), so it shares their warm cache entries
                     pop = init_population(topo, bkeys[0], n)
+                    eps_solo = float(reqs[0].params.get("epsilon", 1e-4))
                     totals = totals + fixpoint_density(
-                        topo, pop,
-                        float(reqs[0].params.get("epsilon", 1e-4)))[None]
+                        topo, pop, eps_solo)[None]
+                    self._dispatch_flops += self._probe_flops(
+                        f"serve.cost.fixpoint_density.{topo.variant}"
+                        f".solo.n{n}",
+                        fixpoint_density, (topo, pop, eps_solo))
                 self._note_program(dispatch.kind, (str(topo), k, n))
                 done += n
             per_variant.append(np.asarray(totals))
@@ -800,6 +852,13 @@ class ExperimentService:
             metrics = unstack_tenants(out[1], k)
             ltriples = (unstack_tenants(out[2], k) if lineage else
                         [None] * k)
+            from ..utils.aot import abstract_stacked_soup_state
+
+            self._dispatch_flops += self._probe_flops(
+                f"serve.cost.soup.k{k}.n{cfg.size}.g{gens}"
+                + (".lineage" if lineage else ""),
+                evolve_stacked_donated,
+                (cfg, abstract_stacked_soup_state(cfg, k)), kw)
         else:
             kw = {"generations": gens, "metrics": True}
             if lineage:
@@ -809,6 +868,12 @@ class ExperimentService:
             out = evolve(cfg, seed(cfg, keys[0]), **kw)
             finals, metrics = [out[0]], [out[1]]
             ltriples = [out[2]] if lineage else [None]
+            from ..utils.aot import abstract_soup_state
+
+            self._dispatch_flops += self._probe_flops(
+                f"serve.cost.soup.solo.n{cfg.size}.g{gens}"
+                + (".lineage" if lineage else ""),
+                evolve, (cfg, abstract_soup_state(cfg)), kw)
         self._note_program(dispatch.kind,
                            (repr(cfg), gens, lineage, k))
         results = []

@@ -220,6 +220,13 @@ def add_pipeline_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "host-only, so results are bit-identical either "
                         "way — this knob exists as the A/B oracle for "
                         "exactly that claim")
+    p.add_argument("--no-costs", action="store_true",
+                   help="skip the cost plane (telemetry.costs): no "
+                        "chunk-program cost probe, no compile-ledger "
+                        "rows, no soup_hlo_flops/soup_hbm_bytes gauges. "
+                        "Cost accounting is host-side compile metadata, "
+                        "so results are bit-identical either way — the "
+                        "--no-spans-style A/B oracle for that claim")
     return p
 
 
@@ -416,6 +423,52 @@ def emit_chunk_spans(spans, stage: str, gen: int, chunk: int,
     spans.emit(f"{stage}.host_io", start,
                float(pipeline_row.get("host_io_s", 0.0)), parent=root,
                generation=gen)
+
+
+def probe_run_costs(args, exp, registry, entry: str, jitted, jit_args,
+                    jit_kwargs, *, particles: int, generations: int) -> None:
+    """The mega loops' cost-plane hook (``telemetry.costs``): AOT-compile
+    the EXACT chunk program the loop is about to dispatch (abstract
+    shapes only — the build is served by the persistent cache, and the
+    loop's own first dispatch then deserializes it, so the probe warms
+    the run rather than taxing it), record its ledger row + XLA
+    cost/memory analysis, fold the ``soup_compile_seconds_total`` /
+    ``soup_aot_cache_*`` / ``soup_hlo_flops`` / ``soup_hbm_bytes``
+    metrics into the run registry, and emit one ``{"kind": "cost"}``
+    events row — what ``report`` derives the apps/s-vs-HLO-flops
+    roofline line from.
+
+    Skipped under ``--no-costs`` (the A/B bitwise oracle) and entirely
+    host-side + fail-soft: a cost-plane failure is logged, never fatal."""
+    if getattr(args, "no_costs", False):
+        return
+    from ..telemetry import costs
+
+    if not costs.enabled():
+        return
+    try:
+        from ..utils.aot import aot_compile
+
+        e = aot_compile(entry, jitted, jit_args, jit_kwargs)
+        # the memoized entry keeps its Compiled, so a memo hit (e.g. an
+        # in-process restart re-entering the loop) yields the same full
+        # cost/memory fields as the miss that filled it
+        fields = costs.extract_costs(e.compiled)
+        costs.fold_cost_metrics(registry)
+        exp.event(kind="cost", entry=entry, particles=particles,
+                  generations=generations, cached=e.cached,
+                  lower_s=round(e.lower_s, 4),
+                  compile_s=round(e.compile_s, 4),
+                  ledger=costs.ledger_path(), **fields)
+        errors = costs.consume_ledger_errors()
+        if errors:
+            exp.log(f"cost plane: {'; '.join(errors)}", kind="cost_error")
+    except Exception as err:  # never let cost bookkeeping kill a run
+        try:
+            exp.log(f"cost plane probe failed: {type(err).__name__}: {err}",
+                    kind="cost_error")
+        except Exception:
+            pass
 
 
 def update_fleet_gauges(registry, run_dir: str, dist) -> None:

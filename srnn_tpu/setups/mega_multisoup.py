@@ -54,8 +54,9 @@ from .common import (add_dynamics_args, add_flightrec_args,
                      flush_lineage_window, init_distributed,
                      latest_checkpoint, make_flightrec, make_lineage,
                      make_on_stall, make_pipeline, make_spans,
-                     load_run_config, note_restart, open_run, register,
-                     save_run_config, set_distributed_gauges, stage_label,
+                     load_run_config, note_restart, open_run,
+                     probe_run_costs, register, save_run_config,
+                     set_distributed_gauges, stage_label,
                      update_fleet_gauges, watchdog_chunk)
 
 
@@ -399,6 +400,35 @@ def _run_once(args, ctx=None):
         # condition never forces a device sync.
         owned = False
         gen = int(state.time)
+        # cost plane (telemetry.costs; --no-costs = the A/B oracle): see
+        # mega_soup — probe the chunk program's cost against the
+        # warmup-identical abstract skeleton, fold the cost gauges, emit
+        # the {"kind":"cost"} roofline source row
+        if primary and stores is None and gen < args.generations:
+            from ..utils.aot import abstract_lineage_state, \
+                abstract_multi_state
+            chunk0 = min(args.checkpoint_every, args.generations - gen)
+            pkw = {"generations": chunk0, "metrics": True,
+                   "health": health_on}
+            if lineage_on:
+                pkw.update(lineage=True, lineage_state=tuple(
+                    abstract_lineage_state(n, mesh=mesh)
+                    for n in cfg.sizes), lineage_capacity=lincap)
+            st_abs = abstract_multi_state(cfg, mesh=mesh)
+            if mesh is not None:
+                from ..parallel import sharded_evolve_multi
+                probe_run_costs(args, exp, registry,
+                                "mega_multisoup.chunk",
+                                sharded_evolve_multi,
+                                (cfg, mesh, st_abs), pkw,
+                                particles=sum(cfg.sizes),
+                                generations=chunk0)
+            else:
+                probe_run_costs(args, exp, registry,
+                                "mega_multisoup.chunk",
+                                evolve_multi_donated, (cfg, st_abs), pkw,
+                                particles=sum(cfg.sizes),
+                                generations=chunk0)
         t_last = _time.perf_counter()
 
         def _class_gauges(counts, prev):

@@ -46,9 +46,9 @@ from .common import (add_dynamics_args, add_flightrec_args,
                      flush_lineage_window, init_distributed,
                      latest_checkpoint, load_run_config, make_flightrec,
                      make_lineage, make_on_stall, make_pipeline,
-                     make_spans, note_restart, open_run, register,
-                     save_run_config, set_distributed_gauges, stage_label,
-                     update_fleet_gauges, watchdog_chunk)
+                     make_spans, note_restart, open_run, probe_run_costs,
+                     register, save_run_config, set_distributed_gauges,
+                     stage_label, update_fleet_gauges, watchdog_chunk)
 
 
 def build_parser():
@@ -348,6 +348,34 @@ def _run_once(args, ctx=None):
         # the loop condition never forces a device sync.
         sh_owned = False
         gen = int(state.time)
+        # cost plane (telemetry.costs; --no-costs = the A/B oracle):
+        # AOT-probe the chunk program against the warmup-identical
+        # abstract skeleton — ledger row, soup_hlo_flops/soup_hbm_bytes
+        # gauges into this run's registry, and the {"kind":"cost"} row
+        # the report roofline derives from.  Host-side only; capture
+        # chunks dispatch per-generation programs, so no probe there.
+        if primary and store is None and gen < args.generations:
+            from ..utils.aot import abstract_lineage_state, \
+                abstract_soup_state
+            chunk0 = min(args.checkpoint_every, args.generations - gen)
+            pkw = {"generations": chunk0, "metrics": True}
+            if health_on:
+                pkw["health"] = True
+            if lineage_on:
+                pkw.update(lineage=True,
+                           lineage_state=abstract_lineage_state(
+                               cfg.size, mesh=mesh),
+                           lineage_capacity=lincap)
+            st_abs = abstract_soup_state(cfg, mesh=mesh)
+            if mesh is not None:
+                from ..parallel import sharded_evolve
+                probe_run_costs(args, exp, registry, "mega_soup.chunk",
+                                sharded_evolve, (cfg, mesh, st_abs), pkw,
+                                particles=cfg.size, generations=chunk0)
+            else:
+                probe_run_costs(args, exp, registry, "mega_soup.chunk",
+                                evolve_donated, (cfg, st_abs), pkw,
+                                particles=cfg.size, generations=chunk0)
         t_last = _time.perf_counter()
 
         def _finisher(gen, chunk, counts_dev, ckpt_state, m=None, h=None,

@@ -1,0 +1,356 @@
+"""The cost plane: a durable per-executable compile/FLOP/memory ledger.
+
+The fleet observatory (PR 12) answers *where time goes between
+processes*; this module answers *where compute goes inside a dispatch*.
+Every ``utils.aot.aot_compile``/``warmup`` build (and the FIRST
+in-process memo hit per entry — hit totals live in the accumulator and
+metrics; a long-lived service hitting the memo once per dispatch must
+not grow the ledger without bound) appends one row to an append-only
+``compile_ledger.jsonl`` living next to the persistent executable
+cache, carrying:
+
+  * build provenance — entry name, backend, ``cached`` (in-process memo
+    hit), ``persistent`` (on-disk cache engaged), lower/compile seconds;
+  * XLA cost analysis — ``Compiled.cost_analysis()`` HLO flops and
+    bytes-accessed (``None`` on backends that do not report them — the
+    reader contract is graceful nulls, never a crash);
+  * XLA memory analysis — ``memory_analysis()`` temp/argument/output/
+    alias bytes (the donation story in numbers; empty for
+    cache-deserialized executables, which is itself recorded).
+
+Writer discipline matches the serve journal: single-line JSON appends,
+flushed per row; readers (:func:`read_ledger`) skip unparseable lines —
+the torn tail of a killed process costs one row, never the ledger.
+Ledger I/O failures are collected (:func:`consume_ledger_errors`) and
+surfaced by the bench stage log; they never break a compile path.
+
+The same data is exported three ways:
+
+  * process RUNTIME metrics at record time and per-run registries via
+    :func:`fold_cost_metrics` — the registered ``soup_compile_seconds_
+    total`` / ``soup_aot_cache_{hits,misses}_total`` counters and
+    ``soup_hlo_flops{entry=}`` / ``soup_hbm_bytes{entry=,kind=}`` gauges
+    (``telemetry/names.py``), folded into each run's ``metrics.prom``;
+  * a ``{"kind": "cost", ...}`` events.jsonl row per probed run entry
+    (``setups.common.probe_run_costs``) that ``report`` turns into the
+    derived apps/s-vs-HLO-flops roofline line;
+  * per-tenant attribution in the experiment service
+    (``serve_tenant_flops_total`` — ``serve/service.py`` divides a
+    dispatch's program flops across its stacked tenants).
+
+Everything here is host-side bookkeeping over compile-time metadata:
+the cost plane can never perturb run results (``--no-costs`` on the
+mega loops is the A/B oracle for exactly that claim, tested).
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: set to "1" to disable the ledger + cost metrics entirely
+DISABLE_ENV = "SRNN_NO_COST_LEDGER"
+#: explicit ledger path override (default: next to the persistent cache)
+LEDGER_PATH_ENV = "SRNN_COST_LEDGER"
+
+LEDGER_NAME = "compile_ledger.jsonl"
+
+_lock = threading.Lock()
+_errors: List[str] = []
+#: entries whose cached:true row was already appended this process — a
+#: long-lived service hits the memo once per dispatch, and appending an
+#: identical hit row each time would grow the never-rotated ledger
+#: without bound (hit TOTALS live in the accumulator/metrics; the ledger
+#: records that hits happen, once per entry)
+_hit_logged: set = set()
+
+#: process-level accumulation folded into run registries on demand
+_ACC = {
+    "hits": 0,
+    "misses": 0,
+    "lower_seconds": 0.0,
+    "compile_seconds": 0.0,
+    "entry_flops": {},       # entry -> last non-null HLO flops
+    "entry_bytes": {},       # entry -> last non-null bytes-accessed
+    "hbm_bytes": {},         # (entry, kind) -> bytes
+}
+
+
+def enabled() -> bool:
+    return os.environ.get(DISABLE_ENV, "0") in ("", "0")
+
+
+def ledger_path() -> Optional[str]:
+    """Resolve the ledger location: the ``SRNN_COST_LEDGER`` override
+    first, else ``compile_ledger.jsonl`` next to (inside) the persistent
+    executable cache dir — the cache and its cost evidence travel
+    together.  ``None`` when the cost plane is disabled."""
+    if not enabled():
+        return None
+    override = os.environ.get(LEDGER_PATH_ENV)
+    if override:
+        return override
+    from ..utils import aot
+
+    base = aot._cache_dir_enabled or aot.default_cache_dir()
+    return os.path.join(base, LEDGER_NAME)
+
+
+def reset_for_tests() -> None:
+    """Drop the process accumulator + error list (tests only)."""
+    with _lock:
+        _ACC.update(hits=0, misses=0, lower_seconds=0.0,
+                    compile_seconds=0.0, entry_flops={}, entry_bytes={},
+                    hbm_bytes={})
+        _errors.clear()
+        _hit_logged.clear()
+
+
+def consume_ledger_errors() -> List[str]:
+    """Drain the collected ledger-write failures (the bench children lift
+    these into their result so the parent's stage_log row names them)."""
+    with _lock:
+        out, _errors[:] = list(_errors), []
+    return out
+
+
+# ---------------------------------------------------------------------------
+# extraction (graceful nulls: backends vary in what they report)
+# ---------------------------------------------------------------------------
+
+
+def _first_number(d: dict, key: str) -> Optional[float]:
+    v = d.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def extract_costs(compiled: Any) -> Dict[str, Optional[float]]:
+    """Pull the cost/memory analysis out of one ``jax.stages.Compiled``.
+
+    Every field may be ``None``: ``cost_analysis`` raises or omits keys
+    on some backends, and a cache-deserialized executable reports an
+    empty ``memory_analysis`` (stats are not serialized) — zeros there
+    are recorded as-is, they are the deserialization fingerprint."""
+    out: Dict[str, Optional[float]] = {
+        "flops": None, "bytes_accessed": None,
+        "temp_bytes": None, "argument_bytes": None, "output_bytes": None,
+        "alias_bytes": None, "generated_code_bytes": None,
+    }
+    try:
+        ca = compiled.cost_analysis()
+        # jax 0.4.x returns a list with one dict per computation; newer
+        # versions a plain dict — normalize to the first/only mapping
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            out["flops"] = _first_number(ca, "flops")
+            out["bytes_accessed"] = _first_number(ca, "bytes accessed")
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for field, attr in (("temp_bytes", "temp_size_in_bytes"),
+                            ("argument_bytes", "argument_size_in_bytes"),
+                            ("output_bytes", "output_size_in_bytes"),
+                            ("alias_bytes", "alias_size_in_bytes"),
+                            ("generated_code_bytes",
+                             "generated_code_size_in_bytes")):
+            v = getattr(ma, attr, None)
+            if isinstance(v, (int, float)):
+                out[field] = float(v)
+    except Exception:
+        pass
+    return out
+
+
+#: the ``soup_hbm_bytes`` gauge's ``kind=`` label values, in ledger-row
+#: field order (alias bytes = donation's win; see DESIGN §19)
+HBM_KINDS = ("temp", "argument", "output", "alias")
+
+
+# ---------------------------------------------------------------------------
+# recording (called by utils.aot on every compile/memo hit)
+# ---------------------------------------------------------------------------
+
+
+def record_compile(entry: str, *, cached: bool, lower_s: float,
+                   compile_s: float, persistent: bool,
+                   compiled: Any = None, backend: str = "") -> None:
+    """Fold one aot_compile outcome into the ledger + accumulator +
+    RUNTIME metrics.  Fail-soft by construction — cost bookkeeping must
+    never break a compile path; write failures are collected for the
+    bench stage log instead of raised."""
+    if not enabled():
+        return
+    costs = extract_costs(compiled) if (compiled is not None
+                                        and not cached) else {}
+    row = {"entry": entry, "cached": bool(cached),
+           "backend": backend, "persistent": bool(persistent),
+           "lower_s": round(float(lower_s), 4),
+           "compile_s": round(float(compile_s), 4),
+           "wall": round(time.time(), 3)}
+    row.update(costs)
+    with _lock:
+        if cached:
+            _ACC["hits"] += 1
+            if entry in _hit_logged:
+                # the hit is COUNTED (accumulator above; folded at the
+                # next miss/first-hit/explicit fold) but not re-appended,
+                # and the per-dispatch hot path skips the file I/O + fold
+                return
+            _hit_logged.add(entry)
+        else:
+            _ACC["misses"] += 1
+            _ACC["lower_seconds"] += float(lower_s)
+            _ACC["compile_seconds"] += float(compile_s)
+            if costs.get("flops") is not None:
+                _ACC["entry_flops"][entry] = costs["flops"]
+            if costs.get("bytes_accessed") is not None:
+                _ACC["entry_bytes"][entry] = costs["bytes_accessed"]
+            for kind in HBM_KINDS:
+                v = costs.get(f"{kind}_bytes")
+                if v is not None:
+                    _ACC["hbm_bytes"][(entry, kind)] = v
+    _append_row(row)
+    try:
+        from .metrics import RUNTIME
+
+        fold_cost_metrics(RUNTIME)
+    except Exception:
+        pass
+
+
+def _append_row(row: dict) -> None:
+    path = ledger_path()
+    if path is None:
+        return
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+    except Exception as e:
+        with _lock:
+            _errors.append(f"cost ledger append failed: "
+                           f"{type(e).__name__}: {e}")
+
+
+def read_ledger(path: Optional[str] = None) -> Tuple[List[dict], int]:
+    """Parse the ledger; returns ``(rows, skipped)`` where ``skipped``
+    counts unparseable lines (the torn tail of a killed process) — same
+    reader contract as the fleet merge and the serve journal."""
+    path = path or ledger_path()
+    rows: List[dict] = []
+    skipped = 0
+    if path is None:
+        return rows, skipped
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return rows, skipped
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            skipped += 1
+            continue
+        if not isinstance(row, dict):
+            skipped += 1
+            continue
+        rows.append(row)
+    return rows, skipped
+
+
+# ---------------------------------------------------------------------------
+# metric export (names.py: the registered cost metrics)
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """Accumulator copy (the fold source + the tests' oracle)."""
+    with _lock:
+        return {"hits": _ACC["hits"], "misses": _ACC["misses"],
+                "lower_seconds": _ACC["lower_seconds"],
+                "compile_seconds": _ACC["compile_seconds"],
+                "entry_flops": dict(_ACC["entry_flops"]),
+                "entry_bytes": dict(_ACC["entry_bytes"]),
+                "hbm_bytes": dict(_ACC["hbm_bytes"])}
+
+
+def entry_flops(entry: str) -> Optional[float]:
+    """Last-known HLO flops of one compiled entry (``None`` when the
+    backend reported none) — the serve tier's attribution source."""
+    with _lock:
+        return _ACC["entry_flops"].get(entry)
+
+
+def fold_cost_metrics(registry) -> None:
+    """Fold the process accumulator into ``registry`` (a run's registry
+    or RUNTIME): counters advance by delta (safe to call repeatedly),
+    gauges are last-value.  Eagerly registers every cost metric so a
+    run's ``metrics.prom`` always exposes the series — a backend that
+    reports no flops shows the registered zero-state, not a missing
+    family."""
+    snap = snapshot()
+    c = registry.counter(
+        "soup_compile_seconds_total",
+        help="backend compile seconds spent by aot_compile builds",
+        unit="seconds")
+    c.inc(max(0.0, snap["compile_seconds"] - c.value()))
+    c = registry.counter(
+        "soup_aot_cache_hits_total",
+        help="aot_compile calls served from the in-process executable "
+             "memo")
+    c.inc(max(0, snap["hits"] - c.value()))
+    c = registry.counter(
+        "soup_aot_cache_misses_total",
+        help="aot_compile calls that lowered+compiled (a persistent "
+             "on-disk cache hit still counts here, just with near-zero "
+             "compile seconds)")
+    c.inc(max(0, snap["misses"] - c.value()))
+    flops_g = registry.gauge(
+        "soup_hlo_flops",
+        help="XLA cost-analysis HLO flops of the compiled entry")
+    for entry, flops in snap["entry_flops"].items():
+        flops_g.set(flops, entry=entry)
+    hbm_g = registry.gauge(
+        "soup_hbm_bytes",
+        help="XLA memory-analysis bytes of the compiled entry "
+             "(kind=temp/argument/output/alias)", unit="bytes")
+    for (entry, kind), b in snap["hbm_bytes"].items():
+        hbm_g.set(b, entry=entry, kind=kind)
+
+
+# ---------------------------------------------------------------------------
+# roofline derivation (the report line)
+# ---------------------------------------------------------------------------
+
+
+def roofline(cost_row: dict, gens_per_sec: Optional[float]) -> dict:
+    """Derive the apps/s-vs-HLO-flops roofline numbers from one
+    ``{"kind": "cost"}`` event row (flops of the chunk program, its
+    generation count and particle count) and the run's measured rate.
+    Every output may be ``None`` — backends without cost analysis or a
+    run killed before its first heartbeat still render, just sparser."""
+    flops = cost_row.get("flops")
+    gens = cost_row.get("generations") or 0
+    particles = cost_row.get("particles") or 0
+    out = {
+        "entry": cost_row.get("entry"),
+        "flops_per_generation": (flops / gens) if flops and gens else None,
+        "flops_per_app": (flops / (gens * particles))
+        if flops and gens and particles else None,
+        "apps_per_sec": (gens_per_sec * particles)
+        if gens_per_sec and particles else None,
+        "flops_per_sec": None,
+    }
+    if out["flops_per_generation"] is not None and gens_per_sec:
+        out["flops_per_sec"] = out["flops_per_generation"] * gens_per_sec
+    return out

@@ -271,6 +271,10 @@ def fleet_summary(run_dir: str, timeline_tail: int = 16) -> dict:
         "skipped_lines": skipped,
         "checkpoints": len(ckpts),
         "latest_checkpoint": ckpts[-1] if ckpts else None,
+        # a just-created run dir (no events.jsonl yet, or only torn/empty
+        # files) is a NORMAL state the watch loop and report must name,
+        # not an implicit empty render
+        "no_data": not timeline,
         "timeline_tail": tail,
     }
 
@@ -289,6 +293,11 @@ def render_fleet(s: dict, out) -> None:
     w = out.write
     nproc = len(s["processes"])
     w(f"fleet: {s['run_dir']}\n")
+    if s.get("no_data"):
+        w("  no data yet — no parseable event rows in this run dir (a "
+          "just-created run, or one killed before its first write); "
+          "re-check once the run heartbeats\n")
+        return
     w(f"  {nproc} process lane(s), {s['timeline_rows']} merged timeline "
       f"rows"
       + (f", {s['skipped_lines']} unparseable line(s) skipped"
@@ -338,3 +347,123 @@ def render_fleet(s: dict, out) -> None:
             if r.get("message") and body == "log":
                 body = str(r["message"])[:60]
             w(f"  [{stamp} p{r.get('process', 0)}] {body}\n")
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace export (report --trace)
+# ---------------------------------------------------------------------------
+
+#: thread-lane ids inside each process's trace group: host spans (chunk
+#: roots + device_wait/host_io children, hostio collectives) vs the
+#: serve tier's per-ticket span families
+_TID_SPANS = 1
+_TID_SERVE = 2
+_TID_EVENTS = 3
+
+
+def profiler_trace_dirs(run_dir: str) -> List[str]:
+    """Device-trace directories linked from this run: the armed
+    ``jax.profiler`` traces inside the run's flight-recorder triage
+    bundles (``triage-*/trace``, non-empty only).  A wedged TPU attempt's
+    bundle thereby joins the same export instead of rotting unfound."""
+    out = []
+    for bundle in sorted(glob.glob(os.path.join(run_dir, "triage-*"))):
+        trace = os.path.join(bundle, "trace")
+        try:
+            if os.path.isdir(trace) and any(os.scandir(trace)):
+                out.append(os.path.abspath(trace))
+        except OSError:
+            continue
+    return out
+
+
+def _span_event(row: dict) -> Optional[dict]:
+    """One span row -> a Chrome 'complete' event (``ph=X``).  Structured
+    SpanStream rows carry ``start_s``; legacy span rows (PR 2 ``span()``)
+    only ``t`` + ``seconds`` — their start is derived."""
+    dur = row.get("seconds")
+    if not isinstance(dur, (int, float)):
+        return None
+    start = row.get("start_s")
+    if not isinstance(start, (int, float)):
+        t = row.get("t")
+        if not isinstance(t, (int, float)):
+            return None
+        start = max(0.0, float(t) - float(dur))
+    name = str(row.get("span", "span"))
+    args = {k: row[k] for k in ("trace_id", "tenant", "request_kind",
+                                "generation", "generations", "stage",
+                                "mode", "stack_k", "per_tenant_s", "error")
+            if row.get(k) is not None}
+    return {"name": name, "ph": "X", "cat": "span",
+            "ts": round(float(start) * 1e6, 1),
+            "dur": round(float(dur) * 1e6, 1),
+            "pid": int(row.get("process", 0)),
+            "tid": _TID_SERVE if name.startswith("serve.") else _TID_SPANS,
+            "args": args}
+
+
+def perfetto_trace(run_dir: str) -> dict:
+    """The PR 12 merged fleet timeline as a Chrome/Perfetto-loadable
+    trace document (``chrome://tracing`` / ui.perfetto.dev JSON object
+    format): one ``pid`` group per process with named lanes — host spans,
+    serve-ticket slices — plus gens/sec counter tracks from the
+    heartbeats and instant markers for restarts/watchdog trips/preempts.
+    Timestamps are the run-relative monotonic seconds every process
+    already stamps (microseconds in the export, per the trace format).
+
+    The armed ``jax.profiler`` device traces of any triage bundle in the
+    run dir are LINKED under ``otherData.device_traces`` — a wedged
+    device attempt leaves a loadable trace pointer in the same bundle
+    instead of a dead bench row."""
+    timeline, skipped = merged_timeline(run_dir)
+    events: List[dict] = []
+    pids = set()
+    for row in timeline:
+        pid = int(row.get("process", 0))
+        kind = row.get("kind")
+        if kind == "span":
+            ev = _span_event(row)
+            if ev is not None:
+                pids.add(pid)
+                events.append(ev)
+        elif kind == "heartbeat":
+            t = row.get("t")
+            if isinstance(t, (int, float)) \
+                    and row.get("gens_per_sec") is not None:
+                pids.add(pid)
+                events.append({
+                    "name": "gens_per_sec", "ph": "C", "cat": "heartbeat",
+                    "ts": round(float(t) * 1e6, 1), "pid": pid,
+                    "args": {"gens_per_sec": float(row["gens_per_sec"])}})
+        elif kind in ("restart", "watchdog", "preempt", "cost"):
+            t = row.get("t")
+            if isinstance(t, (int, float)):
+                pids.add(pid)
+                events.append({
+                    "name": kind, "ph": "i", "s": "p", "cat": "marker",
+                    "ts": round(float(t) * 1e6, 1), "pid": pid,
+                    "tid": _TID_EVENTS,
+                    "args": {k: row[k] for k in
+                             ("reasons", "fault", "generation", "entry",
+                              "flops", "bundle") if row.get(k) is not None}})
+    for pid in sorted(pids):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"p{pid}"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": _TID_SPANS, "args": {"name": "host spans"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": _TID_SERVE,
+                       "args": {"name": "serve tickets"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": _TID_EVENTS, "args": {"name": "markers"}})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "run_dir": os.path.abspath(run_dir),
+            "processes": sorted(pids),
+            "skipped_lines": skipped,
+            "device_traces": profiler_trace_dirs(run_dir),
+        },
+    }

@@ -19,6 +19,12 @@ Naming convention (:func:`check_name`):
 
 ``GRANDFATHERED`` lists pre-convention names kept for dashboard
 compatibility; do not add new entries — fix the name instead.
+
+Liveness: the srnnlint pass also checks the REVERSE direction — every
+name declared here must have at least one emission site in the package
+(a registration call or the name spelled in a runtime table like
+``EVENT_COUNTERS``), so the table cannot accumulate dead metrics as new
+families land.
 """
 
 import re
@@ -125,6 +131,15 @@ CANONICAL_METRICS: Dict[str, str] = {
     "aot_lower_seconds_total": "counter",
     "aot_compile_seconds_total": "counter",
     "aot_compile_seconds": "histogram",
+    # -- cost observatory (telemetry.costs: the compile/FLOP/memory
+    #    ledger folded into every run's metrics.prom; serve attributes
+    #    dispatch flops across its stacked tenants) ----------------------
+    "soup_compile_seconds_total": "counter",
+    "soup_aot_cache_hits_total": "counter",
+    "soup_aot_cache_misses_total": "counter",
+    "soup_hlo_flops": "gauge",
+    "soup_hbm_bytes": "gauge",
+    "serve_tenant_flops_total": "counter",
 }
 
 #: pre-convention names kept for dashboard compatibility (do not extend):

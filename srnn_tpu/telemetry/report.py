@@ -2,6 +2,7 @@
 
     python -m srnn_tpu.telemetry.report <run_dir> [--json]
     python -m srnn_tpu.telemetry.report --fleet <run_dir> [--json]
+    python -m srnn_tpu.telemetry.report --trace <run_dir> [--json]
     python -m srnn_tpu.telemetry.report --triage <bundle_dir> [--json]
     python -m srnn_tpu.telemetry.report --dynamics <run_dir> [--json]
 
@@ -20,6 +21,16 @@ renders as a single-process one.
 (``telemetry.fleet``): ONE merged cross-process timeline, a per-process
 lane table, and the straggler attribution (who is slowest, skew ratio,
 generations of lag).
+
+``--trace`` exports that merged timeline as a Chrome/Perfetto-loadable
+``trace.json`` (one lane group per process: host spans, serve-ticket
+slices, gens/sec counter tracks, restart/watchdog markers) and links any
+triage bundle's armed ``jax.profiler`` device trace from the same
+document.
+
+Plain reports on cost-plane runs additionally render a ``cost:`` block —
+the chunk program's HLO flops/bytes (``telemetry.costs``) and the derived
+apps/s-vs-HLO-flops roofline at the run's measured p50 rate.
 
 ``--triage`` renders a flight-recorder bundle (``telemetry.flightrec``):
 the trip reason and thresholds, the ring tail, the health trajectory
@@ -140,6 +151,23 @@ def summarize(run_dir: str) -> dict:
     final_metrics = dict(metric_rows[-1].get("metrics", {})) \
         if metric_rows else {}
 
+    # cost observatory: the {"kind":"cost"} probe rows (telemetry.costs)
+    # + the run's p50 rate -> the derived apps/s-vs-HLO-flops roofline
+    costs = []
+    from .costs import roofline
+
+    rates = [float(hb["gens_per_sec"]["p50"]) for hb in heartbeats.values()
+             if isinstance(hb.get("gens_per_sec"), dict)]
+    p50 = max(rates) if rates else None
+    for row in by_kind.get("cost", []):
+        costs.append({"row": {k: row.get(k) for k in
+                              ("entry", "flops", "bytes_accessed",
+                               "temp_bytes", "argument_bytes",
+                               "output_bytes", "alias_bytes",
+                               "generations", "particles", "cached",
+                               "compile_s", "ledger")},
+                      "roofline": roofline(row, p50)})
+
     return {
         "run_dir": os.path.abspath(run_dir),
         "meta": meta,
@@ -148,6 +176,7 @@ def summarize(run_dir: str) -> dict:
         "worker_files": [os.path.basename(p) for _i, p in worker_files],
         "heartbeats": heartbeats,
         "spans": spans,
+        "costs": costs,
         "metrics": final_metrics,
         "metrics_flushes": len(metric_rows),
         "has_prom_file": os.path.exists(
@@ -203,6 +232,24 @@ def _render(s: dict, out) -> None:
         for name, sp in sorted(s["spans"].items(),
                                key=lambda kv: -kv[1]["total_s"]):
             w(f"  {name}: {sp['total_s']}s over {sp['count']} blocks\n")
+
+    for c in s.get("costs", []):
+        row, rf = c["row"], c["roofline"]
+        flops = row.get("flops")
+        w(f"cost: {row.get('entry')} — "
+          + (f"{flops:.3g} HLO flops/chunk" if flops is not None
+             else "no cost analysis on this backend (null)")
+          + (f" ({row['generations']} gens x {row['particles']} "
+             f"particles)" if row.get("generations") else "")
+          + (f", compile {row['compile_s']}s" if row.get("compile_s")
+             else "")
+          + "\n")
+        if rf.get("flops_per_app") is not None:
+            line = (f"  roofline: {rf['flops_per_app']:.3g} flops/app")
+            if rf.get("apps_per_sec") is not None:
+                line += (f" -> {rf['apps_per_sec']:.3g} apps/s at p50 = "
+                         f"{rf['flops_per_sec']:.3g} HLO FLOP/s achieved")
+            w(line + "\n")
 
     if s["metrics"]:
         w(f"metrics (cumulative, {s['metrics_flushes']} flushes"
@@ -445,6 +492,13 @@ def main(argv=None) -> int:
                    help="render the fleet observatory view: merged "
                         "cross-process timeline, per-process lanes, "
                         "straggler attribution (telemetry.fleet)")
+    p.add_argument("--trace", action="store_true",
+                   help="export the merged fleet timeline (host spans of "
+                        "every process + serve-ticket slices + heartbeat "
+                        "counter tracks) as a Chrome/Perfetto-loadable "
+                        "trace.json in the run dir; any triage bundle's "
+                        "armed jax.profiler device trace is linked under "
+                        "otherData.device_traces")
     p.add_argument("--dynamics", action="store_true",
                    help="render the run's replication-dynamics trail "
                         "(lineage.jsonl via telemetry.genealogy)")
@@ -454,6 +508,37 @@ def main(argv=None) -> int:
     if not os.path.isdir(args.run_dir):
         print(f"report: {args.run_dir}: not a directory", file=sys.stderr)
         return 2
+    if args.trace:
+        from ..utils.atomicio import atomic_write_text
+        from .fleet import perfetto_trace
+
+        doc = perfetto_trace(args.run_dir)
+        if not doc["traceEvents"]:
+            # the no-data contract (exit 2, no dead artifact) holds for
+            # --json too: automation gets an explicit no_data flag
+            # instead of an empty-but-valid trace document
+            if args.json:
+                doc["otherData"]["no_data"] = True
+                print(json.dumps(doc, default=str))
+            else:
+                print(f"report: {args.run_dir}: no data yet — no span/"
+                      "heartbeat rows to export (a just-created run "
+                      "dir?)", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc, default=str))
+            return 0
+        path = os.path.join(args.run_dir, "trace.json")
+        atomic_write_text(path, json.dumps(doc, default=str))
+        n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+        print(f"trace: {path} — {len(doc['traceEvents'])} events "
+              f"({n_spans} spans) across processes "
+              f"{doc['otherData']['processes']}; load in "
+              "ui.perfetto.dev or chrome://tracing")
+        for d in doc["otherData"]["device_traces"]:
+            print(f"  device trace (jax.profiler, TensorBoard-loadable): "
+                  f"{d}")
+        return 0
     if args.fleet:
         from .fleet import fleet_summary, render_fleet
 

@@ -15,6 +15,13 @@ poll hook consume it).
 Pure reader: file tails and one ``stats`` socket op — attaching a watch
 to a live run can never perturb it.  Stdout is this module's product
 (it is on the srnnlint prints allowlist).
+
+A JUST-CREATED run dir (no ``events.jsonl`` yet, zero-length or
+all-torn files) is a normal state, not an error: ``--once`` snapshots
+carry ``no_data: true`` and the refresh view renders an explicit "no
+data yet" line (``telemetry.fleet``) instead of a traceback or a
+confusing empty table — the watch is typically attached BEFORE the run
+heartbeats.
 """
 
 import argparse

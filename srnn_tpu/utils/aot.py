@@ -304,6 +304,8 @@ def aot_compile(name: str, jitted, args: Tuple, kwargs: Optional[dict] = None,
     hit = _EXECUTABLES.get(key)
     if hit is not None:
         _record_aot_metrics(name, hit=True)
+        _record_cost(name, cached=True, lower_s=0.0, compile_s=0.0,
+                     persistent=persistent, compiled=None, backend=backend)
         return hit._replace(cached=True, lower_s=0.0, compile_s=0.0)
     prev_dir = None
     if persistent:
@@ -345,7 +347,25 @@ def aot_compile(name: str, jitted, args: Tuple, kwargs: Optional[dict] = None,
     _EXECUTABLES[key] = entry
     _record_aot_metrics(name, hit=False, lower_s=entry.lower_s,
                         compile_s=entry.compile_s)
+    _record_cost(name, cached=False, lower_s=entry.lower_s,
+                 compile_s=entry.compile_s, persistent=persistent,
+                 compiled=compiled, backend=backend)
     return entry
+
+
+def _record_cost(name: str, **kw) -> None:
+    """One cost-ledger row per aot_compile outcome (``telemetry.costs``:
+    compile seconds, memo hit/miss, XLA cost/memory analysis into
+    ``compile_ledger.jsonl`` next to the persistent cache + the
+    ``soup_compile_*``/``soup_hlo_flops``/``soup_hbm_bytes`` metrics).
+    Fail-soft like :func:`_record_aot_metrics` — the cost plane must
+    never break a compile path."""
+    try:
+        from ..telemetry import costs
+
+        costs.record_compile(name, **kw)
+    except Exception:
+        pass
 
 
 def _record_aot_metrics(entry: str, hit: bool, lower_s: float = 0.0,

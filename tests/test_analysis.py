@@ -831,6 +831,34 @@ def test_metric_names_fires_on_unknown_and_miskinded(tmp_path):
     assert codes(found) == ["M001", "M002"]
 
 
+def test_metric_liveness_fires_on_declared_but_never_emitted(tmp_path):
+    """M005: a name in CANONICAL_METRICS with no emission site anywhere
+    in the package is dead dashboard weight.  The fixture repo emits ONE
+    canonical name (as a literal registration) and spells a second in a
+    runtime-table dict — every other canonical name must be reported
+    dead, and those two must not."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        EVENT_COUNTERS = {"attacking": ("soup_attacks_total", "help")}
+
+        def f(registry):
+            registry.counter("soup_generations_total").inc(1)
+        """})
+    dead = {f.message.split("'")[1] for f in run_pass(ctx, "metric-names")
+            if f.code == "M005"}
+    assert "soup_generations_total" not in dead      # literal registration
+    assert "soup_attacks_total" not in dead          # runtime-table spell
+    assert "soup_hlo_flops" in dead                  # nothing emits it here
+    assert "serve_tenant_flops_total" in dead
+
+
+def test_metric_liveness_clean_on_real_repo(repo_ctx):
+    """The real package has an emission site for every declared name
+    (this is the gate that keeps names.py from accumulating dead
+    metrics as new families land)."""
+    assert [f for f in run_pass(repo_ctx, "metric-names")
+            if f.code == "M005"] == []
+
+
 # ---------------------------------------------------------------------------
 # waivers / baseline machinery
 # ---------------------------------------------------------------------------

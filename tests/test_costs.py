@@ -1,0 +1,422 @@
+"""Cost observatory: the compile/FLOP/memory ledger (telemetry.costs),
+the Perfetto trace export (report --trace), the perf-regression sentinel
+(benchmarks/regress.py), and the watch/report no-data hardening.
+
+The load-bearing invariant mirrors --no-spans: the cost plane is
+host-side compile metadata only, so a run with it is bitwise-identical
+to a run without (--no-costs, tested below)."""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from srnn_tpu.experiment import restore_checkpoint          # noqa: E402
+from srnn_tpu.setups.common import REGISTRY                 # noqa: E402
+from srnn_tpu.telemetry import costs, fleet, watch          # noqa: E402
+from srnn_tpu.telemetry.metrics import MetricsRegistry      # noqa: E402
+from srnn_tpu.utils import aot                              # noqa: E402
+
+
+@pytest.fixture
+def ledger(tmp_path, monkeypatch):
+    """Point the cost plane at a private ledger + a clean accumulator."""
+    path = str(tmp_path / "compile_ledger.jsonl")
+    monkeypatch.setenv(costs.LEDGER_PATH_ENV, path)
+    monkeypatch.delenv(costs.DISABLE_ENV, raising=False)
+    costs.reset_for_tests()
+    yield path
+    costs.reset_for_tests()
+
+
+def _tiny_entry(tag="a"):
+    @jax.jit
+    def f(x):
+        return (x * 2.0).sum()
+
+    return f, (jax.ShapeDtypeStruct((8, 8), jax.numpy.float32),), tag
+
+
+# ---------------------------------------------------------------------------
+# ledger round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_records_miss_then_hit_and_matches_memo_counters(ledger):
+    aot.clear_executable_cache()
+    f, args, _ = _tiny_entry()
+    e1 = aot.aot_compile("costs.test.tiny", f, args)
+    e2 = aot.aot_compile("costs.test.tiny", f, args)
+    assert not e1.cached and e2.cached
+    rows, skipped = costs.read_ledger(ledger)
+    assert skipped == 0
+    mine = [r for r in rows if r["entry"] == "costs.test.tiny"]
+    assert [r["cached"] for r in mine] == [False, True]
+    # hit/miss accounting matches the aot memo outcome exactly
+    snap = costs.snapshot()
+    assert snap["hits"] == 1 and snap["misses"] == 1
+    # the miss row carries cost/memory analysis on this backend; every
+    # field is ALLOWED to be null, but must exist (graceful-null contract)
+    miss = mine[0]
+    for k in ("flops", "bytes_accessed", "temp_bytes", "argument_bytes",
+              "output_bytes"):
+        assert k in miss
+    assert miss["compile_s"] >= 0 and miss["backend"] == "cpu"
+
+
+def test_ledger_torn_tail_skipped(ledger):
+    aot.clear_executable_cache()
+    f, args, _ = _tiny_entry()
+    aot.aot_compile("costs.test.torn", f, args)
+    with open(ledger, "a") as fh:
+        fh.write('{"entry": "half-written row, no clos')
+    rows, skipped = costs.read_ledger(ledger)
+    assert skipped == 1
+    assert any(r["entry"] == "costs.test.torn" for r in rows)
+
+
+def test_extract_costs_graceful_on_hostile_backend(ledger):
+    class Hostile:
+        def cost_analysis(self):
+            raise RuntimeError("no cost model on this backend")
+
+        def memory_analysis(self):
+            raise RuntimeError("nope")
+
+    out = costs.extract_costs(Hostile())
+    assert set(out) >= {"flops", "temp_bytes"}
+    assert all(v is None for v in out.values())
+    # and a record with such an object still lands a parseable row
+    costs.record_compile("costs.test.hostile", cached=False, lower_s=0.1,
+                         compile_s=0.2, persistent=True,
+                         compiled=Hostile(), backend="weird")
+    rows, skipped = costs.read_ledger(ledger)
+    row = [r for r in rows if r["entry"] == "costs.test.hostile"][0]
+    assert skipped == 0 and row["flops"] is None
+
+
+def test_ledger_write_failure_collected_not_raised(tmp_path, monkeypatch):
+    monkeypatch.setenv(costs.LEDGER_PATH_ENV,
+                       str(tmp_path / "nope" / "ledger.jsonl"))
+    costs.reset_for_tests()
+    # a ledger path whose parent cannot be created must not raise
+    monkeypatch.setattr(os, "makedirs",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError(30)))
+    costs.record_compile("costs.test.fail", cached=False, lower_s=0.0,
+                         compile_s=0.0, persistent=True, backend="cpu")
+    errs = costs.consume_ledger_errors()
+    assert errs and "ledger append failed" in errs[0]
+    assert costs.consume_ledger_errors() == []   # drained
+
+
+def test_fold_cost_metrics_is_idempotent_and_exports(ledger):
+    aot.clear_executable_cache()
+    f, args, _ = _tiny_entry()
+    aot.aot_compile("costs.test.fold", f, args)
+    reg = MetricsRegistry()
+    costs.fold_cost_metrics(reg)
+    costs.fold_cost_metrics(reg)   # delta-fold: calling twice is safe
+    snap = costs.snapshot()
+    assert reg.counter("soup_aot_cache_misses_total").value() \
+        == snap["misses"]
+    assert abs(reg.counter("soup_compile_seconds_total").value()
+               - snap["compile_seconds"]) < 1e-9
+    prom = reg.to_prometheus()
+    assert "srnn_soup_hlo_flops" in prom
+    assert "srnn_soup_hbm_bytes" in prom
+    if snap["entry_flops"].get("costs.test.fold") is not None:
+        assert 'srnn_soup_hlo_flops{entry="costs.test.fold"}' in prom
+
+
+def test_disabled_cost_plane_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.setenv(costs.DISABLE_ENV, "1")
+    monkeypatch.setenv(costs.LEDGER_PATH_ENV, str(tmp_path / "l.jsonl"))
+    costs.reset_for_tests()
+    aot.clear_executable_cache()
+    f, args, _ = _tiny_entry()
+    aot.aot_compile("costs.test.disabled", f, args)
+    assert costs.ledger_path() is None
+    assert not os.path.exists(tmp_path / "l.jsonl")
+    assert costs.snapshot()["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the A/B oracle: cost plane on == off, bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_cost_plane_does_not_perturb_results(tmp_path, monkeypatch):
+    """mega_soup default vs --no-costs: weights/uids/PRNG bitwise equal;
+    the default run carries the cost gauges + ledger + roofline source
+    row, the --no-costs run none of them."""
+    monkeypatch.setenv(costs.LEDGER_PATH_ENV,
+                       str(tmp_path / "compile_ledger.jsonl"))
+    costs.reset_for_tests()
+    with_costs = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "47", "--root", str(tmp_path / "a")])
+    without = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "47", "--no-costs",
+         "--root", str(tmp_path / "b")])
+    a = restore_checkpoint(os.path.join(with_costs, "ckpt-gen00000006"))
+    b = restore_checkpoint(os.path.join(without, "ckpt-gen00000006"))
+    np.testing.assert_array_equal(np.asarray(a.weights),
+                                  np.asarray(b.weights))
+    np.testing.assert_array_equal(np.asarray(a.uids), np.asarray(b.uids))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(a.key)),
+        np.asarray(jax.random.key_data(b.key)))
+
+    def cost_rows(d):
+        return [json.loads(l) for l in
+                open(os.path.join(d, "events.jsonl"))
+                if '"kind": "cost"' in l]
+
+    rows = cost_rows(with_costs)
+    assert len(rows) == 1 and rows[0]["entry"] == "mega_soup.chunk"
+    assert rows[0]["particles"] == 64 and rows[0]["generations"] == 2
+    assert not cost_rows(without)
+    prom = open(os.path.join(with_costs, "metrics.prom")).read()
+    assert "srnn_soup_hlo_flops" in prom and "srnn_soup_hbm_bytes" in prom
+    assert 'srnn_soup_hlo_flops{entry="mega_soup.chunk"}' in prom
+    prom_b = open(os.path.join(without, "metrics.prom")).read()
+    assert "srnn_soup_hlo_flops{" not in prom_b
+    ledger_rows, _ = costs.read_ledger()
+    assert any(r["entry"] == "mega_soup.chunk" for r in ledger_rows)
+
+    # the report renders the cost block + derived roofline from the run
+    from srnn_tpu.telemetry import report
+
+    s = report.summarize(with_costs)
+    assert len(s["costs"]) == 1
+    rf = s["costs"][0]["roofline"]
+    if s["costs"][0]["row"]["flops"] is not None:
+        assert rf["flops_per_app"] > 0 and rf["apps_per_sec"] > 0
+    out = io.StringIO()
+    report._render(s, out)
+    assert "cost: mega_soup.chunk" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def _write_events(run_dir, rows, name="events.jsonl"):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, name), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_perfetto_trace_lanes_and_schema(tmp_path):
+    """One lane group per process; serve.* spans land on the serve-ticket
+    lane; every non-metadata event carries ph/ts/pid."""
+    run = str(tmp_path / "run")
+    _write_events(run, [
+        {"kind": "span", "span": "mega_soup.chunk", "span_id": 1,
+         "process": 0, "start_s": 0.5, "seconds": 1.0, "t": 1.5},
+        {"kind": "heartbeat", "stage": "mega_soup", "t": 1.6,
+         "generation": 2, "gens_per_sec": 3.5},
+        {"kind": "span", "span": "serve.ticket", "span_id": 2,
+         "trace_id": "t000001", "tenant": "sweep0", "process": 0,
+         "start_s": 2.0, "seconds": 0.25, "t": 2.25},
+        {"kind": "restart", "t": 3.0, "fault": "device_loss"},
+    ])
+    _write_events(run, [
+        {"kind": "span", "span": "mega_soup.chunk", "span_id": 1,
+         "process": 1, "start_s": 0.6, "seconds": 0.9, "t": 1.5},
+    ], name="events-p1.jsonl")
+    doc = fleet.perfetto_trace(run)
+    evs = doc["traceEvents"]
+    for e in evs:
+        assert "ph" in e and "pid" in e
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+    assert doc["otherData"]["processes"] == [0, 1]
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert {e["pid"] for e in slices} == {0, 1}
+    serve_slices = [e for e in slices if e["name"] == "serve.ticket"]
+    assert serve_slices and serve_slices[0]["tid"] == fleet._TID_SERVE
+    assert serve_slices[0]["args"]["tenant"] == "sweep0"
+    host = [e for e in slices if e["name"] == "mega_soup.chunk"]
+    assert all(e["tid"] == fleet._TID_SPANS for e in host)
+    # ts is microseconds of the run-relative start
+    assert host[0]["ts"] == pytest.approx(0.5e6)
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert counters and counters[0]["args"]["gens_per_sec"] == 3.5
+    markers = [e for e in evs if e["ph"] == "i"]
+    assert markers and markers[0]["args"]["fault"] == "device_loss"
+
+
+def test_perfetto_links_triage_device_trace(tmp_path):
+    run = str(tmp_path / "run")
+    _write_events(run, [
+        {"kind": "span", "span": "x", "span_id": 1, "process": 0,
+         "start_s": 0.0, "seconds": 0.1, "t": 0.1}])
+    trace_dir = os.path.join(run, "triage-gen00000004-stall", "trace")
+    os.makedirs(trace_dir)
+    open(os.path.join(trace_dir, "events.pb"), "w").write("x")
+    doc = fleet.perfetto_trace(run)
+    assert doc["otherData"]["device_traces"] == [os.path.abspath(trace_dir)]
+    # an EMPTY trace dir (profiler armed but never captured) is not linked
+    empty = os.path.join(run, "triage-gen00000009-nan", "trace")
+    os.makedirs(empty)
+    assert fleet.perfetto_trace(run)["otherData"]["device_traces"] \
+        == [os.path.abspath(trace_dir)]
+
+
+def test_report_trace_cli_writes_trace_json(tmp_path, capsys):
+    from srnn_tpu.telemetry import report
+
+    run = str(tmp_path / "run")
+    _write_events(run, [
+        {"kind": "span", "span": "mega_soup.chunk", "span_id": 1,
+         "process": 0, "start_s": 0.0, "seconds": 0.5, "t": 0.5}])
+    assert report.main(["--trace", run]) == 0
+    doc = json.load(open(os.path.join(run, "trace.json")))
+    assert doc["traceEvents"]
+    assert "trace:" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# no-data hardening (watch --once / report --fleet on a just-created dir)
+# ---------------------------------------------------------------------------
+
+
+def test_report_fleet_on_just_created_run_dir(tmp_path, capsys):
+    from srnn_tpu.telemetry import report
+
+    run = str(tmp_path / "fresh")
+    os.makedirs(run)
+    open(os.path.join(run, "events.jsonl"), "w").close()  # zero-length
+    assert report.main(["--fleet", run]) == 0
+    out = capsys.readouterr().out
+    assert "no data yet" in out
+    s = fleet.fleet_summary(run)
+    assert s["no_data"] and s["processes"] == {}
+    # report --trace names the same state instead of writing a dead file
+    assert report.main(["--trace", run]) == 2
+    assert not os.path.exists(os.path.join(run, "trace.json"))
+
+
+def test_watch_once_on_just_created_run_dir(tmp_path, capsys):
+    run = str(tmp_path / "fresh")
+    os.makedirs(run)
+    assert watch.main([run, "--once"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["no_data"] is True
+    assert snap["last_event_age_s"] is None and snap["health"] is None
+    # the refresh-loop renderer takes the same snapshot without distress
+    out = io.StringIO()
+    watch.render(dict(snap), out)
+    assert "no data yet" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# the perf-regression sentinel
+# ---------------------------------------------------------------------------
+
+
+def _regress():
+    spec = importlib.util.spec_from_file_location(
+        "regress", os.path.join(REPO_ROOT, "benchmarks", "regress.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_regress_clean_against_committed_history():
+    regress = _regress()
+    fresh = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r06.json"))
+    history = regress.load_history(os.path.join(REPO_ROOT, "BENCH_*.json"))
+    verdict = regress.compare(fresh, history)
+    assert verdict["ok"], verdict["regressions"]
+    legs = {l["leg"]: l for l in verdict["legs"]}
+    assert legs["apps_per_chip"]["verdict"] == "ok"
+    # r01-r05 wrapper files unwrapped; accelerator r02 excluded from the
+    # cpu family's comparison set
+    assert "BENCH_r02.json" not in legs["apps_per_chip"]["history_rounds"]
+
+
+def test_regress_flags_synthetic_regression():
+    regress = _regress()
+    fresh = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r06.json"))
+    fresh["value"] *= 0.6
+    history = regress.load_history(os.path.join(REPO_ROOT, "BENCH_*.json"))
+    verdict = regress.compare(fresh, history)
+    assert not verdict["ok"]
+    (finding,) = verdict["regressions"]
+    assert finding["kind"] == "soup_bench_regression"
+    assert finding["leg"] == "apps_per_chip" and finding["ratio"] < 0.75
+    # higher-is-worse direction: a p95 blowup also flags
+    fresh2 = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r06.json"))
+    fresh2["serve"]["load"]["p95_ms"] *= 10
+    v2 = regress.compare(fresh2, history + [("BENCH_r06.json",
+                                             regress.load_result(
+                                                 os.path.join(
+                                                     REPO_ROOT,
+                                                     "BENCH_r06.json")))])
+    assert any(f["leg"] == "serve_load_p95_ms" for f in v2["regressions"])
+
+
+def test_regress_cli_and_micro_mode(tmp_path):
+    regress = _regress()
+    # CLI: clean -> 0, synthetic scale -> 1, garbage -> 2
+    assert regress.main([os.path.join(REPO_ROOT, "BENCH_r06.json")]) == 0
+    assert regress.main([os.path.join(REPO_ROOT, "BENCH_r06.json"),
+                         "--scale", "apps_per_chip=0.6"]) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert regress.main([str(bad)]) == 2
+    # micro docs: warning-only, never a failing verdict
+    micro = tmp_path / "micro.json"
+    micro.write_text(json.dumps({"bench": "micro_dispatch", "rows": [
+        {"row": "telemetry", "overhead_pct": 3.0},
+        {"row": "health", "overhead_pct": 55.0}]}))
+    assert regress.main([str(micro), "--json"]) == 0
+    verdict = regress.compare_micro(json.loads(micro.read_text()))
+    assert verdict["ok"]
+    assert [w["leg"] for w in verdict["warnings"]] == ["micro.health"]
+
+
+# ---------------------------------------------------------------------------
+# serve: per-tenant flops attribution
+# ---------------------------------------------------------------------------
+
+
+def test_serve_attributes_tenant_flops(tmp_path, monkeypatch):
+    from srnn_tpu.serve import ExperimentService
+
+    monkeypatch.setenv(costs.LEDGER_PATH_ENV,
+                       str(tmp_path / "ledger.jsonl"))
+    costs.reset_for_tests()
+    svc = ExperimentService(str(tmp_path / "svc"), max_stack=2)
+    try:
+        t1 = svc.submit("fixpoint_density",
+                        {"seed": 0, "trials": 32, "batch": 32},
+                        tenant="alpha")
+        t2 = svc.submit("fixpoint_density",
+                        {"seed": 1, "trials": 32, "batch": 32},
+                        tenant="beta")
+        svc.run_pending()
+        assert svc.wait(t1, 60)["status"] == "done"
+        assert svc.wait(t2, 60)["status"] == "done"
+        c = svc.registry.counter("serve_tenant_flops_total")
+        va = c.value(tenant="alpha", kind="fixpoint_density",
+                     mode="stacked")
+        vb = c.value(tenant="beta", kind="fixpoint_density",
+                     mode="stacked")
+        # CPU reports HLO flops; the stacked program's cost splits evenly
+        assert va > 0 and va == vb
+        # and the service's stats snapshot exposes the series
+        assert any(k.startswith("srnn_serve_tenant_flops_total")
+                   for k in svc.stats()["metrics"])
+    finally:
+        svc.close()
