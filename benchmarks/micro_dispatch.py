@@ -359,6 +359,52 @@ def row_spans() -> dict:
         os.unlink(tmp.name)
 
 
+def row_export() -> dict:
+    """Walltime overhead of the live telemetry plane's per-chunk turn —
+    registry snapshot into the history ring, metrics_history.jsonl
+    append (flush-per-row), and alert-rule evaluation — on top of the
+    ``metered.health`` chunk (documented bound <= ~5%, like the other
+    host-side planes): the sample is pure host work off the device hot
+    path.  Plain baseline interleaved per the shared protocol."""
+    import tempfile
+
+    from srnn_tpu.telemetry.alerts import AlertEngine, default_run_rules
+    from srnn_tpu.telemetry.metrics import MetricsRegistry
+    from srnn_tpu.telemetry.timeseries import MetricHistory
+
+    fns = _chunk_fns()
+    registry = MetricsRegistry()
+    tmp = tempfile.NamedTemporaryFile(  # noqa: SIM115 - closed at exit
+        mode="w", suffix=".jsonl", prefix="srnn_micro_export_",
+        delete=False)
+    tmp.close()
+    history = MetricHistory(registry, capacity=512, path=tmp.name)
+    engine = AlertEngine(default_run_rules(), registry, history)
+    health = fns["health"]
+
+    def export():
+        value = health()
+        # the gauge/counter churn a real chunk finisher performs before
+        # its sample, so the snapshot is a representative size
+        registry.counter("soup_generations_total",
+                         help="generations").inc(TELEMETRY_GENS)
+        registry.gauge("gens_per_sec", help="rate").set(
+            123.0, stage="micro")
+        registry.gauge("soup_health_nan_frac", help="nan").set(0.0)
+        history.sample()
+        engine.evaluate()
+        return value
+
+    try:
+        return _overhead_row("export",
+                             {"plain": fns["plain"], "health": health,
+                              "export": export},
+                             base="health", feature="export")
+    finally:
+        history.close()
+        os.unlink(tmp.name)
+
+
 def row_fused() -> dict:
     """``generation_impl='fused'`` vs the phase chain at the micro config
     (same dynamics, same draws).  On Mosaic backends this measures the
@@ -446,11 +492,11 @@ def main(argv=None) -> int:
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
             row_telemetry(), row_health(), row_lineage(), row_spans(),
-            row_fused(), row_stacked()]
+            row_export(), row_fused(), row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h, l, sp, fu, sk = rows
+        c, d, m, t, h, l, sp, ex, fu, sk = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -479,6 +525,10 @@ def main(argv=None) -> int:
               f"{sp['spans_ms_per_chunk']:.1f}ms vs metered.health "
               f"{sp['health_ms_per_chunk']:.1f}ms per chunk "
               f"({sp['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# export(N={ex['n']}, G={ex['generations']}): +live plane "
+              f"{ex['export_ms_per_chunk']:.1f}ms vs metered.health "
+              f"{ex['health_ms_per_chunk']:.1f}ms per chunk "
+              f"({ex['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
         print(f"# fused(N={fu['n']}, G={fu['generations']}): "
               f"{fu['fused_ms_per_chunk']:.1f}ms vs phases "
               f"{fu['plain_ms_per_chunk']:.1f}ms per chunk "

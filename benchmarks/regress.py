@@ -76,7 +76,7 @@ LEGS = {
 #: micro_dispatch overhead rows: generous bounds (warning-only — see the
 #: module docstring on session drift) on the documented <=5%-class rows
 MICRO_BOUND_PCT = 20.0
-MICRO_ROWS = ("telemetry", "health", "lineage", "spans")
+MICRO_ROWS = ("telemetry", "health", "lineage", "spans", "export")
 
 
 def _get(doc, path):

@@ -143,9 +143,19 @@ summary+=$(printf '%-34s %-4s %4ss' "service_smoke" "$status" "$((SECONDS-t0))")
 # one while the other 7 complete, dedupe an idempotent resubmit against
 # the journal, render the self-heal stats in `watch --service --once`,
 # and leave metrics.prom showing the replay + quarantine counters.
+# Live telemetry leg (PR 15): the restart runs with --max-queue 8 and
+# --metrics-port, so the 8-ticket replay restores a queue AT the
+# admission bound — the serve_queue_full alert must fire (events.jsonl
+# row + soup_alerts_total in metrics.prom), and a live /metrics scrape
+# after the drain must agree with the on-disk snapshot's counters.
 t0=$SECONDS
 sc_root=$(mktemp -d)
 sc_ok=1
+sc_port=$(python - <<'PY'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+PY
+)
 SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve --root "$sc_root/svc" \
     --batch-window-s 1.5 --chaos serve_kill@1 > "$sc_root/serve.log" 2>&1 &
 sc_pid=$!
@@ -177,6 +187,7 @@ PY
     fi
     SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve --root "$sc_root/svc" \
         --batch-window-s 0.2 --chaos serve_poison_tenant@1 \
+        --max-queue 8 --metrics-port "$sc_port" \
         >> "$sc_root/serve.log" 2>&1 &
     sc_pid=$!
     up=0
@@ -215,10 +226,24 @@ PY
             > "$sc_root/watch.json" 2>>"$sc_root/serve.log" || sc_ok=0
         python - "$sc_root/watch.json" >> "$sc_root/serve.log" 2>&1 <<'PY' || sc_ok=0
 import json, sys
-sh = json.load(open(sys.argv[1]))["service"]["self_healing"]
+svc = json.load(open(sys.argv[1]))["service"]
+sh = svc["self_healing"]
 assert sh["replayed"] == 8 and sh["quarantined"] == 1, sh
 assert "overload_rejections" in sh and "deadline_expirations" in sh
-print("serve_chaos_smoke: watch --service self-heal stats OK")
+# the replay restored a queue at the admission bound: the queue-depth
+# alert fired (and cleared once the drain emptied it)
+assert svc["alerts"] and svc["alerts"]["fired"] >= 1, svc["alerts"]
+print("serve_chaos_smoke: watch --service self-heal + alert stats OK")
+PY
+        python - "$sc_port" >> "$sc_root/serve.log" 2>&1 <<'PY' || sc_ok=0
+import sys, urllib.request
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{int(sys.argv[1])}/metrics", timeout=5).read().decode()
+# live scrape agrees with the settled counters the on-disk snapshot
+# shows after shutdown (asserted below) — one registry, two views
+assert "srnn_serve_journal_replays_total 8" in body, body[:400]
+assert 'srnn_soup_alerts_total{rule="serve_queue_full"}' in body
+print("serve_chaos_smoke: live /metrics scrape OK")
 PY
         SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
             --socket "$sc_root/svc/serve.sock" --shutdown \
@@ -227,6 +252,10 @@ PY
         grep -q 'srnn_serve_journal_replays_total 8' \
             "$sc_root/svc/metrics.prom" || sc_ok=0
         grep -Eq 'srnn_serve_quarantined_tenants_total\{[^}]*\} 1' \
+            "$sc_root/svc/metrics.prom" || sc_ok=0
+        grep -q '"rule": "serve_queue_full", "state": "firing"' \
+            "$sc_root/svc/events.jsonl" || sc_ok=0
+        grep -Eq 'srnn_soup_alerts_total\{rule="serve_queue_full"\} [1-9]' \
             "$sc_root/svc/metrics.prom" || sc_ok=0
     else
         sc_ok=0
@@ -403,6 +432,93 @@ else
 fi
 rm -rf "$cost_root"
 summary+=$(printf '%-34s %-4s %4ss' "cost_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
+# Live telemetry alerts smoke (srnn_tpu/telemetry exporter + alerts): a
+# REAL 2-process launcher run exports each worker's /metrics on
+# base_port+i with a floor straggler threshold (skew >= 1.0 always
+# holds, so the rule must fire on the first fleet fold).  Both workers'
+# endpoints are scraped MID-RUN (plus the primary's /healthz, which
+# aggregates worker liveness from the heartbeat lanes); afterwards the
+# straggler alert must be in events.jsonl and the watch panel.
+t0=$SECONDS
+al_root=$(mktemp -d)
+al_ok=1
+al_port=$(python - <<'PY'
+import socket
+s1, s2 = socket.socket(), socket.socket()
+for _ in range(64):
+    s1.bind(("127.0.0.1", 0))
+    p = s1.getsockname()[1]
+    try:
+        s2.bind(("127.0.0.1", p + 1))
+        break
+    except OSError:
+        s1.close(); s1 = socket.socket()
+print(p); s1.close(); s2.close()
+PY
+)
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.distributed.launch \
+    --processes 2 -- mega_soup --smoke --seed 43 --sharded \
+    --generations 24 --root "$al_root/run" \
+    --metrics-port "$al_port" --alert-straggler-skew 1.0 \
+    > "$al_root/out.log" 2>&1 &
+al_pid=$!
+scraped=0
+for _ in $(seq 1 450); do
+    if python - "$al_port" >> "$al_root/scrape.log" 2>&1 <<'PY'
+import json, sys, urllib.request
+p = int(sys.argv[1])
+for off in (0, 1):   # primary exports on p, worker 1 on p+1
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{p+off}/metrics", timeout=2).read().decode()
+    assert "srnn_heartbeat_generation" in body \
+        or "srnn_soup_precision_weight_bits" in body, body[:200]
+health = json.load(urllib.request.urlopen(
+    f"http://127.0.0.1:{p}/healthz", timeout=2))
+assert health.get("ok") is True, health
+assert "workers" in health, health
+print("alerts_smoke: scraped both workers + aggregated healthz")
+PY
+    then scraped=1; break; fi
+    kill -0 "$al_pid" 2>/dev/null || break
+    sleep 0.2
+done
+if [ "$scraped" -ne 1 ]; then
+    echo "alerts_smoke: mid-run scrape of both workers failed" \
+        >> "$al_root/out.log"
+    tail -n 5 "$al_root/scrape.log" >> "$al_root/out.log" 2>/dev/null
+    al_ok=0
+fi
+wait "$al_pid" || al_ok=0
+al_dir=$(ls -d "$al_root"/run/exp-* 2>/dev/null | head -1)
+if [ -n "$al_dir" ]; then
+    grep -q '"rule": "soup_straggler_skew", "state": "firing"' \
+        "$al_dir/events.jsonl" || al_ok=0
+    grep -Eq 'srnn_soup_alerts_total\{rule="soup_straggler_skew"\} [1-9]' \
+        "$al_dir/metrics.prom" || al_ok=0
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.watch \
+        "$al_dir" --once > "$al_root/snap.json" 2>>"$al_root/out.log" \
+        || al_ok=0
+    python - "$al_root/snap.json" >> "$al_root/out.log" 2>&1 <<'PY' || al_ok=0
+import json, sys
+snap = json.load(open(sys.argv[1]))
+alerts = snap["alerts"]
+assert alerts["fired"] >= 1, alerts
+assert "soup_straggler_skew" in alerts["active"], alerts
+assert snap["history"] and snap["history"]["samples"] >= 1, snap["history"]
+print("alerts_smoke: watch panel shows the firing straggler alert")
+PY
+else
+    al_ok=0
+fi
+if [ "$al_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("alerts_smoke")
+    tail -n 40 "$al_root/out.log"
+fi
+rm -rf "$al_root"
+summary+=$(printf '%-34s %-4s %4ss' "alerts_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
 echo
 echo "=== run_tests.sh summary ==="

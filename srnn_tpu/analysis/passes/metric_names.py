@@ -22,6 +22,14 @@ Codes:
     registration nor the name spelled in a runtime table
     (``EVENT_COUNTERS``-style dicts, gauge-name loops).  A declared-but-
     never-emitted metric is dashboard debt; delete it or emit it.
+  * ``M006`` — REFERENCE validity (the inverse of M005, for the live
+    telemetry plane): every registry name an alert rule references
+    (a ``Rule(metric="…")`` call — ``telemetry.alerts``) or the scraped
+    /healthz endpoint surfaces (the ``HEALTHZ_METRICS`` allowlist —
+    ``telemetry.exporter``) must exist in ``CANONICAL_METRICS``.  A rule
+    watching a name nobody can ever emit would silently never fire —
+    worse than no rule, because the operator believes the condition is
+    covered.
 """
 
 import ast
@@ -88,6 +96,37 @@ def _emitted_names(ctx: AnalysisContext, canonical) -> set:
     return emitted
 
 
+def _referenced_names(ctx: AnalysisContext):
+    """(name, rel, lineno, where) for every metric name the live
+    telemetry plane REFERENCES: the ``metric=`` keyword of any
+    ``Rule(...)`` call (the declarative alert tables — rules built
+    anywhere in the package, not just the default sets), and every
+    string element of a module-level ``HEALTHZ_METRICS`` tuple/list
+    (the scraped-endpoint allowlist)."""
+    for mod in ctx.package_modules():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                fname = getattr(f, "id", None) or getattr(f, "attr", None)
+                if fname == "Rule":
+                    for kw in node.keywords:
+                        if kw.arg == "metric" \
+                                and isinstance(kw.value, ast.Constant) \
+                                and isinstance(kw.value.value, str):
+                            yield (kw.value.value, mod.rel, node.lineno,
+                                   "alert rule")
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "HEALTHZ_METRICS" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        yield (elt.value, mod.rel, elt.lineno,
+                               "healthz allowlist")
+
+
 def run(ctx: AnalysisContext):
     # the canonical table and convention checker are the product source of
     # truth — import them instead of re-parsing (the CLI already paid the
@@ -121,6 +160,18 @@ def run(ctx: AnalysisContext):
         for problem in check_name(name, kind):
             yield Finding(pass_id=PASS.id, code="M003", path=names_rel,
                           line=1, message=problem)
+    # M006 runs even when the registration scan is empty (M004): a rule
+    # table referencing phantom names is wrong independently of whether
+    # any registrations were found
+    for name, rel, lineno, where in _referenced_names(ctx):
+        if name not in CANONICAL_METRICS:
+            yield Finding(
+                pass_id=PASS.id, code="M006", path=rel, line=lineno,
+                message=f"{where} references metric {name!r} which is "
+                        "not in telemetry.names.CANONICAL_METRICS — a "
+                        "rule/allowlist over a name nobody can emit "
+                        "would silently never fire; declare the metric "
+                        "or fix the spelling")
     if not seen:
         yield Finding(
             pass_id=PASS.id, code="M004",

@@ -55,6 +55,12 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="S",
                    help="base of the deterministic dispatch-retry "
                         "backoff")
+    p.add_argument("--metrics-port", type=int, default=0, metavar="PORT",
+                   help="live telemetry: serve the service registry at "
+                        "http://127.0.0.1:PORT/metrics (+/healthz with "
+                        "queue depth and the active-alerts panel); 0 = "
+                        "off.  The history rings + metrics_history.jsonl "
+                        "+ alert rules run either way (host-side only)")
     p.add_argument("--chaos", default=None, metavar="SPEC",
                    help="serve-layer fault injection, e.g. "
                         "'serve_kill@1,serve_dispatch_fault@2:io,"
@@ -124,7 +130,47 @@ def main(argv=None) -> int:
                                 dispatch_retries=args.dispatch_retries,
                                 retry_backoff_s=args.retry_backoff_s,
                                 chaos=chaos)
+    # live telemetry plane: history ring + metrics_history.jsonl in the
+    # service root, the serve alert rules (queue depth at the admission
+    # bound, SLO burn, overload pushback), and — with --metrics-port —
+    # the /metrics + /healthz endpoint over the SAME registry that
+    # writes metrics.prom
+    from ..telemetry.alerts import AlertEngine, default_serve_rules
+    from ..telemetry.timeseries import MetricHistory
+
+    history = MetricHistory(
+        service.registry,
+        path=os.path.join(args.root, "metrics_history.jsonl"))
+    engine = AlertEngine(default_serve_rules(max_queue=args.max_queue),
+                         service.registry, history)
+    service.attach_live(history, engine)
+    exporter = None
+    if args.metrics_port:
+        from ..telemetry.exporter import MetricsExporter, healthz_metrics
+
+        def healthz():
+            return {"ok": True, "queue_depth": service.queue_depth(),
+                    "active_alerts": engine.active(),
+                    "metrics": healthz_metrics(service.registry)}
+
+        # bind failures are non-fatal (same contract as the mega loops'
+        # make_live_plane): observability must never take down the
+        # service — the journaled tickets still need their replay
+        try:
+            exporter = MetricsExporter(service.registry,
+                                       port=args.metrics_port,
+                                       healthz=healthz)
+            print(f"serve: /metrics + /healthz live on {exporter.url}",
+                  flush=True)
+        except OSError as e:
+            print(f"serve: metrics exporter bind failed on "
+                  f":{args.metrics_port} ({e}); continuing without the "
+                  "live endpoint", flush=True)
     replayed = service.recover()
+    # replayed tickets restored a (possibly at-the-bound) queue before
+    # the dispatch loop exists — sample now so the depth alert's firing
+    # edge is on the record even if the first drain resolves it
+    service._sample_live()
     if replayed:
         print(f"serve: replayed {replayed} journaled ticket(s) from a "
               "previous run", flush=True)
@@ -147,6 +193,8 @@ def main(argv=None) -> int:
         server.serve_until_shutdown()
     finally:
         signal.signal(signal.SIGTERM, prev)
+        if exporter is not None:
+            exporter.close()
         service.close()
     unfinished = service._self_healing_stats()["journal_unfinished"]
     if unfinished:

@@ -142,6 +142,9 @@ class ServiceServer(socketserver.ThreadingMixIn,
         batching window a chance to aggregate, drain."""
         while not self._stop.is_set():
             if self.service.queue_depth() == 0:
+                # rate-alert windows keep sliding while idle (throttled
+                # inside — a fired SLO-burn alert must clear on quiet)
+                self.service.idle_sample_live()
                 time.sleep(min(0.05, self.batch_window_s or 0.05))
                 continue
             if self.batch_window_s > 0:

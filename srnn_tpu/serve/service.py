@@ -238,6 +238,10 @@ class ExperimentService:
         self._programs = set()   # distinct (kind, key, shape) signatures
         self._dispatch_flops = 0.0   # HLO flops of the dispatch in flight
         self._closed = False
+        # live telemetry plane (attach_live): the history ring sampled at
+        # the top of every drain + the declarative alert engine
+        self._live_history = None
+        self._live_engine = None
         self._t0 = time.monotonic()
 
     # -- submission / results -------------------------------------------
@@ -413,6 +417,53 @@ class ExperimentService:
 
     # -- execution -------------------------------------------------------
 
+    def attach_live(self, history, engine=None) -> None:
+        """Arm the live telemetry plane: ``history`` (a
+        ``telemetry.timeseries.MetricHistory`` over this service's
+        registry, its jsonl stream in the service root) is sampled at
+        the TOP of every drain — before the queue pops, so the
+        queue-depth gauge still holds its pre-drain peak and a
+        queue-at-the-bound condition is observable — and ``engine`` (a
+        ``telemetry.alerts.AlertEngine``) evaluates on the same cadence,
+        each transition riding events.jsonl as a ``{"kind": "alert"}``
+        row.  Both close with the service."""
+        self._live_history = history
+        self._live_engine = engine
+        self._live_last_sample = float("-inf")
+
+    def _sample_live(self) -> None:
+        """One live-plane turn, inline on the dispatch thread (the
+        sample is a registry snapshot + a jsonl append — microseconds
+        against a dispatch).  Fail-soft: a telemetry error must never
+        take down the dispatch loop."""
+        if self._live_history is None:
+            return
+        try:
+            self._live_last_sample = time.monotonic()
+            self._live_history.sample()
+            if self._live_engine is not None:
+                for transition in self._live_engine.evaluate():
+                    self._event_row(kind="alert", **transition)
+        except Exception as e:  # pragma: no cover - defensive
+            import sys
+
+            print(f"serve: live telemetry sample failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+
+    def idle_sample_live(self, min_interval_s: float = 5.0) -> None:
+        """Throttled live-plane turn for the dispatcher's IDLE ticks.
+        Rate windows must keep sliding while no traffic arrives — a
+        fired SLO-burn alert clears only once a quiet window passes,
+        and with sampling confined to ``run_pending`` an idle service
+        would report it firing until the next request.  Throttled so a
+        50ms idle poll doesn't grow metrics_history.jsonl one row per
+        tick."""
+        if self._live_history is None:
+            return
+        if time.monotonic() - self._live_last_sample < min_interval_s:
+            return
+        self._sample_live()
+
     def run_pending(self, window_s: float = 0.0) -> int:
         """Drain the queue through the scheduler: plan stacked/solo
         dispatches, execute them, publish results.  Returns the number of
@@ -420,6 +471,10 @@ class ExperimentService:
         the transport just performed before this drain (the stacking
         knob) — it attributes each ticket's pre-dispatch wait between
         queue backlog and window in the ticket-span breakdown."""
+        # live plane first: the queue-depth gauge still holds the
+        # pre-drain peak, so a saturated queue fires its alert even
+        # though this very drain is about to empty it
+        self._sample_live()
         with self._lock:
             batch, self._pending = self._pending, []
         if not batch:
@@ -432,6 +487,10 @@ class ExperimentService:
         for dispatch in plan:
             self._run_dispatch(dispatch, window_s=window_s)
         self.write_metrics()
+        # post-drain turn: conditions this drain resolved (the queue is
+        # empty again) emit their "cleared" edge now rather than at the
+        # next burst
+        self._sample_live()
         return len(batch)
 
     def _expire_overdue(self, reqs: Sequence[Request]) -> List[Request]:
@@ -980,6 +1039,10 @@ class ExperimentService:
             "serve_request_seconds",
             help="submit-to-completion latency", unit="seconds",
             buckets=_LATENCY_BUCKETS).quantile(0.95)
+        alerts = None
+        if self._live_engine is not None:
+            alerts = {"active": self._live_engine.active(),
+                      "fired": self._counter_total("soup_alerts_total")}
         return {"completed": done, "queue_depth": depth,
                 "distinct_programs": programs,
                 "uptime_s": round(time.monotonic() - self._t0, 2),
@@ -990,6 +1053,7 @@ class ExperimentService:
                     if p95 is not None else None,
                 },
                 "self_healing": self._self_healing_stats(),
+                "alerts": alerts,
                 "metrics": self.registry.rows()}
 
     def _counter_total(self, name: str) -> int:
@@ -1051,6 +1115,8 @@ class ExperimentService:
         self.journal.close()
         if self._lineage is not None:
             self._lineage.close()
+        if self._live_history is not None:
+            self._live_history.close()
 
     def __enter__(self) -> "ExperimentService":
         return self

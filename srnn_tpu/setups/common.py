@@ -471,6 +471,97 @@ def probe_run_costs(args, exp, registry, entry: str, jitted, jit_args,
             pass
 
 
+def add_telemetry_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The live telemetry plane's CLI knobs shared by the mega-run entry
+    points (see ``telemetry.exporter``/``timeseries``/``alerts``)."""
+    p.add_argument("--metrics-port", type=int, default=0, metavar="PORT",
+                   help="serve this process's live metrics registry at "
+                        "http://127.0.0.1:PORT/metrics (+/healthz); 0 = "
+                        "off.  Distributed runs export each worker at "
+                        "PORT+process_id; the primary's /healthz "
+                        "aggregates worker liveness from the heartbeat "
+                        "lanes (file reads only)")
+    p.add_argument("--no-export", action="store_true",
+                   help="drop the whole live telemetry plane (HTTP "
+                        "exporter, metric history rings + "
+                        "metrics_history.jsonl, alert engine); the plane "
+                        "is host-side, so results are bit-identical "
+                        "either way — the --no-spans-style A/B oracle "
+                        "for that claim")
+    p.add_argument("--history-ring", type=int, default=512, metavar="N",
+                   help="per-series metric-history ring capacity in "
+                        "samples (one sample per chunk; overflow drops "
+                        "the oldest points — the jsonl stream keeps the "
+                        "full trail)")
+    p.add_argument("--alert-nan-frac", type=float, default=0.02,
+                   metavar="F",
+                   help="alert when the NaN/Inf particle fraction "
+                        "exceeds F (the soup_nan_frac rule)")
+    p.add_argument("--alert-straggler-skew", type=float, default=4.0,
+                   metavar="R",
+                   help="alert when the fastest/slowest process "
+                        "gens-per-sec ratio reaches R (the "
+                        "soup_straggler_skew rule; distributed runs)")
+    return p
+
+
+def make_live_plane(args, exp, registry, dist, stage: str):
+    """Build one process's live telemetry plane (``telemetry.exporter.
+    LivePlane``): the history ring (jsonl stream process-0-gated like
+    every run artifact), the alert engine (primary-only — one alert
+    stream per run), and the HTTP exporter when ``--metrics-port`` is
+    set (workers bind PORT+process_id).  ``--no-export`` returns ``None``
+    — the bitwise A/B reference.  Exporter bind failures are logged and
+    non-fatal: observability must never take down a run."""
+    if getattr(args, "no_export", False):
+        return None
+    from ..telemetry.alerts import AlertEngine, default_run_rules
+    from ..telemetry.exporter import (LivePlane, MetricsExporter,
+                                      healthz_metrics, worker_liveness)
+    from ..telemetry.timeseries import MetricHistory
+
+    active = dist is not None and dist.active
+    primary = dist.primary if active else True
+    history = MetricHistory(
+        registry, capacity=getattr(args, "history_ring", 512),
+        path=os.path.join(exp.dir, "metrics_history.jsonl")
+        if primary else None)
+    engine = None
+    if primary:
+        engine = AlertEngine(
+            default_run_rules(
+                nan_frac=getattr(args, "alert_nan_frac", 0.02),
+                straggler_skew=getattr(args, "alert_straggler_skew", 4.0)),
+            registry, history)
+    exporter = None
+    port = getattr(args, "metrics_port", 0) or 0
+    if port:
+        port += dist.process_id if active else 0
+        run_dir = exp.dir
+        nproc = dist.num_processes if active else 1
+
+        def healthz():
+            out = {"ok": True, "stage": stage,
+                   "metrics": healthz_metrics(registry)}
+            if engine is not None:
+                out["active_alerts"] = engine.active()
+            if active and primary:
+                workers = worker_liveness(run_dir, nproc)
+                out["workers"] = workers
+                out["ok"] = all(w["ok"] for w in workers.values())
+            return out
+
+        try:
+            exporter = MetricsExporter(registry, port=port,
+                                       healthz=healthz)
+            exp.log(f"telemetry: /metrics + /healthz live on "
+                    f"{exporter.url}")
+        except OSError as e:
+            exp.log(f"telemetry: exporter bind failed on :{port} "
+                    f"({e}); continuing without the live endpoint")
+    return LivePlane(history=history, engine=engine, exporter=exporter)
+
+
 def update_fleet_gauges(registry, run_dir: str, dist) -> None:
     """Fold the LIVE straggler attribution into the registry (the
     ``soup_straggler_*`` gauges) from a bounded tail-read of every

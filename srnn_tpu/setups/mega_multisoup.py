@@ -47,14 +47,15 @@ from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
 from ..distributed import add_distributed_args
 from .common import (add_dynamics_args, add_flightrec_args,
-                     add_pipeline_args, add_resilience_args, base_parser,
-                     build_soup_mesh, chunk_boundary_faults, close_spans,
+                     add_pipeline_args, add_resilience_args,
+                     add_telemetry_args, base_parser, build_soup_mesh,
+                     chunk_boundary_faults, close_spans,
                      emit_chunk_spans, fetch_for_checkpoint,
                      finish_pipeline, flush_lineage_probe,
                      flush_lineage_window, init_distributed,
                      latest_checkpoint, make_flightrec, make_lineage,
-                     make_on_stall, make_pipeline, make_spans,
-                     load_run_config, note_restart, open_run,
+                     make_live_plane, make_on_stall, make_pipeline,
+                     make_spans, load_run_config, note_restart, open_run,
                      probe_run_costs, register, save_run_config,
                      set_distributed_gauges, stage_label,
                      update_fleet_gauges, watchdog_chunk)
@@ -102,6 +103,7 @@ def build_parser():
                    help="shard every type's particle axis over ALL visible "
                         "devices (shard_map data parallel)")
     add_pipeline_args(p)
+    add_telemetry_args(p)
     add_flightrec_args(p)
     add_dynamics_args(p)
     add_resilience_args(p)
@@ -337,7 +339,7 @@ def _run_once(args, ctx=None):
     if lineage_on and lin_writer is not None:
         exp.log(f"lineage: epoch {lin_writer.epoch}, "
                 f"{lincap} edge rows/window -> lineage.jsonl")
-    stores = writer = None
+    stores = writer = live = None
     import time as _time
     try:
         # writer spawns INSIDE the try (see mega_soup): a crash in this
@@ -353,6 +355,10 @@ def _run_once(args, ctx=None):
         # --no-spans is the bit-identical A/B reference)
         spans = make_spans(args, exp, registry, writer, dist,
                            "mega_multisoup")
+        # live telemetry plane (--no-export = the bitwise A/B oracle;
+        # see mega_soup / telemetry.exporter)
+        live = make_live_plane(args, exp, registry, dist,
+                               "mega_multisoup")
         hb = Heartbeat(exp, stage=stage_label("mega_multisoup", dist),
                        total_generations=args.generations,
                        registry=registry,
@@ -504,6 +510,11 @@ def _run_once(args, ctx=None):
                                                 payload, type_names=tnames)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
+                    if live is not None:
+                        # history sample + alert evaluation, ordered
+                        # with this chunk's registry mutations (see
+                        # mega_soup)
+                        live.sample(exp, writer, generation=gen)
                     # run-dir artifacts are process-0-gated (DESIGN §16)
                     if primary:
                         if dist.active:
@@ -622,8 +633,14 @@ def _run_once(args, ctx=None):
         try:
             try:
                 try:
-                    if writer is not None:
-                        writer.close()
+                    try:
+                        if writer is not None:
+                            writer.close()
+                    finally:
+                        # after the writer drained (see mega_soup): stop
+                        # the exporter, close metrics_history.jsonl
+                        if live is not None:
+                            live.close()
                 finally:
                     if stores is not None:
                         for store in stores:

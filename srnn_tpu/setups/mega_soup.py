@@ -39,16 +39,18 @@ from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
 from ..distributed import add_distributed_args
 from .common import (add_dynamics_args, add_flightrec_args,
-                     add_pipeline_args, add_resilience_args, base_parser,
-                     build_soup_mesh, chunk_boundary_faults, close_spans,
+                     add_pipeline_args, add_resilience_args,
+                     add_telemetry_args, base_parser, build_soup_mesh,
+                     chunk_boundary_faults, close_spans,
                      emit_chunk_spans, fetch_for_checkpoint,
                      finish_pipeline, flush_lineage_probe,
                      flush_lineage_window, init_distributed,
                      latest_checkpoint, load_run_config, make_flightrec,
-                     make_lineage, make_on_stall, make_pipeline,
-                     make_spans, note_restart, open_run, probe_run_costs,
-                     register, save_run_config, set_distributed_gauges,
-                     stage_label, update_fleet_gauges, watchdog_chunk)
+                     make_lineage, make_live_plane, make_on_stall,
+                     make_pipeline, make_spans, note_restart, open_run,
+                     probe_run_costs, register, save_run_config,
+                     set_distributed_gauges, stage_label,
+                     update_fleet_gauges, watchdog_chunk)
 
 
 def build_parser():
@@ -105,6 +107,7 @@ def build_parser():
                         "writes one .traj shard per process (multihost-safe) "
                         "merged offline by read_sharded_store")
     add_pipeline_args(p)
+    add_telemetry_args(p)
     add_flightrec_args(p)
     add_dynamics_args(p)
     add_resilience_args(p)
@@ -272,7 +275,7 @@ def _run_once(args, ctx=None):
     if lineage_on and lin_writer is not None:
         exp.log(f"lineage: epoch {lin_writer.epoch}, "
                 f"{lincap} edge rows/window -> lineage.jsonl")
-    store = writer = None
+    store = writer = live = None
     import time as _time
     try:
         # the writer's non-daemon worker spawns INSIDE the try: any
@@ -289,6 +292,11 @@ def _run_once(args, ctx=None):
         # fleet observatory: structured chunk/gather spans (host-only —
         # the evolved state is bit-identical with --no-spans, tested)
         spans = make_spans(args, exp, registry, writer, dist, "mega_soup")
+        # live telemetry plane (--no-export = the bitwise A/B oracle):
+        # history rings + metrics_history.jsonl + alert engine, sampled
+        # once per chunk in the finisher; /metrics + /healthz HTTP
+        # endpoint when --metrics-port is set
+        live = make_live_plane(args, exp, registry, dist, "mega_soup")
         hb = Heartbeat(exp, stage=stage_label("mega_soup", dist),
                        total_generations=args.generations,
                        registry=registry,
@@ -443,6 +451,12 @@ def _run_once(args, ctx=None):
                                                 payload)
                     hb.beat(generation=gen, gens_per_sec=chunk / dt,
                             chunk_seconds=round(dt, 3))
+                    if live is not None:
+                        # history sample + alert evaluation ride the
+                        # writer AFTER this chunk's gauge updates and
+                        # BEFORE its flush_events, so an alert row can
+                        # never cite registry state newer than its chunk
+                        live.sample(exp, writer, generation=gen)
                     # run-dir artifacts are process-0-gated (DESIGN §16):
                     # workers contribute through the collective shard
                     # boundaries, never through these sinks
@@ -602,8 +616,15 @@ def _run_once(args, ctx=None):
         try:
             try:
                 try:
-                    if writer is not None:
-                        writer.close()
+                    try:
+                        if writer is not None:
+                            writer.close()
+                    finally:
+                        # after the writer drained (queued history/alert
+                        # sample jobs reference the live plane's handles):
+                        # stop the exporter, close metrics_history.jsonl
+                        if live is not None:
+                            live.close()
                 finally:
                     if store is not None:
                         store.close()

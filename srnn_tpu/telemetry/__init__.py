@@ -36,6 +36,12 @@ from .dynamics import (BASIN_NAMES, EDGE_NAMES, FixpointStats, LineageState,
                        LineageWindow, LineageWriter, seed_lineage,
                        seed_lineage_blocks, update_dynamics_registry,
                        window_record)
+from .exporter import (HEALTHZ_METRICS, LivePlane, MetricsExporter,
+                       healthz_metrics, worker_liveness)
+from .timeseries import (MetricHistory, load_history_rows, sparkline,
+                         summarize_history)
+from .alerts import (AlertEngine, Rule, default_run_rules,
+                     default_serve_rules)
 
 __all__ = [
     "N_ACTIONS", "SoupMetrics", "accumulate_soup_metrics", "count_events",
@@ -53,4 +59,8 @@ __all__ = [
     "BASIN_NAMES", "EDGE_NAMES", "FixpointStats", "LineageState",
     "LineageWindow", "LineageWriter", "seed_lineage", "seed_lineage_blocks",
     "update_dynamics_registry", "window_record",
+    "HEALTHZ_METRICS", "LivePlane", "MetricsExporter", "healthz_metrics",
+    "worker_liveness",
+    "MetricHistory", "load_history_rows", "sparkline", "summarize_history",
+    "AlertEngine", "Rule", "default_run_rules", "default_serve_rules",
 ]

@@ -1,0 +1,237 @@
+"""Declarative alert engine over the registry + history rings.
+
+The paper's pathologies — populations diverging to NaN, collapsing to
+zero, a straggling host dragging the fleet, a service queue quietly
+saturating — all have registry signals (PR 2's health gauges, PR 12's
+straggler gauges and SLO counter, PR 13's admission gauges) but until
+this module nothing WATCHED them: an operator discovered a bad run by
+reading files after it ended.  An :class:`AlertEngine` evaluates a small
+declarative :class:`Rule` table at every history sample (once per chunk
+or dispatch — alerting shares the telemetry cadence, it never adds one):
+
+  * ``threshold`` — the metric's latest value (label sets summed)
+    compared against a bound: ``soup_health_nan_frac > 0.02``,
+    ``serve_queue_depth >= max_queue``.
+  * ``rate`` — the per-second rate over a trailing window:
+    ``serve_slo_violations_total`` burning, watchdog trips arriving.
+  * ``absence`` — the metric has never been sampled (or its last sample
+    is older than the window).  Absence rules get a grace period of one
+    window from the engine's first evaluation, so bring-up is never a
+    false page.  Scope honesty: ``sample()`` snapshots EVERY registered
+    series each turn, so within one process a registered metric's
+    series can only go stale if the sampling cadence itself stops — and
+    a stopped cadence stops rule evaluation with it.  In-process,
+    absence therefore means "never REGISTERED within the window" (a
+    fleet fold that never produced, a subsystem that never came up);
+    detecting a wedged sampler from outside is the scraper's job (a
+    flat ``heartbeat_generation`` across scrapes, or /healthz worker
+    staleness — both live independently of the run loop).
+
+Rules latch per name: the ``firing -> cleared`` edge is reported exactly
+once each way (a NaN storm is one alert, not one per chunk).  Every
+transition increments ``soup_alerts_total{rule=}``, the active count
+rides the ``soup_alerts_active`` gauge (so alert state is itself
+scrapeable), and the CALLER emits each transition as a
+``{"kind": "alert"}`` events row — rendering in ``watch`` (active-alerts
+panel), ``report`` (alert trail), and the Perfetto export (markers).
+
+What this is intentionally NOT: a pager.  No delivery, no dedup windows,
+no escalation — the engine names conditions in the run's own telemetry
+channels; routing them somewhere is the scraper's job (README).
+
+Every ``metric=`` a rule references must exist in
+``telemetry.names.CANONICAL_METRICS`` — the srnnlint metric-names pass
+(M006) fails the build otherwise, the inverse of its M005 liveness
+check, so a rule cannot silently watch a metric nobody emits.
+"""
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+}
+
+KINDS = ("threshold", "rate", "absence")
+
+
+class Rule:
+    """One declarative alert rule.
+
+    ``metric`` is the BARE registry name (no ``srnn_`` prefix; label
+    sets fold by sum — see ``telemetry.timeseries``).  ``kind`` selects
+    the evaluation (``threshold`` | ``rate`` | ``absence``); ``op`` and
+    ``value`` bound threshold/rate rules; ``window_s`` is the rate
+    window or the absence staleness bound."""
+
+    def __init__(self, *, name: str, metric: str, kind: str = "threshold",
+                 op: str = ">", value: float = 0.0, window_s: float = 60.0,
+                 help: str = ""):
+        if kind not in KINDS:
+            raise ValueError(f"rule {name!r}: unknown kind {kind!r} "
+                             f"(expected one of {KINDS})")
+        if op not in _OPS:
+            raise ValueError(f"rule {name!r}: unknown op {op!r} "
+                             f"(expected one of {sorted(_OPS)})")
+        self.name = name
+        self.metric = metric
+        self.kind = kind
+        self.op = op
+        self.value = float(value)
+        self.window_s = float(window_s)
+        self.help = help
+
+    def __repr__(self):
+        bound = (f"stale>{self.window_s:g}s" if self.kind == "absence"
+                 else f"{self.op}{self.value:g}"
+                 + (f"/{self.window_s:g}s" if self.kind == "rate" else ""))
+        return f"Rule({self.name}: {self.kind} {self.metric} {bound})"
+
+
+def default_run_rules(*, nan_frac: float = 0.02, zero_frac: float = 0.9,
+                      straggler_skew: float = 4.0) -> List[Rule]:
+    """The mega loops' rule table (thresholds mirror the watchdog's CLI
+    defaults — the watchdog acts in-process, the alert makes the same
+    condition visible to a scraper).  Threshold/rate rules over metrics
+    a run never registers (e.g. straggler gauges in a solo run) simply
+    never fire — no mode split needed.  Deliberately NO absence rule
+    over the process's own heartbeat: every registered series is
+    re-stamped each sample, and a wedged loop stops evaluation with the
+    cadence, so such a rule is structurally unable to fire — false
+    coverage, worse than none (see the module docstring; wedge
+    detection belongs to the in-process watchdog and to scrapers)."""
+    return [
+        Rule(name="soup_nan_frac", metric="soup_health_nan_frac",
+             kind="threshold", op=">", value=nan_frac,
+             help="NaN/Inf particle fraction past the divergence bound"),
+        Rule(name="soup_zero_collapse", metric="soup_health_zero_frac",
+             kind="threshold", op=">", value=zero_frac,
+             help="population collapsing to the zero fixpoint"),
+        Rule(name="soup_straggler_skew",
+             metric="soup_straggler_skew_ratio",
+             kind="threshold", op=">=", value=straggler_skew,
+             help="fastest/slowest process gens-per-sec skew (a host is "
+                  "dragging the fleet)"),
+        Rule(name="soup_watchdog_burn", metric="soup_watchdog_trips_total",
+             kind="rate", op=">", value=0.0, window_s=600.0,
+             help="watchdog trips arriving (anomalous chunks)"),
+    ]
+
+
+def default_serve_rules(*, max_queue: int = 0,
+                        window_s: float = 60.0) -> List[Rule]:
+    """The experiment service's rule table.  The queue-depth bound is
+    ``--max-queue`` when admission control is armed (depth AT the bound
+    means submits are being rejected) and a generous default otherwise."""
+    depth = float(max_queue) if max_queue else 512.0
+    return [
+        Rule(name="serve_queue_full", metric="serve_queue_depth",
+             kind="threshold", op=">=", value=depth,
+             help="dispatch queue at the admission bound"),
+        Rule(name="serve_slo_burn", metric="serve_slo_violations_total",
+             kind="rate", op=">", value=0.0, window_s=window_s,
+             help="requests exceeding the --slo-p95-ms target"),
+        Rule(name="serve_overload", metric="serve_overload_rejections_total",
+             kind="rate", op=">", value=0.0, window_s=window_s,
+             help="submits rejected at admission"),
+    ]
+
+
+class AlertEngine:
+    """Evaluate a rule table against one registry + history pair.
+
+    ``evaluate()`` returns the TRANSITIONS of this turn (``state:
+    "firing" | "cleared"`` dicts, ready to ride an events row);
+    ``active()`` snapshots the currently-firing set (the watch panel and
+    /healthz read it from other threads — locked)."""
+
+    def __init__(self, rules: List[Rule], registry, history):
+        self.rules = list(rules)
+        self.registry = registry
+        self.history = history
+        self._lock = threading.Lock()
+        self._state: Dict[str, dict] = {}
+        self._born: Optional[float] = None
+        # registered eagerly so a clean run scrapes the 0, not a missing
+        # series (the serve counters' discipline)
+        registry.counter("soup_alerts_total",
+                         help="alert rule firing transitions")
+        registry.gauge("soup_alerts_active",
+                       help="alert rules currently firing").set(0)
+
+    def _check(self, rule: Rule, now: float):
+        """(value, firing) for one rule at ``now``."""
+        if rule.kind == "absence":
+            age = self.history.age_s(rule.metric, now=now)
+            if age is None:
+                # never sampled: grace of one window from first evaluate
+                born = self._born if self._born is not None else now
+                return None, (now - born) > rule.window_s
+            return round(age, 3), age > rule.window_s
+        if rule.kind == "rate":
+            r = self.history.rate(rule.metric, rule.window_s, now=now)
+            if r is None:
+                return None, False
+            return round(r, 6), _OPS[rule.op](r, rule.value)
+        v = self.history.latest_sum(rule.metric)
+        if v is None:
+            return None, False
+        return round(v, 6), _OPS[rule.op](v, rule.value)
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """One evaluation turn (call AFTER ``history.sample()`` so rules
+        see the sample they ride with).  Returns the transitions."""
+        now = self.history.now() if now is None else float(now)
+        transitions: List[dict] = []
+        with self._lock:
+            if self._born is None:
+                self._born = now
+            for rule in self.rules:
+                value, firing = self._check(rule, now)
+                st = self._state.setdefault(
+                    rule.name, {"firing": False, "since": None,
+                                "value": None})
+                if firing:
+                    st["value"] = value
+                if firing and not st["firing"]:
+                    st.update(firing=True, since=now)
+                    transitions.append(self._transition(
+                        rule, "firing", value))
+                    self.registry.counter(
+                        "soup_alerts_total",
+                        help="alert rule firing transitions").inc(
+                            1, rule=rule.name)
+                elif not firing and st["firing"]:
+                    st.update(firing=False, since=now)
+                    transitions.append(self._transition(
+                        rule, "cleared", value))
+            n_active = sum(1 for st in self._state.values()
+                           if st["firing"])
+        self.registry.gauge("soup_alerts_active",
+                            help="alert rules currently firing").set(
+                                n_active)
+        return transitions
+
+    @staticmethod
+    def _transition(rule: Rule, state: str, value) -> dict:
+        return {"rule": rule.name, "state": state, "metric": rule.metric,
+                "rule_kind": rule.kind, "value": value,
+                "threshold": (None if rule.kind == "absence"
+                              else rule.value),
+                "window_s": (rule.window_s
+                             if rule.kind in ("rate", "absence") else None),
+                "help": rule.help or None}
+
+    def active(self) -> List[dict]:
+        """Currently-firing rules (name, observed value, seconds since
+        the firing edge) — the watch panel / healthz payload."""
+        now = self.history.now()
+        with self._lock:
+            return [{"rule": name, "value": st["value"],
+                     "for_s": round(now - st["since"], 1)
+                     if st["since"] is not None else None}
+                    for name, st in sorted(self._state.items())
+                    if st["firing"]]

@@ -1,0 +1,258 @@
+"""Live metrics endpoint: an OpenMetrics-style HTTP exporter.
+
+Every telemetry surface before this module is pull-from-disk: the
+``metrics.prom`` textfile is a point-in-time snapshot the chunk finisher
+publishes, ``events.jsonl`` needs a reader on the same filesystem, and
+``watch`` polls both.  The exporter is the live half — a stdlib
+``http.server`` on a ``spawn_thread`` serving THE SAME per-run
+:class:`~srnn_tpu.telemetry.metrics.MetricsRegistry` the sinks flush, so
+a scrape at a round boundary and the on-disk ``metrics.prom`` agree by
+construction (one registry, two views).
+
+Endpoints (GET only):
+
+  * ``/metrics`` — the registry's Prometheus text exposition (format
+    0.0.4, the dialect every OpenMetrics scraper ingests), rendered
+    per-request from the live registry.  Each scrape counts into
+    ``soup_scrapes_total`` AFTER its body renders, so a response never
+    includes its own scrape.
+  * ``/healthz`` — one JSON liveness object from the caller-supplied
+    ``healthz()`` provider (plus ``uptime_s``/``port``/``scrapes``
+    stamped here); ``ok: false`` answers 503 so a plain HTTP prober
+    needs no JSON parsing.  The distributed primary's provider
+    aggregates worker liveness from the PR 12 heartbeat lanes via
+    :func:`worker_liveness` — file mtime reads only, so the
+    no-collectives-off-the-loop rule (DESIGN §16) holds trivially.
+
+Threading: the accept/serve loop runs on one registered
+``spawn_thread``; per-request handler threads are stdlib
+``ThreadingHTTPServer`` internals, marked daemon so a scraper that
+connects and stalls can never hang ``close()`` (handlers own no
+buffered I/O — every sink write belongs to the run's BackgroundWriter).
+The registry itself is lock-per-metric, so scrapes concurrent with the
+run loop's mutations always see a consistent per-series value.
+
+The whole plane is host-side: ``--no-export`` (the mega loops' A/B
+oracle) never builds it, and results are bitwise-identical either way —
+tested, like ``--no-spans`` and ``--no-costs`` before it.
+"""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+#: registry names a /healthz body surfaces as its ``metrics`` field (the
+#: scraped-endpoint allowlist): every entry must exist in
+#: ``telemetry.names.CANONICAL_METRICS`` — the srnnlint metric-names
+#: pass (M006) enforces it, the inverse of the M005 liveness check.
+HEALTHZ_METRICS = (
+    "heartbeat_generation",
+    "gens_per_sec",
+    "serve_queue_depth",
+    "soup_health_nan_frac",
+    "soup_alerts_active",
+)
+
+#: exposition content type (Prometheus text format 0.0.4 — the dialect
+#: OpenMetrics scrapers ingest; matches what metrics.prom holds)
+_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def healthz_metrics(registry) -> Dict[str, float]:
+    """The :data:`HEALTHZ_METRICS` slice of one registry's flat rows —
+    what a /healthz provider embeds so a single probe answers "is it up
+    AND roughly where is it" without a full scrape."""
+    rows = registry.rows()
+    out: Dict[str, float] = {}
+    for name in HEALTHZ_METRICS:
+        prefix = f"srnn_{name}"
+        for key, value in rows.items():
+            if key == prefix or key.startswith(prefix + "{"):
+                out[key] = value
+    return out
+
+
+def worker_liveness(run_dir: str, num_processes: int,
+                    stale_after_s: float = 120.0) -> Dict[str, dict]:
+    """Per-process liveness from the heartbeat lanes: seconds since each
+    process's event file was last written (process 0's ``events.jsonl``,
+    workers' ``events-p<i>.jsonl``).  Pure ``mtime`` reads — callable
+    from any thread, never a collective.  A missing file or an age past
+    ``stale_after_s`` marks that process ``ok: false``."""
+    from .fleet import event_paths
+
+    paths = event_paths(run_dir)
+    now = time.time()
+    out: Dict[str, dict] = {}
+    for p in range(max(1, int(num_processes))):
+        path = paths.get(p)
+        try:
+            age = round(now - os.path.getmtime(path), 1) \
+                if path is not None else None
+        except OSError:
+            age = None
+        out[str(p)] = {"age_s": age,
+                       "ok": age is not None and age <= stale_after_s}
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # per-request logging is noise for a scrape endpoint; failures
+    # surface as HTTP statuses, not stderr lines
+    def log_message(self, fmt, *args):  # noqa: ARG002
+        pass
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        exporter: "MetricsExporter" = self.server.exporter  # type: ignore
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = exporter.registry.to_prometheus().encode("utf-8")
+            self._send(200, body, _CONTENT_TYPE)
+            exporter.count_scrape("metrics")
+        elif path == "/healthz":
+            doc = exporter.healthz_doc()
+            body = (json.dumps(doc, default=str) + "\n").encode("utf-8")
+            self._send(200 if doc.get("ok", True) else 503, body,
+                       "application/json")
+            exporter.count_scrape("healthz")
+        else:
+            self._send(404, b"not found\n", "text/plain; charset=utf-8")
+
+
+class _Server(ThreadingHTTPServer):
+    #: handler threads are stdlib internals serving one short response
+    #: each and own no buffered I/O; daemon-ness means a stalled scraper
+    #: connection cannot hang exporter.close() (which joins only the
+    #: registered accept-loop thread)
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class MetricsExporter:
+    """One process's live ``/metrics`` + ``/healthz`` endpoint.
+
+    >>> ex = MetricsExporter(registry, port=9100,
+    ...                      healthz=lambda: {"ok": True, "stage": "run"})
+    >>> ex.port        # the bound port (ephemeral when constructed with 0)
+    >>> ex.close()     # shutdown + join, idempotent
+
+    ``port=0`` binds an OS-assigned ephemeral port (tests); CLI callers
+    gate on their ``--metrics-port`` flag BEFORE constructing (0 = off is
+    the flag's contract, not this class's).  ``healthz`` is a zero-arg
+    callable returning the liveness dict (``ok`` defaults true); it runs
+    on handler threads, so providers must only read thread-safe state
+    (registry reads, file mtimes, alert-engine snapshots all qualify).
+    """
+
+    def __init__(self, registry, port: int = 0, host: str = "127.0.0.1",
+                 healthz: Optional[Callable[[], dict]] = None):
+        from ..utils.pipeline import spawn_thread
+
+        self.registry = registry
+        self._healthz = healthz
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._scrapes = 0
+        self._closed = False
+        self._server = _Server((host, int(port)), _Handler)
+        self._server.exporter = self  # type: ignore[attr-defined]
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread = spawn_thread(self._server.serve_forever,
+                                    name=f"srnn-metrics-exporter-{self.port}")
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def count_scrape(self, endpoint: str) -> None:
+        with self._lock:
+            self._scrapes += 1
+        self.registry.counter(
+            "soup_scrapes_total",
+            help="HTTP scrapes served by the live exporter").inc(
+                1, endpoint=endpoint)
+
+    @property
+    def scrapes(self) -> int:
+        with self._lock:
+            return self._scrapes
+
+    def healthz_doc(self) -> dict:
+        doc = {"ok": True}
+        if self._healthz is not None:
+            try:
+                doc.update(self._healthz() or {})
+            except Exception as e:  # a broken provider is itself unhealth
+                doc = {"ok": False,
+                       "error": f"healthz provider: {type(e).__name__}: {e}"}
+        doc.setdefault("uptime_s", round(time.monotonic() - self._t0, 1))
+        doc.setdefault("port", self.port)
+        doc.setdefault("scrapes", self.scrapes)
+        return doc
+
+    def close(self) -> None:
+        """Stop serving and join the accept thread; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._server.shutdown()
+        self._thread.join()
+        self._server.server_close()
+
+    def __enter__(self) -> "MetricsExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class LivePlane:
+    """The composed live telemetry plane of one process: the history
+    ring (:class:`~srnn_tpu.telemetry.timeseries.MetricHistory`), the
+    alert engine (:class:`~srnn_tpu.telemetry.alerts.AlertEngine`,
+    primary-only in distributed runs — one alert stream per run), and
+    the optional HTTP exporter.  ``sample()`` is the once-per-chunk (or
+    once-per-dispatch) turn: ring + jsonl row, then rule evaluation,
+    with every transition emitted as a ``{"kind": "alert"}`` event row —
+    all as ONE ordered job on the run's BackgroundWriter, so an alert
+    can never cite registry state newer than its chunk."""
+
+    def __init__(self, history=None, engine=None, exporter=None):
+        self.history = history
+        self.engine = engine
+        self.exporter = exporter
+
+    def sample(self, exp, writer=None, **context) -> None:
+        from ..utils.pipeline import submit_or_run
+
+        def job():
+            if self.history is not None:
+                self.history.sample()
+            if self.engine is not None:
+                for transition in self.engine.evaluate():
+                    exp.event(kind="alert", **context, **transition)
+
+        submit_or_run(writer, job)
+
+    def active_alerts(self):
+        return self.engine.active() if self.engine is not None else []
+
+    def close(self) -> None:
+        """Exporter first (no scrape may outlive the registry's run),
+        then the history file.  Call AFTER the run's writer drained —
+        queued sample jobs reference the history handle."""
+        if self.exporter is not None:
+            self.exporter.close()
+        if self.history is not None:
+            self.history.close()

@@ -436,17 +436,21 @@ def perfetto_trace(run_dir: str) -> dict:
                     "name": "gens_per_sec", "ph": "C", "cat": "heartbeat",
                     "ts": round(float(t) * 1e6, 1), "pid": pid,
                     "args": {"gens_per_sec": float(row["gens_per_sec"])}})
-        elif kind in ("restart", "watchdog", "preempt", "cost"):
+        elif kind in ("restart", "watchdog", "preempt", "cost", "alert"):
             t = row.get("t")
             if isinstance(t, (int, float)):
                 pids.add(pid)
+                name = kind if kind != "alert" \
+                    else f"alert:{row.get('rule', '?')}:" \
+                         f"{row.get('state', '?')}"
                 events.append({
-                    "name": kind, "ph": "i", "s": "p", "cat": "marker",
+                    "name": name, "ph": "i", "s": "p", "cat": "marker",
                     "ts": round(float(t) * 1e6, 1), "pid": pid,
                     "tid": _TID_EVENTS,
                     "args": {k: row[k] for k in
                              ("reasons", "fault", "generation", "entry",
-                              "flops", "bundle") if row.get(k) is not None}})
+                              "flops", "bundle", "rule", "state", "value",
+                              "threshold") if row.get(k) is not None}})
     for pid in sorted(pids):
         events.append({"name": "process_name", "ph": "M", "pid": pid,
                        "args": {"name": f"p{pid}"}})

@@ -37,6 +37,24 @@ _NAMESPACE = "srnn"
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
+def _fsync_dir(path: str) -> None:
+    # inlined twin of utils.atomicio.fsync_dir (this module imports
+    # nothing from srnn_tpu — see the module docstring): rename alone
+    # leaves the directory entry unsynced, so a power loss could
+    # resurrect a STALE metrics.prom beside a newer events.jsonl.
+    # Fail-soft on filesystems that refuse directory fsync.
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
@@ -262,15 +280,21 @@ class MetricsRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_textfile(self, path: str) -> str:
-        """Atomically write the exposition to ``path`` (tmp + rename, so a
-        concurrent scraper never sees a torn file).  Returns ``path``."""
+        """Atomically write the exposition to ``path`` (tmp + fsync +
+        rename + parent-directory fsync, so a concurrent scraper never
+        sees a torn file and a power loss cannot resurrect a STALE
+        snapshot beside a newer events.jsonl — the checkpoint-marker
+        discipline).  Returns ``path``."""
         body = self.to_prometheus()
         d = os.path.dirname(os.path.abspath(path))
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".prom_")
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(body)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
+            _fsync_dir(d)
         except BaseException:
             try:
                 os.remove(tmp)

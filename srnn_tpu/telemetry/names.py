@@ -140,6 +140,11 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_hlo_flops": "gauge",
     "soup_hbm_bytes": "gauge",
     "serve_tenant_flops_total": "counter",
+    # -- live telemetry plane (telemetry.exporter scrape counter;
+    #    telemetry.alerts firing transitions + active-rule gauge) --------
+    "soup_scrapes_total": "counter",
+    "soup_alerts_total": "counter",
+    "soup_alerts_active": "gauge",
 }
 
 #: pre-convention names kept for dashboard compatibility (do not extend):

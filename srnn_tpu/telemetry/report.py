@@ -56,6 +56,11 @@ from .metrics import quantile_from_times
 #: dirs keep rendering (the counter name never carried the typo)
 _LEGACY_KEYS = {"zweo_dead": "zero_dead"}
 
+#: metrics_history.jsonl is append-only and unbounded — the summary
+#: reads a bounded tail (each row is a full registry dump, so 4MB is
+#: hundreds of samples; watch uses a smaller bound for its refresh loop)
+_HISTORY_TAIL_BYTES = 4 << 20
+
 
 def _normalize_legacy(row: Any) -> Any:
     """Recursively rename legacy (misspelled) keys in one event row."""
@@ -168,6 +173,31 @@ def summarize(run_dir: str) -> dict:
                                "compile_s", "ledger")},
                       "roofline": roofline(row, p50)})
 
+    # live telemetry plane (PR 15): rate-over-time digests from the
+    # metrics_history.jsonl stream + the alert engine's transition trail.
+    # The stream is append-only and unbounded, so this reader
+    # tail-bounds like every other (4MB ≈ hundreds of full-registry
+    # rows — plenty for sparklines and trailing rates; a week-long run's
+    # full trail is jq's job, not the summary's)
+    from .timeseries import summarize_history
+
+    history = summarize_history(
+        os.path.join(run_dir, "metrics_history.jsonl"),
+        tail_bytes=_HISTORY_TAIL_BYTES)
+    alerts_by_rule: Dict[str, dict] = {}
+    for row in by_kind.get("alert", []):
+        rule = str(row.get("rule", "?"))
+        d = alerts_by_rule.setdefault(
+            rule, {"fired": 0, "cleared": 0, "last_state": None})
+        state = row.get("state")
+        if state == "firing":
+            d["fired"] += 1
+        elif state == "cleared":
+            d["cleared"] += 1
+        d["last_state"] = state
+        if row.get("value") is not None:
+            d["last_value"] = row["value"]
+
     return {
         "run_dir": os.path.abspath(run_dir),
         "meta": meta,
@@ -177,6 +207,9 @@ def summarize(run_dir: str) -> dict:
         "heartbeats": heartbeats,
         "spans": spans,
         "costs": costs,
+        "history": history,
+        "alerts": {"rows": len(by_kind.get("alert", [])),
+                   "by_rule": alerts_by_rule},
         "metrics": final_metrics,
         "metrics_flushes": len(metric_rows),
         "has_prom_file": os.path.exists(
@@ -250,6 +283,27 @@ def _render(s: dict, out) -> None:
                 line += (f" -> {rf['apps_per_sec']:.3g} apps/s at p50 = "
                          f"{rf['flops_per_sec']:.3g} HLO FLOP/s achieved")
             w(line + "\n")
+
+    hist = s.get("history")
+    if hist and hist.get("series"):
+        w(f"history ({hist['samples']} samples over {hist['span_s']}s, "
+          "metrics_history.jsonl):\n")
+        for name, d in sorted(hist["series"].items()):
+            line = f"  {name}: {d['spark']} last={d['last']}"
+            if "rate_per_s" in d:
+                line += f"  rate={d['rate_per_s']}/s"
+            else:
+                line += f"  [{d['min']}..{d['max']}]"
+            w(line + "\n")
+
+    alerts = s.get("alerts") or {}
+    if alerts.get("rows"):
+        w(f"alerts ({alerts['rows']} transition row(s)):\n")
+        for rule, d in sorted(alerts["by_rule"].items()):
+            w(f"  {rule}: fired {d['fired']}x"
+              + (f", last value {d['last_value']}"
+                 if d.get("last_value") is not None else "")
+              + f", last state {d['last_state']}\n")
 
     if s["metrics"]:
         w(f"metrics (cumulative, {s['metrics_flushes']} flushes"

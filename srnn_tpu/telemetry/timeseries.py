@@ -1,0 +1,234 @@
+"""Metric history: bounded in-memory rings + the append-only
+``metrics_history.jsonl`` stream.
+
+The registry's two sinks are cumulative snapshots — the LAST events row
+(or the current ``metrics.prom``) is the whole story, which is exactly
+right for post-mortems and exactly wrong for trajectories: "is gens/sec
+degrading", "is the queue draining", "how fast is the SLO burn" all need
+*history*.  :class:`MetricHistory` samples a registry once per
+chunk/dispatch into a bounded per-series ring (newest wins, oldest
+drops — the stream degrades to a window, never grows without bound) and
+optionally appends each sample as one single-line JSON row to
+``metrics_history.jsonl`` (flush-per-row, skip-unparseable readers —
+the repo's jsonl contract), so ``report`` renders rate-over-time and
+``watch`` gets real sparkline history instead of two-poll deltas.
+
+Clocks: ring timestamps are monotonic seconds since the history was
+created (≈ run start — safe for rates, immune to wall clock steps);
+each jsonl row also carries the wall stamp for cross-run correlation.
+
+Aggregation rule: the alert engine and the renderers address metrics by
+their BARE registry name (``serve_queue_depth``); a lookup folds every
+label set of that name by SUM.  Right for counters and for the
+single-series gauges the default rules watch; a per-label rule would
+need its own series key (documented limitation, not a trap — rules name
+whole metrics).
+
+Counter resets are not unwrapped: a fresh process starts a fresh
+registry AND a fresh history, so within one history's lifetime counters
+are monotone.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+#: sparkline glyphs, one per level (flat series render as all-bottom)
+_SPARK = "▁▂▃▄▅▆▇█"
+
+#: the series the file-tail renderers (watch sparklines, report history
+#: block) surface by default — bare registry names, summed across labels
+DEFAULT_RENDER_SERIES = ("gens_per_sec", "soup_generations_total",
+                         "serve_queue_depth", "serve_requests_total",
+                         "soup_alerts_active")
+
+
+def sparkline(values, width: int = 32) -> str:
+    """Render a numeric series as a unicode sparkline (last ``width``
+    points; empty string for an empty series)."""
+    vals = [float(v) for v in values][-max(1, int(width)):]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+class MetricHistory:
+    """Bounded per-series history of one registry.
+
+    >>> h = MetricHistory(registry, capacity=512,
+    ...                   path=run_dir + "/metrics_history.jsonl")
+    >>> h.sample()                      # once per chunk / dispatch
+    >>> h.rate("serve_slo_violations_total", window_s=60.0)
+
+    Ring overflow: each series keeps its newest ``capacity`` points
+    (``deque(maxlen=...)``); evicted points are counted in
+    ``dropped_points``.  The jsonl stream is append-only and unbounded —
+    rotation is the operator's call, and every reader tail-bounds.
+
+    Thread-safety: ``sample`` runs on the run's writer thread (or the
+    serve dispatch thread) while exporter handler threads read
+    ``latest_sum``/``age_s`` for /healthz — one lock covers the rings.
+    """
+
+    def __init__(self, registry, capacity: int = 512,
+                 path: Optional[str] = None):
+        self.registry = registry
+        self.capacity = max(2, int(capacity))
+        self.path = path
+        self._file = None
+        self._rings: Dict[str, deque] = {}
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.samples_total = 0
+        self.dropped_points = 0
+
+    def now(self) -> float:
+        return time.monotonic() - self._t0
+
+    # -- producer --------------------------------------------------------
+
+    def sample(self, t: Optional[float] = None, **extra) -> dict:
+        """Take one snapshot of the registry: append every series'
+        current value to its ring and (when ``path`` is set) one
+        ``{"kind": "metrics_history"}`` row to the jsonl stream.
+        ``t`` overrides the monotonic stamp (tests)."""
+        t = self.now() if t is None else float(t)
+        rows = self.registry.rows()
+        with self._lock:
+            for key, value in rows.items():
+                ring = self._rings.get(key)
+                if ring is None:
+                    ring = self._rings[key] = deque(maxlen=self.capacity)
+                if len(ring) == self.capacity:
+                    self.dropped_points += 1
+                ring.append((t, float(value)))
+            self.samples_total += 1
+        row = {"kind": "metrics_history", "t": round(t, 3),
+               "wall": round(time.time(), 3), "metrics": rows}
+        row.update(extra)
+        if self.path is not None:
+            if self._file is None:
+                self._file = open(self.path, "a")
+            self._file.write(json.dumps(row) + "\n")
+            self._file.flush()
+        return row
+
+    # -- readers (bare-name lookups, label sets folded by sum) -----------
+
+    def _matching(self, name: str) -> List[deque]:
+        prefix = name if name.startswith("srnn_") else f"srnn_{name}"
+        return [ring for key, ring in self._rings.items()
+                if key == prefix or key.startswith(prefix + "{")]
+
+    def series(self, name: str) -> List[Tuple[float, float]]:
+        """The summed (t, value) trajectory of ``name`` across its label
+        sets, on the union of sample stamps (series registered mid-run
+        contribute from their first sample on)."""
+        with self._lock:
+            rings = [list(r) for r in self._matching(name)]
+        folded: Dict[float, float] = {}
+        for ring in rings:
+            for t, v in ring:
+                folded[t] = folded.get(t, 0.0) + v
+        return sorted(folded.items())
+
+    def latest_sum(self, name: str) -> Optional[float]:
+        """Sum of each matching series' NEWEST point (None: never
+        sampled)."""
+        with self._lock:
+            rings = [r for r in self._matching(name) if r]
+        if not rings:
+            return None
+        return sum(r[-1][1] for r in rings)
+
+    def age_s(self, name: str, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since ``name`` was last sampled (None: never)."""
+        with self._lock:
+            rings = [r for r in self._matching(name) if r]
+        if not rings:
+            return None
+        now = self.now() if now is None else float(now)
+        return now - max(r[-1][0] for r in rings)
+
+    def rate(self, name: str, window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        """Per-second rate of ``name`` over the trailing window: summed
+        first-to-last delta of the in-window points divided by their
+        span.  ``None`` until two in-window points exist — an absence of
+        evidence, distinct from a measured 0.0."""
+        now = self.now() if now is None else float(now)
+        cutoff = now - max(1e-9, float(window_s))
+        pts = [(t, v) for t, v in self.series(name) if t >= cutoff]
+        if len(pts) < 2:
+            return None
+        span = pts[-1][0] - pts[0][0]
+        if span <= 0:
+            return None
+        return (pts[-1][1] - pts[0][1]) / span
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# ---------------------------------------------------------------------------
+# metrics_history.jsonl readers (watch sparklines, report history block)
+# ---------------------------------------------------------------------------
+
+
+def load_history_rows(path: str, tail_bytes: Optional[int] = None
+                      ) -> List[dict]:
+    """Parse ``metrics_history.jsonl`` rows (skip-unparseable — the torn
+    tail of a killed run costs its last row, never the reader)."""
+    from .fleet import load_rows
+
+    rows, _bad = load_rows(path, 0, tail_bytes=tail_bytes)
+    return [r for r in rows if r.get("kind") == "metrics_history"
+            and isinstance(r.get("metrics"), dict)]
+
+
+def _row_sum(row: dict, name: str) -> Optional[float]:
+    prefix = f"srnn_{name}"
+    vals = [v for k, v in row["metrics"].items()
+            if (k == prefix or k.startswith(prefix + "{"))
+            and isinstance(v, (int, float))]
+    return sum(vals) if vals else None
+
+
+def summarize_history(path: str, names=DEFAULT_RENDER_SERIES,
+                      tail_bytes: Optional[int] = None) -> Optional[dict]:
+    """Digest one history stream for the renderers: sample count, span,
+    and per selected series first/last/min/max + sparkline (+ the
+    first-to-last per-second rate for ``_total`` counters).  ``None``
+    when the file is absent/empty — a pre-live-plane run dir is a normal
+    state, not an error."""
+    rows = load_history_rows(path, tail_bytes=tail_bytes)
+    if not rows:
+        return None
+    t_first, t_last = rows[0].get("t", 0.0), rows[-1].get("t", 0.0)
+    span = max(0.0, float(t_last) - float(t_first))
+    series = {}
+    for name in names:
+        pts = [(r.get("t", 0.0), v) for r in rows
+               for v in [_row_sum(r, name)] if v is not None]
+        if not pts:
+            continue
+        vals = [v for _t, v in pts]
+        d = {"first": round(vals[0], 3), "last": round(vals[-1], 3),
+             "min": round(min(vals), 3), "max": round(max(vals), 3),
+             "points": len(vals), "spark": sparkline(vals)}
+        if name.endswith("_total") and len(pts) >= 2:
+            pspan = pts[-1][0] - pts[0][0]
+            if pspan > 0:
+                d["rate_per_s"] = round((vals[-1] - vals[0]) / pspan, 3)
+        series[name] = d
+    return {"samples": len(rows), "span_s": round(span, 1),
+            "series": series}

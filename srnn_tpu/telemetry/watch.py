@@ -2,6 +2,7 @@
 
     python -m srnn_tpu.telemetry.watch <run_dir> [--interval S] [--once]
     python -m srnn_tpu.telemetry.watch --service SOCKET [--once]
+    python -m srnn_tpu.telemetry.watch --url http://host:port [--once]
 
 The operator view `tail`-ing heartbeat files by hand used to
 approximate: one refresh-loop screen of stage, generation, gens/sec,
@@ -9,12 +10,24 @@ health, restarts and last checkpoint across ALL processes of a run
 (``telemetry.fleet``'s merged lanes), or — with ``--service`` — a
 running experiment service's queue/throughput/SLO state.  ``--once``
 prints a single machine-readable JSON snapshot instead (the CI
-``observability_smoke`` group and ``scripts/tpu_watch.sh``'s opt-in
-poll hook consume it).
+``observability_smoke``/``alerts_smoke`` groups and
+``scripts/tpu_watch.sh``'s opt-in poll hook consume it).
 
-Pure reader: file tails and one ``stats`` socket op — attaching a watch
-to a live run can never perturb it.  Stdout is this module's product
-(it is on the srnnlint prints allowlist).
+Live telemetry plane (PR 15): run dirs additionally render an
+ACTIVE-ALERTS panel (the ``{"kind": "alert"}`` rows the alert engine
+streams into events.jsonl — tail-bounded, last state per rule wins) and
+real sparkline history from ``metrics_history.jsonl`` instead of
+two-poll deltas.  ``--url`` consumes a live exporter endpoint
+(``telemetry.exporter``: ``/healthz`` + ``/metrics``) as an alternative
+to run-dir polling — same render loop, same ``--once`` JSON.
+**Precedence**: when both a run_dir and ``--url`` are given, the URL is
+the authority for liveness and active alerts (it reads the process's
+registry directly; files lag by up to one chunk) and renders first; the
+run-dir lanes view still follows for per-process detail.
+
+Pure reader: file tails, one ``stats`` socket op, or one HTTP GET pair —
+attaching a watch to a live run can never perturb it.  Stdout is this
+module's product (it is on the srnnlint prints allowlist).
 
 A JUST-CREATED run dir (no ``events.jsonl`` yet, zero-length or
 all-torn files) is a normal state, not an error: ``--once`` snapshots
@@ -34,18 +47,65 @@ from .fleet import event_paths, fleet_summary, load_rows
 
 _HEALTH_PREFIX = "srnn_soup_health_"
 
-#: the health scan only needs the LAST metrics row, which sits within a
+#: the health/alert scan only needs the LAST rows, which sit within a
 #: handful of rows of the file's end — a bounded tail read keeps the
 #: refresh loop off a week-long run's full events.jsonl
 _HEALTH_TAIL_BYTES = 262144
 
+#: metrics_history.jsonl sparklines read the same bounded tail
+_HISTORY_TAIL_BYTES = 262144
+
+
+def _alerts_from_rows(rows) -> dict:
+    """Fold alert transition rows (file order) into the panel state:
+    last state per rule wins; ``fired`` counts the firing edges."""
+    state = {}
+    fired = 0
+    for row in rows:
+        if row.get("kind") != "alert" or not row.get("rule"):
+            continue
+        if row.get("state") == "firing":
+            fired += 1
+        state[str(row["rule"])] = row.get("state")
+    return {"fired": fired,
+            "active": sorted(r for r, st in state.items()
+                             if st == "firing")}
+
+
+def _alert_rows(path) -> list:
+    """Every alert transition row of one events file — a FULL read, not
+    a tail: rules LATCH, so a long-lived alert is exactly one firing
+    row, and a tail bound would silently drop it from the panel while
+    the condition still holds.  The substring filter keeps the scan one
+    cheap pass (alert rows are rare; the lane summary already reads the
+    same file in full), json-parsing only matching lines."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                if '"kind": "alert"' not in line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return rows
+
 
 def snapshot(run_dir: str) -> dict:
     """One machine-readable fleet snapshot: the merged per-process lanes
-    plus liveness (seconds since ANY process wrote an event) and the
-    last flushed health gauges.  Cost note: the lane summary reads every
-    event file in full (beats/p50 are whole-run statistics); only the
-    health scan is tail-bounded."""
+    plus liveness (seconds since ANY process wrote an event), the last
+    flushed health gauges, the active-alerts panel, and sparkline
+    history when the run streams ``metrics_history.jsonl``.  Cost note:
+    the lane summary reads every event file in full (beats/p50 are
+    whole-run statistics) and the alert fold re-reads the primary's in
+    full through a cheap line filter (rules latch — the one firing row
+    of a long-lived alert must not scroll out of a tail); the
+    health/history scans are tail-bounded."""
+    from .timeseries import summarize_history
+
     s = fleet_summary(run_dir, timeline_tail=0)
     s.pop("timeline_tail", None)
     mtimes = []
@@ -67,6 +127,13 @@ def snapshot(run_dir: str) -> dict:
             if health:
                 s["health"] = health
             break
+    # alert rows are primary-only (one alert stream per run) — full
+    # line-filtered scan of events.jsonl, NOT the health tail above
+    s["alerts"] = _alerts_from_rows(
+        _alert_rows(os.path.join(run_dir, "events.jsonl")))
+    s["history"] = summarize_history(
+        os.path.join(run_dir, "metrics_history.jsonl"),
+        tail_bytes=_HISTORY_TAIL_BYTES)
     return s
 
 
@@ -83,6 +150,31 @@ def render(s: dict, out) -> None:
     if health:
         cells = "  ".join(f"{k}={v}" for k, v in sorted(health.items()))
         out.write(f"health: {cells}\n")
+    render_alerts(s.get("alerts"), out)
+    hist = s.get("history")
+    if hist and hist.get("series"):
+        for name, d in sorted(hist["series"].items()):
+            out.write(f"history {name}: {d['spark']} last={d['last']}"
+                      + (f" ({d['rate_per_s']}/s)"
+                         if "rate_per_s" in d else "") + "\n")
+
+
+def render_alerts(alerts, out) -> None:
+    """The active-alerts panel (shared by the run-dir, service and URL
+    views).  Accepts either the file-tail shape ({active: [names],
+    fired: n}) or the engine/stats shape ({active: [dicts], fired: n});
+    silent when the run has no alert trail at all."""
+    if not alerts:
+        return
+    active = alerts.get("active") or []
+    names = [a["rule"] if isinstance(a, dict) else str(a) for a in active]
+    if names:
+        out.write("ALERTS: " + ", ".join(names)
+                  + f"  ({alerts.get('fired', len(names))} firing "
+                    "transition(s))\n")
+    elif alerts.get("fired"):
+        out.write(f"alerts: none active ({alerts['fired']} fired, "
+                  "all cleared)\n")
 
 
 # ---------------------------------------------------------------------------
@@ -101,6 +193,7 @@ def service_snapshot(socket_path: str) -> dict:
            "distinct_programs": stats.get("distinct_programs"),
            "uptime_s": stats.get("uptime_s"),
            "slo": stats.get("slo"),
+           "alerts": stats.get("alerts"),
            "self_healing": stats.get("self_healing")}
     uptime = stats.get("uptime_s") or 0
     out["requests_per_sec"] = round(stats.get("completed", 0) / uptime, 3) \
@@ -122,6 +215,7 @@ def render_service(s: dict, out) -> None:
                   + (f"p95<={target}ms target, " if target else "no target, ")
                   + (f"measured p95~{p95}ms, " if p95 is not None else "")
                   + f"{slo.get('violations', 0)} violation(s)\n")
+    render_alerts(s.get("alerts"), out)
     sh = s.get("self_healing")
     if sh:
         mq = sh.get("max_queue")
@@ -135,6 +229,81 @@ def render_service(s: dict, out) -> None:
                     f"rejection(s), {sh.get('deadline_expirations')} "
                     f"deadline expiration(s), "
                     f"{sh.get('results_evicted')} result(s) evicted\n")
+
+
+# ---------------------------------------------------------------------------
+# live endpoint mode (--url, telemetry.exporter)
+# ---------------------------------------------------------------------------
+
+#: exposition prefixes the URL view surfaces (a scrape carries hundreds
+#: of series; the console shows the operator's first questions)
+_URL_METRIC_PREFIXES = ("srnn_heartbeat_generation", "srnn_gens_per_sec",
+                        "srnn_serve_queue_depth", "srnn_serve_requests",
+                        "srnn_soup_generations_total",
+                        "srnn_soup_alerts_active",
+                        "srnn_soup_health_nan_frac")
+
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal text-format parse: ``{name{labels}: float}`` rows, comment
+    and malformed lines skipped (a live scrape is never torn — the
+    exporter writes whole bodies — but the parser stays defensive)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _sep, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def url_snapshot(url: str, timeout_s: float = 5.0) -> dict:
+    """One /healthz + /metrics round trip to a live exporter."""
+    import urllib.error
+    import urllib.request
+
+    base = url.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/healthz",
+                                    timeout=timeout_s) as r:
+            health = json.load(r)
+    except urllib.error.HTTPError as e:
+        # 503 = the endpoint is up and says NOT healthy — that IS a
+        # snapshot, not a transport failure
+        health = json.loads(e.read().decode("utf-8", "replace") or "{}")
+    with urllib.request.urlopen(base + "/metrics", timeout=timeout_s) as r:
+        series = parse_prometheus(r.read().decode("utf-8", "replace"))
+    return {"url": base, "healthz": health,
+            "metric_series": len(series),
+            "metrics": {k: v for k, v in sorted(series.items())
+                        if k.startswith(_URL_METRIC_PREFIXES)}}
+
+
+def render_url(s: dict, out) -> None:
+    hz = s.get("healthz") or {}
+    out.write(time.strftime("-- watch %H:%M:%S ")
+              + f"live {s['url']} "
+              + ("[ok]" if hz.get("ok") else "[NOT OK]") + "\n")
+    bits = [f"{k}={hz[k]}" for k in ("stage", "uptime_s", "scrapes")
+            if hz.get(k) is not None]
+    if bits:
+        out.write("  " + "  ".join(bits) + "\n")
+    workers = hz.get("workers")
+    if workers:
+        cells = "  ".join(
+            f"p{p}:{'ok' if w.get('ok') else 'STALE'}"
+            + (f"({w['age_s']}s)" if w.get("age_s") is not None else "")
+            for p, w in sorted(workers.items(), key=lambda kv: int(kv[0])))
+        out.write(f"  workers: {cells}\n")
+    active = hz.get("active_alerts")
+    if active is not None:
+        render_alerts({"active": active, "fired": len(active)}, out)
+    for name, value in (s.get("metrics") or {}).items():
+        out.write(f"  {name} = {value}\n")
 
 
 # ---------------------------------------------------------------------------
@@ -152,15 +321,24 @@ def main(argv=None) -> int:
                    help="watch a running experiment service's stats/"
                         "queue/SLO state instead of (or as well as) a "
                         "run dir")
+    p.add_argument("--url", default=None, metavar="URL",
+                   help="consume a live exporter endpoint "
+                        "(http://host:port — telemetry.exporter's "
+                        "/healthz + /metrics) instead of run-dir "
+                        "polling; when BOTH are given the URL wins for "
+                        "liveness and active alerts (the registry is "
+                        "the authority; files lag by up to one chunk) "
+                        "and the run-dir lanes render after it")
     p.add_argument("--interval", type=float, default=5.0, metavar="S",
                    help="refresh period of the watch loop")
     p.add_argument("--once", action="store_true",
                    help="print one JSON snapshot and exit (machine-"
-                        "readable; what the CI smoke and the tpu_watch "
+                        "readable; what the CI smokes and the tpu_watch "
                         "poll hook consume)")
     args = p.parse_args(argv)
-    if not args.run_dir and not args.service:
-        p.error("give a run_dir, --service SOCKET, or both")
+    if not args.run_dir and not args.service and not args.url:
+        p.error("give a run_dir, --service SOCKET, --url URL, or a "
+                "combination")
     if args.run_dir and not os.path.isdir(args.run_dir):
         print(f"watch: {args.run_dir}: not a directory", file=sys.stderr)
         return 2
@@ -169,6 +347,12 @@ def main(argv=None) -> int:
         snap = {}
         if args.run_dir:
             snap = snapshot(args.run_dir)
+        if args.url:
+            try:
+                snap["live"] = url_snapshot(args.url)
+            except Exception as e:
+                snap["live"] = {"url": args.url,
+                                "error": f"{type(e).__name__}: {e}"}
         if args.service:
             try:
                 snap["service"] = service_snapshot(args.service)
@@ -183,6 +367,12 @@ def main(argv=None) -> int:
     try:
         while True:
             snap = take()
+            live = snap.get("live")
+            if live:  # the URL is the liveness authority: renders first
+                if "error" in live:
+                    print(f"live: {live['error']}")
+                else:
+                    render_url(live, sys.stdout)
             if args.run_dir:
                 render(snap, sys.stdout)
             svc = snap.get("service")

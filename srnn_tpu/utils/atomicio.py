@@ -15,10 +15,30 @@ resume sidecar — the files ``--resume`` reads first.
 import os
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-published rename inside it is durable:
+    ``os.replace`` updates the directory entry, and until the directory
+    itself syncs a power loss can resurrect the OLD file beside newer
+    siblings (a stale ``metrics.prom`` next to a newer ``events.jsonl``,
+    a vanished checkpoint marker).  Fail-soft: filesystems that refuse
+    directory fsync (some network mounts) lose only this extra guarantee,
+    never the write."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def atomic_write_bytes(path: str, data: bytes) -> str:
-    """Publish ``data`` at ``path`` atomically (tmp + fsync + rename).
-    The tmp file lives in the target's directory so the rename never
-    crosses a filesystem boundary."""
+    """Publish ``data`` at ``path`` atomically (tmp + fsync + rename +
+    parent-directory fsync).  The tmp file lives in the target's
+    directory so the rename never crosses a filesystem boundary."""
     path = os.path.abspath(path)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
@@ -26,6 +46,7 @@ def atomic_write_bytes(path: str, data: bytes) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
     return path
 
 

@@ -859,6 +859,38 @@ def test_metric_liveness_clean_on_real_repo(repo_ctx):
             if f.code == "M005"] == []
 
 
+def test_metric_references_fire_on_unknown_rule_and_allowlist(tmp_path):
+    """M006 (the inverse of M005): a ``Rule(metric=...)`` alert rule or
+    a ``HEALTHZ_METRICS`` allowlist entry naming a metric outside
+    CANONICAL_METRICS is a silently-dead watch — the fixture seeds one
+    bad rule, one good rule, and one bad allowlist entry."""
+    ctx = make_repo(tmp_path, {
+        "srnn_tpu/telemetry/exporter.py": """
+        HEALTHZ_METRICS = ("heartbeat_generation", "no_such_gauge")
+        """,
+        "srnn_tpu/rules.py": """
+        def my_rules(Rule):
+            return [Rule(name="ok", metric="soup_health_nan_frac",
+                         kind="threshold", value=0.5),
+                    Rule(name="bad", metric="not_declared_anywhere",
+                         kind="threshold", value=1.0)]
+        """})
+    found = [f for f in run_pass(ctx, "metric-names") if f.code == "M006"]
+    refs = sorted(f.message.split("'")[1] for f in found)
+    assert refs == ["no_such_gauge", "not_declared_anywhere"]
+    paths = {f.path for f in found}
+    assert any(p.endswith("exporter.py") for p in paths)
+    assert any(p.endswith("rules.py") for p in paths)
+
+
+def test_metric_references_clean_on_real_repo(repo_ctx):
+    """Every metric the shipped alert rule tables and the /healthz
+    allowlist reference is declared (keeps a rule from silently
+    watching a name nobody can emit)."""
+    assert [f for f in run_pass(repo_ctx, "metric-names")
+            if f.code == "M006"] == []
+
+
 # ---------------------------------------------------------------------------
 # waivers / baseline machinery
 # ---------------------------------------------------------------------------
