@@ -83,9 +83,11 @@ RESCUE_RESERVE_S = 330.0
 # the multi-tenant experiment-service load leg (srnn_tpu.serve): runs
 # FIRST (host-CPU pinned — a wedged tunnel cannot eat it) and reports
 # requests/sec at measured p50/p95 plus the 8-concurrent-sweeps vs
-# 8-solo-processes comparison.  0 disables (the bench e2e tests pin tiny
+# 8-solo-processes comparison, then the 1/2/4-worker fleet saturation
+# sweep (three subprocess fleets at ~20s each, hence the bigger default
+# than the other CPU legs).  0 disables (the bench e2e tests pin tiny
 # deadlines and must not inherit a multi-minute extra stage).
-SERVE_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_SERVE_TIMEOUT_S", "420"))
+SERVE_TIMEOUT_S = float(os.environ.get("SRNN_BENCH_SERVE_TIMEOUT_S", "600"))
 # the distributed-tier leg (srnn_tpu.distributed): a 2-process CPU-mesh
 # mega_soup through the launcher vs the single-process run of the same
 # config — proves the multi-host plumbing end to end on this host
@@ -292,7 +294,16 @@ def _serve_leg() -> dict:
         compile count during serving, and a per-tenant bitwise parity
         check against the solo processes' saved artifacts.
       * ``load``: closed-loop requests/sec at measured p50/p95 latency
-        (C client threads submitting tiny sweeps for a fixed window).
+        (C client threads submitting tiny sweeps for a fixed window),
+        under the continuous-batching controller (the production
+        default) — plus the window-occupancy ratio (ticket time spent
+        waiting for stackmates over total request time) the adaptive
+        windows exist to shrink.
+      * ``saturation``: the same closed-loop load against real
+        ``python -m srnn_tpu.serve`` processes at 1/2/4 dispatch
+        workers — the scale-out curve over the shared journal/AOT-cache
+        substrate (admitted vs replayed counts keep the recovery story
+        on the record).
     """
     import shutil
     import tempfile
@@ -311,11 +322,14 @@ def _serve_leg() -> dict:
     batch = int(os.environ.get("SRNN_BENCH_SERVE_BATCH", "512"))
     load_s = float(os.environ.get("SRNN_BENCH_SERVE_LOAD_S", "8"))
     load_clients = int(os.environ.get("SRNN_BENCH_SERVE_CLIENTS", "4"))
-    # the load leg's latency target: requests slower than this count into
-    # serve_slo_violations_total (the adaptive-window signal); 350ms sits
-    # just above the window-bound p95 ~312ms PR 10 measured, so a healthy
-    # run reads near-zero and a regression reads loud
-    slo_ms = float(os.environ.get("SRNN_BENCH_SERVE_SLO_P95_MS", "350"))
+    # the load leg's latency target — the adaptive controller's set
+    # point: windows shrink under violations and grow on headroom, so
+    # measured p95 hovers at this value.  100ms is well under the
+    # fixed-window p95 ~312ms PR 10 measured (with SLO headroom the law
+    # correctly grows back to the 250ms ceiling and the leg would just
+    # re-measure the fixed window); it is also ~4x the tiny sweep's
+    # dispatch time, so the windows still buy real stacking
+    slo_ms = float(os.environ.get("SRNN_BENCH_SERVE_SLO_P95_MS", "100"))
     # admission control: a BOUNDED queue keeps the saturation story
     # honest — past it the service pushes back with typed overload
     # rejections (counted below) instead of hiding load in the queue
@@ -332,10 +346,17 @@ def _serve_leg() -> dict:
                                 max_queue=max_queue)
         _hb("serve", "warmup")
         svc.warm("fixpoint_density", {"trials": trials, "batch": batch})
+        # EVERY width 1..C: the adaptive floor-start windows make odd
+        # stack widths (a drain catching 2 of 4 clients) routine, and a
+        # cold width mid-load would bill its compile to the p95
         svc.warm("fixpoint_density",
                  {"trials": load_trials, "batch": load_trials},
-                 widths=(load_clients, 1))
+                 widths=tuple(range(1, load_clients + 1)))
         sock = os.path.join(root, "serve.sock")
+        # the sweeps phase runs FIXED-window (one guaranteed width-8
+        # stack — the amortization/parity story, comparable to the
+        # committed fixed-window rounds); the controller attaches before
+        # the load phase, which measures the adaptive tier
         server = ServiceServer(svc, sock, batch_window_s=0.25)
         server_thread = spawn_thread(server.serve_until_shutdown,
                                      name="bench-serve-server")
@@ -418,8 +439,17 @@ def _serve_leg() -> dict:
         # its own seeded-backoff client, so an overload rejection backs
         # off deterministically instead of hammering the full queue)
         _hb("serve", "load", seconds=load_s, clients=load_clients)
-        rejections_before = (client.stats().get("self_healing") or {}).get(
+        # flip the dispatcher to continuous batching for the load phase
+        # (the dispatch loop reads .controller every cycle)
+        from srnn_tpu.serve.controller import make_controller
+
+        controller = make_controller(0.25, slo_ms)
+        svc.attach_controller(controller)
+        server.controller = controller
+        pre_stats = client.stats()
+        rejections_before = (pre_stats.get("self_healing") or {}).get(
             "overload_rejections", 0)
+        rows_before = pre_stats.get("metrics") or {}
         stop_at = time.monotonic() + load_s
         lat_lists = [[] for _ in range(load_clients)]
 
@@ -453,6 +483,20 @@ def _serve_leg() -> dict:
         sh = load_stats.get("self_healing") or {}
         rejected = (sh.get("overload_rejections", 0) or 0) \
             - (rejections_before or 0)
+        rows_after = load_stats.get("metrics") or {}
+
+        def _hist_sum_delta(prefix):
+            after = sum(v for k, v in rows_after.items()
+                        if k.startswith(prefix))
+            return after - sum(v for k, v in rows_before.items()
+                               if k.startswith(prefix))
+
+        # window occupancy: of the load window's total request seconds,
+        # the share spent WAITING for stackmates — the fixed 250ms window
+        # ran this near 0.8 (window-bound); the adaptive floor-start
+        # windows should read well under that
+        win_sum = _hist_sum_delta("srnn_serve_ticket_window_seconds_sum")
+        req_sum = _hist_sum_delta("srnn_serve_request_seconds_sum")
         out["load"] = {
             "clients": load_clients,
             "window_s": round(load_wall, 2),
@@ -469,7 +513,126 @@ def _serve_leg() -> dict:
             "max_queue": max_queue,
             "admitted": len(lats),
             "rejected": rejected,
+            "replayed": sh.get("replayed", 0),
+            "window_occupancy": round(win_sum / req_sum, 4)
+            if req_sum > 0 else None,
+            "dispatch": load_stats.get("dispatch"),
         }
+
+        # -- saturation sweep: the same closed-loop load against REAL
+        # `python -m srnn_tpu.serve` processes at 1/2/4 dispatch workers
+        # (fleet mode: shared persistent AOT cache, per-tenant sticky
+        # round-robin, journal-backed replay on worker death) — the
+        # scale-out curve the continuous-batching tier exists to bend
+        sat_s = float(os.environ.get("SRNN_BENCH_SERVE_SAT_S", "5"))
+        sat_workers = [int(x) for x in os.environ.get(
+            "SRNN_BENCH_SERVE_SAT_WORKERS", "1,2,4").split(",") if x]
+
+        def saturation_row(nw):
+            froot = os.path.join(root, f"fleet{nw}")
+            fsock = os.path.join(froot, "serve.sock")
+            os.makedirs(froot, exist_ok=True)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "srnn_tpu.serve", "--root", froot,
+                 "--workers", str(nw), "--batch-window-s", "0.25",
+                 "--slo-p95-ms", str(slo_ms),
+                 "--max-queue", str(max_queue),
+                 "--warm-fixpoint-density", f"{load_trials},{load_trials}"],
+                cwd=repo, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            row = {"workers": nw}
+            try:
+                fc = ServiceClient(fsock, retries=6, backoff_base_s=0.05,
+                                   seed=nw)
+                fc.wait_until_up(180)
+                # pin + warm each load tenant's sticky worker OUTSIDE the
+                # timed window (worker startup is the fleet's cold cost,
+                # not its steady-state latency)
+                for s in range(load_clients):
+                    fc.request("fixpoint_density",
+                               {"seed": s, "trials": load_trials,
+                                "batch": load_trials},
+                               tenant=f"sat{s}", timeout_s=180,
+                               idempotency_key=f"satwarm{nw}-{s}")
+                # concurrent warm bursts until a full round serves fast:
+                # the adaptive windows stack whatever widths the arrival
+                # pattern produces, and each worker must have compiled
+                # (or cache-loaded) ITS widths before the timed window
+                for burst in range(20):
+                    tb = time.monotonic()
+                    bthreads = [
+                        spawn_thread(
+                            lambda s=s, b=burst: fc.request(
+                                "fixpoint_density",
+                                {"seed": s, "trials": load_trials,
+                                 "batch": load_trials},
+                                tenant=f"sat{s}", timeout_s=180,
+                                idempotency_key=f"satburst{nw}-{b}-{s}"),
+                            name=f"bench-serve-warm{i}")
+                        for i, s in enumerate(range(load_clients))]
+                    for t in bthreads:
+                        t.join()
+                    if time.monotonic() - tb < 1.0:
+                        break
+                stop_at = time.monotonic() + sat_s
+                sat_lats = [[] for _ in range(load_clients)]
+
+                def sat_loader(lats, seed):
+                    c = ServiceClient(fsock, retries=6,
+                                      backoff_base_s=0.05, seed=seed)
+                    n = 0
+                    while time.monotonic() < stop_at:
+                        t1 = time.monotonic()
+                        n += 1
+                        c.request("fixpoint_density",
+                                  {"seed": seed, "trials": load_trials,
+                                   "batch": load_trials},
+                                  tenant=f"sat{seed}", timeout_s=60,
+                                  idempotency_key=f"sat{nw}-{seed}-{n}")
+                        lats.append(time.monotonic() - t1)
+
+                t1 = time.monotonic()
+                sat_threads = [
+                    spawn_thread(sat_loader, name=f"bench-serve-sat{i}",
+                                 args=(sat_lats[i], i))
+                    for i in range(load_clients)]
+                for t in sat_threads:
+                    t.join()
+                wall = time.monotonic() - t1
+                flat = [x for lst in sat_lats for x in lst]
+                st = fc.stats()
+                front = st.get("front") or {}
+                row.update(
+                    clients=load_clients,
+                    window_s=round(wall, 2),
+                    requests=len(flat),
+                    requests_per_sec=round(len(flat) / max(wall, 1e-9), 2),
+                    p50_ms=round(1e3 * quantile_from_times(flat, 0.5), 1),
+                    p95_ms=round(1e3 * quantile_from_times(flat, 0.95), 1),
+                    # admitted-vs-replayed: replay > 0 here would mean a
+                    # worker died mid-load and the journal healed it —
+                    # on a clean bench box both rows read replays=0
+                    admitted=front.get("admitted", len(flat)),
+                    replayed=front.get(
+                        "replayed",
+                        (st.get("self_healing") or {}).get("replayed", 0)),
+                    deaths=front.get("deaths", 0))
+            finally:
+                try:
+                    ServiceClient(fsock).shutdown()
+                except Exception:
+                    pass
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+            return row
+
+        out["saturation"] = {}
+        for nw in sat_workers:
+            _hb("serve", "saturation", workers=nw)
+            out["saturation"][f"w{nw}"] = saturation_row(nw)
     finally:
         # teardown runs on EVERY path: an exception above must not leave
         # the non-daemon server/writer threads alive (the child would

@@ -3,10 +3,11 @@ for the soup hot path, before/after the AOT + donation subsystem.
 
 One JSON line of rows (plus ``telemetry``/``health``/``lineage``/
 ``fused``: the in-scan carries' dispatch overhead, ``spans``: the fleet
-observatory's per-chunk span emission on top of ``metered.health``, and
-``stacked``: the serve tenant-axis amortization — K=8 stacked dispatch
-vs 8 solo dispatches — all on the shared interleaved median-of-medians
-protocol; see their docstrings):
+observatory's per-chunk span emission on top of ``metered.health``,
+``adaptive``: the continuous-batching controller's per-dispatch turn on
+top of ``metered.health``, and ``stacked``: the serve tenant-axis
+amortization — K=8 stacked dispatch vs 8 solo dispatches — all on the
+shared interleaved median-of-medians protocol; see their docstrings):
 
   * ``compile``: wall time of the soup hot path's BACKEND COMPILE (the
     generation step + the 100-generation chunk run, full dynamics) in a
@@ -405,6 +406,51 @@ def row_export() -> dict:
         os.unlink(tmp.name)
 
 
+#: groups per controller turn — wider than any real serve round (the
+#: bench load legs run 1-2 spellings); overstating the fold keeps the
+#: bound honest
+ADAPTIVE_GROUPS = 8
+
+
+def row_adaptive() -> dict:
+    """Walltime overhead of the continuous-batching controller's
+    per-dispatch turn — the ``window_s`` fold over the pending groups
+    plus one ``observe_dispatch`` per retired group — on top of the
+    ``metered.health`` chunk (documented bound <= ~5%, like the other
+    host-side planes): the control law is pure dict arithmetic under a
+    lock, off the device hot path, so the adaptive dispatcher costs
+    (nearly) nothing over the ``--no-adaptive`` oracle per dispatch
+    beyond the window it then chooses to sleep.  The turn alternates
+    burning and clean rounds so both law branches (shrink and grow) are
+    inside the measurement.  Plain baseline interleaved per the shared
+    protocol."""
+    import itertools
+
+    from srnn_tpu.serve.controller import AdaptiveWindowController
+
+    fns = _chunk_fns()
+    ctrl = AdaptiveWindowController(ceiling_s=0.25, slo_p95_ms=500.0)
+    groups = [("fixpoint_density", (16 * (i + 1), 16))
+              for i in range(ADAPTIVE_GROUPS)]
+    health = fns["health"]
+    turn = itertools.count()
+
+    def adaptive():
+        value = health()
+        ctrl.window_s(groups)
+        t = next(turn)
+        for i, g in enumerate(groups):
+            ctrl.observe_dispatch(g, violations=int((t + i) % 3 == 0),
+                                  completed=2)
+        return value
+
+    return _overhead_row("adaptive",
+                         {"plain": fns["plain"], "health": health,
+                          "adaptive": adaptive},
+                         base="health", feature="adaptive",
+                         extra={"groups": ADAPTIVE_GROUPS})
+
+
 def row_fused() -> dict:
     """``generation_impl='fused'`` vs the phase chain at the micro config
     (same dynamics, same draws).  On Mosaic backends this measures the
@@ -492,11 +538,11 @@ def main(argv=None) -> int:
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
             row_telemetry(), row_health(), row_lineage(), row_spans(),
-            row_export(), row_fused(), row_stacked()]
+            row_export(), row_adaptive(), row_fused(), row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h, l, sp, ex, fu, sk = rows
+        c, d, m, t, h, l, sp, ex, ad, fu, sk = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -529,6 +575,11 @@ def main(argv=None) -> int:
               f"{ex['export_ms_per_chunk']:.1f}ms vs metered.health "
               f"{ex['health_ms_per_chunk']:.1f}ms per chunk "
               f"({ex['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# adaptive(N={ad['n']}, G={ad['generations']}, "
+              f"groups={ad['groups']}): +controller turn "
+              f"{ad['adaptive_ms_per_chunk']:.1f}ms vs metered.health "
+              f"{ad['health_ms_per_chunk']:.1f}ms per chunk "
+              f"({ad['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
         print(f"# fused(N={fu['n']}, G={fu['generations']}): "
               f"{fu['fused_ms_per_chunk']:.1f}ms vs phases "
               f"{fu['plain_ms_per_chunk']:.1f}ms per chunk "

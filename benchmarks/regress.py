@@ -27,12 +27,24 @@ the same PR with the reasoning updated here):
   serve_sweeps_speedup_x      down-bad   50%        amortization ratio —
                                                     depends on host load
                                                     during the solo leg
-  serve_load_requests_per_sec down-bad   40%        closed-loop and
-                                                    window-bound; modest
-                                                    drift expected until
-                                                    continuous batching
+  serve_load_requests_per_sec down-bad   40%        closed-loop; since the
+                                                    continuous-batching
+                                                    tier the windows adapt
+                                                    off the SLO, so only
+                                                    host noise remains —
+                                                    the fixed-window r0x
+                                                    history keeps the
+                                                    median conservative
   serve_load_p95_ms           up-bad     50%        latency tail under a
                                                     shared host
+  serve_sat_w{1,2,4}_rps      down-bad   40%        fleet closed-loop rps
+                                                    (subprocess workers on
+                                                    a shared box — same
+                                                    drift class as the
+                                                    load leg)
+  serve_sat_w4_p95_ms         up-bad     50%        the widest fleet's
+                                                    tail; same class as
+                                                    serve_load_p95_ms
   multihost_process_tax       up-bad     75%        gloo/process overhead
                                                     on a 1-2 core CI box
                                                     is inherently noisy
@@ -70,13 +82,22 @@ LEGS = {
     "serve_load_requests_per_sec": (("serve", "load", "requests_per_sec"),
                                     "down", 0.40),
     "serve_load_p95_ms": (("serve", "load", "p95_ms"), "up", 0.50),
+    "serve_sat_w1_rps": (("serve", "saturation", "w1", "requests_per_sec"),
+                         "down", 0.40),
+    "serve_sat_w2_rps": (("serve", "saturation", "w2", "requests_per_sec"),
+                         "down", 0.40),
+    "serve_sat_w4_rps": (("serve", "saturation", "w4", "requests_per_sec"),
+                         "down", 0.40),
+    "serve_sat_w4_p95_ms": (("serve", "saturation", "w4", "p95_ms"),
+                            "up", 0.50),
     "multihost_process_tax": (("multihost", "process_tax"), "up", 0.75),
 }
 
 #: micro_dispatch overhead rows: generous bounds (warning-only — see the
 #: module docstring on session drift) on the documented <=5%-class rows
 MICRO_BOUND_PCT = 20.0
-MICRO_ROWS = ("telemetry", "health", "lineage", "spans", "export")
+MICRO_ROWS = ("telemetry", "health", "lineage", "spans", "export",
+              "adaptive")
 
 
 def _get(doc, path):

@@ -274,6 +274,137 @@ fi
 rm -rf "$sc_root"
 summary+=$(printf '%-34s %-4s %4ss' "serve_chaos_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Continuous-batching fleet smoke (srnn_tpu/serve pool + adaptive
+# windows): a REAL `--workers 2` fleet behind one front socket takes 12
+# tickets from two concurrent client processes, one worker is SIGKILLed
+# mid-load, and EVERY acknowledged ticket must still complete (the front
+# replays the corpse's journal suffix onto the survivor).  Afterwards
+# /healthz must agree (ok:true once healed, the death on the record),
+# `watch --service --once` must render the fleet rows, and the front's
+# metrics.prom must carry the death + replay counters.
+t0=$SECONDS
+ss_root=$(mktemp -d)
+ss_ok=1
+ss_port=$(python - <<'PY'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+PY
+)
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve --root "$ss_root/svc" \
+    --workers 2 --batch-window-s 0.25 --slo-p95-ms 2000 \
+    --metrics-port "$ss_port" > "$ss_root/serve.log" 2>&1 &
+ss_pid=$!
+up=0
+for _ in $(seq 1 300); do
+    if SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+            --socket "$ss_root/svc/serve.sock" --ping 2>/dev/null; then
+        up=1; break
+    fi
+    sleep 0.2
+done
+if [ "$up" -eq 1 ]; then
+    # two concurrent clients submit 6 tickets each (4 tenants spread
+    # sticky round-robin across both workers), drop a marker once their
+    # submits are ACKNOWLEDGED, hold at a barrier until the driver's
+    # SIGKILL has landed (so the kill is guaranteed mid-load: every
+    # admitted ticket still uncollected, the corpse's share stranded),
+    # then collect — BOTH clients' waits must still complete.
+    ss_clients=()
+    for half in 0 1; do
+        SRNN_SETUPS_PLATFORM=cpu python - "$ss_root/svc/serve.sock" \
+            "$half" "$ss_root/submitted.$half" "$ss_root/killed" \
+            >> "$ss_root/serve.log" 2>&1 <<'PY' &
+import os
+import sys
+import time
+from srnn_tpu.serve.client import ServiceClient
+sock, half = sys.argv[1], int(sys.argv[2])
+marker, barrier = sys.argv[3], sys.argv[4]
+c = ServiceClient(sock, retries=5, backoff_base_s=0.2, seed=half)
+tickets = [c.submit("fixpoint_density",
+                    {"seed": half * 6 + i, "trials": 32, "batch": 32},
+                    tenant=f"tn{(half * 6 + i) % 4}",
+                    idempotency_key=f"scale-{half}-{i}")
+           for i in range(6)]
+open(marker, "w").write("\n".join(tickets))
+deadline = time.monotonic() + 180
+while not os.path.exists(barrier):
+    assert time.monotonic() < deadline, "kill barrier never dropped"
+    time.sleep(0.2)
+for t in tickets:
+    assert c.wait(t, timeout_s=300) is not None, t
+PY
+        ss_clients+=($!)
+    done
+    marked=0
+    for _ in $(seq 1 300); do
+        if [ -f "$ss_root/submitted.0" ] && [ -f "$ss_root/submitted.1" ]; then
+            marked=1; break
+        fi
+        sleep 0.2
+    done
+    [ "$marked" -eq 1 ] || ss_ok=0
+    w0_pid=$(SRNN_SETUPS_PLATFORM=cpu python - "$ss_root/svc/serve.sock" \
+        2>>"$ss_root/serve.log" <<'PY'
+import sys
+from srnn_tpu.serve.client import ServiceClient
+print(ServiceClient(sys.argv[1]).stats()["fleet"]["w0"]["pid"])
+PY
+    )
+    if [ -n "$w0_pid" ]; then
+        kill -9 "$w0_pid" 2>/dev/null || ss_ok=0
+    else
+        ss_ok=0
+    fi
+    touch "$ss_root/killed"   # release the clients' collect barrier
+    wait "${ss_clients[0]}" || ss_ok=0
+    wait "${ss_clients[1]}" || ss_ok=0
+    # the fleet healed: healthz ok again, the death on the record, and
+    # the watch console renders the front + per-worker fleet rows
+    python - "$ss_port" >> "$ss_root/serve.log" 2>&1 <<'PY' || ss_ok=0
+import json, sys, urllib.request
+health = json.load(urllib.request.urlopen(
+    f"http://127.0.0.1:{int(sys.argv[1])}/healthz", timeout=5))
+assert health["ok"] is True, health
+assert health["deaths"] == 1 and health["replayed"] >= 1, health
+assert health["workers"]["0"]["ok"] is False, health
+assert health["workers"]["1"]["ok"] is True, health
+print("serve_scale_smoke: healthz loss-then-heal OK")
+PY
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.watch \
+        --service "$ss_root/svc/serve.sock" --once \
+        > "$ss_root/watch.json" 2>>"$ss_root/serve.log" || ss_ok=0
+    python - "$ss_root/watch.json" >> "$ss_root/serve.log" 2>&1 <<'PY' || ss_ok=0
+import json, sys
+svc = json.load(open(sys.argv[1]))["service"]
+front, fleet = svc["front"], svc["fleet"]
+assert front["completed"] == 12 and front["pending"] == 0, front
+assert front["deaths"] == 1 and front["replayed"] >= 1, front
+assert fleet["w0"]["alive"] is False, fleet
+assert fleet["w1"]["alive"] is True and fleet["w1"]["adaptive"], fleet
+print("serve_scale_smoke: watch --service fleet view OK")
+PY
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+        --socket "$ss_root/svc/serve.sock" --shutdown \
+        >> "$ss_root/serve.log" 2>&1 || ss_ok=0
+    wait "$ss_pid" || ss_ok=0
+    grep -q 'srnn_serve_worker_deaths_total 1' \
+        "$ss_root/svc/metrics.prom" || ss_ok=0
+    grep -Eq 'srnn_serve_worker_replays_total [1-9]' \
+        "$ss_root/svc/metrics.prom" || ss_ok=0
+else
+    ss_ok=0
+    kill -9 "$ss_pid" 2>/dev/null
+fi
+if [ "$ss_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("serve_scale_smoke")
+    tail -n 60 "$ss_root/serve.log"
+fi
+rm -rf "$ss_root"
+summary+=$(printf '%-34s %-4s %4ss' "serve_scale_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 # Distributed smoke (srnn_tpu/distributed/): a REAL 2-process CPU-mesh
 # launcher run (gloo collectives, process-0-gated host I/O) must end
 # bitwise-equal to the single-process run of the same config, write each
@@ -416,9 +547,9 @@ assert doc["otherData"]["processes"], "no process lanes"
 print("cost_smoke: Perfetto trace schema OK")
 PY
 fi
-python benchmarks/regress.py BENCH_r06.json --json \
+python benchmarks/regress.py BENCH_r07.json --json \
     > "$cost_root/regress.json" 2>>"$cost_root/out.log" || cost_ok=0
-python benchmarks/regress.py BENCH_r06.json --scale apps_per_chip=0.6 \
+python benchmarks/regress.py BENCH_r07.json --scale apps_per_chip=0.6 \
     >> "$cost_root/out.log" 2>&1
 if [ "$?" -ne 1 ]; then
     echo "cost_smoke: synthetic -30% row not flagged" >> "$cost_root/out.log"

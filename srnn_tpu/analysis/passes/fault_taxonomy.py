@@ -29,9 +29,14 @@ checks, statically:
     vice versa), and the serve chaos fault menu
     (``resilience/chaos.py``'s ``SERVE_FAULT_KINDS``) names only
     retryable kind VALUES (``T009`` — the injector must drill the retry
-    ladder, not silently exercise the fatal path).
+    ladder, not silently exercise the fatal path);
+  * the serve pool's worker-death menu (``serve/pool.py``'s
+    ``WORKER_DEATH_EXC``) names only CONNECTION-class exceptions
+    (``T010`` — the front treats these as "worker gone, replay its
+    journal suffix"; a computational or protocol exception in the tuple
+    would silently convert a reproducible bug into a replay storm).
 
-Codes: ``T001``–``T009`` above; ``T007`` when the supervisor module or
+Codes: ``T001``–``T010`` above; ``T007`` when the supervisor module or
 ``classify_fault`` itself cannot be located (stale registry).
 """
 
@@ -45,6 +50,7 @@ SUPERVISOR_REL = "srnn_tpu/resilience/supervisor.py"
 MAIN_REL = "srnn_tpu/setups/__main__.py"
 SERVICE_REL = "srnn_tpu/serve/service.py"
 CHAOS_REL = "srnn_tpu/resilience/chaos.py"
+POOL_REL = "srnn_tpu/serve/pool.py"
 WATCH_SCRIPTS = ("scripts/tpu_watch.sh", "scripts/tpu_window.sh")
 
 #: the taxonomy exception types whose raise sites must classify
@@ -52,6 +58,16 @@ WATCH_SCRIPTS = ("scripts/tpu_watch.sh", "scripts/tpu_window.sh")
 #: faults — chaos and bootstrap raise them, classify_fault must map them)
 TAXONOMY_EXCEPTIONS = ("StallError", "WriterError", "Preempted",
                        "HostLost", "CoordinatorTimeout")
+
+#: the exception classes that legitimately mean "the worker process is
+#: gone / unreachable" from the front's side of a Unix socket — the only
+#: names serve/pool.py's WORKER_DEATH_EXC may carry (TimeoutError is the
+#: deliberate stall-is-loss policy: a wedged worker is treated as dead)
+CONNECTION_EXCEPTIONS = frozenset({
+    "ConnectionRefusedError", "ConnectionResetError", "BrokenPipeError",
+    "FileNotFoundError", "TimeoutError", "ConnectionAbortedError",
+    "ConnectionError", "EOFError",
+})
 
 #: the canonical XLA/absl status vocabulary (status.proto)
 XLA_STATUSES = frozenset({
@@ -340,6 +356,32 @@ def run(ctx: AnalysisContext):
                                 "the supervisor — serve_dispatch_fault "
                                 "would drill the fatal path, not the "
                                 "retry ladder")
+
+    # T010: the pool front's worker-death menu is connection-class only
+    # (anything else in the tuple turns a reproducible fault into an
+    # unbounded replay ladder across surviving workers)
+    pool_mod = ctx.module(POOL_REL)
+    if pool_mod is not None:
+        tup = _name_tuple(pool_mod.tree, "WORKER_DEATH_EXC")
+        if tup is None:
+            yield Finding(
+                pass_id=PASS.id, code="T010", path=pool_mod.rel, line=1,
+                message="serve/pool.py has no module-level "
+                        "WORKER_DEATH_EXC tuple — the worker-death menu "
+                        "went unscannable; update the fault-taxonomy "
+                        "pass alongside the refactor")
+        else:
+            line, names = tup
+            for name in names:
+                if name not in CONNECTION_EXCEPTIONS:
+                    yield Finding(
+                        pass_id=PASS.id, code="T010", path=pool_mod.rel,
+                        line=line,
+                        message=f"WORKER_DEATH_EXC names {name}, which is "
+                                "not a connection-class exception — the "
+                                "front would reclassify a reproducible "
+                                "fault as a worker death and replay it "
+                                "fleet-wide")
 
 
 PASS = PassSpec(

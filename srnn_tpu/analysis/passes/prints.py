@@ -28,6 +28,8 @@ ALLOWED_FILES = {
                              # its product (snapshots + refresh frames)
     "analysis/__main__.py",  # CLI: this analyzer's own report output
     "serve/__main__.py",     # CLI: service startup line + stats JSON
+    "serve/pool.py",         # CLI tier: the fleet front's [w<i>] worker
+                             # relay + lifecycle lines are its stdout job
     "distributed/launch.py",  # CLI: worker-output relay IS its stdout job
 }
 #: CLI entry-point trees (every setup is a __main__-dispatched script)

@@ -23,11 +23,27 @@ package serves them instead (ROADMAP item 4):
     bisection), admission control (``max_queue`` ->
     :class:`OverloadedError`), per-ticket deadlines, and graceful
     SIGTERM drain around it.
+  * ``serve.controller`` — the continuous-batching tier (PR 16): the
+    dispatcher blocks on admission instead of poll-sleeping, and the
+    :class:`AdaptiveWindowController` adapts each scheduler group's
+    batching window against ``--slo-p95-ms`` (shrink on SLO burn, grow
+    toward the ``--batch-window-s`` ceiling when clean) — deterministic
+    given the same arrival trace; ``--no-adaptive`` is the fixed-window
+    A/B oracle.
+  * ``serve.pool`` — multi-worker scale-out (``--workers N``): N
+    dispatch processes behind one front socket, sharing the persistent
+    AOT cache, with sticky per-tenant round-robin assignment and the
+    journal as the shared-nothing recovery substrate — any worker can
+    replay any admitted ticket, so a worker killed mid-load heals
+    without losing acknowledged work.
 """
 
 from .client import ServiceClient, ServiceError, ServiceOverloaded
+from .controller import AdaptiveWindowController, make_controller
 from .journal import TicketJournal, read_journal
-from .scheduler import DEFAULT_MAX_STACK, Request, plan_dispatches
+from .pool import ServicePool, WorkerHandle
+from .scheduler import (DEFAULT_MAX_STACK, Request, interleave_tenants,
+                        plan_dispatches)
 from .service import (DeadlineExpired, ExperimentService, OverloadedError)
 from .tenant import (evolve_multi_stacked, evolve_multi_stacked_donated,
                      evolve_stacked, evolve_stacked_captured,
@@ -36,6 +52,7 @@ from .tenant import (evolve_multi_stacked, evolve_multi_stacked_donated,
                      seed_stacked, stack_tenants, unstack_tenants)
 
 __all__ = [
+    "AdaptiveWindowController",
     "DEFAULT_MAX_STACK",
     "DeadlineExpired",
     "ExperimentService",
@@ -44,7 +61,9 @@ __all__ = [
     "ServiceClient",
     "ServiceError",
     "ServiceOverloaded",
+    "ServicePool",
     "TicketJournal",
+    "WorkerHandle",
     "read_journal",
     "evolve_multi_stacked",
     "evolve_multi_stacked_donated",
@@ -54,6 +73,8 @@ __all__ = [
     "evolve_stacked_step",
     "evolve_stacked_step_donated",
     "init_population_stacked",
+    "interleave_tenants",
+    "make_controller",
     "plan_dispatches",
     "seed_stacked",
     "stack_tenants",

@@ -27,8 +27,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-stack", type=int, default=8, metavar="K",
                    help="most tenants per stacked dispatch")
     p.add_argument("--batch-window-s", type=float, default=0.25, metavar="S",
-                   help="requests arriving within S seconds of each other "
-                        "are scheduled together (the stacking window)")
+                   help="the stacking window CEILING: the adaptive "
+                        "controller grows each scheduler group's dispatch "
+                        "window toward S when the SLO has headroom and "
+                        "shrinks it under burn; with --no-adaptive, the "
+                        "fixed per-cycle window (the PR 10 behavior)")
+    p.add_argument("--no-adaptive", action="store_true",
+                   help="disable the continuous-batching controller (and "
+                        "the tenant-fairness plan that ships with it): "
+                        "the dispatcher sleeps the fixed --batch-window-s "
+                        "every cycle — the A/B oracle that reproduces the "
+                        "fixed-window results bitwise")
+    p.add_argument("--workers", type=int, default=1, metavar="N",
+                   help="dispatch processes: N>1 runs a worker fleet "
+                        "behind this socket (shared AOT cache, per-tenant "
+                        "sticky round-robin, journal-backed replay when a "
+                        "worker dies; see serve/pool.py)")
     p.add_argument("--slo-p95-ms", type=float, default=0.0, metavar="MS",
                    help="latency target: each request slower than MS "
                         "counts into serve_slo_violations_total and the "
@@ -102,6 +116,27 @@ def main(argv=None) -> int:
         except (OSError, ServiceError) as e:
             print(f"serve client: {e}", file=sys.stderr)
             return 1
+
+    if args.workers > 1:
+        # fleet mode: the front process stays jax-free (the launcher
+        # tier's discipline) — each worker is a full solo service on its
+        # own sub-root, admission/replay live in serve/pool.py
+        from .pool import run_pool
+
+        worker_args = ["--max-stack", str(args.max_stack),
+                       "--batch-window-s", str(args.batch_window_s),
+                       "--slo-p95-ms", str(args.slo_p95_ms),
+                       "--results-ttl-s", str(args.results_ttl_s),
+                       "--dispatch-retries", str(args.dispatch_retries),
+                       "--retry-backoff-s", str(args.retry_backoff_s)]
+        if args.no_adaptive:
+            worker_args.append("--no-adaptive")
+        if args.warm_fixpoint_density:
+            worker_args += ["--warm-fixpoint-density",
+                            args.warm_fixpoint_density]
+        if args.chaos:
+            worker_args += ["--chaos", args.chaos]
+        return run_pool(args, worker_args)
 
     if os.environ.get("SRNN_SETUPS_PLATFORM") == "cpu":
         # config-level CPU pin for subprocess callers (tests, CI) — the
@@ -178,14 +213,20 @@ def main(argv=None) -> int:
         trials, batch = (int(x) for x in
                          args.warm_fixpoint_density.split(","))
         service.warm("fixpoint_density", {"trials": trials, "batch": batch})
+    from .controller import make_controller
+
+    controller = make_controller(args.batch_window_s, args.slo_p95_ms,
+                                 adaptive=not args.no_adaptive)
     server = ServiceServer(service, sock,
-                           batch_window_s=args.batch_window_s)
+                           batch_window_s=args.batch_window_s,
+                           controller=controller)
     # SIGTERM is the preemption signal (the supervisor tier's contract):
     # drain gracefully — finish in flight, journal the rest, exit clean
     prev = signal.signal(signal.SIGTERM, lambda *_: server.stop(drain=True))
     print(f"serve: listening on {sock} (root={args.root}, "
           f"max_stack={args.max_stack}, "
-          f"batch_window_s={args.batch_window_s}"
+          f"batch_window_s={args.batch_window_s}, "
+          f"dispatch={'adaptive' if controller else 'fixed'}"
           + (f", max_queue={args.max_queue}" if args.max_queue else "")
           + (f", chaos={args.chaos}" if args.chaos else "") + ")",
           flush=True)

@@ -34,8 +34,12 @@ class ServiceOverloaded(ServiceError):
 
 
 #: failures where the op can never have REACHED the service (the connect
-#: itself failed) — always safe to retry
-_RETRY_SAFE_EXC = (ConnectionRefusedError, FileNotFoundError)
+#: itself failed) — always safe to retry.  BlockingIOError is Linux's
+#: EAGAIN from connect(2) on an AF_UNIX socket whose listen backlog is
+#: full (a burst of per-op connects against a momentarily stalled accept
+#: loop): nothing was delivered
+_RETRY_SAFE_EXC = (ConnectionRefusedError, FileNotFoundError,
+                   BlockingIOError)
 #: failures where the op may have been DELIVERED before the connection
 #: died — retried only for idempotent messages (reads, or admissions
 #: carrying an ``idempotency_key`` the service dedupes on); a keyless

@@ -1,0 +1,732 @@
+"""Multi-worker serve fleet: N dispatch processes behind one front socket.
+
+``python -m srnn_tpu.serve --workers N`` turns the single-process
+experiment service into a fleet: the FRONT process (this module — pure
+host logic, no jax, the launcher tier's discipline) binds the public
+socket, owns admission, and forwards tickets to N WORKER processes, each
+a full ``python -m srnn_tpu.serve`` service on its own sub-root with its
+own journal, dispatch thread, adaptive window controller, and a SHARED
+persistent AOT cache (``utils.aot.ensure_compilation_cache`` — the env
+is inherited, so worker 2's first soup dispatch deserializes the
+executable worker 1 compiled).
+
+Recovery topology (the PR 13 journal as the shared-nothing substrate):
+
+  * the front journals every admission (append+fsync BEFORE the ticket
+    id is acknowledged — the same contract the solo service keeps), so
+    an acknowledged ticket survives even a ``kill -9`` of the front; a
+    restarted front replays its journal and re-forwards.
+  * each forward carries ``idempotency_key="pool:<front-ticket>"``, so
+    worker journals speak front ticket ids.  Any worker can therefore
+    replay any admitted ticket: when a worker DIES mid-load (SIGKILL,
+    OOM, chaos), the front reads the dead worker's journal suffix
+    (``journal.read_journal`` on its sub-root — the dead process needs
+    no cooperation), maps the unfinished entries back to front tickets,
+    and resubmits them to the survivors.  Acknowledged work is never
+    lost; the executors are deterministic functions of the journaled
+    params, so replayed results are bitwise-equal.
+  * ``/healthz`` tells the story live: ``ok`` is false while any
+    admitted ticket is stranded on a dead worker and true again once the
+    survivors have absorbed the replays (the loss, then the heal); the
+    per-worker ``workers`` map keeps showing the corpse.
+
+Fairness: tenants are assigned to workers STICKY round-robin by first
+appearance (a tenant's tickets land on one worker while it lives, so
+same-spelling tickets still stack; tenants spread across the fleet), and
+each worker runs the service-level fair plan (``scheduler.plan_dispatches
+(fair=True)``) within its own queue.
+
+Process discipline is the PR 11 launcher's: workers spawn with relayed
+``[w<i>]`` output prefixes, reap with terminate-then-kill
+(``distributed.launch._reap``), and the front's exit code never reports
+success over a worker it had to kill.
+"""
+
+import itertools
+import json
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..distributed.launch import _reap
+from ..utils.pipeline import spawn_thread
+from .client import ServiceClient
+from .journal import TicketJournal, read_journal
+from .server import _Handler
+
+#: connection-class failures that mean "this worker is gone" when talking
+#: to a worker socket — the trigger for the death/replay ladder.  The
+#: fault-taxonomy srnnlint pass (T010) checks every member is a
+#: connection-class exception: a value error must never be read as a
+#: worker death, or the replay ladder would double-run real work.
+WORKER_DEATH_EXC = (ConnectionRefusedError, FileNotFoundError,
+                    ConnectionResetError, BrokenPipeError, TimeoutError)
+
+#: monitor cadence: how often worker processes are polled for death
+POLL_S = 0.25
+#: fleet gauge / history-sample refresh cadence (the live plane's turn)
+SAMPLE_S = 5.0
+
+
+class WorkerHandle:
+    """One spawned worker process + its client-side state."""
+
+    def __init__(self, index: int, root: str, socket_path: str,
+                 proc: subprocess.Popen):
+        self.index = index
+        self.root = root
+        self.socket_path = socket_path
+        self.proc = proc
+        self.alive = True
+        self.client = ServiceClient(socket_path)
+
+
+def spawn_worker(index: int, root: str, worker_args: List[str],
+                 module: str = "srnn_tpu.serve") -> WorkerHandle:
+    """Spawn worker ``index`` on ``<root>/workers/w<i>`` with a relayed
+    ``[w<i>]`` output prefix (the launcher's ``[p<i>]`` idiom)."""
+    wroot = os.path.join(root, "workers", f"w{index}")
+    wsock = os.path.join(root, "workers", f"w{index}.sock")
+    os.makedirs(os.path.dirname(wroot), exist_ok=True)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", module, "--root", wroot,
+         "--socket", wsock, *worker_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    def relay():
+        for line in proc.stdout:
+            print(f"[w{index}] {line.rstrip()}", flush=True)
+
+    spawn_thread(relay, name=f"pool-relay-w{index}")
+    return WorkerHandle(index, wroot, wsock, proc)
+
+
+class ServicePool:
+    """The front: admission + forwarding + death/replay over N workers."""
+
+    def __init__(self, root: str, workers: List[WorkerHandle],
+                 registry=None, max_queue: int = 0, history=None,
+                 engine=None):
+        from ..telemetry.metrics import MetricsRegistry
+
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        self.workers = list(workers)
+        self.max_queue = max(0, int(max_queue))
+        self.registry = registry or MetricsRegistry()
+        self.journal = TicketJournal(root)
+        self._history = history
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._done_cv = threading.Condition(self._lock)
+        #: front ticket -> {"kind","params","tenant","worker","key",
+        #: "deadline_s","replays"} for every admitted-not-yet-collected
+        self._tickets: Dict[str, dict] = {}
+        self._idem: Dict[str, str] = {}
+        self._tenant_worker: Dict[str, int] = {}
+        self._rr = 0
+        self._counter = itertools.count(1)
+        self._admitted = 0
+        self._completed = 0
+        self._replayed = 0
+        self._deaths = 0
+        self._draining = False
+        self._stop = threading.Event()
+        self._events = open(os.path.join(root, "events.jsonl"), "a")
+        self._events_lock = threading.Lock()
+        self._t0 = time.monotonic()
+        # eager zeros, the serve counters' discipline: a clean fleet
+        # scrapes 0 deaths/replays, not missing series
+        self.registry.counter("serve_worker_deaths_total",
+                              help="worker processes lost (crash/kill)")
+        self.registry.counter(
+            "serve_worker_replays_total",
+            help="admitted tickets resubmitted to surviving workers "
+                 "after a worker death")
+        self._set_worker_gauge()
+        self._monitor = spawn_thread(self._monitor_loop,
+                                     name="pool-monitor")
+
+    # -- admission / results ---------------------------------------------
+
+    def submit(self, kind: str, params: dict,
+               tenant: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               idempotency_key: Optional[str] = None) -> str:
+        """Admit one ticket at the front (durable-before-acknowledged,
+        the solo service's contract) and forward it to its tenant's
+        worker.  The front is the fleet's admission authority: workers
+        run unbounded queues; ``max_queue`` bounds the ADMITTED-not-
+        collected set here."""
+        from .service import OverloadedError
+
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("service shutting down")
+            if idempotency_key:
+                known = self._idem.get(idempotency_key)
+                if known is not None:
+                    return known
+            depth = len(self._tickets)
+            if self.max_queue and depth >= self.max_queue:
+                self.registry.counter(
+                    "serve_overload_rejections_total",
+                    help="submits rejected at admission "
+                         "(--max-queue)").inc(1, kind=kind)
+                raise OverloadedError(
+                    f"queue full ({depth} >= max_queue={self.max_queue}); "
+                    "back off and resubmit")
+            ticket = f"t{next(self._counter):06d}"
+            tenant = tenant or ticket
+            self.journal.record_submit(
+                ticket=ticket, kind=kind, params=dict(params),
+                tenant=tenant, key=idempotency_key,
+                deadline_wall=(time.time() + float(deadline_s)
+                               if deadline_s is not None else None),
+                wall=time.time())
+            self._tickets[ticket] = {
+                "kind": kind, "params": dict(params), "tenant": tenant,
+                "worker": None, "worker_ticket": None,
+                "deadline_s": deadline_s, "replays": 0,
+                "key": idempotency_key}
+            if idempotency_key:
+                self._idem[idempotency_key] = ticket
+            self._admitted += 1
+        self.registry.counter("serve_requests_total",
+                              help="experiment requests accepted").inc(
+                                  1, kind=kind)
+        self.registry.gauge(
+            "serve_queue_depth",
+            help="requests queued, not yet dispatched").set(
+                self.queue_depth())
+        self._forward(ticket)
+        return ticket
+
+    def _pick_worker(self, tenant: str) -> Optional[WorkerHandle]:
+        """Sticky per-tenant round-robin over the LIVE workers."""
+        with self._lock:
+            alive = [w for w in self.workers if w.alive]
+            if not alive:
+                return None
+            idx = self._tenant_worker.get(tenant)
+            w = next((x for x in alive if x.index == idx), None)
+            if w is None:
+                w = alive[self._rr % len(alive)]
+                self._rr += 1
+                self._tenant_worker[tenant] = w.index
+            return w
+
+    def _forward(self, ticket: str) -> None:
+        """Send ``ticket`` to its tenant's worker; a worker dying under
+        the forward routes through the death ladder and the next
+        survivor takes the ticket (bounded by the fleet size)."""
+        from .client import ServiceError
+
+        for _ in range(len(self.workers) + 1):
+            with self._lock:
+                ent = self._tickets.get(ticket)
+            if ent is None:
+                return   # collected (a racing wait) — nothing to do
+            w = self._pick_worker(ent["tenant"])
+            if w is None:
+                self._resolve_failed(ticket, "no live workers")
+                return
+            try:
+                wt = w.client.submit(ent["kind"], ent["params"],
+                                     tenant=ent["tenant"],
+                                     deadline_s=ent["deadline_s"],
+                                     idempotency_key=f"pool:{ticket}")
+                with self._done_cv:
+                    if ticket in self._tickets:
+                        self._tickets[ticket]["worker"] = w.index
+                        self._tickets[ticket]["worker_ticket"] = wt
+                    self._done_cv.notify_all()
+                return
+            except WORKER_DEATH_EXC:
+                self._note_death(w.index)
+            except ServiceError as e:
+                self._resolve_failed(ticket, str(e))
+                return
+        self._resolve_failed(ticket, "no live workers")
+
+    def _resolve_failed(self, ticket: str, error: str) -> None:
+        with self._lock:
+            ent = self._tickets.get(ticket)
+            if ent is None:
+                return
+            ent["worker"] = None
+            ent["failed"] = {"status": "failed", "error": error,
+                             "mode": "none"}
+            self._done_cv.notify_all()
+
+    def wait(self, ticket: str, timeout_s: float = 600.0) -> dict:
+        """Block until ``ticket`` completes; CONSUMES the entry (the solo
+        service's contract).  Rides out worker deaths: a connection that
+        dies mid-wait triggers the replay ladder and the wait re-targets
+        wherever the ticket landed."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(f"request {ticket} still pending "
+                                   f"after {timeout_s}s")
+            with self._lock:
+                ent = self._tickets.get(ticket)
+                if ent is None:
+                    raise KeyError(f"unknown ticket {ticket!r}")
+                if "failed" in ent:
+                    entry = dict(ent["failed"])
+                    self._finish_locked(ticket, "failed")
+                    return entry
+                widx, wticket = ent["worker"], ent["worker_ticket"]
+            if widx is None or wticket is None:
+                # forward still in flight (or mid-replay): wait for it
+                with self._done_cv:
+                    self._done_cv.wait(timeout=min(0.2, left))
+                continue
+            w = self.workers[widx]
+            try:
+                resp = _raw_op(w.socket_path,
+                               {"op": "wait", "ticket": wticket,
+                                "timeout_s": min(left, 60.0)},
+                               timeout_s=min(left, 60.0) + 10.0)
+            except WORKER_DEATH_EXC:
+                self._note_death(widx)
+                continue
+            if resp.get("status") in ("done", "failed"):
+                with self._lock:
+                    self._finish_locked(ticket, resp["status"])
+                entry = {k: v for k, v in resp.items()
+                         if k not in ("ok", "ticket")}
+                return entry
+            # service-side timeout (clean ok:false, still pending) or a
+            # transient error string: loop and re-check the deadline
+
+    def _finish_locked(self, ticket: str, status: str) -> None:
+        ent = self._tickets.pop(ticket, None)
+        if ent is None:
+            return
+        self._completed += 1
+        self.journal.record_done([ticket], status)
+        if ent.get("key"):
+            self._idem.pop(ent["key"], None)
+        self.registry.gauge(
+            "serve_queue_depth",
+            help="requests queued, not yet dispatched").set(
+                len(self._tickets))
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._tickets)
+
+    def recover(self) -> int:
+        """Replay the FRONT journal after a front restart: unfinished
+        admissions re-enter the tickets table under their original ids
+        and re-forward.  (Workers recover their own journals themselves
+        at startup — this is the front's half of the topology.)"""
+        entries, torn, next_ticket = self.journal.recover()
+        replayed = []
+        with self._lock:
+            self._counter = itertools.count(next_ticket)
+            for e in entries:
+                deadline_s = None
+                if e.deadline_wall is not None:
+                    deadline_s = float(e.deadline_wall) - time.time()
+                self._tickets[e.ticket] = {
+                    "kind": e.kind, "params": dict(e.params),
+                    "tenant": e.tenant, "worker": None,
+                    "worker_ticket": None, "deadline_s": deadline_s,
+                    "replays": 0, "key": e.key}
+                if e.key:
+                    self._idem[e.key] = e.ticket
+                replayed.append(e.ticket)
+            self._admitted += len(replayed)
+        for t in replayed:
+            self._forward(t)
+        if replayed:
+            self._event_row(kind="pool_replay", source="front_journal",
+                            tickets=replayed, torn_tail=torn or None)
+        return len(replayed)
+
+    # -- death / replay ladder -------------------------------------------
+
+    def _note_death(self, index: int) -> None:
+        """The fleet's heal: mark worker ``index`` dead (idempotent),
+        reap its process, read its journal's unfinished suffix, and
+        resubmit every stranded admitted ticket to the survivors."""
+        with self._lock:
+            w = self.workers[index]
+            if not w.alive:
+                return
+            w.alive = False
+            self._deaths += 1
+            stranded = [t for t, ent in self._tickets.items()
+                        if ent["worker"] == index]
+            for t in stranded:
+                self._tickets[t]["worker"] = None
+                self._tickets[t]["worker_ticket"] = None
+                self._tickets[t]["replays"] += 1
+        self.registry.counter(
+            "serve_worker_deaths_total",
+            help="worker processes lost (crash/kill)").inc(1)
+        self._set_worker_gauge()
+        _reap([w.proc], set())
+        # the shared-nothing story: the DEAD worker's journal names every
+        # ticket it had admitted but not finished — read it without any
+        # cooperation from the corpse, map keys back to front tickets
+        from_journal: List[str] = []
+        try:
+            unfinished, _torn, _next = read_journal(
+                os.path.join(w.root, "journal.jsonl"))
+            from_journal = [e.key[len("pool:"):] for e in unfinished
+                            if e.key and e.key.startswith("pool:")]
+        except OSError:
+            pass
+        replay = sorted(set(stranded) | set(from_journal))
+        replay = [t for t in replay if t in self._tickets]
+        self._event_row(kind="pool_worker_death", worker=index,
+                        pid=w.proc.pid,
+                        journal_unfinished=len(from_journal),
+                        replaying=len(replay))
+        print(f"serve pool: worker w{index} died; replaying "
+              f"{len(replay)} ticket(s) onto the survivors", flush=True)
+        if replay:
+            with self._lock:
+                self._replayed += len(replay)
+            self.registry.counter(
+                "serve_worker_replays_total",
+                help="admitted tickets resubmitted to surviving workers "
+                     "after a worker death").inc(len(replay))
+        for t in replay:
+            self._forward(t)
+        with self._done_cv:
+            self._done_cv.notify_all()
+
+    def _set_worker_gauge(self) -> None:
+        with self._lock:
+            alive = sum(1 for w in self.workers if w.alive)
+        self.registry.gauge("serve_workers",
+                            help="live worker processes").set(alive)
+
+    def _monitor_loop(self) -> None:
+        """Poll worker liveness (the death ladder's detector for workers
+        nobody is talking to) and refresh the fleet gauges + the live
+        telemetry plane on the sample cadence."""
+        last_sample = float("-inf")
+        while not self._stop.is_set():
+            for w in list(self.workers):
+                if w.alive and w.proc.poll() is not None:
+                    self._note_death(w.index)
+            now = time.monotonic()
+            if now - last_sample >= SAMPLE_S:
+                last_sample = now
+                self._refresh_fleet_gauges()
+                if self._history is not None:
+                    try:
+                        self._history.sample()
+                        if self._engine is not None:
+                            for tr in self._engine.evaluate():
+                                self._event_row(kind="alert", **tr)
+                    except Exception as e:  # pragma: no cover - defensive
+                        print(f"serve pool: live telemetry sample failed:"
+                              f" {type(e).__name__}: {e}",
+                              file=sys.stderr, flush=True)
+            self._stop.wait(POLL_S)
+
+    def _refresh_fleet_gauges(self) -> None:
+        g = self.registry.gauge(
+            "serve_worker_queue_depth",
+            help="per-worker dispatch queue depth")
+        for w in list(self.workers):
+            if not w.alive:
+                continue
+            try:
+                st = w.client.stats()
+            except Exception:
+                continue
+            g.set(st.get("queue_depth", 0), worker=f"w{w.index}")
+
+    # -- views -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Fleet snapshot: front admission state + one row per worker
+        (queue depth, in-flight slots, adaptive window, replay counts) —
+        the shape ``watch --service`` renders."""
+        with self._lock:
+            depth = len(self._tickets)
+            front = {"admitted": self._admitted,
+                     "completed": self._completed,
+                     "pending": depth, "replayed": self._replayed,
+                     "deaths": self._deaths,
+                     "workers": sum(1 for w in self.workers if w.alive),
+                     "max_queue": self.max_queue or None}
+        fleet = {}
+        for w in list(self.workers):
+            row = {"alive": w.alive, "pid": w.proc.pid}
+            if w.alive:
+                try:
+                    st = w.client.stats()
+                    row.update(
+                        queue_depth=st.get("queue_depth"),
+                        completed=st.get("completed"),
+                        inflight=_metric_sum(st, "serve_inflight_requests"),
+                        window_s=(st.get("dispatch") or {}).get(
+                            "window_min_s"),
+                        adaptive=(st.get("dispatch") or {}).get(
+                            "adaptive"),
+                        replayed=(st.get("self_healing") or {}).get(
+                            "replayed"))
+                except Exception as e:
+                    row["error"] = f"{type(e).__name__}: {e}"
+            fleet[f"w{w.index}"] = row
+        alerts = None
+        if self._engine is not None:
+            alerts = {"active": self._engine.active()}
+        return {"completed": front["completed"], "queue_depth": depth,
+                "uptime_s": round(time.monotonic() - self._t0, 2),
+                "front": front, "fleet": fleet, "alerts": alerts,
+                "metrics": self.registry.rows()}
+
+    def healthz(self) -> dict:
+        """The loss-then-heal contract: ``ok`` is false while any
+        admitted ticket is stranded on a dead worker (between the death
+        and the survivors absorbing its replays) and true again after
+        the heal; dead workers stay visible in ``workers``."""
+        with self._lock:
+            stranded = sum(
+                1 for ent in self._tickets.values()
+                if ent["worker"] is not None
+                and not self.workers[ent["worker"]].alive)
+            unassigned = sum(1 for ent in self._tickets.values()
+                             if ent["worker"] is None
+                             and "failed" not in ent)
+            workers = {str(w.index): {"ok": w.alive, "pid": w.proc.pid}
+                       for w in self.workers}
+            any_alive = any(w.alive for w in self.workers)
+        return {"ok": bool(any_alive and not stranded and not unassigned),
+                "workers": workers, "stranded": stranded + unassigned,
+                "deaths": self._deaths, "replayed": self._replayed,
+                "queue_depth": self.queue_depth()}
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _event_row(self, **fields) -> None:
+        fields.setdefault("t", round(time.monotonic() - self._t0, 4))
+        fields = {k: v for k, v in fields.items() if v is not None}
+        with self._events_lock:
+            self._events.write(json.dumps(fields) + "\n")
+            self._events.flush()
+
+    def close(self, drain: bool = False) -> None:
+        """Stop the fleet: drain (or shut down) every live worker, reap
+        stragglers with the launcher's terminate-then-kill, publish the
+        front's metrics.prom."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._stop.set()
+        self._monitor.join(timeout=10)
+        for w in self.workers:
+            if not w.alive:
+                continue
+            try:
+                if drain:
+                    w.client.drain()
+                else:
+                    w.client.shutdown()
+            except (OSError, RuntimeError):
+                pass
+        _reap([w.proc for w in self.workers], set())
+        self.registry.write_textfile(os.path.join(self.root,
+                                                  "metrics.prom"))
+        self.journal.close()
+        with self._events_lock:
+            self._events.close()
+
+
+def _metric_sum(stats: dict, name: str):
+    """Sum a metric's label sets out of a stats() ``metrics`` rows dict
+    (rows are keyed ``name{labels}`` flat strings)."""
+    rows = stats.get("metrics") or {}
+    vals = [v for k, v in rows.items()
+            if k == name or k.startswith(name + "{")]
+    return sum(vals) if vals else None
+
+
+def _raw_op(socket_path: str, msg: dict, timeout_s: float = 60.0) -> dict:
+    """One worker op returning the parsed response REGARDLESS of ``ok``
+    (the front's proxied wait needs failed entries verbatim, where
+    ``ServiceClient`` would raise them away)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(timeout_s)
+        s.connect(socket_path)
+        s.sendall((json.dumps(msg) + "\n").encode())
+        line = s.makefile("rb").readline()
+    if not line:
+        raise ConnectionResetError("worker closed the connection mid-op")
+    return json.loads(line.decode("utf-8", "replace"))
+
+
+class PoolServer(socketserver.ThreadingMixIn,
+                 socketserver.UnixStreamServer):
+    """The front transport: the SAME one-JSON-line-per-op protocol as
+    ``ServiceServer`` (clients cannot tell a fleet from a solo service),
+    delegating to a :class:`ServicePool`."""
+
+    daemon_threads = False
+    allow_reuse_address = True
+    # the client opens one connection PER OP, so a burst of concurrent
+    # clients is a burst of simultaneous connects; socketserver's default
+    # backlog of 5 overflows whenever the accept loop stalls (e.g. a
+    # worker-death replay) and Linux fails the connect with EAGAIN
+    request_queue_size = 128
+
+    def __init__(self, pool: ServicePool, socket_path: str):
+        from .server import wait_for_socket
+
+        if os.path.exists(socket_path):
+            if wait_for_socket(socket_path, timeout_s=0.0):
+                raise RuntimeError(
+                    f"a live experiment service already answers on "
+                    f"{socket_path}; refusing to steal its socket")
+            os.unlink(socket_path)
+        super().__init__(socket_path, _Handler)
+        self.pool = pool
+        self.socket_path = socket_path
+        self._stop = threading.Event()
+        self._drain = threading.Event()
+
+    def handle_op(self, msg: dict) -> dict:
+        from .service import DeadlineExpired, OverloadedError
+
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True}
+        if op in ("submit", "request"):
+            if self._stop.is_set():
+                return {"ok": False, "error": "service shutting down"}
+            try:
+                ticket = self.pool.submit(
+                    msg["kind"], msg.get("params", {}),
+                    tenant=msg.get("tenant"),
+                    deadline_s=msg.get("deadline_s"),
+                    idempotency_key=msg.get("idempotency_key"))
+            except OverloadedError as e:
+                return {"ok": False, "error": str(e), "overloaded": True}
+            except DeadlineExpired as e:
+                return {"ok": False, "error": str(e),
+                        "deadline_expired": True}
+            if op == "submit":
+                return {"ok": True, "ticket": ticket}
+            msg = dict(msg, ticket=ticket)
+        if op in ("wait", "request"):
+            ticket = msg["ticket"]
+            try:
+                entry = self.pool.wait(
+                    ticket, timeout_s=float(msg.get("timeout_s", 600.0)))
+            except (KeyError, TimeoutError) as e:
+                return {"ok": False, "ticket": ticket, "error": str(e)}
+            out = {"ok": entry.get("status") == "done", "ticket": ticket}
+            out.update(entry)
+            return out
+        if op == "stats":
+            return {"ok": True, "stats": self.pool.stats()}
+        if op == "drain":
+            self.stop(drain=True)
+            return {"ok": True, "bye": True, "draining": True}
+        if op == "shutdown":
+            self.stop()
+            return {"ok": True, "bye": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def stop(self, drain: bool = False) -> None:
+        if drain:
+            self._drain.set()
+        self._stop.set()
+        spawn_thread(self.shutdown, name="pool-stop")
+
+    def serve_until_shutdown(self) -> None:
+        try:
+            self.serve_forever(poll_interval=0.1)
+        finally:
+            self._stop.set()
+            self.pool.close(drain=self._drain.is_set())
+            self.server_close()
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+
+def run_pool(args, worker_args: List[str]) -> int:
+    """``python -m srnn_tpu.serve --workers N`` server mode: spawn the
+    fleet, bind the front socket, serve until shutdown/SIGTERM (SIGTERM
+    drains: workers journal their queues and a restart resumes)."""
+    os.makedirs(args.root, exist_ok=True)
+    sock = args.socket or os.path.join(args.root, "serve.sock")
+    workers = [spawn_worker(i, args.root, worker_args)
+               for i in range(args.workers)]
+    try:
+        for w in workers:
+            w.client.wait_until_up(timeout_s=180.0)
+    except TimeoutError as e:
+        _reap([w.proc for w in workers], set())
+        raise SystemExit(f"serve pool: {e}")
+    from ..telemetry.alerts import (AlertEngine, default_pool_rules,
+                                    default_serve_rules)
+    from ..telemetry.metrics import MetricsRegistry
+    from ..telemetry.timeseries import MetricHistory
+
+    registry = MetricsRegistry()
+    history = MetricHistory(
+        registry, path=os.path.join(args.root, "metrics_history.jsonl"))
+    engine = AlertEngine(
+        default_serve_rules(max_queue=args.max_queue)
+        + default_pool_rules(workers=args.workers),
+        registry, history)
+    pool = ServicePool(args.root, workers, registry=registry,
+                       max_queue=args.max_queue, history=history,
+                       engine=engine)
+    exporter = None
+    if args.metrics_port:
+        from ..telemetry.exporter import MetricsExporter
+
+        try:
+            exporter = MetricsExporter(registry, port=args.metrics_port,
+                                       healthz=pool.healthz)
+            print(f"serve pool: /metrics + /healthz live on "
+                  f"{exporter.url}", flush=True)
+        except OSError as e:
+            print(f"serve pool: metrics exporter bind failed on "
+                  f":{args.metrics_port} ({e}); continuing without the "
+                  "live endpoint", flush=True)
+    replayed = pool.recover()
+    if replayed:
+        print(f"serve pool: replayed {replayed} journaled ticket(s) "
+              "from a previous front", flush=True)
+    server = PoolServer(pool, sock)
+    prev = signal.signal(signal.SIGTERM,
+                         lambda *_: server.stop(drain=True))
+    print(f"serve pool: listening on {sock} (root={args.root}, "
+          f"workers={args.workers}"
+          + (f", max_queue={args.max_queue}" if args.max_queue else "")
+          + ")", flush=True)
+    try:
+        server.serve_until_shutdown()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        if exporter is not None:
+            exporter.close()
+        history.close()
+    pending = pool.queue_depth()
+    if pending:
+        print(f"serve pool: exiting with {pending} ticket(s) journaled "
+              "for replay on restart", flush=True)
+    return 0

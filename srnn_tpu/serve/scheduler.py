@@ -9,6 +9,16 @@ epsilons), so K of them stack into one ``(K, ...)`` tenant-axis dispatch
 exact per-tenant program, so fallback never changes results, only
 amortization.
 
+Fairness (the continuous-batching tier's policy, ``fair=True``): a hog
+tenant flooding one spelling must not starve other tenants' tickets for
+whole drain cycles.  Two stable transforms, both deterministic given the
+same queue snapshot: :func:`interleave_tenants` reorders the batch
+round-robin by tenant (round r takes each tenant's r-th request, tenants
+in first-appearance order) BEFORE grouping, and the planner then emits
+chunks round-robin ACROSS groups (chunk 0 of every group, then chunk 1,
+...) so one group's long chunk train cannot push another group's first
+chunk to the end of the drain.
+
 Pure host logic, no jax: the service owns execution; this module only
 decides who rides together.
 """
@@ -47,8 +57,33 @@ class Dispatch(NamedTuple):
         return len(self.requests) > 1
 
 
+def interleave_tenants(requests: Sequence[Request]) -> List[Request]:
+    """Stable per-tenant round-robin: round r takes the r-th request of
+    each tenant, tenants ordered by first appearance.  Within a tenant,
+    submission order is preserved; across tenants, a hog submitting 50
+    tickets ahead of a second tenant's one no longer owns the first 50
+    stack slots of the drain."""
+    by_tenant: Dict[str, List[Request]] = {}
+    tenant_order: List[str] = []
+    for req in requests:
+        if req.tenant not in by_tenant:
+            by_tenant[req.tenant] = []
+            tenant_order.append(req.tenant)
+        by_tenant[req.tenant].append(req)
+    out: List[Request] = []
+    r = 0
+    while len(out) < len(requests):
+        for tenant in tenant_order:
+            queue = by_tenant[tenant]
+            if r < len(queue):
+                out.append(queue[r])
+        r += 1
+    return out
+
+
 def plan_dispatches(requests: Sequence[Request], group_keys: Dict[str, "callable"],
-                    max_stack: int = DEFAULT_MAX_STACK) -> List[Dispatch]:
+                    max_stack: int = DEFAULT_MAX_STACK,
+                    fair: bool = False) -> List[Dispatch]:
     """Group ``requests`` into stacked/solo dispatches.
 
     ``group_keys`` maps kind -> key function over params; a kind without
@@ -57,7 +92,16 @@ def plan_dispatches(requests: Sequence[Request], group_keys: Dict[str, "callable
     solo dispatch by construction.  The returned plan preserves
     first-submission order across groups (fairness: an early solo request
     is not starved behind later stackable traffic).
+
+    ``fair=True`` (the adaptive tier) layers the tenant policy on top:
+    requests are tenant-interleaved before grouping, and chunks are
+    emitted round-robin across groups rather than group-by-group — see
+    the module docstring.  Stacking itself is unchanged (same spellings
+    ride together either way), so fairness reorders WHO dispatches when,
+    never WHAT a dispatch computes.
     """
+    if fair:
+        requests = interleave_tenants(requests)
     groups: Dict = {}
     order: List = []
     for i, req in enumerate(requests):
@@ -79,10 +123,25 @@ def plan_dispatches(requests: Sequence[Request], group_keys: Dict[str, "callable
             groups[gid] = (full_key, [])
             order.append(gid)
         groups[gid][1].append(req)
-    plan: List[Dispatch] = []
+    chunked: Dict = {}
     for gid in order:
         key, members = groups[gid]
-        for lo in range(0, len(members), max(1, max_stack)):
-            plan.append(Dispatch(kind=members[0].kind, key=key,
-                                 requests=members[lo:lo + max_stack]))
+        chunked[gid] = [
+            Dispatch(kind=members[0].kind, key=key,
+                     requests=members[lo:lo + max_stack])
+            for lo in range(0, len(members), max(1, max_stack))]
+    plan: List[Dispatch] = []
+    if fair:
+        # round-robin across groups: chunk 0 of every group in order,
+        # then chunk 1 of every group, ... — no group's chunk train
+        # monopolizes the head of the drain
+        r = 0
+        while len(plan) < sum(len(c) for c in chunked.values()):
+            for gid in order:
+                if r < len(chunked[gid]):
+                    plan.append(chunked[gid][r])
+            r += 1
+    else:
+        for gid in order:
+            plan.extend(chunked[gid])
     return plan

@@ -17,8 +17,17 @@ threads, joined on ``server_close``); the DISPATCH loop is one dedicated
 thread (``pipeline.spawn_thread``) draining the service queue with a
 batching window, so jax dispatch stays single-threaded no matter how many
 clients connect.  The batching window is the stacking knob: requests
-arriving within ``batch_window_s`` of each other are scheduled together
-and stack when their static spellings match.
+arriving within the window of each other are scheduled together and stack
+when their static spellings match.
+
+Continuous batching (PR 16): the dispatcher BLOCKS on the service's
+admission condition variable while idle (no poll-sleep — an idle worker
+burns no CPU, and the first ticket after quiet wakes it immediately), and
+with a ``controller`` attached the wait window is the ADAPTIVE per-group
+value (``serve.controller``) instead of the fixed ``batch_window_s`` —
+tickets admit into the very next dispatch as soon as the current one
+retires.  ``controller=None`` keeps the PR 10 fixed-window dispatch
+byte-exact (the ``--no-adaptive`` A/B oracle).
 """
 
 import json
@@ -56,9 +65,14 @@ class ServiceServer(socketserver.ThreadingMixIn,
 
     daemon_threads = False   # joined on server_close: no stranded handlers
     allow_reuse_address = True
+    # connection-per-op clients connect in bursts; the socketserver
+    # default backlog of 5 turns any accept-loop stall into EAGAIN
+    # connect failures under concurrent load
+    request_queue_size = 128
 
     def __init__(self, service: ExperimentService, socket_path: str,
-                 batch_window_s: float = 0.25):
+                 batch_window_s: float = 0.25, controller=None,
+                 idle_tick_s: float = 1.0):
         if os.path.exists(socket_path):
             # only a STALE socket (killed server) may be reclaimed — a
             # live server answering ping must not have its socket stolen
@@ -72,6 +86,15 @@ class ServiceServer(socketserver.ThreadingMixIn,
         self.service = service
         self.socket_path = socket_path
         self.batch_window_s = batch_window_s
+        #: adaptive window controller (None = fixed window, the PR 10
+        #: oracle); attaching it also flips the service's fairness plan
+        self.controller = controller
+        if controller is not None:
+            service.attach_controller(controller)
+        #: idle heartbeat: how often a blocked dispatcher wakes to slide
+        #: the rate-alert windows (throttled inside the service) and
+        #: re-check its stop flag
+        self._idle_tick_s = max(0.05, float(idle_tick_s))
         self._stop = threading.Event()
         #: graceful-drain flag (the SIGTERM path): finish the in-flight
         #: dispatch, do NOT dispatch the remaining queue — those tickets
@@ -113,6 +136,7 @@ class ServiceServer(socketserver.ThreadingMixIn,
             return {"ok": True, "bye": True, "draining": True}
         if op == "shutdown":
             self._stop.set()
+            self.service.wake()   # a condvar-blocked dispatcher re-checks
             # unblock serve_forever from a handler thread without joining
             # ourselves: shutdown() must run off the serve_forever thread
             spawn_thread(self.shutdown, name="serve-shutdown")
@@ -138,17 +162,24 @@ class ServiceServer(socketserver.ThreadingMixIn,
     # -- lifecycle -------------------------------------------------------
 
     def _dispatch_loop(self) -> None:
-        """Single-threaded jax dispatch: wait for traffic, give the
-        batching window a chance to aggregate, drain."""
+        """Single-threaded jax dispatch, continuous batching: block on
+        the admission condvar while idle, give the (adaptive) batching
+        window a chance to aggregate, drain, loop straight into the next
+        round — tickets admit into the next dispatch the moment the
+        current one retires."""
         while not self._stop.is_set():
-            if self.service.queue_depth() == 0:
+            if not self.service.wait_for_work(timeout_s=self._idle_tick_s):
                 # rate-alert windows keep sliding while idle (throttled
                 # inside — a fired SLO-burn alert must clear on quiet)
                 self.service.idle_sample_live()
-                time.sleep(min(0.05, self.batch_window_s or 0.05))
                 continue
-            if self.batch_window_s > 0:
-                time.sleep(self.batch_window_s)
+            if self.controller is None:
+                window = self.batch_window_s
+            else:
+                window = self.controller.window_s(
+                    self.service.pending_groups())
+            if window > 0:
+                time.sleep(window)
             if self._drain.is_set():
                 # SIGTERM landed during the window: the queued tickets
                 # stay journaled-unfinished for the restart to replay —
@@ -156,7 +187,7 @@ class ServiceServer(socketserver.ThreadingMixIn,
                 return
             # window_s = the sleep just performed: the service splits each
             # ticket's pre-dispatch wait into queue vs window spans with it
-            self.service.run_pending(window_s=self.batch_window_s)
+            self.service.run_pending(window_s=window)
         if self._drain.is_set():
             return
         # drain whatever raced the stop (handle_op rejects new traffic
@@ -173,6 +204,7 @@ class ServiceServer(socketserver.ThreadingMixIn,
             self.serve_forever(poll_interval=0.1)
         finally:
             self._stop.set()
+            self.service.wake()
             self._dispatcher.join()
             # a submit that slipped between the stop-check and the
             # dispatcher's final drain must not leave its handler thread
@@ -204,6 +236,7 @@ class ServiceServer(socketserver.ThreadingMixIn,
         if drain:
             self._drain.set()
         self._stop.set()
+        self.service.wake()
         spawn_thread(self.shutdown, name="serve-stop")
 
 

@@ -170,7 +170,7 @@ class ExperimentService:
                  writer=None, slo_p95_ms: float = 0.0,
                  max_queue: int = 0, results_ttl_s: float = 0.0,
                  dispatch_retries: int = 2, retry_backoff_s: float = 0.05,
-                 chaos=None):
+                 chaos=None, fair_tenants: bool = False):
         from ..utils.pipeline import BackgroundWriter
 
         os.makedirs(root, exist_ok=True)
@@ -222,8 +222,19 @@ class ExperimentService:
         self._events = open(os.path.join(root, "events.jsonl"), "a")
         self._lineage = None  # opened lazily on the first lineage row
         self.journal = TicketJournal(root)
+        #: the continuous-batching tier's fairness flag (on whenever the
+        #: adaptive controller is attached): tenant-interleave + cross-
+        #: group round-robin chunk emission in every drain's plan
+        self.fair_tenants = bool(fair_tenants)
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
+        #: signaled at admission (submit/recover) — the dispatcher blocks
+        #: here instead of poll-sleeping, so an idle service burns no CPU
+        #: and the first ticket after quiet starts its window immediately
+        self._work = threading.Condition(self._lock)
+        #: the adaptive window controller (attach_controller); None = the
+        #: fixed-window dispatcher, byte-exact PR 10 telemetry included
+        self._controller = None
         self._pending: List[Request] = []
         self._results: Dict[str, dict] = {}
         self._idem: Dict[str, str] = {}          # idempotency key -> ticket
@@ -316,6 +327,7 @@ class ExperimentService:
                 self._idem[idempotency_key] = ticket
                 self._idem_by_ticket[ticket] = idempotency_key
             depth = len(self._pending)
+            self._work.notify_all()   # wake the blocked dispatcher
         if self.chaos is not None:
             self.chaos.note_submit(ticket)
         self.registry.counter("serve_requests_total",
@@ -361,6 +373,8 @@ class ExperimentService:
             replayed = [e for e in entries if e.kind in GROUP_KEYS]
             self._replayed += len(replayed)
             depth = len(self._pending)
+            if depth:
+                self._work.notify_all()
         for e in replayed:
             if self.chaos is not None:
                 self.chaos.note_submit(e.ticket)
@@ -415,7 +429,58 @@ class ExperimentService:
         with self._lock:
             return len(self._pending)
 
+    def wait_for_work(self, timeout_s: float = 1.0) -> bool:
+        """Block until at least one request is pending (or ``timeout_s``
+        elapses); returns whether work is pending.  The dispatcher's
+        idle wait: admission (``submit``/``recover``) signals it, so an
+        idle service burns no CPU and first-ticket latency is bounded by
+        the adaptive window, not a poll interval.  Spurious returns are
+        fine — the caller loops."""
+        with self._work:
+            if self._pending:
+                return True
+            self._work.wait(timeout=timeout_s)
+            return bool(self._pending)
+
+    def wake(self) -> None:
+        """Wake a dispatcher blocked in :meth:`wait_for_work` (the
+        transport's stop/drain path — the dispatcher re-checks its stop
+        flag on every wake)."""
+        with self._work:
+            self._work.notify_all()
+
+    def pending_groups(self) -> List:
+        """Ordered-unique scheduler group ids ``(kind, key)`` of the
+        pending queue — the adaptive controller's lookup domain for the
+        next wait window.  A request whose key function raises (or
+        returns None) reports ``(kind, None)``: the solo pool, which the
+        controller treats as one group per kind."""
+        with self._lock:
+            snapshot = list(self._pending)
+        out, seen = [], set()
+        for req in snapshot:
+            keyfn = GROUP_KEYS.get(req.kind)
+            try:
+                key = keyfn(req.params) if keyfn is not None else None
+            except Exception:
+                key = None
+            gid = (req.kind, key)
+            if gid not in seen:
+                seen.add(gid)
+                out.append(gid)
+        return out
+
     # -- execution -------------------------------------------------------
+
+    def attach_controller(self, controller, fair: bool = True) -> None:
+        """Arm the continuous-batching tier: ``controller`` (an
+        ``serve.controller.AdaptiveWindowController``) observes every
+        retired dispatch and owns the per-group wait windows; ``fair``
+        turns on the tenant-fairness plan (the two ship together — the
+        ``--no-adaptive`` oracle disables both so the fixed-window path
+        is byte-exact PR 10, metrics.prom included)."""
+        self._controller = controller
+        self.fair_tenants = bool(fair)
 
     def attach_live(self, history, engine=None) -> None:
         """Arm the live telemetry plane: ``history`` (a
@@ -483,9 +548,26 @@ class ExperimentService:
                             help="requests queued, not yet dispatched").set(
                                 self.queue_depth())
         batch = self._expire_overdue(batch)
-        plan = plan_dispatches(batch, GROUP_KEYS, self.max_stack)
+        plan = plan_dispatches(batch, GROUP_KEYS, self.max_stack,
+                               fair=self.fair_tenants)
+        inflight = sum(len(d.requests) for d in plan)
+        if self._controller is not None:
+            # fleet-view gauges, adaptive tier only: the fixed-window
+            # oracle's metrics.prom must stay byte-exact PR 10
+            g = self.registry.gauge(
+                "serve_inflight_requests",
+                help="tenant slots in the dispatch round in flight")
+            g.set(inflight)
+            self.registry.gauge(
+                "serve_window_seconds",
+                help="the adaptive batching window just applied "
+                     "(min over pending groups)").set(
+                    max(0.0, float(window_s)))
         for dispatch in plan:
             self._run_dispatch(dispatch, window_s=window_s)
+            if self._controller is not None:
+                inflight -= len(dispatch.requests)
+                g.set(inflight)
         self.write_metrics()
         # post-drain turn: conditions this drain resolved (the queue is
         # empty again) emit their "cleared" edge now rather than at the
@@ -619,6 +701,7 @@ class ExperimentService:
         # replay tickets whose results were already collected
         self._mark_done(dispatch.requests,
                         "done" if error is None else "failed")
+        violations = 0
         with self._done:
             for i, req in enumerate(dispatch.requests):
                 if error is None:
@@ -642,10 +725,12 @@ class ExperimentService:
                         "serve_requests_failed_total",
                         help="requests whose dispatch raised").inc(
                             1, kind=req.kind)
-                self._ticket_spans(req, mode=mode,
-                                   stack_k=len(dispatch.requests),
-                                   dispatch_start=t0, wall=wall, now=now,
-                                   window_s=window_s, error=error)
+                if self._ticket_spans(req, mode=mode,
+                                      stack_k=len(dispatch.requests),
+                                      dispatch_start=t0, wall=wall,
+                                      now=now, window_s=window_s,
+                                      error=error):
+                    violations += 1
                 self._event_row(kind="serve_tenant", ticket=req.ticket,
                                 tenant=req.tenant, request_kind=req.kind,
                                 mode=mode, quarantined=quarantined or None,
@@ -653,6 +738,13 @@ class ExperimentService:
                                 error=error)
             self._evict_results_locked(now)
             self._done.notify_all()
+        if self._controller is not None:
+            # the controller's error signal: this dispatch's share of
+            # the SLO counter (the PR 15 burn rule's numerator) folds
+            # into its group's window — shrink on burn, grow on clean
+            self._controller.observe_dispatch(
+                (dispatch.kind, dispatch.key), violations,
+                len(dispatch.requests))
 
     def _mark_done(self, reqs: Sequence[Request], status: str) -> None:
         """Journal the completions (one fsync for the group) so a restart
@@ -722,9 +814,11 @@ class ExperimentService:
 
     def _ticket_spans(self, req: Request, *, mode: str, stack_k: int,
                       dispatch_start: float, wall: float, now: float,
-                      window_s: float, error) -> None:
+                      window_s: float, error) -> bool:
         """One completed ticket's structured span family + the
-        ``serve_ticket_*`` histograms + the SLO counter.
+        ``serve_ticket_*`` histograms + the SLO counter; returns whether
+        the ticket violated the SLO (the adaptive controller's per-
+        dispatch error signal).
 
         Breakdown contract (asserted in ``tests/test_fleet.py``): the
         root ``serve.ticket`` span's duration is EXACTLY the latency the
@@ -778,6 +872,8 @@ class ExperimentService:
                 "serve_slo_violations_total",
                 help="requests whose latency exceeded the --slo-p95-ms "
                      "target").inc(1, kind=req.kind)
+            return True   # this ticket burns: the controller's signal
+        return False
 
     # -- executors -------------------------------------------------------
 
@@ -1043,7 +1139,13 @@ class ExperimentService:
         if self._live_engine is not None:
             alerts = {"active": self._live_engine.active(),
                       "fired": self._counter_total("soup_alerts_total")}
+        if self._controller is not None:
+            dispatch = self._controller.snapshot()
+            dispatch["fair_tenants"] = self.fair_tenants
+        else:
+            dispatch = {"adaptive": False}
         return {"completed": done, "queue_depth": depth,
+                "dispatch": dispatch,
                 "distinct_programs": programs,
                 "uptime_s": round(time.monotonic() - self._t0, 2),
                 "slo": {
@@ -1093,6 +1195,7 @@ class ExperimentService:
         with self._done:
             self._draining = True   # submit() refuses from here on
             stranded, self._pending = self._pending, []
+            self._work.notify_all()   # unblock an idle dispatcher
         if stranded:
             self._resolve_failed(stranded, reason, journal_done=False,
                                  resumable=resumable)

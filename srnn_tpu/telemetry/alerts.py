@@ -140,6 +140,22 @@ def default_serve_rules(*, max_queue: int = 0,
     ]
 
 
+def default_pool_rules(*, workers: int,
+                       window_s: float = 60.0) -> List[Rule]:
+    """The serve FLEET's rule table (the front process's engine, layered
+    on ``default_serve_rules``): a fleet running below its configured
+    worker count, and worker deaths arriving at all, both page — the
+    replay ladder heals the work, the alert names the capacity loss."""
+    return [
+        Rule(name="serve_worker_down", metric="serve_workers",
+             kind="threshold", op="<", value=float(workers),
+             help="live workers below the configured --workers count"),
+        Rule(name="serve_worker_churn", metric="serve_worker_deaths_total",
+             kind="rate", op=">", value=0.0, window_s=window_s,
+             help="worker processes dying (replay ladder active)"),
+    ]
+
+
 class AlertEngine:
     """Evaluate a rule table against one registry + history pair.
 

@@ -115,16 +115,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         exporter: "MetricsExporter" = self.server.exporter  # type: ignore
         path = self.path.split("?", 1)[0]
+        # count AFTER rendering (a response never includes its own
+        # scrape) but BEFORE sending: once the client has the response
+        # it may scrape again immediately, and that next body must see
+        # this increment
         if path == "/metrics":
             body = exporter.registry.to_prometheus().encode("utf-8")
-            self._send(200, body, _CONTENT_TYPE)
             exporter.count_scrape("metrics")
+            self._send(200, body, _CONTENT_TYPE)
         elif path == "/healthz":
             doc = exporter.healthz_doc()
             body = (json.dumps(doc, default=str) + "\n").encode("utf-8")
+            exporter.count_scrape("healthz")
             self._send(200 if doc.get("ok", True) else 503, body,
                        "application/json")
-            exporter.count_scrape("healthz")
         else:
             self._send(404, b"not found\n", "text/plain; charset=utf-8")
 

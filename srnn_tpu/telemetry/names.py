@@ -104,6 +104,15 @@ CANONICAL_METRICS: Dict[str, str] = {
     "serve_deadline_expirations_total": "counter",
     "serve_queue_rejected_depth": "gauge",
     "serve_results_evicted_total": "counter",
+    # -- continuous batching + worker fleet (serve/controller.py adaptive
+    #    windows set by serve/service.py's drain; serve/pool.py front:
+    #    worker liveness, death/replay ladder, per-worker queue gauges) --
+    "serve_window_seconds": "gauge",
+    "serve_inflight_requests": "gauge",
+    "serve_workers": "gauge",
+    "serve_worker_deaths_total": "counter",
+    "serve_worker_replays_total": "counter",
+    "serve_worker_queue_depth": "gauge",
     # -- fleet observatory (telemetry.fleet: per-process gens/sec skew,
     #    folded live each chunk by the primary's finisher) ----------------
     "soup_straggler_process": "gauge",

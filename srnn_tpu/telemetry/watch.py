@@ -194,7 +194,12 @@ def service_snapshot(socket_path: str) -> dict:
            "uptime_s": stats.get("uptime_s"),
            "slo": stats.get("slo"),
            "alerts": stats.get("alerts"),
-           "self_healing": stats.get("self_healing")}
+           "self_healing": stats.get("self_healing"),
+           # continuous batching + fleet (PR 16): the adaptive window
+           # snapshot, and — pool fronts — the per-worker rows
+           "dispatch": stats.get("dispatch"),
+           "front": stats.get("front"),
+           "fleet": stats.get("fleet")}
     uptime = stats.get("uptime_s") or 0
     out["requests_per_sec"] = round(stats.get("completed", 0) / uptime, 3) \
         if uptime > 0 else 0.0
@@ -207,6 +212,38 @@ def render_service(s: dict, out) -> None:
     out.write(f"  completed={s['completed']}  queue={s['queue_depth']}  "
               f"{s['requests_per_sec']} req/s over {s['uptime_s']}s  "
               f"programs={s['distinct_programs']}\n")
+    d = s.get("dispatch")
+    if d:
+        if d.get("adaptive"):
+            lo, hi = d.get("window_min_s"), d.get("window_max_s")
+            out.write(f"  dispatch: adaptive window "
+                      f"[{lo if lo is not None else '-'}s"
+                      f"..{hi if hi is not None else '-'}s] over "
+                      f"{d.get('groups', 0)} group(s), "
+                      f"ceiling={d.get('ceiling_s')}s"
+                      + (", fair tenants" if d.get("fair_tenants")
+                         else "") + "\n")
+        else:
+            out.write("  dispatch: fixed window (adaptive off)\n")
+    front = s.get("front")
+    if front:
+        out.write(f"  front: {front.get('workers')} worker(s) live, "
+                  f"{front.get('admitted')} admitted, "
+                  f"{front.get('deaths')} death(s), "
+                  f"{front.get('replayed')} ticket(s) replayed\n")
+    fleet = s.get("fleet")
+    if fleet:
+        for name, w in sorted(fleet.items()):
+            if not w.get("alive"):
+                out.write(f"  {name}: DEAD (pid {w.get('pid')})\n")
+                continue
+            win = w.get("window_s")
+            out.write(
+                f"  {name}: queue={w.get('queue_depth')} "
+                f"inflight={w.get('inflight') or 0:g} "
+                f"window={win if win is not None else '-'}s "
+                f"completed={w.get('completed')} "
+                f"replayed={w.get('replayed')}\n")
     slo = s.get("slo")
     if slo:
         target = slo.get("target_p95_ms")

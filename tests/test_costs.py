@@ -334,7 +334,7 @@ def _regress():
 
 def test_regress_clean_against_committed_history():
     regress = _regress()
-    fresh = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r06.json"))
+    fresh = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r07.json"))
     history = regress.load_history(os.path.join(REPO_ROOT, "BENCH_*.json"))
     verdict = regress.compare(fresh, history)
     assert verdict["ok"], verdict["regressions"]
@@ -347,7 +347,7 @@ def test_regress_clean_against_committed_history():
 
 def test_regress_flags_synthetic_regression():
     regress = _regress()
-    fresh = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r06.json"))
+    fresh = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r07.json"))
     fresh["value"] *= 0.6
     history = regress.load_history(os.path.join(REPO_ROOT, "BENCH_*.json"))
     verdict = regress.compare(fresh, history)
@@ -356,21 +356,21 @@ def test_regress_flags_synthetic_regression():
     assert finding["kind"] == "soup_bench_regression"
     assert finding["leg"] == "apps_per_chip" and finding["ratio"] < 0.75
     # higher-is-worse direction: a p95 blowup also flags
-    fresh2 = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r06.json"))
+    fresh2 = regress.load_result(os.path.join(REPO_ROOT, "BENCH_r07.json"))
     fresh2["serve"]["load"]["p95_ms"] *= 10
-    v2 = regress.compare(fresh2, history + [("BENCH_r06.json",
+    v2 = regress.compare(fresh2, history + [("BENCH_r07.json",
                                              regress.load_result(
                                                  os.path.join(
                                                      REPO_ROOT,
-                                                     "BENCH_r06.json")))])
+                                                     "BENCH_r07.json")))])
     assert any(f["leg"] == "serve_load_p95_ms" for f in v2["regressions"])
 
 
 def test_regress_cli_and_micro_mode(tmp_path):
     regress = _regress()
     # CLI: clean -> 0, synthetic scale -> 1, garbage -> 2
-    assert regress.main([os.path.join(REPO_ROOT, "BENCH_r06.json")]) == 0
-    assert regress.main([os.path.join(REPO_ROOT, "BENCH_r06.json"),
+    assert regress.main([os.path.join(REPO_ROOT, "BENCH_r07.json")]) == 0
+    assert regress.main([os.path.join(REPO_ROOT, "BENCH_r07.json"),
                          "--scale", "apps_per_chip=0.6"]) == 1
     bad = tmp_path / "bad.json"
     bad.write_text("{not json")

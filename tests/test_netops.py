@@ -70,6 +70,7 @@ _EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
 
 
+@pytest.mark.slow
 def test_round5_examples_smoke():
     """The analysis examples run headless at smoke scale (figures skipped —
     the committed PNGs are full-sample renders)."""

@@ -1,0 +1,482 @@
+"""Continuous-batching serve tier (PR 16): adaptive windows + the fleet.
+
+Three contracts under test.  The CONTROLLER law: per-group windows start
+at the floor, shrink multiplicatively under SLO burn, grow back toward
+the ``--batch-window-s`` ceiling on clean dispatches — a pure fold over
+the (group, violations) trace, so the same arrival trace always yields
+the same windows.  The A/B ORACLE: with no controller attached the
+dispatch path (and its metrics.prom) is the fixed-window PR 10 behavior
+exactly.  The FLEET: N workers behind one front, sticky per-tenant
+round-robin, the journal as the shared-nothing recovery substrate — a
+worker killed mid-load strands nothing, because the front reads the
+corpse's journal and replays its suffix onto the survivors, with results
+bitwise-equal to a solo run of the same trace.
+
+The in-process fleet tests stand a REAL ``ServiceServer`` per worker on
+its own thread/root/socket (full dispatch path, no subprocess spawn
+cost) behind a real ``ServicePool``; only the worker *process* handle is
+faked.  The subprocess e2e (``--workers 2`` + SIGKILL) is marked
+``slow``.
+"""
+
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from srnn_tpu.serve import (AdaptiveWindowController, ExperimentService,
+                            Request, ServiceClient, ServicePool,
+                            WorkerHandle, interleave_tenants,
+                            make_controller, plan_dispatches, read_journal)
+from srnn_tpu.serve.controller import DEFAULT_FLOOR_S
+from srnn_tpu.serve.server import ServiceServer
+from srnn_tpu.utils.pipeline import spawn_thread
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the one warm spelling every in-process test rides (compile paid once)
+PARAMS = {"trials": 16, "batch": 16}
+GROUP = ("fixpoint_density", None)
+
+
+def _req(ticket, tenant, seed=0, kind="fixpoint_density"):
+    return Request(ticket=ticket, kind=kind,
+                   params=dict(PARAMS, seed=seed), tenant=tenant,
+                   submitted_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# controller law: floor start, shrink under burn, grow on quiet, clamps
+# ---------------------------------------------------------------------------
+
+
+def test_controller_starts_at_floor_grows_clean_shrinks_burn():
+    c = AdaptiveWindowController(ceiling_s=0.25, slo_p95_ms=100.0)
+    assert c.window_s([GROUP]) == DEFAULT_FLOOR_S
+    # clean dispatches grow multiplicatively toward the ceiling...
+    last = DEFAULT_FLOOR_S
+    for _ in range(40):
+        cur = c.observe_dispatch(GROUP, violations=0, completed=4)
+        assert cur >= last
+        last = cur
+    assert last == 0.25            # ...and clamp AT it
+    # burn shrinks multiplicatively back down...
+    for _ in range(40):
+        cur = c.observe_dispatch(GROUP, violations=2, completed=4)
+        assert cur <= last
+        last = cur
+    assert last == DEFAULT_FLOOR_S  # ...and clamps at the floor
+    # an empty dispatch (everything expired) moves nothing
+    assert c.observe_dispatch(GROUP, violations=0, completed=0) == last
+
+
+def test_controller_windows_are_per_group_and_min_folds():
+    c = AdaptiveWindowController(ceiling_s=0.25, slo_p95_ms=100.0)
+    hot, cold = ("soup", "a"), ("soup", "b")
+    for _ in range(10):
+        c.observe_dispatch(cold, violations=0, completed=2)
+    c.observe_dispatch(hot, violations=1, completed=2)
+    assert c.window_s([cold]) > c.window_s([hot])
+    # a mixed pending queue waits the MIN over its groups: the burning
+    # group must not be held hostage to the quiet group's long window
+    assert c.window_s([cold, hot]) == c.window_s([hot])
+    snap = c.snapshot()
+    assert snap["adaptive"] is True and snap["groups"] == 2
+    assert snap["window_min_s"] <= snap["window_max_s"]
+
+
+def test_controller_is_deterministic_over_a_trace():
+    trace = [(GROUP, 0, 4), (GROUP, 1, 4), (("soup", "x"), 0, 2),
+             (GROUP, 0, 4), (("soup", "x"), 3, 2), (GROUP, 0, 4)]
+    def run():
+        c = make_controller(0.25, 100.0)
+        return [c.observe_dispatch(g, v, n) for g, v, n in trace]
+    assert run() == run()
+
+
+def test_make_controller_gates_on_adaptive():
+    assert make_controller(0.25, 100.0, adaptive=False) is None
+    assert make_controller(0.25, 0.0) is not None   # no SLO still adapts
+
+
+# ---------------------------------------------------------------------------
+# fairness: tenant interleave + cross-group round-robin emission
+# ---------------------------------------------------------------------------
+
+
+def test_interleave_tenants_breaks_the_hog():
+    reqs = [_req(f"h{i}", "hog", seed=i) for i in range(6)] \
+        + [_req("b0", "b", seed=10), _req("c0", "c", seed=11)]
+    order = [r.ticket for r in interleave_tenants(reqs)]
+    # round 0 carries every tenant once, in first-appearance order
+    assert order[:3] == ["h0", "b0", "c0"]
+    # within a tenant, submission order is preserved
+    assert [t for t in order if t.startswith("h")] == \
+        [f"h{i}" for i in range(6)]
+
+
+def test_fair_plan_spreads_stack_slots_and_groups():
+    keys = {"fixpoint_density": lambda p: (p["trials"], p["batch"])}
+    reqs = [_req(f"h{i}", "hog", seed=i) for i in range(6)] \
+        + [_req("b0", "b", seed=10), _req("c0", "c", seed=11)]
+    unfair = plan_dispatches(reqs, keys, max_stack=4)
+    fair = plan_dispatches(reqs, keys, max_stack=4, fair=True)
+    # unfair: the hog owns the whole first stack
+    assert {r.tenant for r in unfair[0].requests} == {"hog"}
+    # fair: the first stack seats every waiting tenant
+    assert {"b", "c"}.issubset({r.tenant for r in fair[0].requests})
+    # fairness reorders WHO rides when, never the total membership
+    assert sorted(r.ticket for d in fair for r in d.requests) == \
+        sorted(r.ticket for d in unfair for r in d.requests)
+    # cross-group round-robin: chunk 0 of each group before chunk 1 of any
+    two_groups = [_req(f"a{i}", "t", seed=i) for i in range(8)] + \
+        [Request(ticket=f"s{i}", kind="fixpoint_density",
+                 params={"trials": 32, "batch": 8, "seed": i}, tenant="t",
+                 submitted_s=0.0) for i in range(8)]
+    plan = plan_dispatches(two_groups, keys, max_stack=4, fair=True)
+    gids = [d.key for d in plan]
+    assert gids == [(16, 16), (32, 8), (16, 16), (32, 8)]
+
+
+# ---------------------------------------------------------------------------
+# condvar admission: idle dispatcher blocks, first ticket wakes it
+# ---------------------------------------------------------------------------
+
+
+def test_wait_for_work_blocks_idle_and_wakes_on_submit(tmp_path):
+    svc = ExperimentService(str(tmp_path / "svc"))
+    with svc:
+        t0 = time.monotonic()
+        assert svc.wait_for_work(timeout_s=0.05) is False
+        assert time.monotonic() - t0 >= 0.05   # really waited (no spin)
+
+        def late_submit():
+            time.sleep(0.1)
+            svc.submit("fixpoint_density", dict(PARAMS, seed=0))
+
+        th = spawn_thread(late_submit, name="late-submit")
+        t0 = time.monotonic()
+        assert svc.wait_for_work(timeout_s=30.0) is True
+        # the admission SIGNALED the wait — it returned in ~0.1s, not 30
+        assert time.monotonic() - t0 < 5.0
+        th.join()
+        assert svc.wait_for_work(timeout_s=0.0) is True  # pending short-cut
+        svc.run_pending()
+
+        # wake() unblocks without work (the stop/drain path)
+        th = spawn_thread(lambda: (time.sleep(0.1), svc.wake()),
+                          name="late-wake")
+        t0 = time.monotonic()
+        assert svc.wait_for_work(timeout_s=30.0) is False
+        assert time.monotonic() - t0 < 5.0
+        th.join()
+
+
+def test_pending_groups_orders_unique(tmp_path):
+    svc = ExperimentService(str(tmp_path / "svc"))
+    with svc:
+        svc.submit("fixpoint_density", dict(PARAMS, seed=0))
+        svc.submit("fixpoint_density", dict(PARAMS, seed=1))
+        svc.submit("fixpoint_density",
+                   {"trials": 32, "batch": 8, "seed": 2})
+        groups = svc.pending_groups()
+        assert len(groups) == 2 and groups[0][0] == "fixpoint_density"
+        svc.run_pending()
+        assert svc.pending_groups() == []
+
+
+# ---------------------------------------------------------------------------
+# the adaptive service: controller wired into dispatch; the A/B oracle
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_service_grows_when_clean_shrinks_under_burn(tmp_path):
+    # generous SLO: every dispatch is clean -> the group's window grows
+    svc = ExperimentService(str(tmp_path / "svc"), slo_p95_ms=60000.0)
+    with svc:
+        ctrl = make_controller(0.25, 60000.0)
+        svc.attach_controller(ctrl)
+        gid = None
+        for i in range(3):
+            svc.submit("fixpoint_density", dict(PARAMS, seed=i))
+            gid = gid or svc.pending_groups()[0]   # the REAL spelling key
+            svc.run_pending()
+        grown = ctrl.window_s([gid])
+        assert grown > DEFAULT_FLOOR_S
+        assert ctrl.snapshot()["window_max_s"] > DEFAULT_FLOOR_S
+        st = svc.stats()
+        assert st["dispatch"]["adaptive"] is True
+        assert st["dispatch"]["fair_tenants"] is True
+        assert st["dispatch"]["groups"] == 1
+        # the adaptive-only fleet gauges registered (M005's sites)
+        rows = st["metrics"]
+        assert "srnn_serve_inflight_requests" in rows
+        assert "srnn_serve_window_seconds" in rows
+    # impossible SLO: every ticket violates -> synthetic burn, shrink
+    svc2 = ExperimentService(str(tmp_path / "svc2"), slo_p95_ms=0.001)
+    with svc2:
+        ctrl2 = make_controller(0.25, 0.001)
+        svc2.attach_controller(ctrl2)
+        svc2.submit("fixpoint_density", dict(PARAMS, seed=0))
+        gid = svc2.pending_groups()[0]
+        for _ in range(6):   # pre-grow so the shrink has room to show
+            ctrl2.observe_dispatch(gid, violations=0, completed=1)
+        grown = ctrl2.window_s([gid])
+        svc2.run_pending()
+        assert ctrl2.window_s([gid]) < grown
+        assert svc2.stats()["slo"]["violations"] >= 1
+
+
+def test_no_adaptive_oracle_keeps_legacy_metrics_surface(tmp_path):
+    """The fixed-window oracle must not grow NEW metric series: its
+    metrics.prom stays byte-comparable against the PR 10 service."""
+    svc = ExperimentService(str(tmp_path / "svc"))
+    with svc:
+        svc.submit("fixpoint_density", dict(PARAMS, seed=0))
+        svc.run_pending(window_s=0.25)
+        rows = svc.stats()["metrics"]
+        assert svc.stats()["dispatch"] == {"adaptive": False}
+        assert not any(k.startswith("srnn_serve_window_seconds")
+                       for k in rows)
+        assert not any(k.startswith("srnn_serve_inflight_requests")
+                       for k in rows)
+
+
+# ---------------------------------------------------------------------------
+# the in-process fleet: real workers on threads, fake process handles
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """Process-handle stand-in for an in-process worker thread (pid,
+    poll/terminate/kill — what the pool's monitor and reaper touch)."""
+
+    _pids = itertools.count(90001)
+
+    def __init__(self):
+        self.pid = next(self._pids)
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self):
+        if self.returncode is None:
+            self.returncode = 0
+
+    def kill(self):
+        if self.returncode is None:
+            self.returncode = -9
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+class _Fleet:
+    """N real ServiceServers (threads) behind one real ServicePool."""
+
+    def __init__(self, tmp_path, n, window_s=0.05, windows=None,
+                 max_queue=0):
+        self.root = str(tmp_path)
+        self.services, self.servers, self.threads, handles = [], [], [], []
+        for i in range(n):
+            wroot = os.path.join(self.root, "workers", f"w{i}")
+            wsock = os.path.join(self.root, "workers", f"w{i}.sock")
+            os.makedirs(os.path.dirname(wroot), exist_ok=True)
+            svc = ExperimentService(wroot)
+            w_s = windows[i] if windows else window_s
+            server = ServiceServer(svc, wsock, batch_window_s=w_s)
+            thread = spawn_thread(server.serve_until_shutdown,
+                                  name=f"fleet-w{i}")
+            ServiceClient(wsock).wait_until_up(30)
+            self.services.append(svc)
+            self.servers.append(server)
+            self.threads.append(thread)
+            handles.append(WorkerHandle(i, wroot, wsock, _FakeProc()))
+        self.pool = ServicePool(self.root, handles, max_queue=max_queue)
+
+    def kill_worker(self, i):
+        """The crash: drain stops the worker WITHOUT dispatching its
+        queue (tickets stay journaled-unfinished, exactly what SIGKILL
+        leaves) and the socket disappears.  The fake process handle
+        stays "running" so the pool's liveness monitor does not race the
+        test's explicit death ladder."""
+        self.servers[i].stop(drain=True)
+        self.threads[i].join(timeout=60)
+        self.services[i].close()
+
+    def close(self):
+        self.pool.close()
+        for th in self.threads:
+            th.join(timeout=60)
+        for svc in self.services:
+            svc.close()
+
+
+def test_two_worker_fleet_matches_solo_bitwise(tmp_path):
+    """The scale-out determinism contract: the same trace through a
+    2-worker fleet and through a solo service yields identical results
+    per ticket (executors are pure functions of the journaled params)."""
+    trace = [("a", 0), ("b", 1), ("a", 2), ("c", 3), ("b", 4), ("c", 5)]
+    fleet = _Fleet(tmp_path / "fleet", n=2)
+    try:
+        tickets = [fleet.pool.submit("fixpoint_density",
+                                     dict(PARAMS, seed=s), tenant=t)
+                   for t, s in trace]
+        got = [fleet.pool.wait(t, timeout_s=240) for t in tickets]
+        st = fleet.pool.stats()
+        assert st["front"]["admitted"] == 6
+        assert st["front"]["completed"] == 6
+        assert st["front"]["workers"] == 2
+        # sticky round-robin spread the TENANTS across both workers
+        assert {fleet.pool._tenant_worker[t] for t, _ in trace} == {0, 1}
+        assert fleet.pool.healthz()["ok"] is True
+    finally:
+        fleet.close()
+    solo = ExperimentService(str(tmp_path / "solo"))
+    with solo:
+        ref_t = [solo.submit("fixpoint_density", dict(PARAMS, seed=s),
+                             tenant=t) for t, s in trace]
+        solo.run_pending()
+        for entry, rt in zip(got, ref_t):
+            assert entry["status"] == "done"
+            assert entry["result"] == solo.poll(rt)["result"]
+
+
+@pytest.mark.slow
+def test_kill_worker_mid_load_replays_onto_survivor(tmp_path):
+    """The acceptance chaos drill, in-process: tickets queued on worker
+    0 (long window) when it dies; the front reads the corpse's journal,
+    replays the suffix onto worker 1, and every acknowledged ticket
+    completes.  healthz tells the loss (stranded on a dead worker) and
+    then the heal."""
+    fleet = _Fleet(tmp_path, n=2, windows=[30.0, 0.05])
+    try:
+        # tenant "a" lands sticky on w0 (first tenant, rr slot 0), where
+        # the 30s window keeps the tickets queued — journaled, undone
+        tickets = [fleet.pool.submit("fixpoint_density",
+                                     dict(PARAMS, seed=i), tenant="a")
+                   for i in range(3)]
+        assert fleet.pool._tenant_worker["a"] == 0
+        unfinished, _, _ = read_journal(
+            os.path.join(fleet.root, "workers", "w0", "journal.jsonl"))
+        assert [e.key for e in unfinished] == \
+            [f"pool:{t}" for t in tickets]
+        fleet.kill_worker(0)
+        # the LOSS edge: dead worker, replay not yet run -> not ok
+        with fleet.pool._lock:
+            fleet.pool.workers[0].alive = False
+        hz = fleet.pool.healthz()
+        assert hz["ok"] is False and hz["stranded"] == 3
+        with fleet.pool._lock:
+            fleet.pool.workers[0].alive = True
+        # the HEAL: the death ladder reads w0's journal, replays onto w1
+        fleet.pool._note_death(0)
+        hz = fleet.pool.healthz()
+        assert hz["ok"] is True
+        assert hz["deaths"] == 1 and hz["replayed"] == 3
+        assert hz["workers"]["0"]["ok"] is False   # the corpse stays shown
+        for t in tickets:
+            entry = fleet.pool.wait(t, timeout_s=240)
+            assert entry["status"] == "done"
+        assert fleet.pool.registry.counter(
+            "serve_worker_replays_total").value() == 3
+        assert fleet.pool.registry.counter(
+            "serve_worker_deaths_total").value() == 1
+        assert fleet.pool.registry.gauge("serve_workers").value() == 1
+        rows = [json.loads(l) for l in
+                open(os.path.join(fleet.root, "events.jsonl"))]
+        assert any(r.get("kind") == "pool_worker_death" for r in rows)
+    finally:
+        fleet.close()
+
+
+def test_front_restart_recovers_its_journal(tmp_path):
+    """kill -9 of the FRONT: admitted tickets are journaled
+    durable-before-ack, so a fresh front on the same root replays and
+    completes them under their original ids (idempotent re-forward —
+    the workers dedupe on the ``pool:`` keys)."""
+    fleet = _Fleet(tmp_path, n=1, window_s=0.05)
+    try:
+        tickets = [fleet.pool.submit("fixpoint_density",
+                                     dict(PARAMS, seed=i), tenant="a")
+                   for i in range(2)]
+        # the front "crashes": no close, no drain — only its monitor
+        # stops (a dead process polls nothing)
+        fleet.pool._stop.set()
+        fleet.pool._monitor.join(timeout=10)
+        pool2 = ServicePool(fleet.root, fleet.pool.workers)
+        try:
+            assert pool2.recover() == 2
+            for t in tickets:
+                assert pool2.wait(t, timeout_s=240)["status"] == "done"
+            # the watermark survived: fresh ids continue past replayed
+            assert pool2.submit("fixpoint_density", dict(PARAMS, seed=9),
+                                tenant="a") == "t000003"
+            assert pool2.wait("t000003", timeout_s=240)["status"] == "done"
+        finally:
+            pool2.close()
+            fleet.pool.journal.close()
+            with fleet.pool._events_lock:
+                fleet.pool._events.close()
+    finally:
+        for th in fleet.threads:
+            th.join(timeout=60)
+        for svc in fleet.services:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e (slow): --workers 2, SIGKILL one worker under load
+# ---------------------------------------------------------------------------
+
+
+def _serve_env():
+    env = dict(os.environ)
+    env["SRNN_SETUPS_PLATFORM"] = "cpu"
+    env.pop("PYTHONPATH", None)
+    return env
+
+
+@pytest.mark.slow
+def test_fleet_e2e_sigkill_worker_under_load(tmp_path):
+    root = str(tmp_path / "fleet")
+    log = open(str(tmp_path / "fleet.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "srnn_tpu.serve", "--root", root,
+         "--workers", "2", "--batch-window-s", "0.25",
+         "--slo-p95-ms", "2000"],
+        cwd=REPO, env=_serve_env(), stdout=log,
+        stderr=subprocess.STDOUT)
+    try:
+        client = ServiceClient(os.path.join(root, "serve.sock"),
+                               retries=3, backoff_base_s=0.1)
+        client.wait_until_up(240)
+        tickets = [client.submit("fixpoint_density", dict(PARAMS, seed=s),
+                                 tenant=f"tn{s % 4}",
+                                 idempotency_key=f"fe2e-{s}")
+                   for s in range(8)]
+        stats = client.stats()
+        assert stats["front"]["workers"] == 2
+        victim = stats["fleet"]["w0"]["pid"]
+        os.kill(victim, signal.SIGKILL)
+        # every acknowledged ticket still completes (the survivors
+        # absorb the dead worker's journal suffix)
+        for t in tickets:
+            assert client.wait(t, timeout_s=300) is not None
+        stats = client.stats()
+        assert stats["front"]["deaths"] == 1
+        assert stats["front"]["workers"] == 1
+        assert stats["front"]["completed"] == 8
+        client.shutdown()
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    prom = open(os.path.join(root, "metrics.prom")).read()
+    assert "srnn_serve_worker_deaths_total 1" in prom

@@ -205,6 +205,7 @@ def test_mega_soup_sharded_capture_and_resume(tmp_path):
     np.testing.assert_array_equal(out["weights"][-1], np.asarray(got.weights))
 
 
+@pytest.mark.slow
 def test_mega_multisoup_bit_exact_resume_and_sharded(tmp_path):
     """The heterogeneous mega-soup entry point checkpoints MultiSoupState
     and resumes bit-exactly; the sharded path produces a valid run too."""

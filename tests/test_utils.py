@@ -28,6 +28,7 @@ def test_timed_stats():
     assert 0 < stats["min_s"] <= stats["mean_s"] <= stats["max_s"]
 
 
+@pytest.mark.slow
 def test_trace_writes_profile(tmp_path):
     d = str(tmp_path / "prof")
     with trace(d):
