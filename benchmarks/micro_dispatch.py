@@ -4,7 +4,9 @@ for the soup hot path, before/after the AOT + donation subsystem.
 One JSON line of rows (plus ``telemetry``/``health``/``lineage``/
 ``fused``: the in-scan carries' dispatch overhead, ``spans``: the fleet
 observatory's per-chunk span emission on top of ``metered.health``,
-``adaptive``: the continuous-batching controller's per-dispatch turn on
+``trace_propagation``: the fleet-tracing header/journal/span work per
+traced request on top of ``metered.health``, ``adaptive``: the
+continuous-batching controller's per-dispatch turn on
 top of ``metered.health``, and ``stacked``: the serve tenant-axis
 amortization — K=8 stacked dispatch vs 8 solo dispatches — all on the
 shared interleaved median-of-medians protocol; see their docstrings):
@@ -406,6 +408,60 @@ def row_export() -> dict:
         os.unlink(tmp.name)
 
 
+def row_trace() -> dict:
+    """Walltime overhead of fleet trace-context propagation on top of
+    the ``metered.health`` chunk (documented bound <= ~5%): the
+    ``trace`` variant runs the SAME chunk program and then performs the
+    full host-side propagation work one traced request costs the serve
+    path — mint a trace id, build the submit message with the trace
+    header fields, build the journal row with them, and emit one
+    admit-style span row through a real file-backed channel.  Everything
+    here is host dict/string work off the device hot path; the A/B
+    oracle (``--no-spans`` bitwise identity) already proves the device
+    program never sees these fields.  Plain baseline interleaved per
+    the shared protocol."""
+    import itertools
+    import tempfile
+
+    from srnn_tpu.serve.client import mint_trace_id
+
+    fns = _chunk_fns()
+    tmp = tempfile.NamedTemporaryFile(  # noqa: SIM115 - closed at exit
+        mode="w", suffix=".jsonl", prefix="srnn_micro_trace_",
+        delete=False)
+    health = fns["health"]
+    span_ids = itertools.count(1)
+
+    def trace():
+        value = health()
+        trace_id = mint_trace_id()
+        msg = {"op": "submit", "kind": "fixpoint_density", "params": {},
+               "tenant": "micro", "trace_id": trace_id,
+               "parent_span": next(span_ids)}
+        journal_row = {"e": "submit", "ticket": "t0", "kind": msg["kind"],
+                       "params": {}, "tenant": "micro", "key": None,
+                       "deadline_wall": None, "wall": 0.0,
+                       "trace_id": trace_id,
+                       "parent_span": msg["parent_span"]}
+        row = {"kind": "span", "span": "serve.admit",
+               "span_id": next(span_ids), "trace_id": trace_id,
+               "remote_parent": msg["parent_span"], "ticket": "t0",
+               "process": 0, "start_s": 0.0, "seconds": 0.0}
+        tmp.write(json.dumps(journal_row) + "\n")
+        tmp.write(json.dumps(row) + "\n")
+        tmp.flush()
+        return value
+
+    try:
+        return _overhead_row("trace_propagation",
+                             {"plain": fns["plain"], "health": health,
+                              "trace": trace},
+                             base="health", feature="trace")
+    finally:
+        tmp.close()
+        os.unlink(tmp.name)
+
+
 #: groups per controller turn — wider than any real serve round (the
 #: bench load legs run 1-2 spellings); overstating the fold keeps the
 #: bound honest
@@ -538,11 +594,12 @@ def main(argv=None) -> int:
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
             row_telemetry(), row_health(), row_lineage(), row_spans(),
-            row_export(), row_adaptive(), row_fused(), row_stacked()]
+            row_export(), row_trace(), row_adaptive(), row_fused(),
+            row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h, l, sp, ex, ad, fu, sk = rows
+        c, d, m, t, h, l, sp, ex, tr, ad, fu, sk = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -575,6 +632,10 @@ def main(argv=None) -> int:
               f"{ex['export_ms_per_chunk']:.1f}ms vs metered.health "
               f"{ex['health_ms_per_chunk']:.1f}ms per chunk "
               f"({ex['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# trace(N={tr['n']}, G={tr['generations']}): +propagation "
+              f"{tr['trace_ms_per_chunk']:.1f}ms vs metered.health "
+              f"{tr['health_ms_per_chunk']:.1f}ms per chunk "
+              f"({tr['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
         print(f"# adaptive(N={ad['n']}, G={ad['generations']}, "
               f"groups={ad['groups']}): +controller turn "
               f"{ad['adaptive_ms_per_chunk']:.1f}ms vs metered.health "

@@ -405,6 +405,136 @@ fi
 rm -rf "$ss_root"
 summary+=$(printf '%-34s %-4s %4ss' "serve_scale_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Fleet tracing smoke (PR 17, srnn_tpu/serve + telemetry/fleet): a
+# `--workers 2` pool takes 8 traced tickets, worker w0 is SIGKILLed
+# mid-flight (after its serve.admit spans have demonstrably landed in
+# workers/w0/events.jsonl), and the replayed work completes on the
+# survivor.  Then `report --trace` must emit paired Perfetto flow
+# events (ph "s" at the front's relay spans, ph "f" at the workers'
+# adopted spans), and `report --trace-request <replayed ticket>` must
+# exit 0 with ONE trace_id spanning the front lane AND both worker
+# lanes — the kill -9 story as a single connected trace.
+t0=$SECONDS
+ts_root=$(mktemp -d)
+ts_ok=1
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve --root "$ts_root/svc" \
+    --workers 2 --batch-window-s 0.25 > "$ts_root/serve.log" 2>&1 &
+ts_pid=$!
+up=0
+for _ in $(seq 1 300); do
+    if SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+            --socket "$ts_root/svc/serve.sock" --ping 2>/dev/null; then
+        up=1; break
+    fi
+    sleep 0.2
+done
+if [ "$up" -eq 1 ]; then
+    SRNN_SETUPS_PLATFORM=cpu python - "$ts_root/svc/serve.sock" \
+        "$ts_root/submitted" "$ts_root/killed" \
+        >> "$ts_root/serve.log" 2>&1 <<'PY' &
+import os
+import sys
+import time
+from srnn_tpu.serve.client import ServiceClient
+sock, marker, barrier = sys.argv[1], sys.argv[2], sys.argv[3]
+c = ServiceClient(sock, retries=5, backoff_base_s=0.2)
+tickets = [c.submit("fixpoint_density",
+                    {"seed": i, "trials": 32, "batch": 32},
+                    tenant=f"tn{i % 4}", idempotency_key=f"trace-{i}")
+           for i in range(8)]
+open(marker, "w").write("\n".join(tickets))
+deadline = time.monotonic() + 180
+while not os.path.exists(barrier):
+    assert time.monotonic() < deadline, "kill barrier never dropped"
+    time.sleep(0.2)
+for t in tickets:
+    assert c.wait(t, timeout_s=300) is not None, t
+PY
+    ts_client=$!
+    # kill only once the corpse-to-be has ADMITTED work on the record:
+    # its serve.admit spans in workers/w0/events.jsonl are what the
+    # merged trace must later show for the dead lane
+    admitted=0
+    for _ in $(seq 1 300); do
+        if [ -f "$ts_root/submitted" ] && \
+                grep -q '"span": "serve.admit"' \
+                    "$ts_root/svc/workers/w0/events.jsonl" 2>/dev/null; then
+            admitted=1; break
+        fi
+        sleep 0.2
+    done
+    [ "$admitted" -eq 1 ] || ts_ok=0
+    w0_pid=$(SRNN_SETUPS_PLATFORM=cpu python - "$ts_root/svc/serve.sock" \
+        2>>"$ts_root/serve.log" <<'PY'
+import sys
+from srnn_tpu.serve.client import ServiceClient
+print(ServiceClient(sys.argv[1]).stats()["fleet"]["w0"]["pid"])
+PY
+    )
+    if [ -n "$w0_pid" ]; then
+        kill -9 "$w0_pid" 2>/dev/null || ts_ok=0
+    else
+        ts_ok=0
+    fi
+    touch "$ts_root/killed"
+    wait "$ts_client" || ts_ok=0
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.serve \
+        --socket "$ts_root/svc/serve.sock" --shutdown \
+        >> "$ts_root/serve.log" 2>&1 || ts_ok=0
+    wait "$ts_pid" || ts_ok=0
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+        --trace "$ts_root/svc" >> "$ts_root/serve.log" 2>&1 || ts_ok=0
+    SRNN_SETUPS_PLATFORM=cpu python - "$ts_root/svc" \
+        >> "$ts_root/serve.log" 2>&1 <<'PY' || ts_ok=0
+import json, sys
+from srnn_tpu.telemetry import fleet
+run = sys.argv[1]
+doc = json.load(open(run + "/trace.json"))
+flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+starts = [e for e in flows if e["ph"] == "s"]
+finishes = [e for e in flows if e["ph"] == "f"]
+assert starts and finishes, "no flow arrows in the merged trace"
+assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+assert all(e["pid"] == 0 for e in starts), "hops must source at the front"
+assert any(e["pid"] != 0 for e in finishes), "no worker-side flow binds"
+# the replayed tickets: the front's own front.replay spans name them
+replayed = [json.loads(l) for l in open(run + "/events.jsonl")
+            if '"front.replay"' in l]
+assert replayed, "no front.replay span — the kill never forced a replay"
+full = None
+for row in replayed:
+    s = fleet.trace_request(run, row["ticket"])
+    assert s is not None, f"trace_request knows nothing about {row}"
+    assert s["cross_process_links"] >= 1, s
+    names = {r.get("span") for r in s["spans"]}
+    assert "front.replay" in names and "serve.ticket" in names, \
+        sorted(names)
+    assert s["processes"][0] == 0 and len(s["processes"]) >= 2, s
+    # a ticket the corpse had ADMITTED (its serve.admit flushed before
+    # the kill) renders as ONE trace across all three lanes
+    if s["processes"] == [0, 1, 2]:
+        full = s
+assert full is not None, "no replayed trace spans front+corpse+survivor"
+print(f"trace_smoke: one trace across lanes {full['processes']} "
+      f"({full['cross_process_links']} cross-process links) OK")
+PY
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+        "$ts_root/svc" --trace-request "$(head -1 "$ts_root/submitted")" \
+        > "$ts_root/trace_req.txt" 2>>"$ts_root/serve.log" || ts_ok=0
+    grep -q 'critical path' "$ts_root/trace_req.txt" || ts_ok=0
+else
+    ts_ok=0
+    kill -9 "$ts_pid" 2>/dev/null
+fi
+if [ "$ts_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("trace_smoke")
+    tail -n 60 "$ts_root/serve.log"
+fi
+rm -rf "$ts_root"
+summary+=$(printf '%-34s %-4s %4ss' "trace_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 # Distributed smoke (srnn_tpu/distributed/): a REAL 2-process CPU-mesh
 # launcher run (gloo collectives, process-0-gated host I/O) must end
 # bitwise-equal to the single-process run of the same config, write each

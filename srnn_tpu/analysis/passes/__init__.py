@@ -11,12 +11,13 @@ from typing import Dict, List
 
 from ..core import PassSpec
 from . import (donation, fault_taxonomy, flag_parity, jit_purity,
-               metric_names, prints, threads)
+               metric_names, prints, span_names, threads)
 
 ALL_PASSES: List[PassSpec] = [
     prints.PASS,
     threads.PASS,
     metric_names.PASS,
+    span_names.PASS,
     donation.PASS,
     flag_parity.PASS,
     jit_purity.PASS,

@@ -1,0 +1,144 @@
+"""Pass ``span-names``: every span name the package emits must be
+declared in the canonical table (``telemetry.names.CANONICAL_SPANS``) —
+the tracing twin of the ``metric-names`` gate.  A typo'd span name is
+worse than a typo'd metric: the fleet merge groups trace families by
+name prefix (``serve.``/``front.`` pick the serve lane) and the
+``--trace-request`` critical path keys on ``serve.ticket`` literally, so
+a drifted spelling silently falls out of every view while the emitting
+code looks healthy.
+
+Recognized emission positions (the package's three span idioms):
+
+  * ``<stream>.emit("name", ...)`` / ``<stream>.timed("name", ...)`` —
+    the SpanStream API.  A literal first argument is checked exactly;
+    an f-string first argument (``f"{stage}.chunk"``) contributes its
+    trailing constant as SUFFIX evidence for liveness, since the full
+    name is runtime data.  Only dotted literals count: ``emit`` is a
+    common method name, and span names are dotted by convention.
+  * ``span="name"`` keywords — the serve tier's
+    ``_event_row(kind="span", span=..., ...)`` rows.
+  * ``_span_row(ticket, "name", ...)`` — the pool front helper.
+
+Codes:
+  * ``S001`` — an emitted span name is missing from ``CANONICAL_SPANS``.
+  * ``S002`` — span LIVENESS: a declared name has no evidence anywhere
+    in the package.  Evidence is KNOWN-WEAK by design, mirroring M005:
+    any whole string constant equal to the name (covers the
+    ``relay_name = "front.replay" if ... else "front.relay"`` variable
+    idiom), or an f-string suffix match (``f"{stage}.chunk"`` keeps
+    every declared ``*.chunk`` alive) — a name spelled in a non-emitting
+    context stays "live", because a false S002 on a real span costs more
+    than a missed dead one.
+  * ``S003`` — the scan found no span emissions at all (the pass itself
+    would be dead — fail loudly).
+  * ``S004`` — a declared name violates the naming convention
+    (``telemetry.names.check_span_name``: dotted lowercase).
+"""
+
+import ast
+
+from ..core import AnalysisContext, Finding, PassSpec, call_name, const_str
+
+_NAMES_REL = "srnn_tpu/telemetry/names.py"
+_STREAM_METHODS = ("emit", "timed")
+
+
+def _fstring_suffix(node):
+    """The trailing constant of an f-string (``f"{stage}.chunk"`` ->
+    ``".chunk"``), or None — the only part of a runtime-composed span
+    name the AST can vouch for."""
+    if isinstance(node, ast.JoinedStr) and node.values:
+        tail = node.values[-1]
+        s = const_str(tail)
+        if s and s.startswith("."):
+            return s
+    return None
+
+
+def _emissions(tree):
+    """(name_or_None, suffix_or_None, lineno) for every recognized span
+    emission position in one module."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_name(node)
+        if fname in _STREAM_METHODS and isinstance(node.func,
+                                                   ast.Attribute) \
+                and node.args:
+            lit = const_str(node.args[0])
+            if lit is not None and "." in lit:
+                yield lit, None, node.lineno
+            else:
+                suffix = _fstring_suffix(node.args[0])
+                if suffix is not None:
+                    yield None, suffix, node.lineno
+        if fname == "_span_row" and len(node.args) >= 2:
+            lit = const_str(node.args[1])
+            if lit is not None:
+                yield lit, None, node.lineno
+        for kw in node.keywords:
+            if kw.arg == "span":
+                lit = const_str(kw.value)
+                if lit is not None:
+                    yield lit, None, node.lineno
+
+
+def run(ctx: AnalysisContext):
+    # import the product table rather than re-parsing it — same source-
+    # of-truth rule as the metric-names pass
+    from ...telemetry.names import CANONICAL_SPANS, check_span_name
+
+    seen = False
+    suffixes = set()
+    spelled = set()
+    for mod in ctx.package_modules():
+        if mod.rel == _NAMES_REL:
+            continue
+        for name, suffix, lineno in _emissions(mod.tree):
+            seen = True
+            if suffix is not None:
+                suffixes.add(suffix)
+                continue
+            if name not in CANONICAL_SPANS:
+                yield Finding(
+                    pass_id=PASS.id, code="S001", path=mod.rel,
+                    line=lineno,
+                    message=f"span {name!r} not in telemetry.names."
+                            "CANONICAL_SPANS — declare it (the fleet "
+                            "merge and --trace-request key on canonical "
+                            "spellings)")
+        # liveness evidence: whole string constants anywhere in the
+        # module (known-weak, see module docstring)
+        for node in ast.walk(mod.tree):
+            s = const_str(node)
+            if s is not None and s in CANONICAL_SPANS:
+                spelled.add(s)
+    names_mod = ctx.module(_NAMES_REL)
+    names_rel = names_mod.rel if names_mod else _NAMES_REL
+    for name in CANONICAL_SPANS:
+        for problem in check_span_name(name):
+            yield Finding(pass_id=PASS.id, code="S004", path=names_rel,
+                          line=1, message=problem)
+    if not seen:
+        yield Finding(
+            pass_id=PASS.id, code="S003", path=names_rel, line=1,
+            message="AST scan found no span emissions — the span-names "
+                    "pass is broken or the emission idioms moved")
+        return
+    for name in sorted(CANONICAL_SPANS):
+        if name in spelled:
+            continue
+        if any(name.endswith(sfx) for sfx in suffixes):
+            continue
+        yield Finding(
+            pass_id=PASS.id, code="S002", path=names_rel, line=1,
+            message=f"span {name!r} is declared in CANONICAL_SPANS but "
+                    "has no emission evidence in the package — delete "
+                    "the declaration or emit it")
+
+
+PASS = PassSpec(
+    id="span-names",
+    title="every emitted span name is declared in telemetry.names."
+          "CANONICAL_SPANS (and every declared span is emitted)",
+    run=run)

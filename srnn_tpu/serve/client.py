@@ -18,9 +18,18 @@ and returns the ORIGINAL ticket instead of double-running the work.
 import json
 import socket
 import time
+import uuid
 from typing import Optional
 
 from ..resilience.supervisor import BackoffPolicy
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id (fleet tracing).  Host-only entropy:
+    the id labels telemetry rows and never reaches a dispatch path, so
+    minting cannot perturb results (the ``--no-spans`` bitwise oracle
+    covers the whole propagation chain)."""
+    return uuid.uuid4().hex[:16]
 
 
 class ServiceError(RuntimeError):
@@ -133,20 +142,32 @@ class ServiceClient:
     def _submit_msg(self, op: str, kind: str, params: dict,
                     tenant: Optional[str],
                     deadline_s: Optional[float],
-                    idempotency_key: Optional[str]) -> dict:
+                    idempotency_key: Optional[str],
+                    trace_id: Optional[str] = None,
+                    parent_span: Optional[int] = None) -> dict:
         msg = {"op": op, "kind": kind, "params": params, "tenant": tenant}
         if deadline_s is not None:
             msg["deadline_s"] = deadline_s
         if idempotency_key is not None:
             msg["idempotency_key"] = idempotency_key
+        # trace context rides as optional header fields: a traceless
+        # submit is byte-identical to the pre-tracing protocol
+        if trace_id is not None:
+            msg["trace_id"] = trace_id
+        if parent_span is not None:
+            msg["parent_span"] = parent_span
         return msg
 
     def submit(self, kind: str, params: dict,
                tenant: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               idempotency_key: Optional[str] = None) -> str:
+               idempotency_key: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               parent_span: Optional[int] = None) -> str:
         return self._op(self._submit_msg("submit", kind, params, tenant,
-                                         deadline_s, idempotency_key),
+                                         deadline_s, idempotency_key,
+                                         trace_id or mint_trace_id(),
+                                         parent_span),
                         retry_overload=True)["ticket"]
 
     def wait(self, ticket: str, timeout_s: Optional[float] = None) -> dict:
@@ -160,11 +181,14 @@ class ServiceClient:
                 tenant: Optional[str] = None,
                 timeout_s: Optional[float] = None,
                 deadline_s: Optional[float] = None,
-                idempotency_key: Optional[str] = None) -> dict:
+                idempotency_key: Optional[str] = None,
+                trace_id: Optional[str] = None,
+                parent_span: Optional[int] = None) -> dict:
         """Submit + wait in one op (the setups' submit mode)."""
         t = timeout_s if timeout_s is not None else self.timeout_s
         msg = self._submit_msg("request", kind, params, tenant,
-                               deadline_s, idempotency_key)
+                               deadline_s, idempotency_key,
+                               trace_id or mint_trace_id(), parent_span)
         msg["timeout_s"] = t
         return self._op(msg, timeout_s=t + 10.0,
                         retry_overload=True)["result"]

@@ -63,6 +63,39 @@ class JournalEntry(NamedTuple):
     key: Optional[str]            # client idempotency key, if any
     deadline_wall: Optional[float]  # absolute wall-clock deadline
     wall: float                   # wall-clock admission stamp
+    #: propagated trace context (fleet tracing): the distributed trace
+    #: this admission belongs to and the parent span on the far side of
+    #: the hop.  None on pre-tracing journals — replay behavior is
+    #: identical either way (the fields only label telemetry rows).
+    trace_id: Optional[str] = None
+    parent_span: Optional[int] = None
+    #: record fields THIS reader does not know (a journal written by a
+    #: newer version) — preserved verbatim through recovery compaction,
+    #: so downgrade-then-upgrade never strips them
+    extra: Optional[dict] = None
+
+
+#: the submit-record keys this reader interprets; anything else rides in
+#: ``JournalEntry.extra`` and survives compaction untouched
+_KNOWN_SUBMIT_KEYS = frozenset({
+    "e", "ticket", "kind", "params", "tenant", "key", "deadline_wall",
+    "wall", "trace_id", "parent_span"})
+
+
+def _submit_row(e: JournalEntry) -> dict:
+    """One entry back to its wire form (compaction): the fixed fields,
+    the trace context only when present (pre-tracing journals compact
+    byte-identically), and every unknown field merged back in."""
+    row = {"e": "submit", "ticket": e.ticket, "kind": e.kind,
+           "params": e.params, "tenant": e.tenant, "key": e.key,
+           "deadline_wall": e.deadline_wall, "wall": e.wall}
+    if e.trace_id is not None:
+        row["trace_id"] = e.trace_id
+    if e.parent_span is not None:
+        row["parent_span"] = e.parent_span
+    if e.extra:
+        row.update(e.extra)
+    return row
 
 
 def _ticket_number(ticket: str) -> int:
@@ -109,13 +142,22 @@ def read_journal(path: str) -> Tuple[List[JournalEntry], int, int]:
             max_ticket = max(max_ticket, _ticket_number(str(ticket)))
             if event == "submit":
                 try:
+                    unknown = {k: v for k, v in row.items()
+                               if k not in _KNOWN_SUBMIT_KEYS}
+                    trace_id = row.get("trace_id")
+                    parent_span = row.get("parent_span")
                     entry = JournalEntry(
                         ticket=str(ticket), kind=str(row["kind"]),
                         params=dict(row.get("params") or {}),
                         tenant=str(row.get("tenant") or ticket),
                         key=row.get("key"),
                         deadline_wall=row.get("deadline_wall"),
-                        wall=float(row.get("wall", 0.0)))
+                        wall=float(row.get("wall", 0.0)),
+                        trace_id=(None if trace_id is None
+                                  else str(trace_id)),
+                        parent_span=(None if parent_span is None
+                                     else int(parent_span)),
+                        extra=unknown or None)
                 except (ValueError, KeyError, TypeError):
                     torn += 1
                     continue
@@ -153,10 +195,18 @@ class TicketJournal:
     def record_submit(self, *, ticket: str, kind: str, params: dict,
                       tenant: str, key: Optional[str] = None,
                       deadline_wall: Optional[float] = None,
-                      wall: float) -> None:
-        self._append([{"e": "submit", "ticket": ticket, "kind": kind,
-                       "params": params, "tenant": tenant, "key": key,
-                       "deadline_wall": deadline_wall, "wall": wall}])
+                      wall: float, trace_id: Optional[str] = None,
+                      parent_span: Optional[int] = None) -> None:
+        row = {"e": "submit", "ticket": ticket, "kind": kind,
+               "params": params, "tenant": tenant, "key": key,
+               "deadline_wall": deadline_wall, "wall": wall}
+        # trace context only when propagated: traceless submits journal
+        # byte-identically to pre-tracing builds
+        if trace_id is not None:
+            row["trace_id"] = trace_id
+        if parent_span is not None:
+            row["parent_span"] = parent_span
+        self._append([row])
 
     def record_done(self, tickets: Sequence[str], status: str) -> None:
         """One fsync for a whole dispatch group's completions."""
@@ -181,11 +231,8 @@ class TicketJournal:
                 self.path,
                 json.dumps({"e": "mark", "next_ticket": next_ticket})
                 + "\n"
-                + "".join(json.dumps({
-                    "e": "submit", "ticket": e.ticket, "kind": e.kind,
-                    "params": e.params, "tenant": e.tenant, "key": e.key,
-                    "deadline_wall": e.deadline_wall, "wall": e.wall,
-                }) + "\n" for e in unfinished))
+                + "".join(json.dumps(_submit_row(e)) + "\n"
+                          for e in unfinished))
             self._f = open(self.path, "a", encoding="utf-8")
         return unfinished, torn, next_ticket
 

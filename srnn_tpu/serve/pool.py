@@ -55,6 +55,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..distributed.launch import _reap
+from ..telemetry.exemplars import EXEMPLARS_NAME, ExemplarRing
 from ..utils.pipeline import spawn_thread
 from .client import ServiceClient
 from .journal import TicketJournal, read_journal
@@ -132,6 +133,14 @@ class ServicePool:
         self._tenant_worker: Dict[str, int] = {}
         self._rr = 0
         self._counter = itertools.count(1)
+        #: front-side span ids (front.admit/assign/relay/replay) — only
+        #: unique per process, which is why cross-hop links travel as
+        #: ``remote_parent``/``parent_span``, never as ``parent``
+        self._span_ids = itertools.count(1)
+        #: tail-kept exemplar ring, the front's half: replayed / failed
+        #: tickets keep their full front-span family, the rest keep only
+        #: their admit root
+        self._exemplars = ExemplarRing(os.path.join(root, EXEMPLARS_NAME))
         self._admitted = 0
         self._completed = 0
         self._replayed = 0
@@ -158,12 +167,19 @@ class ServicePool:
     def submit(self, kind: str, params: dict,
                tenant: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               idempotency_key: Optional[str] = None) -> str:
+               idempotency_key: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               parent_span: Optional[int] = None) -> str:
         """Admit one ticket at the front (durable-before-acknowledged,
         the solo service's contract) and forward it to its tenant's
         worker.  The front is the fleet's admission authority: workers
         run unbounded queues; ``max_queue`` bounds the ADMITTED-not-
-        collected set here."""
+        collected set here.
+
+        ``trace_id``/``parent_span`` are the client's trace context;
+        the front journals them, adopts the id for its own
+        ``front.*`` spans, and propagates it across the worker hop —
+        one trace per ticket, fleet-wide."""
         from .service import OverloadedError
 
         with self._lock:
@@ -184,20 +200,32 @@ class ServicePool:
                     "back off and resubmit")
             ticket = f"t{next(self._counter):06d}"
             tenant = tenant or ticket
+            admit_start = time.monotonic() - self._t0
             self.journal.record_submit(
                 ticket=ticket, kind=kind, params=dict(params),
                 tenant=tenant, key=idempotency_key,
                 deadline_wall=(time.time() + float(deadline_s)
                                if deadline_s is not None else None),
-                wall=time.time())
+                wall=time.time(), trace_id=trace_id or None,
+                parent_span=parent_span)
+            admit_span = next(self._span_ids)
             self._tickets[ticket] = {
                 "kind": kind, "params": dict(params), "tenant": tenant,
                 "worker": None, "worker_ticket": None,
                 "deadline_s": deadline_s, "replays": 0,
-                "key": idempotency_key}
+                "key": idempotency_key,
+                "trace_id": trace_id or ticket,
+                "admit_span": admit_span, "spans": []}
             if idempotency_key:
                 self._idem[idempotency_key] = ticket
             self._admitted += 1
+        # the pool hop's root marker: duration = the durable journal
+        # append the ack waited on; remote_parent points back at the
+        # CLIENT's span when it sent one
+        self._span_row(ticket, "front.admit", admit_span,
+                       start_s=admit_start,
+                       seconds=time.monotonic() - self._t0 - admit_start,
+                       remote_parent=parent_span)
         self.registry.counter("serve_requests_total",
                               help="experiment requests accepted").inc(
                                   1, kind=kind)
@@ -207,6 +235,32 @@ class ServicePool:
                 self.queue_depth())
         self._forward(ticket)
         return ticket
+
+    def _span_row(self, ticket: str, name: str, span_id: int, *,
+                  start_s: float, seconds: float,
+                  parent: Optional[int] = None, **labels) -> None:
+        """One front-side span: appended to the front's events.jsonl
+        (the merged fleet timeline's process-0 lane) AND retained on the
+        ticket entry so resolution can capture the family into the
+        exemplar ring."""
+        with self._lock:
+            ent = self._tickets.get(ticket)
+            trace_id = ent["trace_id"] if ent else ticket
+            tenant = ent["tenant"] if ent else None
+            req_kind = ent["kind"] if ent else None
+        row = dict(kind="span", span=name, span_id=span_id,
+                   trace_id=trace_id, ticket=ticket, process=0,
+                   tenant=tenant, request_kind=req_kind,
+                   start_s=round(start_s, 6), seconds=round(seconds, 6),
+                   **labels)
+        if parent is not None:
+            row["parent"] = parent
+        row = {k: v for k, v in row.items() if v is not None}
+        self._event_row(**row)
+        with self._lock:
+            ent = self._tickets.get(ticket)
+            if ent is not None and len(ent.get("spans", ())) < 64:
+                ent["spans"].append(row)
 
     def _pick_worker(self, tenant: str) -> Optional[WorkerHandle]:
         """Sticky per-tenant round-robin over the LIVE workers."""
@@ -233,22 +287,52 @@ class ServicePool:
                 ent = self._tickets.get(ticket)
             if ent is None:
                 return   # collected (a racing wait) — nothing to do
+            assign_start = time.monotonic() - self._t0
             w = self._pick_worker(ent["tenant"])
             if w is None:
                 self._resolve_failed(ticket, "no live workers")
                 return
+            self._span_row(ticket, "front.assign", next(self._span_ids),
+                           start_s=assign_start,
+                           seconds=(time.monotonic() - self._t0
+                                    - assign_start),
+                           parent=ent.get("admit_span"), worker=w.index)
+            # relay span id minted BEFORE the worker submit: it crosses
+            # the hop as the worker ticket's parent_span, so the far
+            # side links back without a second round trip.  A replay
+            # (post-worker-death re-forward) gets its own span name —
+            # the kill -9 story stays legible in the merged timeline.
+            relay_span = next(self._span_ids)
+            relay_name = ("front.replay" if ent.get("replays")
+                          else "front.relay")
+            relay_start = time.monotonic() - self._t0
             try:
                 wt = w.client.submit(ent["kind"], ent["params"],
                                      tenant=ent["tenant"],
                                      deadline_s=ent["deadline_s"],
-                                     idempotency_key=f"pool:{ticket}")
+                                     idempotency_key=f"pool:{ticket}",
+                                     trace_id=ent["trace_id"],
+                                     parent_span=relay_span)
                 with self._done_cv:
                     if ticket in self._tickets:
                         self._tickets[ticket]["worker"] = w.index
                         self._tickets[ticket]["worker_ticket"] = wt
                     self._done_cv.notify_all()
+                self._span_row(ticket, relay_name, relay_span,
+                               start_s=relay_start,
+                               seconds=(time.monotonic() - self._t0
+                                        - relay_start),
+                               parent=ent.get("admit_span"),
+                               worker=w.index, worker_ticket=wt,
+                               replays=ent.get("replays") or None)
                 return
-            except WORKER_DEATH_EXC:
+            except WORKER_DEATH_EXC as e:
+                self._span_row(ticket, relay_name, relay_span,
+                               start_s=relay_start,
+                               seconds=(time.monotonic() - self._t0
+                                        - relay_start),
+                               parent=ent.get("admit_span"),
+                               worker=w.index, error=type(e).__name__)
                 self._note_death(w.index)
             except ServiceError as e:
                 self._resolve_failed(ticket, str(e))
@@ -316,6 +400,19 @@ class ServicePool:
         self.journal.record_done([ticket], status)
         if ent.get("key"):
             self._idem.pop(ent["key"], None)
+        # tail-based retention, the front's half: a ticket that was
+        # REPLAYED across a worker death (or failed) keeps its whole
+        # front-span family — admit, assigns, the dead relay and the
+        # replay — everything else keeps only its admit root
+        reasons = [r for r, on in (("replayed", bool(ent.get("replays"))),
+                                   ("failed", status != "done")) if on]
+        spans = ent.get("spans") or []
+        self._exemplars.add(
+            {"ticket": ticket, "trace_id": ent.get("trace_id", ticket),
+             "reason": ",".join(reasons) or "root",
+             "kind": ent.get("kind"), "tenant": ent.get("tenant"),
+             "replays": ent.get("replays", 0),
+             "spans": spans if reasons else spans[:1]})
         self.registry.gauge(
             "serve_queue_depth",
             help="requests queued, not yet dispatched").set(
@@ -334,6 +431,7 @@ class ServicePool:
         replayed = []
         with self._lock:
             self._counter = itertools.count(next_ticket)
+            now_s = time.monotonic() - self._t0
             for e in entries:
                 deadline_s = None
                 if e.deadline_wall is not None:
@@ -342,11 +440,21 @@ class ServicePool:
                     "kind": e.kind, "params": dict(e.params),
                     "tenant": e.tenant, "worker": None,
                     "worker_ticket": None, "deadline_s": deadline_s,
-                    "replays": 0, "key": e.key}
+                    "replays": 0, "key": e.key,
+                    "trace_id": e.trace_id or e.ticket,
+                    "admit_span": next(self._span_ids), "spans": []}
                 if e.key:
                     self._idem[e.key] = e.ticket
                 replayed.append(e.ticket)
             self._admitted += len(replayed)
+        for t in replayed:
+            # a re-admission marker under the ORIGINAL trace id: the
+            # restarted front's lane joins the trace the dead front left
+            with self._lock:
+                ent = self._tickets.get(t)
+            if ent is not None:
+                self._span_row(t, "front.admit", ent["admit_span"],
+                               start_s=now_s, seconds=0.0, replayed=True)
         for t in replayed:
             self._forward(t)
         if replayed:
@@ -467,6 +575,7 @@ class ServicePool:
                      "workers": sum(1 for w in self.workers if w.alive),
                      "max_queue": self.max_queue or None}
         fleet = {}
+        slowest: List[dict] = []
         for w in list(self.workers):
             row = {"alive": w.alive, "pid": w.proc.pid}
             if w.alive:
@@ -482,15 +591,23 @@ class ServicePool:
                             "adaptive"),
                         replayed=(st.get("self_healing") or {}).get(
                             "replayed"))
+                    slowest.extend(dict(e, worker=f"w{w.index}")
+                                   for e in st.get("slowest") or ())
                 except Exception as e:
                     row["error"] = f"{type(e).__name__}: {e}"
             fleet[f"w{w.index}"] = row
+        # fleet-wide slowest-traces panel: the workers' per-service
+        # top-K lists merged and re-capped (same depth as the solo
+        # service's SLOWEST_KEPT)
+        slowest.sort(key=lambda e: -e.get("seconds", 0.0))
+        del slowest[8:]
         alerts = None
         if self._engine is not None:
             alerts = {"active": self._engine.active()}
         return {"completed": front["completed"], "queue_depth": depth,
                 "uptime_s": round(time.monotonic() - self._t0, 2),
-                "front": front, "fleet": fleet, "alerts": alerts,
+                "front": front, "fleet": fleet, "slowest": slowest,
+                "alerts": alerts,
                 "metrics": self.registry.rows()}
 
     def healthz(self) -> dict:
@@ -506,8 +623,19 @@ class ServicePool:
             unassigned = sum(1 for ent in self._tickets.values()
                              if ent["worker"] is None
                              and "failed" not in ent)
-            workers = {str(w.index): {"ok": w.alive, "pid": w.proc.pid}
-                       for w in self.workers}
+            workers = {}
+            for w in self.workers:
+                # event-lane heartbeat age, the PR 15 worker_liveness
+                # idiom: seconds since the worker's events.jsonl moved
+                # (pure mtime read — callable under the lock)
+                try:
+                    age = round(time.time() - os.path.getmtime(
+                        os.path.join(w.root, "events.jsonl")), 1)
+                except OSError:
+                    age = None
+                workers[str(w.index)] = {"ok": w.alive,
+                                         "pid": w.proc.pid,
+                                         "age_s": age}
             any_alive = any(w.alive for w in self.workers)
         return {"ok": bool(any_alive and not stranded and not unassigned),
                 "workers": workers, "stranded": stranded + unassigned,
@@ -617,7 +745,9 @@ class PoolServer(socketserver.ThreadingMixIn,
                     msg["kind"], msg.get("params", {}),
                     tenant=msg.get("tenant"),
                     deadline_s=msg.get("deadline_s"),
-                    idempotency_key=msg.get("idempotency_key"))
+                    idempotency_key=msg.get("idempotency_key"),
+                    trace_id=msg.get("trace_id"),
+                    parent_span=msg.get("parent_span"))
             except OverloadedError as e:
                 return {"ok": False, "error": str(e), "overloaded": True}
             except DeadlineExpired as e:
@@ -671,8 +801,15 @@ def run_pool(args, worker_args: List[str]) -> int:
     drains: workers journal their queues and a restart resumes)."""
     os.makedirs(args.root, exist_ok=True)
     sock = args.socket or os.path.join(args.root, "serve.sock")
-    workers = [spawn_worker(i, args.root, worker_args)
-               for i in range(args.workers)]
+    workers = []
+    for i in range(args.workers):
+        wargs = list(worker_args)
+        if args.metrics_port:
+            # port layout mirrors the PR 15 mega fleet: the front (the
+            # fleet's process 0) owns PORT below; worker i — process
+            # i+1 of the merged timeline — exports on PORT+1+i
+            wargs += ["--metrics-port", str(args.metrics_port + 1 + i)]
+        workers.append(spawn_worker(i, args.root, wargs))
     try:
         for w in workers:
             w.client.wait_until_up(timeout_s=180.0)

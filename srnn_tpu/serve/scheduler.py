@@ -44,6 +44,14 @@ class Request(NamedTuple):
     #: client idempotency key — a resubmit with the same key dedupes
     #: against the live table / the durable journal instead of re-running
     idem_key: Optional[str] = None
+    #: propagated trace context (fleet tracing).  ``trace_id`` is the
+    #: distributed trace this ticket belongs to (the service falls back
+    #: to the ticket id when absent); ``parent_span`` is the span id of
+    #: the far side of the hop (e.g. the pool front's relay span), kept
+    #: as a REMOTE link because span ids are only unique per process.
+    #: Telemetry labels only — scheduling/grouping never reads them.
+    trace_id: Optional[str] = None
+    parent_span: Optional[int] = None
 
 
 class Dispatch(NamedTuple):

@@ -152,7 +152,9 @@ class ServiceServer(socketserver.ThreadingMixIn,
                 msg["kind"], msg.get("params", {}),
                 tenant=msg.get("tenant"),
                 deadline_s=msg.get("deadline_s"),
-                idempotency_key=msg.get("idempotency_key"))
+                idempotency_key=msg.get("idempotency_key"),
+                trace_id=msg.get("trace_id"),
+                parent_span=msg.get("parent_span"))
         except OverloadedError as e:
             return {"ok": False, "error": str(e), "overloaded": True}
         except DeadlineExpired as e:

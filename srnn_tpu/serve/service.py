@@ -60,6 +60,7 @@ import numpy as np
 
 from ..resilience.supervisor import (DEVICE_LOSS, IO, STALL, BackoffPolicy,
                                      classify_fault)
+from ..telemetry.exemplars import EXEMPLARS_NAME, ExemplarRing
 from ..telemetry.metrics import MetricsRegistry
 from .journal import TicketJournal
 from .scheduler import (DEFAULT_MAX_STACK, Dispatch, Request,
@@ -158,6 +159,10 @@ GROUP_KEYS = {
 #: not grow without bound; soup results can embed whole final states)
 RESULT_RETENTION = 4096
 
+#: slowest-traces panel depth (``stats()['slowest']``, rendered by
+#: ``watch --service``): the in-memory top-K by latency, flag-labeled
+SLOWEST_KEPT = 8
+
 
 class ExperimentService:
     """Queue + scheduler + executors + telemetry; one instance per
@@ -246,6 +251,11 @@ class ExperimentService:
         self._warming = False    # warm() dispatches skip telemetry rows
         self._tickets = itertools.count(1)
         self._span_ids = itertools.count(1)   # ticket-span ids
+        #: tail-kept exemplar traces (full family for SLO-violating /
+        #: failed / quarantined tickets, root-only otherwise) + the
+        #: in-memory slowest-traces panel the stats op exposes
+        self._exemplars = ExemplarRing(os.path.join(root, EXEMPLARS_NAME))
+        self._slowest: List[dict] = []
         self._programs = set()   # distinct (kind, key, shape) signatures
         self._dispatch_flops = 0.0   # HLO flops of the dispatch in flight
         self._closed = False
@@ -260,7 +270,9 @@ class ExperimentService:
     def submit(self, kind: str, params: dict,
                tenant: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               idempotency_key: Optional[str] = None) -> str:
+               idempotency_key: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               parent_span: Optional[int] = None) -> str:
         """Admit one request; returns its ticket id.
 
         The returned id is DURABLE: the journal append (with fsync)
@@ -271,6 +283,11 @@ class ExperimentService:
         of double-running.  Raises :class:`OverloadedError` past
         ``max_queue`` and :class:`DeadlineExpired` for a ``deadline_s``
         that is already spent.
+
+        ``trace_id``/``parent_span`` are the propagated trace context
+        (fleet tracing): journaled with the submit and adopted by the
+        ticket's span family, so a pool-forwarded ticket keeps ONE trace
+        across the hop.  Telemetry-only — dispatch never reads them.
         """
         if kind not in GROUP_KEYS:
             raise ValueError(f"unknown request kind {kind!r}; "
@@ -312,7 +329,9 @@ class ExperimentService:
                           deadline_mono=(now + float(deadline_s)
                                          if deadline_s is not None
                                          else None),
-                          idem_key=idempotency_key or None)
+                          idem_key=idempotency_key or None,
+                          trace_id=trace_id or None,
+                          parent_span=parent_span)
             # durable BEFORE acknowledged: fsync under the admission lock,
             # so the ticket id never outruns its journal record
             self.journal.record_submit(
@@ -320,7 +339,10 @@ class ExperimentService:
                 tenant=req.tenant, key=idempotency_key,
                 deadline_wall=(time.time() + float(deadline_s)
                                if deadline_s is not None else None),
-                wall=time.time())
+                wall=time.time(), trace_id=req.trace_id,
+                parent_span=req.parent_span)
+            admit_done = time.monotonic()
+            admit_span = next(self._span_ids)
             self._pending.append(req)
             self._unfinished.add(ticket)
             if idempotency_key:
@@ -328,6 +350,18 @@ class ExperimentService:
                 self._idem_by_ticket[ticket] = idempotency_key
             depth = len(self._pending)
             self._work.notify_all()   # wake the blocked dispatcher
+        # admission span: emitted NOW, not at completion like the ticket
+        # family — it is the corpse's only lane marker for a ticket whose
+        # worker dies mid-flight, which is exactly the trace the fleet
+        # merge must still render end to end.  Duration = the durable
+        # journal append the ack waited on.
+        self._event_row(kind="span", span="serve.admit",
+                        span_id=admit_span,
+                        trace_id=req.trace_id or ticket,
+                        remote_parent=req.parent_span, ticket=ticket,
+                        process=0, tenant=req.tenant, request_kind=kind,
+                        start_s=round(now - self._t0, 6),
+                        seconds=round(admit_done - now, 6))
         if self.chaos is not None:
             self.chaos.note_submit(ticket)
         self.registry.counter("serve_requests_total",
@@ -364,7 +398,8 @@ class ExperimentService:
                 req = Request(ticket=e.ticket, kind=e.kind,
                               params=dict(e.params), tenant=e.tenant,
                               submitted_s=now, deadline_mono=deadline_mono,
-                              idem_key=e.key)
+                              idem_key=e.key, trace_id=e.trace_id,
+                              parent_span=e.parent_span)
                 self._pending.append(req)
                 self._unfinished.add(e.ticket)
                 if e.key:
@@ -372,9 +407,22 @@ class ExperimentService:
                     self._idem_by_ticket[e.ticket] = e.key
             replayed = [e for e in entries if e.kind in GROUP_KEYS]
             self._replayed += len(replayed)
+            replay_spans = [next(self._span_ids) for _ in replayed]
             depth = len(self._pending)
             if depth:
                 self._work.notify_all()
+        for e, span_id in zip(replayed, replay_spans):
+            # the survivor's re-admission marker, under the ORIGINAL
+            # trace id: the merged fleet timeline shows the corpse's
+            # serve.admit and this replay admit in one trace
+            self._event_row(kind="span", span="serve.admit",
+                            span_id=span_id,
+                            trace_id=e.trace_id or e.ticket,
+                            remote_parent=e.parent_span, ticket=e.ticket,
+                            process=0, tenant=e.tenant,
+                            request_kind=e.kind, replayed=True,
+                            start_s=round(now - self._t0, 6),
+                            seconds=0.0)
         for e in replayed:
             if self.chaos is not None:
                 self.chaos.note_submit(e.ticket)
@@ -729,7 +777,8 @@ class ExperimentService:
                                       stack_k=len(dispatch.requests),
                                       dispatch_start=t0, wall=wall,
                                       now=now, window_s=window_s,
-                                      error=error):
+                                      error=error,
+                                      quarantined=quarantined):
                     violations += 1
                 self._event_row(kind="serve_tenant", ticket=req.ticket,
                                 tenant=req.tenant, request_kind=req.kind,
@@ -814,7 +863,8 @@ class ExperimentService:
 
     def _ticket_spans(self, req: Request, *, mode: str, stack_k: int,
                       dispatch_start: float, wall: float, now: float,
-                      window_s: float, error) -> bool:
+                      window_s: float, error,
+                      quarantined: bool = False) -> bool:
         """One completed ticket's structured span family + the
         ``serve_ticket_*`` histograms + the SLO counter; returns whether
         the ticket violated the SLO (the adaptive controller's per-
@@ -827,7 +877,15 @@ class ExperimentService:
         window's share), window (``min(pre-dispatch wait, window_s)`` —
         a ticket that arrived mid-window only sat out the remainder),
         dispatch (its group's execution wall), publish (result-delivery
-        residual)."""
+        residual).
+
+        Trace adoption (fleet tracing): the family's ``trace_id`` is the
+        PROPAGATED id when the submit carried one (a pool-forwarded
+        ticket), the ticket id otherwise — and the root records the far
+        side of the hop as ``remote_parent`` (the front's relay span id;
+        a remote link, not ``parent``, because span ids are only unique
+        per process).  The resolved family also feeds tail-based
+        exemplar retention and the slowest-traces panel."""
         latency = now - req.submitted_s
         pre_dispatch = max(0.0, dispatch_start - req.submitted_s)
         window_wait = min(max(0.0, float(window_s)), pre_dispatch)
@@ -835,12 +893,13 @@ class ExperimentService:
         publish = max(0.0, latency - pre_dispatch - wall)
         start = req.submitted_s - self._t0
         root = next(self._span_ids)
-        common = dict(trace_id=req.ticket, process=0, tenant=req.tenant,
-                      request_kind=req.kind)
-        self._event_row(kind="span", span="serve.ticket", span_id=root,
-                        start_s=round(start, 6),
-                        seconds=round(latency, 6), mode=mode,
-                        stack_k=stack_k, error=error, **common)
+        common = dict(trace_id=req.trace_id or req.ticket, process=0,
+                      tenant=req.tenant, request_kind=req.kind)
+        rows = [dict(kind="span", span="serve.ticket", span_id=root,
+                     start_s=round(start, 6),
+                     seconds=round(latency, 6), mode=mode,
+                     stack_k=stack_k, error=error, ticket=req.ticket,
+                     remote_parent=req.parent_span, **common)]
         for name, child_start, dur, extra in (
                 ("serve.ticket.queue", start, queue_wait, {}),
                 ("serve.ticket.window", start + queue_wait, window_wait,
@@ -850,10 +909,12 @@ class ExperimentService:
                   "per_tenant_s": round(wall / max(1, stack_k), 6)}),
                 ("serve.ticket.publish", now - self._t0 - publish, publish,
                  {})):
-            self._event_row(kind="span", span=name,
-                            span_id=next(self._span_ids), parent=root,
-                            start_s=round(child_start, 6),
-                            seconds=round(dur, 6), **common, **extra)
+            rows.append(dict(kind="span", span=name,
+                             span_id=next(self._span_ids), parent=root,
+                             start_s=round(child_start, 6),
+                             seconds=round(dur, 6), **common, **extra))
+        for row in rows:
+            self._event_row(**row)
         h = self.registry.histogram
         h("serve_ticket_queue_seconds",
           help="per-ticket backlog wait before the batching window",
@@ -867,13 +928,48 @@ class ExperimentService:
           help="per-ticket dispatch-group execution wall",
           unit="seconds", buckets=_LATENCY_BUCKETS).observe(
             wall, kind=req.kind)
-        if self.slo_p95_ms > 0 and latency * 1000.0 > self.slo_p95_ms:
+        violated = (self.slo_p95_ms > 0
+                    and latency * 1000.0 > self.slo_p95_ms)
+        if violated:
             self.registry.counter(
                 "serve_slo_violations_total",
                 help="requests whose latency exceeded the --slo-p95-ms "
                      "target").inc(1, kind=req.kind)
-            return True   # this ticket burns: the controller's signal
-        return False
+        self._retain_exemplar(req, rows, latency=latency, mode=mode,
+                              violated=violated, quarantined=quarantined,
+                              error=error)
+        return violated   # a violating ticket burns: controller signal
+
+    def _retain_exemplar(self, req: Request, rows: List[dict], *,
+                         latency: float, mode: str, violated: bool,
+                         quarantined: bool, error) -> None:
+        """Tail-based retention: a ticket that violated the SLO, failed,
+        or was quarantined keeps its FULL span family in the bounded
+        exemplars ring; every other ticket keeps only its root span.
+        Also maintains the slowest-traces panel (stats ``slowest``) —
+        caller holds the service lock, so the list update is safe."""
+        reasons = [r for r, on in (("slo", violated),
+                                   ("quarantined", quarantined),
+                                   ("failed", error is not None)) if on]
+        spans = rows if reasons else rows[:1]
+        record = {"ticket": req.ticket,
+                  "trace_id": req.trace_id or req.ticket,
+                  "reason": ",".join(reasons) or "root",
+                  "seconds": round(latency, 6), "kind": req.kind,
+                  "tenant": req.tenant,
+                  "spans": [{k: v for k, v in row.items()
+                             if v is not None} for row in spans]}
+        # rides the writer like the span rows themselves: retention is
+        # one appended line off the dispatch thread, never an fsync
+        self.writer.submit(self._exemplars.add, record)
+        self._slowest.append(
+            {"ticket": req.ticket, "trace_id": req.trace_id or req.ticket,
+             "seconds": round(latency, 6), "kind": req.kind,
+             "tenant": req.tenant, "mode": mode,
+             "slo_violation": violated, "failed": error is not None,
+             "quarantined": quarantined})
+        self._slowest.sort(key=lambda e: -e["seconds"])
+        del self._slowest[SLOWEST_KEPT:]
 
     # -- executors -------------------------------------------------------
 
@@ -1128,6 +1224,7 @@ class ExperimentService:
             done = self._completed
             depth = len(self._pending)
             programs = len(self._programs)
+            slowest = [dict(e) for e in self._slowest]
         violations = sum(
             v for _suffix, v in self.registry.counter(
                 "serve_slo_violations_total").samples())
@@ -1155,6 +1252,7 @@ class ExperimentService:
                     if p95 is not None else None,
                 },
                 "self_healing": self._self_healing_stats(),
+                "slowest": slowest,
                 "alerts": alerts,
                 "metrics": self.registry.rows()}
 

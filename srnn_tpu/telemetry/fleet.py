@@ -58,13 +58,40 @@ def worker_event_paths(run_dir: str) -> Dict[int, str]:
     return out
 
 
-def load_rows(path: str, process: int,
-              tail_bytes: Optional[int] = None) -> Tuple[List[dict], int]:
+_POOL_WORKER_RE = re.compile(r"^w(\d+)$")
+
+
+def pool_worker_event_paths(run_dir: str) -> Dict[int, str]:
+    """``{process_id: path}`` for a serve-POOL layout: the front's
+    ``workers/w<i>/events.jsonl`` sub-roots map to process lanes
+    ``i + 1`` (the front itself is process 0, like the mega primary).
+    Empty for non-pool run dirs, so the mega layout is untouched."""
+    out: Dict[int, str] = {}
+    wdir = os.path.join(run_dir, "workers")
+    try:
+        names = os.listdir(wdir)
+    except OSError:
+        return out
+    for name in names:
+        m = _POOL_WORKER_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(wdir, name, "events.jsonl")
+        if os.path.exists(path):
+            out[int(m.group(1)) + 1] = path
+    return out
+
+
+def load_rows(path: str, process: int, tail_bytes: Optional[int] = None,
+              force_process: bool = False) -> Tuple[List[dict], int]:
     """Parse one jsonl event file into rows tagged with ``process``;
     returns ``(rows, skipped)`` where ``skipped`` counts unparseable
     lines (torn tails, mid-write reads).  ``tail_bytes`` reads only the
     file's end (the live-watch path); the first tail line is dropped as
-    potentially clipped."""
+    potentially clipped.  ``force_process`` OVERRIDES each row's own
+    ``process`` field — pool workers are solo services that stamp
+    ``process: 0`` into their rows, and their lane identity lives in
+    the fleet's file layout, not the rows."""
     rows: List[dict] = []
     skipped = 0
     try:
@@ -92,7 +119,10 @@ def load_rows(path: str, process: int,
         if not isinstance(row, dict):
             skipped += 1
             continue
-        row.setdefault("process", process)
+        if force_process:
+            row["process"] = process
+        else:
+            row.setdefault("process", process)
         rows.append(row)
     return rows, skipped
 
@@ -100,9 +130,13 @@ def load_rows(path: str, process: int,
 def event_paths(run_dir: str) -> Dict[int, str]:
     """Every process's event file, process 0's ``events.jsonl`` included
     — the ONE place the fleet's file layout is spelled (merge, live
-    gauges and the watch console all read through this)."""
+    gauges and the watch console all read through this).  Covers both
+    layouts: the mega fleet's ``events-p<i>.jsonl`` siblings and the
+    serve pool's ``workers/w<i>/events.jsonl`` sub-roots (front = 0,
+    worker i = lane i+1)."""
     paths = {0: os.path.join(run_dir, "events.jsonl")}
     paths.update(worker_event_paths(run_dir))
+    paths.update(pool_worker_event_paths(run_dir))
     return paths
 
 
@@ -113,7 +147,11 @@ def merged_timeline(run_dir: str) -> Tuple[List[dict], int]:
     stamped = []
     skipped = 0
     for process, path in sources:
-        rows, bad = load_rows(path, process)
+        # a non-zero lane whose file is a bare events.jsonl is a pool
+        # worker sub-root: its rows say process 0 (each worker is a solo
+        # service) and the layout, not the row, names the lane
+        force = process != 0 and os.path.basename(path) == "events.jsonl"
+        rows, bad = load_rows(path, process, force_process=force)
         skipped += bad
         for seq, row in enumerate(rows):
             stamped.append((float(row.get("t", 0.0)),
@@ -265,7 +303,9 @@ def fleet_summary(run_dir: str, timeline_tail: int = 16) -> dict:
         "run_dir": os.path.abspath(run_dir),
         "processes": {str(p): lanes[p] for p in sorted(lanes)},
         "worker_files": [os.path.basename(p) for _i, p in
-                         sorted(worker_event_paths(run_dir).items())],
+                         sorted(worker_event_paths(run_dir).items())]
+                        + [os.path.relpath(p, run_dir) for _i, p in
+                           sorted(pool_worker_event_paths(run_dir).items())],
         "straggler": straggler_attribution(rates, gens),
         "timeline_rows": len(timeline),
         "skipped_lines": skipped,
@@ -393,14 +433,60 @@ def _span_event(row: dict) -> Optional[dict]:
     name = str(row.get("span", "span"))
     args = {k: row[k] for k in ("trace_id", "tenant", "request_kind",
                                 "generation", "generations", "stage",
-                                "mode", "stack_k", "per_tenant_s", "error")
+                                "mode", "stack_k", "per_tenant_s", "error",
+                                "ticket", "remote_parent", "worker",
+                                "worker_ticket", "replays", "replayed")
             if row.get(k) is not None}
+    serve_lane = name.startswith("serve.") or name.startswith("front.")
     return {"name": name, "ph": "X", "cat": "span",
             "ts": round(float(start) * 1e6, 1),
             "dur": round(float(dur) * 1e6, 1),
             "pid": int(row.get("process", 0)),
-            "tid": _TID_SERVE if name.startswith("serve.") else _TID_SPANS,
+            "tid": _TID_SERVE if serve_lane else _TID_SPANS,
             "args": args}
+
+
+def _flow_events(span_rows: List[Tuple[dict, dict]]) -> List[dict]:
+    """Perfetto flow arrows for the pool hop: every span carrying a
+    ``remote_parent`` (a propagated trace-context parent from ANOTHER
+    process) is bound back to the span that minted that id — the front's
+    ``front.relay``/``front.replay`` — as a paired ``ph:"s"`` (start, at
+    the source span's end) / ``ph:"f", bp:"e"`` (finish, at the dest
+    span's start) flow.  Span ids are only unique per process, so
+    resolution keys on ``(trace_id, span_id)``, requires a DIFFERENT
+    pid, and prefers a ``front.*`` source when ids collide across
+    lanes.  Cross-process clocks are approximate (module docstring); the
+    start stamp is clamped so an arrow never points backwards."""
+    sources: Dict[Tuple[str, int], List[Tuple[dict, dict]]] = {}
+    for row, ev in span_rows:
+        if row.get("trace_id") is not None \
+                and row.get("span_id") is not None:
+            key = (str(row["trace_id"]), int(row["span_id"]))
+            sources.setdefault(key, []).append((row, ev))
+    out: List[dict] = []
+    flow_id = 0
+    for row, ev in span_rows:
+        rp = row.get("remote_parent")
+        if rp is None or row.get("trace_id") is None:
+            continue
+        cands = [s for s in sources.get((str(row["trace_id"]), int(rp)), [])
+                 if s[1]["pid"] != ev["pid"]]
+        if not cands:
+            continue
+        pref = [s for s in cands
+                if str(s[0].get("span", "")).startswith("front.")]
+        _src_row, src_ev = (pref or cands)[0]
+        flow_id += 1
+        start_ts = min(round(src_ev["ts"] + src_ev["dur"], 1), ev["ts"])
+        out.append({"name": "hop", "cat": "flow", "ph": "s", "id": flow_id,
+                    "ts": start_ts, "pid": src_ev["pid"],
+                    "tid": src_ev["tid"],
+                    "args": {"trace_id": row["trace_id"]}})
+        out.append({"name": "hop", "cat": "flow", "ph": "f", "bp": "e",
+                    "id": flow_id, "ts": ev["ts"], "pid": ev["pid"],
+                    "tid": ev["tid"],
+                    "args": {"trace_id": row["trace_id"]}})
+    return out
 
 
 def perfetto_trace(run_dir: str) -> dict:
@@ -418,6 +504,7 @@ def perfetto_trace(run_dir: str) -> dict:
     instead of a dead bench row."""
     timeline, skipped = merged_timeline(run_dir)
     events: List[dict] = []
+    span_rows: List[Tuple[dict, dict]] = []
     pids = set()
     for row in timeline:
         pid = int(row.get("process", 0))
@@ -427,6 +514,7 @@ def perfetto_trace(run_dir: str) -> dict:
             if ev is not None:
                 pids.add(pid)
                 events.append(ev)
+                span_rows.append((row, ev))
         elif kind == "heartbeat":
             t = row.get("t")
             if isinstance(t, (int, float)) \
@@ -451,6 +539,7 @@ def perfetto_trace(run_dir: str) -> dict:
                              ("reasons", "fault", "generation", "entry",
                               "flops", "bundle", "rule", "state", "value",
                               "threshold") if row.get(k) is not None}})
+    events.extend(_flow_events(span_rows))
     for pid in sorted(pids):
         events.append({"name": "process_name", "ph": "M", "pid": pid,
                        "args": {"name": f"p{pid}"}})
@@ -470,4 +559,125 @@ def perfetto_trace(run_dir: str) -> dict:
             "skipped_lines": skipped,
             "device_traces": profiler_trace_dirs(run_dir),
         },
+    }
+
+
+# ---------------------------------------------------------------------------
+# single-request traces (report --trace-request)
+# ---------------------------------------------------------------------------
+
+
+def _exemplar_family(run_dir: str,
+                     want: str) -> Tuple[List[dict], Optional[str]]:
+    """Span rows for ``want`` recovered from the exemplar rings — the
+    fallback when the event files have rotated past the ticket but
+    tail-retention kept it.  The front's ring sits at the run-dir root
+    (lane 0), each pool worker's next to its own events file (lane i+1);
+    a worker ring is keyed by the WORKER's ticket, so once any ring
+    yields the trace id, the others are re-searched by it."""
+    from .exemplars import EXEMPLARS_NAME, find_exemplar
+
+    ex_paths = [(0, os.path.join(run_dir, EXEMPLARS_NAME))]
+    for p, epath in sorted(pool_worker_event_paths(run_dir).items()):
+        ex_paths.append((p, os.path.join(os.path.dirname(epath),
+                                         EXEMPLARS_NAME)))
+    recs: Dict[int, dict] = {}
+    trace_id: Optional[str] = None
+    for p, path in ex_paths:
+        rec = find_exemplar(path, want)
+        if rec is not None:
+            recs[p] = rec
+            if trace_id is None and rec.get("trace_id") is not None:
+                trace_id = str(rec["trace_id"])
+    if trace_id is not None and trace_id != want:
+        for p, path in ex_paths:
+            if p not in recs:
+                rec = find_exemplar(path, trace_id)
+                if rec is not None:
+                    recs[p] = rec
+    rows: List[dict] = []
+    for p, rec in sorted(recs.items()):
+        for s in rec.get("spans") or ():
+            if isinstance(s, dict):
+                row = dict(s)
+                row["process"] = p
+                rows.append(row)
+    return rows, trace_id
+
+
+_TRACE_SPAN_KEYS = ("process", "span", "span_id", "parent", "remote_parent",
+                    "start_s", "seconds", "ticket", "worker",
+                    "worker_ticket", "replays", "replayed", "error",
+                    "tenant", "request_kind", "mode")
+
+
+def trace_request(run_dir: str, ticket: str) -> Optional[dict]:
+    """Everything known about ONE request's trace: resolve ``ticket`` (a
+    front or worker ticket id, or a trace id) to its trace, collect the
+    full cross-process span family, and compute the critical-path
+    breakdown of the final ``serve.ticket`` root.  Primary source is the
+    merged timeline; the exemplar rings are the fallback for tickets the
+    event files no longer hold.  Returns ``None`` when nobody knows the
+    ticket.  Per-lane clocks are each process's run-relative stamps, so
+    cross-lane offsets are approximate (module docstring)."""
+    want = str(ticket)
+    timeline, _skipped = merged_timeline(run_dir)
+    spans = [r for r in timeline if r.get("kind") == "span"]
+    trace_id: Optional[str] = None
+    for r in spans:
+        if str(r.get("ticket")) == want or str(r.get("trace_id")) == want:
+            trace_id = str(r.get("trace_id") or want)
+            break
+    family: List[dict] = []
+    source = "events"
+    if trace_id is not None:
+        family = [r for r in spans if str(r.get("trace_id")) == trace_id]
+    if not family:
+        family, trace_id = _exemplar_family(run_dir, want)
+        source = "exemplars"
+    if not family:
+        return None
+    family.sort(key=lambda r: (int(r.get("process", 0)),
+                               float(r.get("start_s") or r.get("t") or 0.0)))
+    procs = sorted({int(r.get("process", 0)) for r in family})
+    hops = sum(1 for r in family if r.get("remote_parent") is not None)
+    by_name: Dict[str, dict] = {}
+    for r in family:
+        d = by_name.setdefault(str(r.get("span", "?")),
+                               {"count": 0, "total_s": 0.0})
+        d["count"] += 1
+        d["total_s"] += float(r.get("seconds") or 0.0)
+    for d in by_name.values():
+        d["total_s"] = round(d["total_s"], 6)
+    # critical path of the FINAL root (post-replay on a replayed ticket):
+    # the serve.ticket wall split across its direct children
+    crit: List[dict] = []
+    root_s = None
+    roots = [r for r in family if r.get("span") == "serve.ticket"]
+    if roots:
+        root = roots[-1]
+        rid = root.get("span_id")
+        rp = int(root.get("process", 0))
+        root_s = float(root.get("seconds") or 0.0)
+        for r in family:
+            if r.get("parent") == rid and int(r.get("process", 0)) == rp \
+                    and r is not root:
+                sec = float(r.get("seconds") or 0.0)
+                crit.append({
+                    "span": str(r.get("span", "?")),
+                    "seconds": round(sec, 6),
+                    "fraction": round(sec / root_s, 4) if root_s > 0
+                    else None})
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "ticket": want,
+        "trace_id": trace_id,
+        "source": source,
+        "processes": procs,
+        "cross_process_links": hops,
+        "spans": [{k: r.get(k) for k in _TRACE_SPAN_KEYS
+                   if r.get(k) is not None} for r in family],
+        "by_name": by_name,
+        "root_seconds": round(root_s, 6) if root_s is not None else None,
+        "critical_path": crit,
     }

@@ -168,7 +168,54 @@ GRANDFATHERED = frozenset({
     "pipeline_chunk_device_idle_bound_s",
 })
 
+#: The canonical SPAN-name table — the tracing twin of
+#: ``CANONICAL_METRICS``.  Every ``{"kind": "span"}`` row any module
+#: emits (SpanStream ``emit``/``timed``, the serve tier's
+#: ``_event_row(kind="span", span=...)`` families, the pool front's
+#: ``_span_row``) must carry a name declared here; the srnnlint
+#: ``span-names`` pass (S001/S002/S003) enforces both directions, the
+#: same discipline M001/M005 apply to metrics.  Values describe the
+#: emitting layer.  Span names are DOTTED lowercase
+#: (:func:`check_span_name`); the f-string chunk spans
+#: (``f"{stage}.chunk"``) are declared per concrete stage so a renamed
+#: setup cannot silently orphan its trace lanes.
+CANONICAL_SPANS: Dict[str, str] = {
+    # -- mega chunk spans (setups.common.emit_chunk_spans f-strings) -----
+    "mega_soup.chunk": "chunk root (mega_soup)",
+    "mega_soup.device_wait": "chunk child (mega_soup)",
+    "mega_soup.host_io": "chunk child (mega_soup)",
+    "mega_multisoup.chunk": "chunk root (mega_multisoup)",
+    "mega_multisoup.device_wait": "chunk child (mega_multisoup)",
+    "mega_multisoup.host_io": "chunk child (mega_multisoup)",
+    # -- distributed host I/O collectives (distributed.hostio sink) ------
+    "hostio.fetch_tree": "host gather collective",
+    "hostio.broadcast_run_dir": "run-dir broadcast collective",
+    # -- serve ticket families (serve/service.py per-ticket traces) ------
+    "serve.admit": "admission + journal fsync (durable-before-ack)",
+    "serve.ticket": "per-request root span",
+    "serve.ticket.queue": "backlog wait before the batching window",
+    "serve.ticket.window": "batching-window share sat out",
+    "serve.ticket.dispatch": "dispatch-group execution wall",
+    "serve.ticket.publish": "result publication + waiter wake",
+    # -- pool front hop (serve/pool.py; PR 17 fleet tracing) -------------
+    "front.admit": "front admission + journal fsync",
+    "front.assign": "worker selection (sticky round-robin)",
+    "front.relay": "forward to the worker (trace-context propagated)",
+    "front.replay": "re-forward after a worker death",
+}
+
 _SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_SPAN_NAME = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+
+def check_span_name(name: str) -> "list[str]":
+    """Convention violations for one span name (empty = clean): dotted
+    lowercase, at least one dot (the layer prefix is the lane contract —
+    ``serve.``/``front.`` rows render in the serve lane)."""
+    if not _SPAN_NAME.match(name):
+        return [f"{name}: span names are dotted lowercase "
+                "(layer.name[.child])"]
+    return []
 _BAD_UNIT_SUFFIXES = ("_sec", "_secs", "_ms", "_millis", "_mb", "_kb")
 
 

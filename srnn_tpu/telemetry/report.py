@@ -3,6 +3,7 @@
     python -m srnn_tpu.telemetry.report <run_dir> [--json]
     python -m srnn_tpu.telemetry.report --fleet <run_dir> [--json]
     python -m srnn_tpu.telemetry.report --trace <run_dir> [--json]
+    python -m srnn_tpu.telemetry.report --trace-request <ticket> <run_dir>
     python -m srnn_tpu.telemetry.report --triage <bundle_dir> [--json]
     python -m srnn_tpu.telemetry.report --dynamics <run_dir> [--json]
 
@@ -465,6 +466,39 @@ def _render_triage(s: dict, out) -> None:
 
 
 # ---------------------------------------------------------------------------
+# single-request traces (telemetry.fleet.trace_request)
+# ---------------------------------------------------------------------------
+
+
+def _render_trace_request(s: dict, out) -> None:
+    w = out.write
+    lanes = ", ".join(f"p{p}" for p in s["processes"])
+    w(f"trace {s['ticket']} (trace_id={s['trace_id']}, via "
+      f"{s['source']}): {len(s['spans'])} span(s) across {lanes}, "
+      f"{s['cross_process_links']} cross-process link(s)\n")
+    for r in s["spans"]:
+        start = r.get("start_s")
+        stamp = f"+{start:9.4f}s" if isinstance(start, (int, float)) \
+            else "          ?"
+        sec = r.get("seconds")
+        dur = f"{sec:.4f}s" if isinstance(sec, (int, float)) else "?"
+        extras = [f"{k}={r[k]}" for k in
+                  ("worker", "worker_ticket", "replays", "replayed",
+                   "error", "mode") if r.get(k) is not None]
+        link = " <-hop" if r.get("remote_parent") is not None else ""
+        w(f"  [p{r.get('process', 0)} {stamp}] {r.get('span', '?'):<16} "
+          f"{dur:>10}{link}"
+          + (("  " + " ".join(extras)) if extras else "") + "\n")
+    if s["critical_path"]:
+        w(f"critical path (serve.ticket {s['root_seconds']}s):\n")
+        for c in sorted(s["critical_path"],
+                        key=lambda c: -(c["seconds"] or 0.0)):
+            frac = f" {c['fraction'] * 100:5.1f}%" \
+                if c.get("fraction") is not None else ""
+            w(f"  {c['span']:<16} {c['seconds']:.4f}s{frac}\n")
+
+
+# ---------------------------------------------------------------------------
 # replication dynamics (telemetry.genealogy over lineage.jsonl)
 # ---------------------------------------------------------------------------
 
@@ -553,6 +587,13 @@ def main(argv=None) -> int:
                         "trace.json in the run dir; any triage bundle's "
                         "armed jax.profiler device trace is linked under "
                         "otherData.device_traces")
+    p.add_argument("--trace-request", metavar="TICKET",
+                   help="render ONE request's end-to-end trace: resolve "
+                        "TICKET (front/worker ticket id or trace id) to "
+                        "its span family across every process lane, with "
+                        "the critical-path breakdown of the final "
+                        "serve.ticket root; falls back to the exemplar "
+                        "rings when the event files no longer hold it")
     p.add_argument("--dynamics", action="store_true",
                    help="render the run's replication-dynamics trail "
                         "(lineage.jsonl via telemetry.genealogy)")
@@ -592,6 +633,23 @@ def main(argv=None) -> int:
         for d in doc["otherData"]["device_traces"]:
             print(f"  device trace (jax.profiler, TensorBoard-loadable): "
                   f"{d}")
+        return 0
+    if args.trace_request:
+        from .fleet import trace_request
+
+        s = trace_request(args.run_dir, args.trace_request)
+        if s is None:
+            # same no-data contract as --trace: exit 2, name the state
+            print(f"report: {args.run_dir}: ticket "
+                  f"{args.trace_request!r} not found in the merged "
+                  "timeline or any exemplar ring (resolved root-only "
+                  "tickets keep just their serve.ticket row)",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(s, indent=1, default=str))
+        else:
+            _render_trace_request(s, sys.stdout)
         return 0
     if args.fleet:
         from .fleet import fleet_summary, render_fleet

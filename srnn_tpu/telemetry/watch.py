@@ -199,7 +199,9 @@ def service_snapshot(socket_path: str) -> dict:
            # snapshot, and — pool fronts — the per-worker rows
            "dispatch": stats.get("dispatch"),
            "front": stats.get("front"),
-           "fleet": stats.get("fleet")}
+           "fleet": stats.get("fleet"),
+           # fleet tracing (PR 17): the slowest retained traces
+           "slowest": stats.get("slowest")}
     uptime = stats.get("uptime_s") or 0
     out["requests_per_sec"] = round(stats.get("completed", 0) / uptime, 3) \
         if uptime > 0 else 0.0
@@ -252,6 +254,21 @@ def render_service(s: dict, out) -> None:
                   + (f"p95<={target}ms target, " if target else "no target, ")
                   + (f"measured p95~{p95}ms, " if p95 is not None else "")
                   + f"{slo.get('violations', 0)} violation(s)\n")
+    slowest = s.get("slowest")
+    if slowest:
+        out.write("  slowest traces (report --trace-request <ticket>):\n")
+        for e in slowest:
+            flags = "".join(
+                tag for tag, on in ((" SLO", e.get("slo_violation")),
+                                    (" FAILED", e.get("failed")),
+                                    (" QUARANTINED", e.get("quarantined")),
+                                    (" replayed", e.get("replays")))
+                if on)
+            where = f" @{e['worker']}" if e.get("worker") else ""
+            out.write(f"    {e.get('ticket')}: "
+                      f"{float(e.get('seconds') or 0.0):.4f}s "
+                      f"{e.get('kind')}/{e.get('tenant')}{where}"
+                      f"{flags}\n")
     render_alerts(s.get("alerts"), out)
     sh = s.get("self_healing")
     if sh:

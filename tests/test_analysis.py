@@ -891,6 +891,67 @@ def test_metric_references_clean_on_real_repo(repo_ctx):
             if f.code == "M006"] == []
 
 
+def test_span_names_fire_on_undeclared_emissions(tmp_path):
+    """S001: each of the three emission idioms (SpanStream emit/timed,
+    the serve ``span=`` keyword rows, the pool front's ``_span_row``)
+    fires on a name outside CANONICAL_SPANS; an undotted ``.emit()``
+    call (some unrelated API) is NOT a span emission."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        def f(stream, ticket, queue):
+            stream.emit("bogus.span", 1.0, 0.5)
+            with stream.timed("front.bogus"):
+                pass
+            _span_row(ticket, "serve.bogus", 7, start_s=0.0, seconds=0.1)
+            _event_row(kind="span", span="serve.ticket.bogus", span_id=1)
+            queue.emit("message")            # undotted: not a span idiom
+            stream.emit("serve.ticket", 1.0, 0.5)   # declared: clean
+        """})
+    found = [f for f in run_pass(ctx, "span-names") if f.code == "S001"]
+    bad = sorted(f.message.split("'")[1] for f in found)
+    assert bad == ["bogus.span", "front.bogus", "serve.bogus",
+                   "serve.ticket.bogus"]
+    assert all(f.path == "srnn_tpu/mod.py" for f in found)
+
+
+def test_span_liveness_fires_on_declared_but_never_emitted(tmp_path):
+    """S002 (the M005 twin): the fixture emits one canonical name as a
+    literal, spells a second as a bare string constant (the
+    ``relay_name = ... if ... else ...`` idiom), and covers the chunk
+    families through an f-string SUFFIX — every other declared span is
+    dead, and those must not be."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": """
+        def f(stream, stage, replays):
+            stream.emit("serve.ticket", 1.0, 0.5)
+            stream.emit(f"{stage}.chunk", 1.0, 0.5)
+            name = "front.replay" if replays else "front.relay"
+            return name
+        """})
+    dead = {f.message.split("'")[1] for f in run_pass(ctx, "span-names")
+            if f.code == "S002"}
+    assert "serve.ticket" not in dead         # literal emission
+    assert "front.relay" not in dead          # whole-constant evidence
+    assert "front.replay" not in dead
+    assert "mega_soup.chunk" not in dead      # f-string suffix evidence
+    assert "mega_multisoup.chunk" not in dead
+    assert "front.assign" in dead             # nothing spells it here
+    assert "serve.admit" in dead
+
+
+def test_span_names_scan_going_dark_is_loud(tmp_path):
+    """S003: a fixture with no span emissions at all means the pass's
+    idiom recognition broke (or the idioms moved) — one loud finding,
+    not a silently-green gate."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/mod.py": "X = 1\n"})
+    assert codes(run_pass(ctx, "span-names")) == ["S003"]
+
+
+def test_span_liveness_clean_on_real_repo(repo_ctx):
+    """Every declared span has an emission site in the real package —
+    the gate that keeps CANONICAL_SPANS from accumulating dead lanes."""
+    assert [f for f in run_pass(repo_ctx, "span-names")
+            if f.code == "S002"] == []
+
+
 # ---------------------------------------------------------------------------
 # waivers / baseline machinery
 # ---------------------------------------------------------------------------

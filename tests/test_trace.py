@@ -1,0 +1,408 @@
+"""Fleet-wide request tracing (PR 17): trace-context propagation from
+client through the pool front to worker dispatch, the cross-process
+fleet merge with Perfetto flow arrows, tail-kept exemplar retention,
+and the ``report --trace-request`` critical-path view.
+
+The load-bearing contracts drilled here:
+
+  * the journal is FORWARD-COMPATIBLE — trace fields (and any unknown
+    field a newer writer adds) survive recovery compaction verbatim,
+    and a traceless journal compacts byte-identically to pre-tracing
+    builds;
+  * a pool worker's ``workers/w<i>/events.jsonl`` rows hardcode
+    ``process: 0`` (each worker is a solo service) — the fleet merge
+    must FORCE them onto lane ``i+1`` from the file layout;
+  * a replayed ticket is ONE trace: a single ``trace_id`` spanning the
+    front and every worker lane, connected by ``remote_parent`` links
+    that render as paired Perfetto flow events.
+"""
+
+import json
+import os
+
+import pytest
+
+from srnn_tpu.serve.journal import (TicketJournal, read_journal)
+from srnn_tpu.telemetry import fleet
+from srnn_tpu.telemetry.exemplars import (EXEMPLARS_NAME, ExemplarRing,
+                                          find_exemplar, read_exemplars)
+
+# ---------------------------------------------------------------------------
+# journal: trace context + forward compatibility
+# ---------------------------------------------------------------------------
+
+
+def test_journal_trace_fields_round_trip(tmp_path):
+    j = TicketJournal(str(tmp_path))
+    j.record_submit(ticket="t000001", kind="soup", params={"seed": 1},
+                    tenant="a", wall=10.0, trace_id="cafe0123",
+                    parent_span=7)
+    j.record_submit(ticket="t000002", kind="soup", params={"seed": 2},
+                    tenant="b", wall=11.0)
+    j.close()
+    entries, torn, nxt = read_journal(j.path)
+    assert torn == 0 and nxt == 3
+    assert (entries[0].trace_id, entries[0].parent_span) == ("cafe0123", 7)
+    assert (entries[1].trace_id, entries[1].parent_span) == (None, None)
+    # traceless submits journal WITHOUT the trace keys (byte-compat)
+    lines = [json.loads(l) for l in open(j.path)]
+    assert "trace_id" in lines[0] and "trace_id" not in lines[1]
+    assert "parent_span" not in lines[1]
+
+
+def test_journal_preserves_unknown_fields_through_compaction(tmp_path):
+    """A journal written by a NEWER version carries fields this reader
+    does not know; recovery compaction must pass them through verbatim
+    (downgrade-then-upgrade never strips them)."""
+    path = tmp_path / "journal.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "e": "submit", "ticket": "t000001", "kind": "soup",
+            "params": {}, "tenant": "a", "key": None,
+            "deadline_wall": None, "wall": 1.0,
+            "trace_id": "feed0001", "parent_span": 3,
+            "priority": "high", "baggage": {"x": 1}}) + "\n")
+        f.write(json.dumps({"e": "submit", "ticket": "t000002",
+                            "kind": "soup", "params": {}, "tenant": "b",
+                            "key": None, "deadline_wall": None,
+                            "wall": 2.0}) + "\n")
+        f.write(json.dumps({"e": "done", "ticket": "t000002",
+                            "status": "done"}) + "\n")
+    entries, _torn, _nxt = read_journal(str(path))
+    assert entries[0].extra == {"priority": "high", "baggage": {"x": 1}}
+    assert entries[1].ticket if len(entries) > 1 else True  # t2 is done
+    j = TicketJournal(str(tmp_path))
+    unfinished, torn, nxt = j.recover()
+    j.close()
+    assert [e.ticket for e in unfinished] == ["t000001"]
+    rows = [json.loads(l) for l in open(path)]
+    assert rows[0] == {"e": "mark", "next_ticket": 3}
+    sub = rows[1]
+    assert sub["priority"] == "high" and sub["baggage"] == {"x": 1}
+    assert sub["trace_id"] == "feed0001" and sub["parent_span"] == 3
+    # a second recovery is a fixed point: nothing decays per cycle
+    j2 = TicketJournal(str(tmp_path))
+    j2.recover()
+    j2.close()
+    rows2 = [json.loads(l) for l in open(path)]
+    assert rows2 == rows
+
+
+# ---------------------------------------------------------------------------
+# exemplar ring: tail-kept traces
+# ---------------------------------------------------------------------------
+
+
+def test_exemplar_ring_append_find_and_compaction(tmp_path):
+    path = str(tmp_path / EXEMPLARS_NAME)
+    ring = ExemplarRing(path, capacity=4)
+    for i in range(10):
+        ring.add({"ticket": f"t{i:06d}", "trace_id": f"tr{i}",
+                  "reason": "slo", "spans": [{"span": "serve.ticket"}]})
+    rows = read_exemplars(path)
+    # compacts past 2*capacity down to the newest `capacity`
+    assert len(rows) <= 2 * 4
+    assert rows[-1]["ticket"] == "t000009"
+    # newest-wins lookup, by ticket OR trace id
+    ring.add({"ticket": "t000009", "trace_id": "tr9", "reason": "replayed"})
+    assert find_exemplar(path, "t000009")["reason"] == "replayed"
+    assert find_exemplar(path, "tr9")["reason"] == "replayed"
+    assert find_exemplar(path, "never-issued") is None
+    # a torn tail (kill -9 mid-append) is skipped, not fatal
+    with open(path, "a") as f:
+        f.write('{"ticket": "t999999", "tr')
+    assert find_exemplar(path, "t999999") is None
+    assert read_exemplars(path)[-1]["ticket"] == "t000009"
+
+
+def test_exemplar_ring_adopts_existing_file(tmp_path):
+    path = str(tmp_path / EXEMPLARS_NAME)
+    ExemplarRing(path, capacity=2).add({"ticket": "a"})
+    ring = ExemplarRing(path, capacity=2)   # restart: adopts line count
+    for t in ("b", "c", "d", "e"):
+        ring.add({"ticket": t})
+    assert len(read_exemplars(path)) <= 4
+    assert read_exemplars(path)[-1]["ticket"] == "e"
+
+
+# ---------------------------------------------------------------------------
+# service: trace adoption end to end (submit -> spans -> exemplars)
+# ---------------------------------------------------------------------------
+
+
+def test_service_adopts_propagated_trace_context(tmp_path):
+    """A submit carrying trace context (the pool-forwarded case): the
+    serve.admit span and the whole serve.ticket family adopt the
+    propagated trace_id, the root records the far side of the hop as
+    remote_parent (never parent), the SLO-violating ticket keeps its
+    FULL span family in the exemplar ring, and stats surfaces the
+    slowest-traces panel."""
+    from srnn_tpu.serve.service import ExperimentService
+
+    root = str(tmp_path / "svc")
+    svc = ExperimentService(root, max_stack=8, slo_p95_ms=0.001)
+    with svc:
+        t1 = svc.submit("fixpoint_density",
+                        {"seed": 0, "trials": 64, "batch": 32},
+                        tenant="a", trace_id="cafe0123", parent_span=42)
+        assert svc.run_pending(window_s=0.05) == 1
+        assert svc.wait(t1)["status"] == "done"
+        stats = svc.stats()
+        svc.writer.flush()
+    rows = [json.loads(l) for l in open(os.path.join(root, "events.jsonl"))]
+    spans = [r for r in rows if r.get("kind") == "span"]
+    admit = [r for r in spans if r["span"] == "serve.admit"]
+    assert admit and admit[0]["trace_id"] == "cafe0123"
+    assert admit[0]["remote_parent"] == 42 and admit[0]["ticket"] == t1
+    assert "parent" not in admit[0]
+    fam = [r for r in spans if r.get("trace_id") == "cafe0123"]
+    names = {r["span"] for r in fam}
+    assert {"serve.admit", "serve.ticket", "serve.ticket.queue",
+            "serve.ticket.window", "serve.ticket.dispatch",
+            "serve.ticket.publish"} <= names
+    (ticket_root,) = [r for r in fam if r["span"] == "serve.ticket"]
+    assert ticket_root["remote_parent"] == 42
+    # tail retention: the 1-microsecond SLO makes this ticket a keeper
+    rec = find_exemplar(os.path.join(root, EXEMPLARS_NAME), t1)
+    assert rec is not None and "slo" in rec["reason"]
+    assert rec["trace_id"] == "cafe0123"
+    assert len(rec["spans"]) == 5   # full family, not just the root
+    # the slowest panel carries the pointer the operator follows
+    (slow,) = [e for e in stats["slowest"] if e["ticket"] == t1]
+    assert slow["trace_id"] == "cafe0123" and slow["slo_violation"]
+
+
+def test_service_untraced_submit_roots_its_own_trace(tmp_path):
+    """No propagated context -> the ticket id IS the trace id (the PR 12
+    contract test_serve_ticket_spans_breakdown_and_slo leans on), and a
+    sub-SLO ticket retains only its root span."""
+    from srnn_tpu.serve.service import ExperimentService
+
+    root = str(tmp_path / "svc")
+    with ExperimentService(root, max_stack=8) as svc:   # no SLO target
+        t1 = svc.submit("fixpoint_density",
+                        {"seed": 0, "trials": 64, "batch": 32}, tenant="a")
+        svc.run_pending(window_s=0.05)
+        assert svc.wait(t1)["status"] == "done"
+        svc.writer.flush()
+    rec = find_exemplar(os.path.join(root, EXEMPLARS_NAME), t1)
+    assert rec["reason"] == "root" and len(rec["spans"]) == 1
+    assert rec["trace_id"] == t1
+
+
+# ---------------------------------------------------------------------------
+# fleet merge: pool layout, forced lanes, flow arrows
+# ---------------------------------------------------------------------------
+
+
+def _span(name, span_id, *, trace_id, t, dur, process=0, **kw):
+    row = {"t": t, "kind": "span", "span": name, "span_id": span_id,
+           "trace_id": trace_id, "process": process,
+           "start_s": round(t - dur, 6), "seconds": dur}
+    row.update(kw)
+    return row
+
+
+def _craft_pool_run_dir(tmp_path):
+    """A pool front run dir: front events at the root (lane 0) with the
+    front.admit/assign/relay/replay hop spans for ticket t000001 (relayed
+    to w0, killed, replayed to w1), plus two worker sub-roots whose rows
+    all claim ``process: 0`` — w1's file OUT OF ORDER and w0's file with
+    a torn tail (the kill -9 corpse)."""
+    run = tmp_path / "pool"
+    run.mkdir()
+    tr = "cafe0123"
+    front = [
+        _span("front.admit", 1, trace_id=tr, t=1.0, dur=0.001,
+              ticket="t000001", tenant="a"),
+        _span("front.assign", 2, trace_id=tr, t=1.01, dur=0.0001,
+              ticket="t000001", worker=0),
+        _span("front.relay", 3, trace_id=tr, t=1.02, dur=0.01,
+              ticket="t000001", worker=0, worker_ticket="t000001"),
+        _span("front.replay", 4, trace_id=tr, t=3.0, dur=0.01,
+              ticket="t000001", worker=1, worker_ticket="t000001",
+              replays=1),
+    ]
+    with open(run / "events.jsonl", "w") as f:
+        for row in front:
+            f.write(json.dumps(row) + "\n")
+    # dead worker w0: adopted the trace (remote_parent = relay span 3),
+    # then a torn tail where the kill landed
+    w0 = run / "workers" / "w0"
+    w0.mkdir(parents=True)
+    with open(w0 / "events.jsonl", "w") as f:
+        f.write(json.dumps(_span("serve.admit", 1, trace_id=tr, t=1.03,
+                                 dur=0.001, ticket="t000001",
+                                 remote_parent=3)) + "\n")
+        f.write('{"t": 1.9, "kind": "span", "span": "serve.tick')
+    # survivor w1: replayed family, root + children — written OUT OF
+    # ORDER so the merge must sort, not trust file order
+    w1 = run / "workers" / "w1"
+    w1.mkdir(parents=True)
+    fam = [
+        _span("serve.ticket", 10, trace_id=tr, t=3.6, dur=0.5,
+              ticket="t000001", remote_parent=4, mode="stacked"),
+        _span("serve.ticket.queue", 11, trace_id=tr, t=3.2, dur=0.1,
+              parent=10),
+        _span("serve.ticket.dispatch", 12, trace_id=tr, t=3.55, dur=0.35,
+              parent=10),
+    ]
+    with open(w1 / "events.jsonl", "w") as f:
+        for row in (fam[2], fam[0], fam[1]):
+            f.write(json.dumps(row) + "\n")
+    return run, tr
+
+
+def test_pool_merge_forces_worker_lanes(tmp_path):
+    run, tr = _craft_pool_run_dir(tmp_path)
+    rows, skipped = fleet.merged_timeline(str(run))
+    assert skipped == 1   # w0's torn tail dropped, not fatal
+    ts = [r["t"] for r in rows]
+    assert ts == sorted(ts)
+    # worker rows said process 0; the layout overrode them to lanes 1/2
+    by_lane = {}
+    for r in rows:
+        by_lane.setdefault(r["process"], []).append(r["span"])
+    assert by_lane[0] == ["front.admit", "front.assign", "front.relay",
+                          "front.replay"]
+    assert by_lane[1] == ["serve.admit"]
+    assert set(by_lane[2]) == {"serve.ticket", "serve.ticket.queue",
+                               "serve.ticket.dispatch"}
+    # every row across all three lanes is ONE trace
+    assert {r["trace_id"] for r in rows} == {tr}
+    s = fleet.fleet_summary(str(run))
+    assert s["worker_files"] == [os.path.join("workers", "w0",
+                                              "events.jsonl"),
+                                 os.path.join("workers", "w1",
+                                              "events.jsonl")]
+    assert set(s["processes"]) == {"0", "1", "2"}
+
+
+def test_perfetto_flow_events_pair_across_the_hop(tmp_path):
+    """Every remote_parent becomes a paired ph:"s"/"f" flow bound to the
+    front span that minted the id — the kill-9 story renders as ONE
+    connected trace: front.relay -> dead w0, front.replay -> survivor
+    w1."""
+    run, tr = _craft_pool_run_dir(tmp_path)
+    doc = fleet.perfetto_trace(str(run))
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+    starts = {e["id"]: e for e in flows if e["ph"] == "s"}
+    finishes = {e["id"]: e for e in flows if e["ph"] == "f"}
+    assert len(starts) == len(finishes) == 2   # relay hop + replay hop
+    assert set(starts) == set(finishes)
+    hops = set()
+    for fid, s in starts.items():
+        f = finishes[fid]
+        assert f["bp"] == "e"
+        assert s["args"]["trace_id"] == f["args"]["trace_id"] == tr
+        assert s["pid"] == 0 and f["pid"] != 0   # front -> worker, always
+        assert s["ts"] <= f["ts"]                # arrows never point back
+        hops.add((s["pid"], f["pid"]))
+    assert hops == {(0, 1), (0, 2)}
+    # the span slices themselves land on the serve lane of each process
+    serve_evs = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "span" and
+                 (e["name"].startswith("serve.") or
+                  e["name"].startswith("front."))]
+    assert {e["tid"] for e in serve_evs} == {2}
+    assert {e["pid"] for e in serve_evs} == {0, 1, 2}
+
+
+def test_trace_request_resolves_by_ticket_and_trace_id(tmp_path):
+    run, tr = _craft_pool_run_dir(tmp_path)
+    for want in ("t000001", tr):
+        s = fleet.trace_request(str(run), want)
+        assert s is not None and s["source"] == "events"
+        assert s["trace_id"] == tr
+        assert s["processes"] == [0, 1, 2]
+        # w0's admit + w1's root each carry a cross-process link
+        assert s["cross_process_links"] == 2
+        assert s["by_name"]["front.replay"]["count"] == 1
+        assert s["root_seconds"] == pytest.approx(0.5)
+        crit = {c["span"]: c for c in s["critical_path"]}
+        assert set(crit) == {"serve.ticket.queue",
+                             "serve.ticket.dispatch"}
+        assert crit["serve.ticket.dispatch"]["fraction"] == \
+            pytest.approx(0.35 / 0.5, abs=1e-3)
+    assert fleet.trace_request(str(run), "never-issued") is None
+
+
+def test_trace_request_falls_back_to_exemplar_rings(tmp_path):
+    """Events rotated past the ticket but tail retention kept it: the
+    front ring holds the front spans keyed by the FRONT ticket, the
+    worker ring its family keyed by the WORKER ticket — the fallback
+    joins them through the shared trace id."""
+    run = tmp_path / "pool"
+    (run / "workers" / "w0").mkdir(parents=True)
+    with open(run / "workers" / "w0" / "events.jsonl", "w") as f:
+        f.write("")   # present (the lane exists) but empty
+    with open(run / "events.jsonl", "w") as f:
+        f.write("")
+    tr = "feed0042"
+    front_ring = ExemplarRing(str(run / EXEMPLARS_NAME))
+    front_ring.add({"ticket": "t000007", "trace_id": tr,
+                    "reason": "replayed",
+                    "spans": [{"kind": "span", "span": "front.admit",
+                               "span_id": 1, "trace_id": tr,
+                               "start_s": 1.0, "seconds": 0.001,
+                               "ticket": "t000007"}]})
+    wring = ExemplarRing(str(run / "workers" / "w0" / EXEMPLARS_NAME))
+    wring.add({"ticket": "t000031", "trace_id": tr, "reason": "slo",
+               "spans": [{"kind": "span", "span": "serve.ticket",
+                          "span_id": 9, "trace_id": tr,
+                          "remote_parent": 3, "start_s": 1.2,
+                          "seconds": 0.4, "ticket": "t000031"}]})
+    s = fleet.trace_request(str(run), "t000007")
+    assert s is not None and s["source"] == "exemplars"
+    assert s["trace_id"] == tr
+    assert s["processes"] == [0, 1]
+    assert s["cross_process_links"] == 1
+    assert {r["span"] for r in s["spans"]} == {"front.admit",
+                                               "serve.ticket"}
+    # resolving by the WORKER's ticket finds the same joined trace
+    s2 = fleet.trace_request(str(run), "t000031")
+    assert s2 is not None and s2["trace_id"] == tr
+    assert s2["processes"] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# report / watch surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_report_trace_request_cli(tmp_path, capsys):
+    from srnn_tpu.telemetry import report
+
+    run, tr = _craft_pool_run_dir(tmp_path)
+    assert report.main([str(run), "--trace-request", "t000001"]) == 0
+    text = capsys.readouterr().out
+    assert tr in text and "front.relay" in text
+    assert "<-hop" in text and "critical path" in text
+    assert report.main([str(run), "--trace-request", "t000001",
+                        "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["trace_id"] == tr and doc["cross_process_links"] == 2
+    assert report.main([str(run), "--trace-request", "nope"]) == 2
+
+
+def test_watch_service_render_slowest_panel():
+    from srnn_tpu.telemetry import watch
+
+    out = []
+
+    class Out:
+        write = staticmethod(out.append)
+
+    watch.render_service(
+        {"socket": "/tmp/s.sock", "completed": 3, "queue_depth": 0,
+         "requests_per_sec": 1.0, "uptime_s": 5.0, "distinct_programs": 1,
+         "slowest": [
+             {"ticket": "t000001", "trace_id": "cafe0123",
+              "seconds": 1.25, "kind": "soup", "tenant": "a",
+              "slo_violation": True, "failed": False,
+              "quarantined": False, "replays": 1, "worker": "w1"}]},
+        Out())
+    text = "".join(out)
+    assert "slowest traces" in text and "--trace-request" in text
+    assert "t000001" in text and "1.2500s" in text
+    assert "SLO" in text and "replayed" in text and "@w1" in text
