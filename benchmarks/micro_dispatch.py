@@ -523,6 +523,108 @@ def row_fused() -> dict:
         extra={"mosaic_kernel": native_mosaic_backend()})
 
 
+def row_int8() -> dict:
+    """``population_dtype='int8'`` vs the f32 chunk at the micro config
+    (same dynamics, same draws — int8 quantizes ONCE per generation at
+    the same point both spellings share).  Measures the per-generation
+    quantize/dequantize tax next to the 4x storage win; informational
+    like every overhead row (at mega shapes the tax amortizes against
+    memory bandwidth — bench.py's leg is the authoritative number)."""
+    import jax
+
+    from srnn_tpu.soup import evolve, seed
+
+    cfg = _config(TELEMETRY_N)
+    icfg = cfg._replace(population_dtype="int8")
+    st = seed(cfg, jax.random.key(0))
+    ist = seed(icfg, jax.random.key(0))
+
+    def plain():
+        s = evolve(cfg, st, generations=TELEMETRY_GENS)
+        return float(s.next_uid)
+
+    def int8():
+        s = evolve(icfg, ist, generations=TELEMETRY_GENS)
+        return float(s.next_uid)
+
+    return _overhead_row("int8", {"plain": plain, "int8": int8},
+                         base="plain", feature="int8")
+
+
+#: run length the autotune grid cost amortizes over: a 10k-generation
+#: mega run at the default --checkpoint-every=20 dispatches ~500 chunks,
+#: and the grid is paid once per (shape, backend) key per CACHE lifetime
+#: (tuning.json memo-hits every later run)
+AUTOTUNE_NOMINAL_CHUNKS = 500
+
+
+def row_autotune() -> dict:
+    """The block autotuner's two costs on the shared protocol:
+
+      * per-dispatch: the public ``apply_chain_blocked`` wrapper's
+        tuning-table lookup vs an explicit-block call (same compiled
+        program; measures pure host lookup/indirection — should read ~0%)
+      * one-time: the candidate-grid measurement wall (``grid_s``),
+        reported amortized over a nominal 500-chunk run
+        (``amortized_over_run_pct``, documented bound <= ~5%; the grid is
+        ~20 dispatches of the measured shape, so this holds by
+        construction for any run past ~400 chunks — and later runs pay
+        ZERO, the tuning.json memo)."""
+    import jax
+
+    from srnn_tpu import autotune, init_population
+    from srnn_tpu.ops.pallas_generation import (_apply_chain_blocked,
+                                                apply_chain_blocked)
+    from srnn_tpu.topology import Topology
+
+    topo = Topology("weightwise", width=2, depth=2)
+    n, steps = TELEMETRY_N, 40
+    wT = (init_population(topo, jax.random.key(0), n) * 0.05).T
+
+    def plain():
+        out = _apply_chain_blocked(topo, wT, steps, block=min(2048, n))
+        return float(out.sum())
+
+    def tuned():
+        out = apply_chain_blocked(topo, wT, steps)
+        return float(out.sum())
+
+    out = _overhead_row("autotune", {"plain": plain, "autotune": tuned},
+                        base="plain", feature="autotune")
+    out["n"], out["generations"] = n, steps
+    # the one-time grid wall, measured directly (bypassing tuning.json so
+    # a memo hit cannot fake a zero)
+    cands = tuple(min(b, n) for b in autotune.APPLY_CHAIN_CANDIDATES)
+
+    def grid_run(block):
+        jax.block_until_ready(_apply_chain_blocked(topo, wT, steps,
+                                                   block=block))
+
+    t0 = time.perf_counter()
+    autotune._measure_walls(grid_run, cands)
+    grid_s = time.perf_counter() - t0
+    # amortization denominator: the REAL chunk program a mega run
+    # dispatches (the telemetry rows' plain chunk), not the apply chain —
+    # the grid is paid once per cache lifetime, against a whole run
+    import statistics
+
+    chunk = _chunk_fns()["plain"]
+    chunk()  # compile + warm
+    t_ch = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        chunk()
+        t_ch.append(time.perf_counter() - t0)
+    chunk_s = statistics.median(t_ch)
+    run_s = chunk_s * AUTOTUNE_NOMINAL_CHUNKS
+    out["grid_s"] = round(grid_s, 3)
+    out["chunk_s"] = round(chunk_s, 3)
+    out["nominal_run_chunks"] = AUTOTUNE_NOMINAL_CHUNKS
+    out["amortized_over_run_pct"] = round(100 * grid_s / max(run_s, 1e-9),
+                                          2)
+    return out
+
+
 STACKED_K = 8
 #: tiny-population shape, deliberately: the service's clientele is the
 #: paper's experiment suite (soups of 10-20), where per-dispatch overhead
@@ -595,11 +697,12 @@ def main(argv=None) -> int:
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
             row_telemetry(), row_health(), row_lineage(), row_spans(),
             row_export(), row_trace(), row_adaptive(), row_fused(),
-            row_stacked()]
+            row_int8(), row_autotune(), row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        c, d, m, t, h, l, sp, ex, tr, ad, fu, sk = rows
+        (c, d, m, t, h, l, sp, ex, tr, ad, fu, i8, au,
+         sk) = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
               "persistent cache)", file=sys.stderr)
@@ -646,6 +749,17 @@ def main(argv=None) -> int:
               f"{fu['plain_ms_per_chunk']:.1f}ms per chunk "
               f"({fu['overhead_pct']:+.1f}%, "
               f"mosaic_kernel={fu['mosaic_kernel']})", file=sys.stderr)
+        print(f"# int8(N={i8['n']}, G={i8['generations']}): "
+              f"{i8['int8_ms_per_chunk']:.1f}ms vs f32 "
+              f"{i8['plain_ms_per_chunk']:.1f}ms per chunk "
+              f"({i8['overhead_pct']:+.1f}% quantize/dequant tax)",
+              file=sys.stderr)
+        print(f"# autotune(N={au['n']}, steps={au['generations']}): "
+              f"lookup {au['autotune_ms_per_chunk']:.1f}ms vs explicit "
+              f"{au['plain_ms_per_chunk']:.1f}ms "
+              f"({au['overhead_pct']:+.1f}%); grid {au['grid_s']:.2f}s "
+              f"= {au['amortized_over_run_pct']:.1f}% of a "
+              f"{au['nominal_run_chunks']}-chunk run", file=sys.stderr)
         print(f"# stacked(K={sk['k']}, N={sk['n']}, G={sk['generations']}): "
               f"one stacked dispatch {sk['stacked_ms_per_chunk']:.1f}ms vs "
               f"8 solo dispatches {sk['solo8_ms_per_chunk']:.1f}ms "

@@ -199,6 +199,91 @@ def sweep_bf16_parity(n_seeds: int) -> dict:
     }
 
 
+INT8_GENS = 100
+INT8_N = 256
+INT8_PER_GEN_GENS = 30
+
+
+def _int8_cfgs():
+    cfg32, _ = _bf16_cfgs()
+    return cfg32, cfg32._replace(population_dtype="int8")
+
+
+def _int8_as_f32_state(st):
+    """Dequantized f32 twin of an int8 state (codes x per-particle scale
+    — the same view every compute path takes at generation start)."""
+    from srnn_tpu.soup import _upcast
+
+    cfg8 = _int8_cfgs()[1]
+    return st._replace(weights=_upcast(cfg8, st.weights, st.scales),
+                       scales=None)
+
+
+def per_gen_int8_drift(gens: int = INT8_PER_GEN_GENS) -> float:
+    """Worst single-generation relative L-inf between the int8 mode and
+    an f32 generation started from the SAME (dequantized) state,
+    re-synced every generation.  One generation quantizes exactly once
+    (the quantize-point contract), losing at most half a step of the
+    per-particle scale ``amax/127`` — so the bound is O(2^-8) relative,
+    the same magnitude class as the bf16 row (PARITY.md int8 table)."""
+    cfg32, cfg8 = _int8_cfgs()
+    st8 = seed(cfg8, jax.random.key(0))
+    worst = 0.0
+    for _ in range(gens):
+        n32 = evolve(cfg32, _int8_as_f32_state(st8), generations=1)
+        st8 = evolve(cfg8, st8, generations=1)
+        w32 = np.asarray(n32.weights, np.float32)
+        w8 = np.asarray(_int8_as_f32_state(st8).weights, np.float32)
+        fin = np.isfinite(w32).all(1) & np.isfinite(w8).all(1)
+        scale = max(float(np.abs(w32[fin]).max()), 1e-9)
+        worst = max(worst, float(np.abs(w32[fin] - w8[fin]).max()) / scale)
+    return worst
+
+
+def sweep_int8_parity(n_seeds: int) -> dict:
+    """f32 <-> int8 population-mode parity (the PARITY.md int8 rows),
+    measured exactly like the bf16 sweep: a per-generation tolerance
+    bound from shared state, then distributional agreement of the
+    decorrelated 100-generation trajectories (the dynamic is chaotic —
+    a half-step quantization difference decorrelates trajectories just
+    like a bf16 rounding does; claims at trajectory level are
+    statistical, never elementwise)."""
+    cfg32, cfg8 = _int8_cfgs()
+    uid_agree, linf, census_l1, exact = [], [], [], True
+    for s in range(n_seeds):
+        f32 = evolve(cfg32, seed(cfg32, jax.random.key(s)),
+                     generations=INT8_GENS)
+        q8 = evolve(cfg8, seed(cfg8, jax.random.key(s)),
+                    generations=INT8_GENS)
+        exact = exact and q8.uids.dtype == jnp.int32 \
+            and q8.weights.dtype == jnp.int8 \
+            and q8.scales is not None \
+            and int(q8.time) == INT8_GENS \
+            and int(jnp.max(q8.uids)) < int(q8.next_uid)
+        u32, u8 = np.asarray(f32.uids), np.asarray(q8.uids)
+        match = u32 == u8
+        uid_agree.append(float(match.mean()))
+        w32 = np.asarray(f32.weights, np.float32)
+        w8 = np.asarray(_int8_as_f32_state(q8).weights, np.float32)
+        finite = np.isfinite(w32).all(1) & np.isfinite(w8).all(1)
+        lanes = match & finite
+        linf.append(float(np.abs(w32[lanes] - w8[lanes]).max())
+                    if lanes.any() else 0.0)
+        c32 = np.asarray(count(cfg32, f32))
+        c8 = np.asarray(count(cfg8, q8))
+        census_l1.append(int(np.abs(c32 - c8).sum()))
+    return {
+        "row": f"int8_parity[N={INT8_N},train=5,{INT8_GENS}gen]",
+        "seeds": n_seeds,
+        "per_gen_rel_linf": round(per_gen_int8_drift(), 6),
+        "integer_state_exact": bool(exact),
+        "uid_agreement_mean": round(float(np.mean(uid_agree)), 4),
+        "census_l1_mean": round(float(np.mean(census_l1)), 2),
+        "end_state_linf_matched_median": round(float(np.median(linf)), 5),
+        "end_state_linf_matched_max": round(float(np.max(linf)), 5),
+    }
+
+
 def _report(name: str, rows: np.ndarray, reference: dict) -> dict:
     mean = rows.mean(0)
     sd = rows.std(0, ddof=1 if rows.shape[0] > 1 else 0)
@@ -225,8 +310,9 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--seeds", type=int, default=10)
     p.add_argument("--rows", nargs="*",
-                   default=["soup", "rnn", "rnn_hypotheses", "bf16"],
-                   choices=["soup", "rnn", "rnn_hypotheses", "bf16"])
+                   default=["soup", "rnn", "rnn_hypotheses", "bf16", "int8"],
+                   choices=["soup", "rnn", "rnn_hypotheses", "bf16",
+                            "int8"])
     args = p.parse_args()
     watchdog(2400.0, on_fire=lambda: print(json.dumps(
         {"row": "parity_sweep", "error": "watchdog: wedged > 2400s"}),
@@ -240,6 +326,8 @@ def main():
         print(json.dumps(sweep_rnn_hypotheses(args.seeds)))
     if "bf16" in args.rows:
         print(json.dumps(sweep_bf16_parity(args.seeds)))
+    if "int8" in args.rows:
+        print(json.dumps(sweep_int8_parity(args.seeds)))
 
 
 if __name__ == "__main__":

@@ -45,9 +45,15 @@ the same PR with the reasoning updated here):
   serve_sat_w4_p95_ms         up-bad     50%        the widest fleet's
                                                     tail; same class as
                                                     serve_load_p95_ms
-  multihost_process_tax       up-bad     75%        gloo/process overhead
+  multihost_process_tax       up-bad     125%       gloo/process overhead
                                                     on a 1-2 core CI box
-                                                    is inherently noisy
+                                                    is inherently noisy;
+                                                    the PR 18 autotuner
+                                                    sped up the SOLO
+                                                    denominator, shifting
+                                                    the ratio ~1.8 → ~3.x
+                                                    until tuned rounds
+                                                    dominate the median
 
 Backends are compared like-for-like: a fresh CPU(-forced/-fallback)
 result is only judged against historical CPU rows — an accelerator
@@ -90,14 +96,31 @@ LEGS = {
                          "down", 0.40),
     "serve_sat_w4_p95_ms": (("serve", "saturation", "w4", "p95_ms"),
                             "up", 0.50),
-    "multihost_process_tax": (("multihost", "process_tax"), "up", 0.75),
+    # the tax is multi_wall / SOLO_wall: the PR 18 autotuner cut the
+    # solo denominator ~10-15% while the 2-process leg stays pinned by
+    # single-core time-slicing, so the ratio shifted structurally from
+    # ~1.8 to ~3.0-3.6 on this 1-vCPU host — tolerance covers the
+    # denominator shift until tuned rounds dominate the median
+    "multihost_process_tax": (("multihost", "process_tax"), "up", 1.25),
+    # tuned leg (PR 18): apps/chip judged ONLY among rounds that ran
+    # with an autotuned lane block (``tuned_block`` present in the
+    # result) — the autotuner moves the fused-chain median ~1.9x, so a
+    # tuned round must never be excused by an untuned median and an
+    # untuned round must never be judged against a tuned one
+    "tuned_apps_per_chip": (("value",), "down", 0.25),
 }
+
+#: legs whose median is meaningless below this many history rounds: the
+#: autotuner moved the fused-chain numbers so much that a 1-round
+#: "median" would whipsaw every verdict around whichever single round
+#: happened to land first after a re-baseline
+MIN_ROUNDS = {"scan_apps_per_chip": 2, "tuned_apps_per_chip": 2}
 
 #: micro_dispatch overhead rows: generous bounds (warning-only — see the
 #: module docstring on session drift) on the documented <=5%-class rows
 MICRO_BOUND_PCT = 20.0
 MICRO_ROWS = ("telemetry", "health", "lineage", "spans", "export",
-              "adaptive")
+              "adaptive", "int8", "autotune")
 
 
 def _get(doc, path):
@@ -153,7 +176,10 @@ def compare(fresh: dict, history: list) -> dict:
     legs = []
     findings = []
     for leg, (path, direction, tol) in LEGS.items():
+        tuned_leg = leg == "tuned_apps_per_chip"
         fresh_v = _get(fresh, path)
+        if tuned_leg and not fresh.get("tuned_block"):
+            fresh_v = None   # fresh round ran untuned: nothing to judge
         row = {"leg": leg, "fresh": fresh_v, "direction": direction,
                "tolerance": tol}
         if fresh_v is None or fresh_v <= 0:
@@ -171,9 +197,19 @@ def compare(fresh: dict, history: list) -> dict:
             if path[0] in ("value", "scan_apps_per_chip") \
                     and _backend_family(doc) != fam:
                 continue
+            if tuned_leg and not doc.get("tuned_block"):
+                continue
             hist.append((name, v))
         if not hist:
             row["verdict"] = "no comparable history"
+            legs.append(row)
+            continue
+        need = MIN_ROUNDS.get(leg, 1)
+        if len(hist) < need:
+            # judging against a sub-minimum "median" whipsaws; record the
+            # rounds seen so the next committed BENCH_r0x arms the leg
+            row["verdict"] = f"insufficient history (<{need} rounds)"
+            row["history_rounds"] = [n for n, _v in hist]
             legs.append(row)
             continue
         med = _median([v for _n, v in hist])
@@ -195,6 +231,27 @@ def compare(fresh: dict, history: list) -> dict:
                            f"{med:.4g} ({(ratio - 1) * 100:+.1f}%, "
                            f"tolerance {'-' if direction == 'down' else '+'}"
                            f"{tol * 100:.0f}%)"})
+    # tuning-lost sentinel: a fresh fused-chain round that ran UNTUNED
+    # while the committed trajectory is tuned means the autotuner
+    # regressed (tuning.json unreadable, SRNN_NO_AUTOTUNE left set, or
+    # the warmup hook broke) — the apps/chip median would only notice
+    # rounds later, after the damage moved it
+    tuned_hist = [n for n, doc in history
+                  if doc.get("tuned_block")
+                  and _backend_family(doc) == fam]
+    if fresh.get("impl") and not fresh.get("tuned_block") \
+            and len(tuned_hist) >= MIN_ROUNDS["tuned_apps_per_chip"]:
+        findings.append({
+            "kind": "soup_bench_regression", "leg": "tuned_block",
+            "fresh": None, "direction": "down", "tolerance": 0.0,
+            "message": "fused-chain leg ran UNTUNED (no tuned_block) but "
+                       f"{len(tuned_hist)} tuned history round(s) exist "
+                       "— block autotuner regression (tuning.json "
+                       "missing/corrupt or SRNN_NO_AUTOTUNE left set)"})
+        legs.append({"leg": "tuned_block", "fresh": None,
+                     "direction": "down", "tolerance": 0.0,
+                     "history_rounds": tuned_hist,
+                     "verdict": "REGRESSION"})
     return {"metric": "soup_bench_regression",
             "backend_family": fam,
             "history_files": [n for n, _d in history],

@@ -694,6 +694,52 @@ fi
 rm -rf "$cost_root"
 summary+=$(printf '%-34s %-4s %4ss' "cost_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Autotune smoke (srnn_tpu/autotune): a fused mega-soup smoke run with
+# the deterministic grid (SRNN_AUTOTUNE_FIXED=1, isolated cache dir)
+# must WRITE tuning.json next to the executable cache, count its grid
+# measurements, and publish the chosen block in the run's metrics.prom;
+# a second identical run in a fresh process must MEMO-HIT the persisted
+# table — cache-hit counter up, zero new measurements — with the same
+# block gauge.  The restart-amortization story drilled end to end.
+t0=$SECONDS
+at_root=$(mktemp -d)
+at_ok=1
+at_env="SRNN_SETUPS_PLATFORM=cpu SRNN_AUTOTUNE_FIXED=1 \
+JAX_COMPILATION_CACHE_DIR=$at_root/cache SRNN_COMPILE_CACHE_DIR=$at_root/cache"
+env $at_env python -m srnn_tpu.setups mega_soup --smoke --seed 5 \
+    --root "$at_root/run1" --layout popmajor --generation-impl fused \
+    > "$at_root/out.log" 2>&1 || at_ok=0
+[ -s "$at_root/cache/tuning.json" ] || { echo "autotune_smoke: no \
+tuning.json after run 1" >> "$at_root/out.log"; at_ok=0; }
+at1=$(ls -d "$at_root"/run1/exp-* 2>/dev/null | head -1)
+grep -q 'srnn_soup_autotune_block{kind="generation"' \
+    "$at1/metrics.prom" 2>/dev/null || at_ok=0
+grep -Eq 'srnn_soup_autotune_measurements_total [1-9]' \
+    "$at1/metrics.prom" 2>/dev/null || at_ok=0
+grep -q '"kind": "autotune"' "$at1/events.jsonl" 2>/dev/null || at_ok=0
+env $at_env python -m srnn_tpu.setups mega_soup --smoke --seed 5 \
+    --root "$at_root/run2" --layout popmajor --generation-impl fused \
+    >> "$at_root/out.log" 2>&1 || at_ok=0
+at2=$(ls -d "$at_root"/run2/exp-* 2>/dev/null | head -1)
+grep -Eq 'srnn_soup_autotune_cache_hits_total [1-9]' \
+    "$at2/metrics.prom" 2>/dev/null || at_ok=0
+if grep -q 'srnn_soup_autotune_measurements_total' \
+        "$at2/metrics.prom" 2>/dev/null; then
+    echo "autotune_smoke: run 2 re-measured instead of memo-hitting" \
+        >> "$at_root/out.log"
+    at_ok=0
+fi
+grep -q 'srnn_soup_autotune_block{kind="generation"' \
+    "$at2/metrics.prom" 2>/dev/null || at_ok=0
+if [ "$at_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("autotune_smoke")
+    tail -n 40 "$at_root/out.log"
+fi
+rm -rf "$at_root"
+summary+=$(printf '%-34s %-4s %4ss' "autotune_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 # Live telemetry alerts smoke (srnn_tpu/telemetry exporter + alerts): a
 # REAL 2-process launcher run exports each worker's /metrics on
 # base_port+i with a floor straggler threshold (skew >= 1.0 always

@@ -294,8 +294,12 @@ class Experiment:
 
 
 def _soup_state_to_pytree(state: SoupState) -> Dict[str, Any]:
-    """Typed PRNG keys don't serialize; split into raw key data + impl tag."""
-    return {
+    """Typed PRNG keys don't serialize; split into raw key data + impl tag.
+
+    int8 populations add a ``scales`` entry (the per-particle dequant
+    vector — codes are meaningless without it); f32/bf16 trees keep the
+    exact pre-int8 schema so old checkpoints restore unchanged."""
+    tree = {
         "weights": state.weights,
         "uids": state.uids,
         "next_uid": state.next_uid,
@@ -303,6 +307,9 @@ def _soup_state_to_pytree(state: SoupState) -> Dict[str, Any]:
         "key_data": jax.random.key_data(state.key),
         "key_impl": str(jax.random.key_impl(state.key)),
     }
+    if state.scales is not None:
+        tree["scales"] = state.scales
+    return tree
 
 
 def _soup_state_from_pytree(tree: Dict[str, Any]) -> SoupState:
@@ -316,6 +323,7 @@ def _soup_state_from_pytree(tree: Dict[str, Any]) -> SoupState:
         next_uid=jnp.asarray(tree["next_uid"]),
         time=jnp.asarray(tree["time"]),
         key=key,
+        scales=jnp.asarray(tree["scales"]) if "scales" in tree else None,
     )
 
 
@@ -380,6 +388,8 @@ def save_multi_checkpoint(path: str, state, primary: bool = True) -> str:
         "key_data": jax.random.key_data(state.key),
         "key_impl": str(jax.random.key_impl(state.key)),
     }
+    if state.scales is not None:
+        tree["scales"] = list(state.scales)
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, tree, force=True)
@@ -408,4 +418,6 @@ def restore_multi_checkpoint(path: str):
         next_uid=jnp.asarray(tree["next_uid"]),
         time=jnp.asarray(tree["time"]),
         key=key,
+        scales=tuple(jnp.asarray(s) for s in tree["scales"])
+        if "scales" in tree else None,
     )

@@ -18,7 +18,7 @@ the learner's input contract, which only same-type pairs guarantee.
 """
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -108,6 +108,14 @@ class MultiSoupState(NamedTuple):
     next_uid: jnp.ndarray
     time: jnp.ndarray
     key: jax.Array
+    # int8 mode only: per-type (N_t,) f32 dequantization scales (see
+    # SoupState.scales — None stays an EMPTY subtree for f32/bf16 states)
+    scales: Optional[Tuple[jnp.ndarray, ...]] = None
+
+
+def _type_scales(state: MultiSoupState, t: int) -> Optional[jnp.ndarray]:
+    """Type ``t``'s int8 scale vector (None for f32/bf16 states)."""
+    return None if state.scales is None else state.scales[t]
 
 
 class MultiSoupEvents(NamedTuple):
@@ -117,18 +125,24 @@ class MultiSoupEvents(NamedTuple):
 
 
 def seed_multi(config: MultiSoupConfig, key: jax.Array) -> MultiSoupState:
-    from .soup import _pop_dtype
+    from .soup import _downcast, _pop_dtype
 
     keys = jax.random.split(key, len(config.topos) + 1)
-    weights, uids = [], []
+    weights, uids, scales = [], [], []
     offs = config.offsets
     for t, topo in enumerate(config.topos):
-        weights.append(init_population(topo, keys[t], config.sizes[t])
-                       .astype(_pop_dtype(config)))
+        w = init_population(topo, keys[t], config.sizes[t])
+        if config.population_dtype == "int8":
+            w, sc = _downcast(config, w)
+            scales.append(sc)
+        else:
+            w = w.astype(_pop_dtype(config))
+        weights.append(w)
         uids.append(jnp.arange(offs[t], offs[t + 1], dtype=jnp.int32))
     return MultiSoupState(
         weights=tuple(weights), uids=tuple(uids),
-        next_uid=jnp.int32(config.total), time=jnp.int32(0), key=keys[-1])
+        next_uid=jnp.int32(config.total), time=jnp.int32(0), key=keys[-1],
+        scales=tuple(scales) if scales else None)
 
 
 def _attack_phase(config: MultiSoupConfig, weights, k_gate, k_tgt):
@@ -291,7 +305,8 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
     att_idx = jnp.full(n, -1, jnp.int32)
-    wTs = tuple(_upcast(config, wT) for wT in wTs)
+    wTs = tuple(_upcast(config, wT, _type_scales(state, t), paxis=-1)
+                for t, wT in enumerate(wTs))
 
     # --- attack (cross-type, last-attacker-wins) ------------------------
     with jax.named_scope("multisoup.attack"):
@@ -322,7 +337,8 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
     all_uids = jnp.concatenate(state.uids)
     lin_info = []
 
-    out_wTs, new_uids, actions, counterparts, losses = [], [], [], [], []
+    out_wTs, out_scales, new_uids = [], [], []
+    actions, counterparts, losses = [], [], []
     total_deaths = jnp.int32(0)
     re_keys = jax.random.split(k_re, len(config.topos))
     for t, topo in enumerate(config.topos):
@@ -410,7 +426,9 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        out_wTs.append(_downcast(config, wT_t))
+        stored_t, scales_t = _downcast(config, wT_t, paxis=-1)
+        out_wTs.append(stored_t)
+        out_scales.append(scales_t)
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -418,7 +436,9 @@ def _evolve_multi_popmajor(config: MultiSoupConfig, state: MultiSoupState,
 
     new_state = MultiSoupState(
         weights=state.weights, uids=tuple(new_uids),
-        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key,
+        scales=tuple(out_scales)
+        if config.population_dtype == "int8" else None)
     events = MultiSoupEvents(tuple(actions), tuple(counterparts),
                              tuple(losses))
     if lins is not None:
@@ -466,7 +486,8 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
     n = config.total
     offs = config.offsets
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    weights = tuple(_upcast(config, w) for w in state.weights)
+    weights = tuple(_upcast(config, w, _type_scales(state, t))
+                    for t, w in enumerate(state.weights))
     att_idx = jnp.full(n, -1, jnp.int32)
 
     # --- attack (cross-type) -------------------------------------------
@@ -482,7 +503,8 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
     all_uids = jnp.concatenate(state.uids)
     lin_info = []
 
-    new_weights, new_uids, actions, counterparts, losses = [], [], [], [], []
+    new_weights, new_scales, new_uids = [], [], []
+    actions, counterparts, losses = [], [], []
     total_deaths = jnp.int32(0)
     re_keys = jax.random.split(k_re, len(config.topos))
     for t, topo in enumerate(config.topos):
@@ -528,7 +550,9 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
             n_t, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        new_weights.append(_downcast(config, w_t))
+        stored_t, scales_t = _downcast(config, w_t)
+        new_weights.append(stored_t)
+        new_scales.append(scales_t)
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -536,7 +560,9 @@ def _evolve_multi_step(config: MultiSoupConfig, state: MultiSoupState,
 
     new_state = MultiSoupState(
         weights=tuple(new_weights), uids=tuple(new_uids),
-        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key,
+        scales=tuple(new_scales)
+        if config.population_dtype == "int8" else None)
     events = MultiSoupEvents(tuple(actions), tuple(counterparts),
                              tuple(losses))
     if lins is not None:
@@ -623,9 +649,10 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
             out += (ltriple,)
         return out if len(out) > 1 else final
 
-    def close(lins, ws, axis):
+    def close(lins, ws, axis, scales=None):
         """End-of-window per-type fixpoint census (ws = per-type weights
-        in the layout's orientation)."""
+        in the layout's orientation; ``scales`` = the final state's int8
+        scale tuple, None otherwise)."""
         from .nets import apply_to_weights
         from .ops.popmajor import apply_popmajor
 
@@ -634,7 +661,9 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         new_lins, stats = [], []
         for t, (lin_t, w_t) in enumerate(zip(lins, ws)):
             topo = config.topos[t]
-            w_t = _upcast(config, w_t)
+            w_t = _upcast(config, w_t,
+                          None if scales is None else scales[t],
+                          paxis=-1 if axis == 0 else 0)
             if axis == 0:
                 fw = apply_popmajor(topo, w_t, w_t)
             else:
@@ -660,7 +689,12 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
             if metrics:
                 ms = acc(ms, ev)
             if health:
-                hs = acc_h(hs, new_wTs, 0)
+                from .soup import _stored_view
+
+                hs = acc_h(hs, tuple(
+                    _stored_view(config, wT, _type_scales(new_s, t),
+                                 paxis=-1)
+                    for t, wT in enumerate(new_wTs)), 0)
             return (new_s, new_wTs, ms, hs, lins, win), None
 
         light = state._replace(weights=tuple(
@@ -671,7 +705,7 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         final = final._replace(weights=tuple(wT.T for wT in wTs))
         ltriple = None
         if lineage:
-            lins, stats = close(lins, wTs, 0)
+            lins, stats = close(lins, wTs, 0, final.scales)
             ltriple = (lins, win, stats)
         return pack(final, ms, hs, ltriple)
 
@@ -685,14 +719,18 @@ def _evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
         if metrics:
             ms = acc(ms, ev)
         if health:
-            hs = acc_h(hs, new_s.weights, -1)
+            from .soup import _stored_view
+
+            hs = acc_h(hs, tuple(
+                _stored_view(config, w, _type_scales(new_s, t))
+                for t, w in enumerate(new_s.weights)), -1)
         return (new_s, ms, hs, lins, win), None
 
     (final, ms, hs, lins, win), _ = jax.lax.scan(
         body, (state, m0, h0, l0, w0), None, length=generations)
     ltriple = None
     if lineage:
-        lins, stats = close(lins, final.weights, -1)
+        lins, stats = close(lins, final.weights, -1, final.scales)
         ltriple = (lins, win, stats)
     return pack(final, ms, hs, ltriple)
 
@@ -714,7 +752,11 @@ evolve_multi_donated = jax.jit(_evolve_multi,
 @functools.partial(jax.jit, static_argnames=("config",))
 def count_multi(config: MultiSoupConfig, state: MultiSoupState) -> jnp.ndarray:
     """(T, 5) per-type class histograms (types keep their own science)."""
-    rows = [count_classes(classify_batch(config.topos[t], state.weights[t],
-                                         config.epsilon))
+    from .soup import _stored_view
+
+    rows = [count_classes(classify_batch(
+                config.topos[t],
+                _stored_view(config, state.weights[t], _type_scales(state, t)),
+                config.epsilon))
             for t in range(len(config.topos))]
     return jnp.stack(rows)

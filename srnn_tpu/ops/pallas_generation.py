@@ -306,14 +306,14 @@ def _make_generation_kernel(topo: Topology, *, attack: bool, learn: int,
 
 @functools.partial(jax.jit, static_argnames=(
     "topo", "severity", "train", "lr", "remove_divergent", "remove_zero",
-    "epsilon", "interpret"))
-def generation_popmajor(topo: Topology, wT, freshT, attackerT=None,
-                        has_attacker=None, otherT=None, other_attackerT=None,
-                        other_attacked=None, learn_gate=None, *,
-                        severity: int = 0, train: int = 0, lr: float = 0.01,
-                        remove_divergent: bool = False,
-                        remove_zero: bool = False, epsilon: float = 1e-4,
-                        interpret: bool = False):
+    "epsilon", "interpret", "block"))
+def _generation_popmajor(topo: Topology, wT, freshT, attackerT=None,
+                         has_attacker=None, otherT=None, other_attackerT=None,
+                         other_attacked=None, learn_gate=None, *,
+                         severity: int = 0, train: int = 0, lr: float = 0.01,
+                         remove_divergent: bool = False,
+                         remove_zero: bool = False, epsilon: float = 1e-4,
+                         interpret: bool = False, block: int = None):
     """One fused generation over a (P, N) population block-by-block.
 
     ``attackerT``/``has_attacker`` enable the in-kernel attack phase
@@ -343,7 +343,7 @@ def generation_popmajor(topo: Topology, wT, freshT, attackerT=None,
                        learn_gate.astype(jnp.int32),
                        other_attacked.astype(jnp.int32)])
 
-    block = min(generation_block(p), n)
+    block = min(block or generation_block(p), n)
     pad = (-n) % block
     arrays = [wT, freshT]
     if attack:
@@ -379,6 +379,33 @@ def generation_popmajor(topo: Topology, wT, freshT, attackerT=None,
     return out, loss[0], dead[0] != 0, dead[1] != 0
 
 
+def generation_popmajor(topo: Topology, wT, freshT, attackerT=None,
+                        has_attacker=None, otherT=None, other_attackerT=None,
+                        other_attacked=None, learn_gate=None, *,
+                        severity: int = 0, train: int = 0, lr: float = 0.01,
+                        remove_divergent: bool = False,
+                        remove_zero: bool = False, epsilon: float = 1e-4,
+                        interpret: bool = False, block: int = None):
+    """Public spelling of the fused generation: ``block=None`` resolves
+    the lane block through the autotuner's tuning table (``srnn_tpu.
+    autotune``; pure in-memory/file lookup at trace time, never a
+    measurement) and falls back to the :func:`generation_block` VMEM
+    formula when the key is untuned or ``SRNN_NO_AUTOTUNE=1``.  The
+    block only tiles the grid — every output column is computed from
+    that column alone — so results are bitwise block-invariant and the
+    untuned path is the tuned path's A/B oracle."""
+    if block is None:
+        from .. import autotune
+
+        block = autotune.lookup("generation", topo.variant, wT.shape[1],
+                                topo.num_weights, dtype=str(wT.dtype))
+    return _generation_popmajor(
+        topo, wT, freshT, attackerT, has_attacker, otherT, other_attackerT,
+        other_attacked, learn_gate, severity=severity, train=train, lr=lr,
+        remove_divergent=remove_divergent, remove_zero=remove_zero,
+        epsilon=epsilon, interpret=interpret, block=block)
+
+
 # ---------------------------------------------------------------------------
 # lane-blocked chained self-application: the megakernel idea as a pure-XLA
 # program — the CPU fast path for bench.py's applications/sec workload
@@ -386,7 +413,7 @@ def generation_popmajor(topo: Topology, wT, freshT, attackerT=None,
 
 
 @functools.partial(jax.jit, static_argnames=("topo", "steps", "block"))
-def apply_chain_blocked(topo: Topology, wT, steps: int, block: int = 2048):
+def _apply_chain_blocked(topo: Topology, wT, steps: int, block: int = 2048):
     """``steps`` chained self-applications with the chain UNROLLED per lane
     block: a ``lax.scan`` walks (P, block) tiles and each tile runs the
     whole chain while it is cache-resident, so HBM/DRAM traffic is one
@@ -416,3 +443,19 @@ def apply_chain_blocked(topo: Topology, wT, steps: int, block: int = 2048):
     _, out = jax.lax.scan(one_tile, None, tiles)
     out = jnp.moveaxis(out, 0, 1).reshape(p, nb * block)
     return out[:, :n] if pad else out
+
+
+def apply_chain_blocked(topo: Topology, wT, steps: int, block: int = None):
+    """Public spelling of the lane-blocked chain: ``block=None`` resolves
+    the tile through the autotuner's tuning table (``srnn_tpu.autotune``)
+    and falls back to the historical 2048 default when the key is untuned
+    or ``SRNN_NO_AUTOTUNE=1``.  Each output column depends only on its
+    own column, so every block size computes bitwise-identical results —
+    tuning moves the cache cliff, not the math."""
+    if block is None:
+        from .. import autotune
+
+        block = autotune.lookup("apply_chain", topo.variant, wT.shape[1],
+                                topo.num_weights,
+                                dtype=str(wT.dtype)) or 2048
+    return _apply_chain_blocked(topo, wT, steps, block=block)

@@ -61,13 +61,17 @@ from .mesh import SOUP_AXIS
 from .compat import shard_map
 
 
-def _mstate_specs(t: int) -> MultiSoupState:
+def _mstate_specs(t: int, int8: bool = False) -> MultiSoupState:
+    # int8 populations carry per-type per-particle scale vectors that shard
+    # with the particle axis like uids; f32/bf16 states have scales=None
+    # (empty subtree), so the spec tree mirrors that None-for-None
     return MultiSoupState(
         weights=tuple(P(SOUP_AXIS) for _ in range(t)),
         uids=tuple(P(SOUP_AXIS) for _ in range(t)),
         next_uid=P(),
         time=P(),
         key=P(),
+        scales=tuple(P(SOUP_AXIS) for _ in range(t)) if int8 else None,
     )
 
 
@@ -87,21 +91,29 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
     lineage carry (``lins``/``win``/``lincfg``) the advanced per-type
     carries + the per-shard edge window ride along (mint bases from
     all-gathered mask ranks, chained type-major — the uid-block order)."""
+    from ..multisoup import _type_scales
     from ..soup import _downcast, _upcast
 
     n = config.total
     offs = config.offsets
     d = jax.lax.axis_index(SOUP_AXIS)
+    int8 = config.population_dtype == "int8"
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    w_loc = [_upcast(config, w) for w in state.weights]
+    w_loc = [_upcast(config, w, _type_scales(state, t))
+             for t, w in enumerate(state.weights)]
     n_locs = [w.shape[0] for w in w_loc]
 
     # start-of-generation gathers: attacker weight tables + uid tables
     # (storage dtype on the wire — exact bf16->f32 upcast after, see
-    # sharded_soup._local_evolve)
+    # sharded_soup._local_evolve; int8 additionally gathers the per-type
+    # scale vectors and dequantizes after, elementwise per particle so
+    # gather-then-dequant equals dequant-then-gather bitwise)
+    all_s = tuple(jax.lax.all_gather(s, SOUP_AXIS, tiled=True)
+                  for s in state.scales) if int8 else None
     all_w = tuple(_upcast(config, jax.lax.all_gather(w, SOUP_AXIS,
-                                                     tiled=True))
-                  for w in state.weights)
+                                                     tiled=True),
+                          None if all_s is None else all_s[t])
+                  for t, w in enumerate(state.weights))
     all_uids_t = tuple(jax.lax.all_gather(u, SOUP_AXIS, tiled=True)
                        for u in state.uids)
     all_uids = jnp.concatenate(all_uids_t)
@@ -120,6 +132,7 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
     lin_info = []
 
     new_weights, new_uids, actions, counterparts, losses = [], [], [], [], []
+    new_scales = []
     total_deaths = jnp.int32(0)
     re_keys = jax.random.split(k_re, len(config.topos))
     for t, topo in enumerate(config.topos):
@@ -204,7 +217,9 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        new_weights.append(_downcast(config, w_t))
+        stored_t, scales_t = _downcast(config, w_t)
+        new_weights.append(stored_t)
+        new_scales.append(scales_t)
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -212,7 +227,8 @@ def _local_evolve_multi(config: MultiSoupConfig, state: MultiSoupState,
 
     new_state = MultiSoupState(
         weights=tuple(new_weights), uids=tuple(new_uids),
-        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key,
+        scales=tuple(new_scales) if int8 else None)
     events = MultiSoupEvents(tuple(actions), tuple(counterparts),
                              tuple(losses))
     if lins is not None:
@@ -236,7 +252,7 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
     kernels (``ops/popmajor*.py``), cross-type attacks via
     ``cross_apply_popmajor``.  The lineage carry threads exactly as in
     ``_local_evolve_multi`` (globally-ranked mint bases, type-major)."""
-    from ..multisoup import _fused_type_route
+    from ..multisoup import _fused_type_route, _type_scales
     from ..ops.popmajor import learn_epochs_popmajor, train_epochs_popmajor
     from ..ops.popmajor_cross import cross_apply_popmajor
     from ..soup import _downcast, _upcast
@@ -247,14 +263,21 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
     n = config.total
     offs = config.offsets
     d = jax.lax.axis_index(SOUP_AXIS)
+    int8 = config.population_dtype == "int8"
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
     # storage-dtype shards ride the start-of-generation gather (bf16 ships
-    # half the bytes; the upcast after is exact); the per-type POST-attack
-    # re-gathers stay f32 — mid-generation values, see sharded_soup
+    # half the bytes; the upcast after is exact; int8 adds the per-type
+    # scale gathers — dequant commutes with the gather); the per-type
+    # POST-attack re-gathers stay f32 — mid-generation values, see
+    # sharded_soup
+    all_sT = tuple(jax.lax.all_gather(s, SOUP_AXIS, tiled=True)
+                   for s in state.scales) if int8 else None
     all_wT = tuple(_upcast(config, jax.lax.all_gather(wT, SOUP_AXIS,
-                                                      axis=1, tiled=True))
-                   for wT in wT_locs)
-    wT_locs = tuple(_upcast(config, wT) for wT in wT_locs)
+                                                      axis=1, tiled=True),
+                           None if all_sT is None else all_sT[t], paxis=-1)
+                   for t, wT in enumerate(wT_locs))
+    wT_locs = tuple(_upcast(config, wT, _type_scales(state, t), paxis=-1)
+                    for t, wT in enumerate(wT_locs))
     n_locs = [wT.shape[1] for wT in wT_locs]
     all_uids_t = tuple(jax.lax.all_gather(u, SOUP_AXIS, tiled=True)
                        for u in state.uids)
@@ -273,6 +296,7 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
     lin_info = []
 
     new_wTs, new_uids, actions, counterparts, losses = [], [], [], [], []
+    new_scales = []
     total_deaths = jnp.int32(0)
     re_keys = jax.random.split(k_re, len(config.topos))
     for t, topo in enumerate(config.topos):
@@ -395,7 +419,9 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
             n_loc, sl(attack_gate), all_uids[sl(attack_tgt)],
             learn_gate, learn_cp, config.train > 0, death_action, death_cp)
 
-        new_wTs.append(_downcast(config, wT_t))
+        stored_t, scales_t = _downcast(config, wT_t, paxis=-1)
+        new_wTs.append(stored_t)
+        new_scales.append(scales_t)
         new_uids.append(uids_t)
         actions.append(action)
         counterparts.append(counterpart)
@@ -403,7 +429,8 @@ def _local_evolve_multi_popmajor(config: MultiSoupConfig,
 
     new_state = MultiSoupState(
         weights=state.weights, uids=tuple(new_uids),
-        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key)
+        next_uid=state.next_uid + total_deaths, time=state.time + 1, key=key,
+        scales=tuple(new_scales) if int8 else None)
     events = MultiSoupEvents(tuple(actions), tuple(counterparts),
                              tuple(losses))
     if lins is not None:
@@ -438,11 +465,13 @@ def _sharded_evolve_multi_step(config: MultiSoupConfig, mesh: Mesh,
         body = functools.partial(_local_evolve_multi, config)
     else:
         raise ValueError(f"unknown multisoup layout {config.layout!r}")
+    int8 = config.population_dtype == "int8"
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(_mstate_specs(len(config.topos)),),
-        out_specs=(_mstate_specs(len(config.topos)), _mevent_specs(config)),
+        in_specs=(_mstate_specs(len(config.topos), int8),),
+        out_specs=(_mstate_specs(len(config.topos), int8),
+                   _mevent_specs(config)),
         check_vma=False,
     )
     return fn(state)
@@ -514,9 +543,19 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         from ..telemetry.device import (accumulate_health, psum_health,
                                         zero_health)
 
-        def acc_h(hs, ws, axis):
-            return tuple(accumulate_health(h, w, axis, config.epsilon)
-                         for h, w in zip(hs, ws))
+        def acc_h(hs, ws, scs, axis):
+            # int8 health folds read the dequantized f32 view; f32/bf16
+            # read storage directly, exactly as before (axis=0 is the
+            # lane-major (P, N/D) layout, particle axis last)
+            from ..soup import _stored_view
+
+            if scs is None:
+                scs = (None,) * len(ws)
+            paxis = -1 if axis == 0 else 0
+            return tuple(
+                accumulate_health(h, _stored_view(config, w, sc, paxis),
+                                  axis, config.epsilon)
+                for h, w, sc in zip(hs, ws, scs))
 
         def flush_h(hs):
             return tuple(psum_health(h, SOUP_AXIS) for h in hs)
@@ -544,7 +583,7 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         return tuple(zero_health() for _ in config.topos) \
             if health else None
 
-    def close(lins, ws, axis):
+    def close(lins, ws, axis, scales=None):
         from ..nets import apply_to_weights
         from ..ops.popmajor import apply_popmajor
 
@@ -553,7 +592,9 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         new_lins, stats = [], []
         for t, (lin_t, w_t) in enumerate(zip(lins, ws)):
             topo = config.topos[t]
-            w_t = _upcast(config, w_t)
+            w_t = _upcast(config, w_t,
+                          None if scales is None else scales[t],
+                          paxis=-1 if axis == 0 else 0)
             if axis == 0:
                 fw = apply_popmajor(topo, w_t, w_t)
             else:
@@ -575,8 +616,9 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
         return out if len(out) > 1 else final
 
     nt = len(config.topos)
-    in_specs = (_mstate_specs(nt),)
-    out_specs = (_mstate_specs(nt),)
+    int8 = config.population_dtype == "int8"
+    in_specs = (_mstate_specs(nt, int8),)
+    out_specs = (_mstate_specs(nt, int8),)
     if metrics:
         out_specs += (_multi_metrics_specs(nt),)
     if health:
@@ -609,7 +651,7 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
                 if metrics:
                     ms = acc(ms, ev)
                 if health:
-                    hs = acc_h(hs, new_wTs, 0)
+                    hs = acc_h(hs, new_wTs, new_s.scales, 0)
                 return (new_s, new_wTs, ms, hs, lins, win), None
 
             (final, wTs, ms, hs, lins, win), _ = jax.lax.scan(
@@ -618,7 +660,7 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
             final = final._replace(weights=tuple(wT.T for wT in wTs))
             ltriple = None
             if lineage:
-                lins, stats = close(lins, wTs, 0)
+                lins, stats = close(lins, wTs, 0, final.scales)
                 ltriple = (lins, win, stats)
             return pack(final, ms, hs, ltriple)
 
@@ -645,14 +687,14 @@ def _sharded_evolve_multi(config: MultiSoupConfig, mesh: Mesh,
             if metrics:
                 ms = acc(ms, ev)
             if health:
-                hs = acc_h(hs, new_s.weights, -1)
+                hs = acc_h(hs, new_s.weights, new_s.scales, -1)
             return (new_s, ms, hs, lins, win), None
 
         (final, ms, hs, lins, win), _ = jax.lax.scan(
             body, (st, m0(), h0(), l0, w0), None, length=generations)
         ltriple = None
         if lineage:
-            lins, stats = close(lins, final.weights, -1)
+            lins, stats = close(lins, final.weights, -1, final.scales)
             ltriple = (lins, win, stats)
         return pack(final, ms, hs, ltriple)
 
@@ -681,21 +723,29 @@ sharded_evolve_multi_donated = jax.jit(
 def sharded_count_multi(config: MultiSoupConfig, mesh: Mesh,
                         state: MultiSoupState) -> jnp.ndarray:
     """(T, 5) per-type global class histograms: local classify + psum."""
+    nt = len(config.topos)
+    int8 = config.population_dtype == "int8"
 
-    def local_count(*w_locs):
-        rows = [count_classes(classify_batch(config.topos[t], w_locs[t],
-                                             config.epsilon))
-                for t in range(len(config.topos))]
+    def local_count(*args):
+        from ..soup import _stored_view
+
+        w_locs, s_locs = args[:nt], args[nt:] if int8 else (None,) * nt
+        rows = [count_classes(classify_batch(
+                    config.topos[t],
+                    _stored_view(config, w_locs[t], s_locs[t]),
+                    config.epsilon))
+                for t in range(nt)]
         return jax.lax.psum(jnp.stack(rows), SOUP_AXIS)
 
+    n_in = nt * 2 if int8 else nt
     fn = shard_map(
         local_count,
         mesh=mesh,
-        in_specs=tuple(P(SOUP_AXIS) for _ in config.topos),
+        in_specs=tuple(P(SOUP_AXIS) for _ in range(n_in)),
         out_specs=P(),
         check_vma=False,
     )
-    return fn(*state.weights)
+    return fn(*state.weights, *state.scales) if int8 else fn(*state.weights)
 
 
 def place_sharded_multi_state(mesh: Mesh, state: MultiSoupState
@@ -710,7 +760,8 @@ def place_sharded_multi_state(mesh: Mesh, state: MultiSoupState
                 f"mesh's {n_dev} devices (each device owns an equal shard "
                 "per type)")
     from .mesh import global_device_put
-    specs = _mstate_specs(len(state.weights))
+    specs = _mstate_specs(len(state.weights),
+                          int8=state.scales is not None)
     return jax.tree.map(
         lambda x, spec: global_device_put(x, NamedSharding(mesh, spec)),
         state, specs)

@@ -77,13 +77,17 @@ def _soup_axes(mesh: Mesh):
     return tuple(mesh.axis_names) if len(mesh.axis_names) > 1 else SOUP_AXIS
 
 
-def _state_specs(axes=SOUP_AXIS):
+def _state_specs(axes=SOUP_AXIS, int8=False):
+    # int8 populations carry a per-particle scale vector that shards with
+    # the particle axis like uids; f32/bf16 states have scales=None (empty
+    # subtree), so the spec tree must mirror that None-for-None
     return SoupState(
         weights=P(axes),
         uids=P(axes),
         next_uid=P(),
         time=P(),
         key=P(),
+        scales=P(axes) if int8 else None,
     )
 
 
@@ -102,7 +106,7 @@ def _local_evolve(config: SoupConfig, state: SoupState,
     from ..soup import _downcast, _upcast
 
     n = config.size
-    w_loc = _upcast(config, state.weights)
+    w_loc = _upcast(config, state.weights, state.scales)
     n_loc = w_loc.shape[0]
     d = jax.lax.axis_index(axes)
     start = d * n_loc
@@ -115,9 +119,14 @@ def _local_evolve(config: SoupConfig, state: SoupState,
     # one collective: everyone sees the start-of-generation population.
     # The gather ships the STORAGE dtype and upcasts after — for bf16
     # populations that halves the dominant collective's bytes, and the
-    # bf16->f32 cast is exact so the values are identical either way
+    # bf16->f32 cast is exact so the values are identical either way.
+    # int8 gathers codes + per-particle scales (quarter the bytes plus an
+    # O(N) vector) and dequantizes after — elementwise per particle, so
+    # gather-then-dequant equals dequant-then-gather bitwise
+    all_s = jax.lax.all_gather(state.scales, axes, tiled=True) \
+        if config.population_dtype == "int8" else None
     all_w = _upcast(config, jax.lax.all_gather(state.weights, axes,
-                                               tiled=True))  # (N, P)
+                                               tiled=True), all_s)  # (N, P)
 
     # --- attack ---------------------------------------------------------
     with jax.named_scope("soup.attack"):
@@ -187,8 +196,9 @@ def _local_evolve(config: SoupConfig, state: SoupState,
         learn_gate_loc, all_uids[learn_tgt_loc],
         config.train > 0, death_action, death_cp)
 
-    new_state = SoupState(_downcast(config, new_w), new_uids, next_uid,
-                          state.time + 1, key)
+    stored, scales = _downcast(config, new_w)
+    new_state = SoupState(stored, new_uids, next_uid,
+                          state.time + 1, key, scales)
     events = SoupEvents(action, counterpart, train_loss)
     if lin is None:
         return new_state, events
@@ -248,7 +258,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     # results and a bf16 bounce there would round where the single-device
     # path does not
     wT_store = wT_loc
-    wT_loc = _upcast(config, wT_loc)
+    wT_loc = _upcast(config, wT_loc, state.scales, paxis=-1)
     has_attacker = jnp.zeros(n_loc, bool)
     att_loc = jnp.full(n_loc, -1, jnp.int32)
 
@@ -257,8 +267,11 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
     # --- attack (soup.py:56-61); last-attacker-wins, same as single-device
     with jax.named_scope("soup.attack"):
         if config.attacking_rate > 0:
+            all_sT = jax.lax.all_gather(state.scales, axes, tiled=True) \
+                if config.population_dtype == "int8" else None
             all_wT = _upcast(config, jax.lax.all_gather(wT_store, axes,
-                                                        axis=1, tiled=True))
+                                                        axis=1, tiled=True),
+                             all_sT, paxis=-1)
             attack_gate = jax.random.uniform(k_ag, (n,)) < config.attacking_rate
             attack_tgt = jax.random.randint(k_at, (n,), 0, n)
             att_idx = jax.ops.segment_max(
@@ -344,7 +357,7 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         death_action = jnp.where(dead_div, ACT_DIV_DEAD, death_action)
         death_action = jnp.where(dead_zero, ACT_ZERO_DEAD, death_action)
         death_cp = jnp.where(dead, uids, -1)
-    wT_loc = _downcast(config, wT_loc)
+    wT_loc, scales = _downcast(config, wT_loc, paxis=-1)
 
     # --- event record (last action wins) --------------------------------
     all_uids = jax.lax.all_gather(state.uids, axes, tiled=True)
@@ -353,7 +366,8 @@ def _local_evolve_popmajor(config: SoupConfig, state: SoupState,
         learn_gate_loc, all_uids[learn_tgt_loc],
         config.train > 0, death_action, death_cp)
 
-    new_state = SoupState(state.weights, uids, next_uid, state.time + 1, key)
+    new_state = SoupState(state.weights, uids, next_uid, state.time + 1, key,
+                          scales)
     events = SoupEvents(action, counterpart, train_loss)
     if lin is None:
         return new_state, events, wT_loc
@@ -380,6 +394,7 @@ def _local_fused_popmajor(config: SoupConfig, state: SoupState,
     phase chain, so pids/uids stay bit-identical to the single-device
     fused step.  Mosaic backends only (``soup._fused_kernel_route``)."""
     from ..ops.pallas_generation import generation_popmajor
+    from ..soup import _downcast, _upcast
 
     n = config.size
     n_loc = wT_loc.shape[1]
@@ -390,6 +405,14 @@ def _local_fused_popmajor(config: SoupConfig, state: SoupState,
     att_loc = jnp.full(n_loc, -1, jnp.int32)
 
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+
+    # int8 dequantizes BEFORE the gather (the kernel sees f32 rows, same
+    # quantize-point contract as the single-device fused step); the one
+    # collective ships f32 here — correctness over collective bytes, the
+    # phase chain keeps the code+scale gather for the bandwidth-sensitive
+    # tier.  bf16 stays raw: its in-kernel cast protocol is unchanged.
+    if config.population_dtype == "int8":
+        wT_loc = _upcast(config, wT_loc, state.scales, paxis=-1)
 
     attacking = config.attacking_rate > 0
     learning = config.learn_from_rate > 0
@@ -447,6 +470,10 @@ def _local_fused_popmajor(config: SoupConfig, state: SoupState,
             remove_divergent=config.remove_divergent,
             remove_zero=config.remove_zero, epsilon=config.epsilon)
 
+    scales = state.scales
+    if config.population_dtype == "int8":
+        wT_loc, scales = _downcast(config, wT_loc, paxis=-1)
+
     dead = dead_div | dead_zero
     all_dead = jax.lax.all_gather(dead, axes, tiled=True)
     rank = jnp.cumsum(all_dead) - 1
@@ -465,7 +492,8 @@ def _local_fused_popmajor(config: SoupConfig, state: SoupState,
         learn_gate_loc, all_uids[learn_tgt_loc],
         config.train > 0, death_action, death_cp)
 
-    new_state = SoupState(state.weights, uids, next_uid, state.time + 1, key)
+    new_state = SoupState(state.weights, uids, next_uid, state.time + 1, key,
+                          scales)
     events = SoupEvents(action, counterpart, train_loss)
     if lin is None:
         return new_state, events, wT_loc
@@ -506,11 +534,12 @@ def _sharded_evolve_step(config: SoupConfig, mesh: Mesh, state: SoupState):
         body = functools.partial(_local_evolve, config, axes=axes)
     else:
         raise ValueError(f"unknown soup layout {config.layout!r}")
+    int8 = config.population_dtype == "int8"
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(_state_specs(axes),),
-        out_specs=(_state_specs(axes), _event_specs(axes)),
+        in_specs=(_state_specs(axes, int8),),
+        out_specs=(_state_specs(axes, int8), _event_specs(axes)),
         check_vma=False,
     )
     return fn(state)
@@ -606,14 +635,16 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
             out += (ltriple,)
         return out if len(out) > 1 else final
 
+    int8 = config.population_dtype == "int8"
+
     def in_specs():
-        specs = (_state_specs(axes),)
+        specs = (_state_specs(axes, int8),)
         if lineage:
             specs += (lineage_specs(axes),)
         return specs
 
     def out_specs():
-        specs = (_state_specs(axes),)
+        specs = (_state_specs(axes, int8),)
         if metrics:
             specs += (_metrics_specs(),)
         if health:
@@ -644,7 +675,14 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
                 if metrics:
                     m = accumulate_soup_metrics(m, ev.action, ev.loss)
                 if health:
-                    h = accumulate_health(h, new_wT, 0, config.epsilon)
+                    # int8 health folds read the dequantized f32 view
+                    # (raw codes mean nothing without their scales);
+                    # f32/bf16 read storage directly, exactly as before
+                    from ..soup import _stored_view
+
+                    h = accumulate_health(
+                        h, _stored_view(config, new_wT, new_s.scales,
+                                        paxis=-1), 0, config.epsilon)
                 return (new_s, new_wT, m, h, lin, win), None
 
             (final, wT, m, h, lin, win), _ = jax.lax.scan(
@@ -654,9 +692,11 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
             ltriple = None
             if lineage:
                 from ..ops.popmajor import apply_popmajor
+                from ..soup import _stored_view
 
-                fw = apply_popmajor(config.topo, wT, wT)
-                lin, fstats = close_window(lin, wT, fw, 0, config.epsilon)
+                wc = _stored_view(config, wT, final.scales, paxis=-1)
+                fw = apply_popmajor(config.topo, wc, wc)
+                lin, fstats = close_window(lin, wc, fw, 0, config.epsilon)
                 ltriple = (lin, win, psum_fixpoints(fstats, axes))
             return pack(final,
                         psum_soup_metrics(m, axes) if metrics else None,
@@ -689,16 +729,20 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
                 if metrics:
                     m = accumulate_soup_metrics(m, ev.action, ev.loss)
                 if health:
-                    h = accumulate_health(h, new_s.weights, -1,
-                                          config.epsilon)
+                    from ..soup import _stored_view
+
+                    h = accumulate_health(
+                        h, _stored_view(config, new_s.weights, new_s.scales),
+                        -1, config.epsilon)
                 return (new_s, m, h, lin, win), None
 
             (final, m, h, lin, win), _ = jax.lax.scan(
                 body, (st, m0, h0, l0, w0), None, length=generations)
-            fw = jax.vmap(lambda wi: _apply(config.topo, wi, wi))(
-                final.weights)
-            lin, fstats = close_window(lin, final.weights, fw, -1,
-                                       config.epsilon)
+            from ..soup import _stored_view
+
+            wc = _stored_view(config, final.weights, final.scales)
+            fw = jax.vmap(lambda wi: _apply(config.topo, wi, wi))(wc)
+            lin, fstats = close_window(lin, wc, fw, -1, config.epsilon)
             return pack(final,
                         psum_soup_metrics(m, axes) if metrics else None,
                         psum_health(h, axes) if health else None,
@@ -724,7 +768,11 @@ def _sharded_evolve(config: SoupConfig, mesh: Mesh, state: SoupState,
             # GSPMD's to place (one small collective per generation)
             m = accumulate_soup_metrics(m, ev.action, ev.loss)
         if health:
-            h = accumulate_health(h, new_state.weights, -1, config.epsilon)
+            from ..soup import _stored_view
+
+            h = accumulate_health(
+                h, _stored_view(config, new_state.weights, new_state.scales),
+                -1, config.epsilon)
         return (new_state, m, h), None
 
     (final, m, h), _ = jax.lax.scan(body, (state, m0, h0), None,
@@ -750,9 +798,21 @@ def sharded_count(config: SoupConfig, mesh: Mesh, state: SoupState) -> jnp.ndarr
 
     axes = _soup_axes(mesh)
 
-    def local_count(w_loc):
-        return count_classes(classify_batch(config.topo, w_loc, config.epsilon))
+    def local_count(w_loc, s_loc=None):
+        from ..soup import _stored_view
 
+        return count_classes(classify_batch(
+            config.topo, _stored_view(config, w_loc, s_loc), config.epsilon))
+
+    if config.population_dtype == "int8":
+        fn = shard_map(
+            lambda w, s: jax.lax.psum(local_count(w, s), axes),
+            mesh=mesh,
+            in_specs=(P(axes), P(axes)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return fn(state.weights, state.scales)
     fn = shard_map(
         lambda w: jax.lax.psum(local_count(w), axes),
         mesh=mesh,
@@ -776,7 +836,7 @@ def place_sharded_state(mesh: Mesh, state: SoupState) -> SoupState:
             f"soup size {n} must be divisible by the mesh's {n_dev} devices "
             f"(each device owns an equal shard)")
     from .mesh import global_device_put
-    specs = _state_specs(_soup_axes(mesh))
+    specs = _state_specs(_soup_axes(mesh), int8=state.scales is not None)
     return jax.tree.map(
         lambda x, spec: global_device_put(x, NamedSharding(mesh, spec)),
         state, specs)

@@ -55,7 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ops/pallas_generation.py) so a fused run on a "
                         "fresh TPU window deserializes instead of paying "
                         "full compile inside the bench deadline")
-    p.add_argument("--population-dtype", choices=("f32", "bf16"),
+    p.add_argument("--population-dtype", choices=("f32", "bf16", "int8"),
                    default="f32",
                    help="population storage dtype of the warmed "
                         "executables (bf16 = mixed-precision population "
@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persistent executable cache location (default: "
                         "$JAX_COMPILATION_CACHE_DIR / "
                         "$SRNN_COMPILE_CACHE_DIR / ~/.cache/srnn_tpu/xla)")
+    p.add_argument("--no-autotune", action="store_true",
+                   help="skip the block autotuner (srnn_tpu.autotune) "
+                        "before warmup; lane blocks stay at the built-in "
+                        "defaults (equivalent: SRNN_NO_AUTOTUNE=1)")
     p.add_argument("--json", action="store_true",
                    help="print one machine-readable JSON line instead of "
                         "the human summary")
@@ -151,6 +155,15 @@ def run(args) -> dict:
 
     cfg = _make_config(args)
     multi = _make_multi(args) if args.multi else None
+    # tune lane blocks BEFORE warmup so the warmed executables are the
+    # tuned programs (a run then deserializes them; --no-autotune /
+    # SRNN_NO_AUTOTUNE=1 keeps the built-in defaults, bit-identically)
+    from . import autotune
+
+    tuned = autotune.autotune_for_run(cfg, no_autotune=args.no_autotune)
+    if multi is not None:
+        tuned += autotune.autotune_for_run(multi,
+                                           no_autotune=args.no_autotune)
     donate_modes = [True, False] if args.both \
         else [not args.no_donate]
     t0 = time.perf_counter()
@@ -167,6 +180,9 @@ def run(args) -> dict:
         "entries": len(rows),
         "total_s": round(time.perf_counter() - t0, 3),
         "rows": rows,
+        "autotuned": [{k: e[k] for k in ("kind", "variant", "n", "p",
+                                         "block", "judged_by")}
+                      for e in tuned],
     }
 
 

@@ -1182,6 +1182,18 @@ class ExperimentService:
         widths = sorted(set(widths or (self.max_stack, 1)))
         self._warming = True   # no lineage/event rows for warm tenants
         try:
+            # block autotuner: tune the spelling's lane blocks BEFORE the
+            # warm dispatch compiles, so the cached executables are the
+            # tuned programs (memo-hit from tuning.json on restart;
+            # SRNN_NO_AUTOTUNE=1 is the A/B oracle).  Fail-soft host-side.
+            if kind == "soup":
+                try:
+                    from .. import autotune
+
+                    autotune.autotune_for_run(
+                        _soup_config_from_params(params))
+                except Exception:
+                    pass
             for k in widths:
                 reqs = [Request(ticket=f"warm{i:03d}", kind=kind,
                                 params=dict(params), tenant=f"warm{i:03d}",

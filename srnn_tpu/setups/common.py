@@ -227,6 +227,14 @@ def add_pipeline_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         "Cost accounting is host-side compile metadata, "
                         "so results are bit-identical either way — the "
                         "--no-spans-style A/B oracle for that claim")
+    p.add_argument("--no-autotune", action="store_true",
+                   help="skip the block autotuner (srnn_tpu.autotune): "
+                        "no tuned-block lookup or warmup grid "
+                        "measurement; lane blocks fall back to the "
+                        "built-in defaults.  Tuning only ever changes a "
+                        "tile size, so results are bit-identical either "
+                        "way — this knob is the A/B oracle for exactly "
+                        "that claim (equivalent: SRNN_NO_AUTOTUNE=1)")
     return p
 
 

@@ -84,7 +84,7 @@ def build_parser():
                         "into one megakernel launch on Mosaic backends "
                         "(popmajor; cross-type attack stays XLA; "
                         "bit-identical XLA fallback elsewhere)")
-    p.add_argument("--population-dtype", choices=("f32", "bf16"),
+    p.add_argument("--population-dtype", choices=("f32", "bf16", "int8"),
                    default="f32",
                    help="per-type population storage dtype (bf16 = "
                         "mixed-precision mode, see PARITY.md)")
@@ -313,6 +313,13 @@ def _run_once(args, ctx=None):
     registry = MetricsRegistry()
     set_precision_gauges(registry, cfg)
     set_distributed_gauges(registry, dist, mesh)
+    # block autotuner (srnn_tpu.autotune; --no-autotune = the A/B bitwise
+    # oracle): per-type lane blocks measured-or-memoed BEFORE warmup, so
+    # the run's executables are the tuned programs from the first compile
+    if primary:
+        from .. import autotune
+        autotune.autotune_for_run(cfg, registry=registry, exp=exp,
+                                  no_autotune=args.no_autotune)
     if cfg.generation_impl == "fused":
         from ..multisoup import resolved_generation_impl
         exp.log("generation_impl=fused: " + ",".join(

@@ -90,7 +90,7 @@ def build_parser():
                         "megakernel launch per lane block on Mosaic "
                         "backends (popmajor; ops/pallas_generation.py; "
                         "bit-identical XLA fallback elsewhere)")
-    p.add_argument("--population-dtype", choices=("f32", "bf16"),
+    p.add_argument("--population-dtype", choices=("f32", "bf16", "int8"),
                    default="f32",
                    help="population storage dtype; bf16 halves population "
                         "HBM and gather bytes, computes in f32, weight "
@@ -248,6 +248,14 @@ def _run_once(args, ctx=None):
     registry = MetricsRegistry()
     set_precision_gauges(registry, cfg)
     set_distributed_gauges(registry, dist, mesh)
+    # block autotuner (srnn_tpu.autotune; --no-autotune = the A/B bitwise
+    # oracle): measure-or-memo the fused generation's lane block BEFORE
+    # warmup/first compile, so every executable this run builds is the
+    # tuned program; emits soup_autotune_* + one {"kind":"autotune"} row
+    if primary:
+        from .. import autotune
+        autotune.autotune_for_run(cfg, registry=registry, exp=exp,
+                                  no_autotune=args.no_autotune)
     if cfg.generation_impl == "fused":
         from ..soup import _fused_kernel_route
         exp.log("generation_impl=fused: "

@@ -136,19 +136,33 @@ class SoupConfig(NamedTuple):
     # an extra rounding); every phase still computes in f32 —
     # weights upcast at generation entry and round back to bf16 exactly
     # once at generation exit (the kernel rounds at the same points).
+    # 'int8' quarters it: weights store as int8 codes with a per-particle
+    # f32 scale (``SoupState.scales``; amax/127 symmetric, divergence
+    # encoded as scale=inf — see DESIGN.md §23), dequantized to f32 at
+    # generation entry and re-quantized at exactly ONE point per
+    # generation (the same exit point in the fused and phase-chain
+    # spellings, so fused==phases stays bitwise at int8 like bf16).
     # Integer state (uids, pids, counters) and the PRNG draw stream are
     # untouched; weight trajectories drift from f32 within the tolerance
     # documented in PARITY.md (benchmarks/parity_sweep.py measures it).
-    population_dtype: str = "f32"       # 'f32' | 'bf16'
+    population_dtype: str = "f32"       # 'f32' | 'bf16' | 'int8'
 
 
 class SoupState(NamedTuple):
-    """Population as struct-of-arrays; the whole soup is one pytree."""
+    """Population as struct-of-arrays; the whole soup is one pytree.
+
+    ``scales`` is the int8 mode's per-particle dequantization scale
+    vector ((N,) f32; ``weights`` then holds int8 codes).  It stays
+    ``None`` — an EMPTY pytree subtree, not a leaf — for f32/bf16
+    populations, so their state trees keep exactly the pre-int8 leaves
+    (checkpoints, donation, tenant stacking and shard specs all see the
+    unchanged pytree)."""
     weights: jnp.ndarray   # (N, P)
     uids: jnp.ndarray      # (N,) int32 — stable particle identity across respawns
     next_uid: jnp.ndarray  # () int32
     time: jnp.ndarray      # () int32 generation counter
     key: jax.Array         # PRNG state for this soup
+    scales: Optional[jnp.ndarray] = None  # (N,) f32 int8 scales | None
 
 
 class SoupEvents(NamedTuple):
@@ -162,35 +176,91 @@ def _pop_dtype(config) -> jnp.dtype:
     """Storage dtype of the population (``population_dtype`` field)."""
     if config.population_dtype == "bf16":
         return jnp.bfloat16
+    if config.population_dtype == "int8":
+        return jnp.int8
     if config.population_dtype != "f32":
         raise ValueError(
             f"unknown population_dtype {config.population_dtype!r}; "
-            "expected 'f32' or 'bf16'")
+            "expected 'f32', 'bf16' or 'int8'")
     return jnp.float32
 
 
-def _upcast(config, w: jnp.ndarray) -> jnp.ndarray:
-    """bf16 storage -> f32 compute view (no-op for f32 populations)."""
-    return w.astype(jnp.float32) if config.population_dtype == "bf16" else w
+def _upcast(config, w: jnp.ndarray, scales: Optional[jnp.ndarray] = None,
+            paxis: int = 0) -> jnp.ndarray:
+    """Storage -> f32 compute view (no-op for f32 populations).
+
+    bf16 upcasts exactly; int8 dequantizes ``codes * scale`` with the
+    per-particle ``scales`` broadcast along the particle axis ``paxis``
+    (0 for row-major (N, P) weights, -1 for the popmajor (P, N)
+    transpose).  A diverged particle's scale is +inf and its codes are
+    all 127, so the dequantized row is +inf and ``is_diverged`` keeps
+    firing (the exact inf/nan pattern is not representable — PARITY.md
+    documents the collapse)."""
+    if config.population_dtype == "bf16":
+        return w.astype(jnp.float32)
+    if config.population_dtype == "int8":
+        shape = [1] * w.ndim
+        shape[paxis] = -1
+        return w.astype(jnp.float32) * scales.reshape(shape)
+    return w
 
 
-def _downcast(config, w: jnp.ndarray) -> jnp.ndarray:
-    """f32 compute result -> storage dtype; the bf16 path's single
-    per-generation rounding point."""
-    return w.astype(jnp.bfloat16) if config.population_dtype == "bf16" else w
+def _downcast(config, w: jnp.ndarray, paxis: int = 0
+              ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """f32 compute result -> ``(storage, scales|None)``; the reduced-
+    precision paths' single per-generation rounding point.
+
+    int8 quantizes symmetrically per particle: ``scale = amax/127``,
+    ``codes = clip(round(w/scale), -127, 127)`` (worst-case abs error
+    scale/2 = amax/254 per weight per generation).  All-zero particles
+    keep ``scale = 1`` so they dequantize to exact zeros; a particle
+    with any non-finite weight encodes as ``scale = +inf, codes = 127``
+    so divergence survives the storage round-trip."""
+    if config.population_dtype == "bf16":
+        return w.astype(jnp.bfloat16), None
+    if config.population_dtype != "int8":
+        return w, None
+    axes = tuple(a for a in range(w.ndim) if a != paxis % w.ndim)
+    amax = jnp.max(jnp.abs(w), axis=axes)
+    div = ~jnp.isfinite(amax)
+    safe = jnp.where(
+        (amax > 0) & ~div,
+        jnp.maximum(amax / 127.0, jnp.finfo(jnp.float32).tiny), 1.0)
+    shape = [1] * w.ndim
+    shape[paxis] = -1
+    q = jnp.clip(jnp.round(w / safe.reshape(shape)), -127.0, 127.0)
+    q = jnp.where(div.reshape(shape), 127.0, q).astype(jnp.int8)
+    scales = jnp.where(div, jnp.inf, safe).astype(jnp.float32)
+    return q, scales
+
+
+def _stored_view(config, w: jnp.ndarray, scales: Optional[jnp.ndarray],
+                 paxis: int = 0) -> jnp.ndarray:
+    """Consumer view of STORED weights (health folds, trajectory records,
+    classification): int8 codes are meaningless without their scales, so
+    the int8 mode hands consumers the dequantized f32 view; f32/bf16
+    consumers read storage directly, exactly as before this mode."""
+    if config.population_dtype == "int8":
+        return _upcast(config, w, scales, paxis)
+    return w
 
 
 def seed(config: SoupConfig, key: jax.Array) -> SoupState:
     """Create the initial population (``Soup.seed``, ``soup.py:45-49``)."""
     k_init, k_state = jax.random.split(key)
     w = init_population(config.topo, k_init, config.size)
-    w = w.astype(_pop_dtype(config))
+    if config.population_dtype == "int8":
+        w, scales = _downcast(config, w)
+    else:
+        w = w.astype(_pop_dtype(config))
+        scales = None
     return SoupState(
         weights=w,
         uids=jnp.arange(config.size, dtype=jnp.int32),
         next_uid=jnp.int32(config.size),
         time=jnp.int32(0),
         key=k_state,
+        scales=scales,
     )
 
 
@@ -265,7 +335,7 @@ def _evolve_parallel(config: SoupConfig, state: SoupState,
     n = config.size
     topo = config.topo
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    w = _upcast(config, state.weights)
+    w = _upcast(config, state.weights, state.scales)
     has_attacker = jnp.zeros(n, bool)
     att_idx = jnp.full(n, -1, jnp.int32)
 
@@ -324,8 +394,8 @@ def _evolve_parallel(config: SoupConfig, state: SoupState,
         n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
         config.train > 0, death_action, death_cp)
 
-    new_state = SoupState(_downcast(config, w), uids, next_uid,
-                          state.time + 1, key)
+    w, scales = _downcast(config, w)
+    new_state = SoupState(w, uids, next_uid, state.time + 1, key, scales)
     events = SoupEvents(action, counterpart, train_loss)
     if lin is None:
         return new_state, events
@@ -481,7 +551,7 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
     n = config.size
     topo = config.topo
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
-    wT = _upcast(config, wT)
+    wT = _upcast(config, wT, state.scales, paxis=-1)
     has_attacker = jnp.zeros(n, bool)
     att_idx = jnp.full(n, -1, jnp.int32)
 
@@ -549,13 +619,13 @@ def _evolve_parallel_popmajor(config: SoupConfig, state: SoupState,
         action = jnp.where(dead_div, ACT_DIV_DEAD, action)
         action = jnp.where(dead_zero, ACT_ZERO_DEAD, action)
         death_cp = jnp.where(dead, uids, -1)
-    wT = _downcast(config, wT)
+    wT, scales = _downcast(config, wT, paxis=-1)
 
     act, cp = _event_record(
         n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
         config.train > 0, action, death_cp)
     new_state = SoupState(state.weights, uids, state.next_uid + deaths,
-                          state.time + 1, key)
+                          state.time + 1, key, scales)
     events = SoupEvents(act, cp, train_loss)
     if lin is None:
         return new_state, events, wT
@@ -583,6 +653,14 @@ def _evolve_fused_popmajor(config: SoupConfig, state: SoupState,
     in-block so learners see post-attack weights like the phase chain.
     The respawn draw happens in XLA (one threefry call) and rides in as
     the fresh block.  Mosaic backends only (see ``_fused_kernel_route``).
+
+    int8 populations dequantize HERE, before the counterpart gathers, and
+    re-quantize at the single exit point below — the kernel sees f32 rows
+    either way, so the fused spelling hits the phase chain's exact
+    quantize points by construction (the documented tradeoff: unlike
+    bf16, int8 rows do not ride the kernel's VMEM blocks at storage
+    width).  bf16 keeps the in-kernel cast protocol (loads upcast, the
+    store rounds), whose points coincide with the phase chain's.
     """
     from .init import fresh_lanes as _fresh_lanes
     from .ops.pallas_generation import generation_popmajor
@@ -590,6 +668,8 @@ def _evolve_fused_popmajor(config: SoupConfig, state: SoupState,
     n = config.size
     topo = config.topo
     key, k_ag, k_at, k_lg, k_lt, k_re = jax.random.split(state.key, 6)
+    if config.population_dtype == "int8":
+        wT = _upcast(config, wT, state.scales, paxis=-1)
     has_attacker = jnp.zeros(n, bool)
     att_idx = jnp.full(n, -1, jnp.int32)
 
@@ -634,6 +714,10 @@ def _evolve_fused_popmajor(config: SoupConfig, state: SoupState,
             remove_divergent=config.remove_divergent,
             remove_zero=config.remove_zero, epsilon=config.epsilon)
 
+    scales = state.scales
+    if config.population_dtype == "int8":
+        wT, scales = _downcast(config, wT, paxis=-1)
+
     dead = dead_div | dead_zero
     action = jnp.full(n, ACT_NONE, jnp.int32)
     rank = jnp.cumsum(dead) - 1
@@ -648,7 +732,7 @@ def _evolve_fused_popmajor(config: SoupConfig, state: SoupState,
         n, attack_gate, state.uids[attack_tgt], learn_gate, state.uids[learn_tgt],
         config.train > 0, action, death_cp)
     new_state = SoupState(state.weights, uids, state.next_uid + deaths,
-                          state.time + 1, key)
+                          state.time + 1, key, scales)
     events = SoupEvents(act, cp, train_loss)
     if lin is None:
         return new_state, events, wT
@@ -1012,13 +1096,19 @@ def _evolve(
                 new_s, ev, new_wT = _evolve_parallel_popmajor(config, s, wT)
             if metrics:
                 m = accumulate_soup_metrics(m, ev.action, ev.loss)
+            # int8 consumers (health folds, trajectory records) read the
+            # dequantized f32 view — raw codes mean nothing without scales
+            vT = _stored_view(config, new_wT, new_s.scales, paxis=-1) \
+                if (health or record) else new_wT
             if health:
-                h = accumulate_health(h, new_wT, 0, config.epsilon)
-            out = (ev, new_wT.T, new_s.uids) if record else None
+                h = accumulate_health(h, vT, 0, config.epsilon)
+            out = (ev, vT.T, new_s.uids) if record else None
             return (new_s, new_wT, m, h, lin, win), out
 
         # the transposed wT is the live weights carry; null the row-major
         # field so the scan doesn't drag a dead (N, P) buffer along
+        # (the int8 scales vector keeps riding the state carry — each
+        # generation's entry dequant needs the previous exit's scales)
         light = state._replace(weights=jnp.zeros((0,), state.weights.dtype))
         (final, wT, m, h, lin, win), recs = jax.lax.scan(
             step_t, (light, state.weights.T, m0, h0, l0, w0), None,
@@ -1027,7 +1117,7 @@ def _evolve(
         if lineage:
             from .ops.popmajor import apply_popmajor
 
-            wc = _upcast(config, wT)
+            wc = _upcast(config, wT, final.scales, paxis=-1)
             fw = apply_popmajor(config.topo, wc, wc)
             lin, fstats = close_window(lin, wc, fw, 0, config.epsilon)
     else:
@@ -1040,15 +1130,17 @@ def _evolve(
                 new_s, ev = evolve_step(config, s)
             if metrics:
                 m = accumulate_soup_metrics(m, ev.action, ev.loss)
+            v = _stored_view(config, new_s.weights, new_s.scales) \
+                if (health or record) else new_s.weights
             if health:
-                h = accumulate_health(h, new_s.weights, -1, config.epsilon)
-            out = (ev, new_s.weights, new_s.uids) if record else None
+                h = accumulate_health(h, v, -1, config.epsilon)
+            out = (ev, v, new_s.uids) if record else None
             return (new_s, m, h, lin, win), out
 
         (final, m, h, lin, win), recs = jax.lax.scan(
             step, (state, m0, h0, l0, w0), None, length=generations)
         if lineage:
-            wc = _upcast(config, final.weights)
+            wc = _upcast(config, final.weights, final.scales)
             fw = jax.vmap(lambda wi: apply_to_weights(config.topo, wi, wi))(
                 wc)
             lin, fstats = close_window(lin, wc, fw, -1, config.epsilon)
@@ -1096,4 +1188,6 @@ def probe_dynamics(topo: Topology, weights: jnp.ndarray,
 def count(config: SoupConfig, state: SoupState) -> jnp.ndarray:
     """(5,) class histogram of the current population
     (``Soup.count``, ``soup.py:89-103``)."""
-    return count_classes(classify_batch(config.topo, state.weights, config.epsilon))
+    return count_classes(classify_batch(
+        config.topo, _stored_view(config, state.weights, state.scales),
+        config.epsilon))

@@ -60,6 +60,11 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_fused_fallback_generations_total": "counter",
     "soup_precision_weight_bits": "gauge",
     "soup_precision_population_bytes": "gauge",
+    # -- block autotuner (srnn_tpu.autotune) -----------------------------
+    "soup_autotune_cache_hits_total": "counter",
+    "soup_autotune_measurements_total": "counter",
+    "soup_autotune_block": "gauge",
+    "soup_autotune_roofline_fraction": "gauge",
     # -- flight recorder (telemetry.flightrec) ---------------------------
     "soup_health_nonfinite_particles": "gauge",
     "soup_health_zero_particles": "gauge",

@@ -126,7 +126,7 @@ def set_precision_gauges(registry: MetricsRegistry, config) -> None:
     """Run-start gauges for the population's precision mode: storage bits
     per weight and the resulting population bytes (``SoupConfig`` or
     ``MultiSoupConfig``)."""
-    bits = 16 if config.population_dtype == "bf16" else 32
+    bits = {"bf16": 16, "int8": 8}.get(config.population_dtype, 32)
     if hasattr(config, "topos"):
         weights = sum(t.num_weights * n
                       for t, n in zip(config.topos, config.sizes))
@@ -134,6 +134,8 @@ def set_precision_gauges(registry: MetricsRegistry, config) -> None:
         weights = config.topo.num_weights * config.size
     registry.gauge("soup_precision_weight_bits",
                    help="population storage bits per weight").set(bits)
+    # int8's per-particle scale vector is an O(N) float rider next to the
+    # O(N*P) codes; the footprint gauge counts the weight storage only
     registry.gauge("soup_precision_population_bytes",
                    help="population storage footprint at the configured "
                    "dtype").set(weights * bits // 8)

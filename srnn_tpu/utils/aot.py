@@ -186,6 +186,7 @@ def abstract_soup_state(config, mesh=None) -> "Any":
 
     from ..soup import SoupState, _pop_dtype
 
+    int8 = config.population_dtype == "int8"
     st = SoupState(
         weights=jax.ShapeDtypeStruct(
             (config.size, config.topo.num_weights), _pop_dtype(config)),
@@ -193,12 +194,14 @@ def abstract_soup_state(config, mesh=None) -> "Any":
         next_uid=jax.ShapeDtypeStruct((), jnp.int32),
         time=jax.ShapeDtypeStruct((), jnp.int32),
         key=_key_array_struct(),
+        scales=jax.ShapeDtypeStruct((config.size,), jnp.float32)
+        if int8 else None,
     )
     if mesh is None:
         return st
     from ..parallel.sharded_soup import _soup_axes, _state_specs
 
-    return _with_shardings(st, _state_specs(_soup_axes(mesh)), mesh)
+    return _with_shardings(st, _state_specs(_soup_axes(mesh), int8), mesh)
 
 
 def abstract_lineage_state(n: int, mesh=None) -> "Any":
@@ -231,6 +234,7 @@ def abstract_multi_state(config, mesh=None) -> "Any":
     from ..multisoup import MultiSoupState
     from ..soup import _pop_dtype
 
+    int8 = config.population_dtype == "int8"
     st = MultiSoupState(
         weights=tuple(
             jax.ShapeDtypeStruct((n, t.num_weights), _pop_dtype(config))
@@ -240,12 +244,14 @@ def abstract_multi_state(config, mesh=None) -> "Any":
         next_uid=jax.ShapeDtypeStruct((), jnp.int32),
         time=jax.ShapeDtypeStruct((), jnp.int32),
         key=_key_array_struct(),
+        scales=tuple(jax.ShapeDtypeStruct((n,), jnp.float32)
+                     for n in config.sizes) if int8 else None,
     )
     if mesh is None:
         return st
     from ..parallel.sharded_multisoup import _mstate_specs
 
-    return _with_shardings(st, _mstate_specs(len(config.topos)), mesh)
+    return _with_shardings(st, _mstate_specs(len(config.topos), int8), mesh)
 
 
 def _stack_abstract(tree, k: int):

@@ -1,0 +1,171 @@
+"""Block autotuner (``srnn_tpu.autotune``).
+
+The autotuner only ever changes a TILE SIZE, so every claim splits in
+two: (1) the machinery — deterministic grid walk under
+``SRNN_AUTOTUNE_FIXED=1``, ``tuning.json`` round-trip with memo-hit on
+restart, corrupt-file graceful skip, roofline-vs-min-wall judgment —
+and (2) the oracle — a mega run with the autotuner on is BITWISE
+identical to the same run under ``--no-autotune``.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from srnn_tpu import autotune
+from srnn_tpu.setups import REGISTRY
+from srnn_tpu.topology import Topology
+from srnn_tpu.utils import aot
+
+WW = Topology("weightwise", width=2, depth=2)
+
+
+@pytest.fixture
+def tuning_dir(tmp_path, monkeypatch):
+    """Isolate tuning.json (and the executable cache it lives next to)
+    in tmp_path, with a clean in-memory memo before and after."""
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("SRNN_COMPILE_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(aot, "_cache_dir_enabled", None)
+    autotune.reset_for_tests()
+    yield tmp_path
+    autotune.reset_for_tests()
+
+
+@pytest.fixture
+def fixed_mode(monkeypatch):
+    """Synthetic-wall mode: the grid walk runs no jax work and is
+    byte-reproducible (smallest candidate wins via min-wall)."""
+    monkeypatch.setenv(autotune.FIXED_ENV, "1")
+
+
+def test_fixed_grid_is_deterministic(tuning_dir, fixed_mode):
+    """Two tunes of the same key from scratch write byte-identical
+    tuning.json files (the grid walk, judgment and persistence carry no
+    timing jitter under SRNN_AUTOTUNE_FIXED=1)."""
+    path = os.path.join(str(tuning_dir), autotune.TUNING_NAME)
+
+    e1 = autotune.autotune_generation(WW, 512)
+    assert e1 is not None and e1["judged_by"] == "min_wall"
+    assert e1["block"] == min(autotune.GENERATION_CANDIDATES)
+    first = open(path, "rb").read()
+
+    os.remove(path)
+    autotune.reset_for_tests()
+    e2 = autotune.autotune_generation(WW, 512)
+    assert e2["block"] == e1["block"]
+    assert open(path, "rb").read() == first
+
+
+def test_roundtrip_memo_hits_without_remeasuring(tuning_dir, fixed_mode):
+    """A restart (fresh memo) serves the persisted winner from
+    tuning.json — lookup is a pure table read, zero new measurements."""
+    e = autotune.autotune_generation(WW, 512)
+    assert autotune._measured_keys  # this process measured
+
+    autotune.reset_for_tests()     # "restart"
+    got = autotune.lookup("generation", WW.variant, 512, WW.num_weights,
+                          dtype="float32")
+    assert got == e["block"]
+    assert not autotune._measured_keys  # served from disk, not re-measured
+    # and the tuning entry round-tripped its full report
+    raw = json.load(open(os.path.join(str(tuning_dir),
+                                      autotune.TUNING_NAME)))
+    assert raw["version"] == autotune.SCHEMA_VERSION
+    (entry,) = raw["entries"].values()
+    assert entry["walls_s"] and entry["candidates"]
+
+
+def test_corrupt_tuning_file_is_skipped_then_overwritten(tuning_dir,
+                                                         fixed_mode):
+    """A torn/garbage tuning.json must never crash: lookups see an empty
+    table, and the next tune atomically replaces the file."""
+    path = os.path.join(str(tuning_dir), autotune.TUNING_NAME)
+    open(path, "w").write('{"version": 1, "entries": ')  # torn write
+    assert autotune.lookup("generation", WW.variant, 512,
+                           WW.num_weights) is None
+
+    autotune.autotune_generation(WW, 512)
+    raw = json.load(open(path))  # valid again
+    assert raw["entries"]
+
+
+def test_judge_roofline_and_min_wall_fallback():
+    """Judgment ranks by achieved flops/wall when the ledger reports
+    flops (a slower wall can still win on a bigger program), and falls
+    back to min wall when it doesn't."""
+    walls = {256: 1.0, 512: 2.0}
+    winner, report = autotune._judge(walls, {256: 100.0, 512: 400.0})
+    assert winner == 512 and report["judged_by"] == "roofline"
+    assert report["roofline_fraction"]["512"] == 1.0
+
+    winner, report = autotune._judge(walls, {256: None, 512: None})
+    assert winner == 256 and report["judged_by"] == "min_wall"
+
+
+def test_disabled_env_blocks_lookup_and_measurement(tuning_dir, fixed_mode,
+                                                    monkeypatch):
+    """SRNN_NO_AUTOTUNE=1 is the A/B oracle switch: no reads, no writes,
+    no measurements."""
+    autotune.autotune_generation(WW, 512)  # persist a winner first
+    autotune.reset_for_tests()
+    monkeypatch.setenv(autotune.DISABLE_ENV, "1")
+    assert not autotune.enabled()
+    assert autotune.tuning_path() is None
+    assert autotune.lookup("generation", WW.variant, 512,
+                           WW.num_weights) is None
+    assert autotune.autotune_generation(WW, 512) is None
+
+
+def _mega_flags(root):
+    return ["--smoke", "--root", str(root), "--layout", "popmajor",
+            "--generation-impl", "fused"]
+
+
+@pytest.mark.slow
+def test_no_autotune_bitwise_ab_mega_soup(tuning_dir, fixed_mode, tmp_path):
+    """The oracle, end to end on the flagship loop: a fused mega_soup
+    smoke with the autotuner active (tuned block resolved from
+    tuning.json) finishes BITWISE identical to its --no-autotune twin —
+    tuning changes tile sizes, never results.  slow lane (subprocess-
+    class acceptance e2e, like the kill9/fleet oracles); the tier-1
+    unit tests above plus the autotune_smoke CI group keep the fast
+    lane covered."""
+    from srnn_tpu.experiment import restore_checkpoint
+
+    d_tuned = REGISTRY["mega_soup"](_mega_flags(tmp_path / "tuned"))
+    assert os.path.exists(os.path.join(str(tuning_dir),
+                                       autotune.TUNING_NAME))
+    d_plain = REGISTRY["mega_soup"](
+        _mega_flags(tmp_path / "plain") + ["--no-autotune"])
+
+    want = restore_checkpoint(os.path.join(d_tuned, "ckpt-gen00000006"))
+    got = restore_checkpoint(os.path.join(d_plain, "ckpt-gen00000006"))
+    np.testing.assert_array_equal(np.asarray(want.weights),
+                                  np.asarray(got.weights))
+    np.testing.assert_array_equal(np.asarray(want.uids),
+                                  np.asarray(got.uids))
+    assert int(want.next_uid) == int(got.next_uid)
+
+
+@pytest.mark.slow
+def test_no_autotune_bitwise_ab_mega_multisoup(tuning_dir, fixed_mode,
+                                               tmp_path):
+    """Same oracle on the heterogeneous loop (per-type tuning keys)."""
+    from srnn_tpu.experiment import restore_multi_checkpoint
+
+    d_tuned = REGISTRY["mega_multisoup"](_mega_flags(tmp_path / "tuned"))
+    d_plain = REGISTRY["mega_multisoup"](
+        _mega_flags(tmp_path / "plain") + ["--no-autotune"])
+
+    want = restore_multi_checkpoint(os.path.join(d_tuned,
+                                                 "ckpt-gen00000006"))
+    got = restore_multi_checkpoint(os.path.join(d_plain,
+                                                "ckpt-gen00000006"))
+    for t in range(len(want.weights)):
+        np.testing.assert_array_equal(np.asarray(want.weights[t]),
+                                      np.asarray(got.weights[t]))
+        np.testing.assert_array_equal(np.asarray(want.uids[t]),
+                                      np.asarray(got.uids[t]))

@@ -17,6 +17,9 @@ Contracts under test:
     arithmetic, never quantized), agrees bitwise between the fused and
     phase spellings, and stays within the PARITY.md per-generation
     tolerance vs f32.
+  * ``population_dtype='int8'`` does the same with quantized codes +
+    per-particle scales; fused==phases is bitwise BY CONSTRUCTION here
+    (dequant/requant outside the kernel — the quantize-point contract).
   * compact-phase configs are subsumed under 'fused' (masks replace
     compaction), including the capacity-overflow regime where the chain's
     compact path falls back to full width.
@@ -247,6 +250,115 @@ def test_bf16_sequential_mode_rejected():
                      population_dtype="bf16")
     with pytest.raises(ValueError, match="population_dtype"):
         soup.evolve_step(cfg, seed(cfg, jax.random.key(0)))
+
+
+# ------------------------------------------------------------ int8 mode
+
+
+def test_int8_fused_matches_phases_bitwise():
+    """At int8 the fused and phase spellings agree BITWISE by
+    construction: dequant/requant sit OUTSIDE the kernel (the
+    quantize-point contract), so both spellings consume the same
+    dequantized f32 view — stronger than the bf16 case, where the cast
+    point had to be matched inside the kernel."""
+    cfg = _full_dynamics(WW, population_dtype="int8")
+    st = seed(cfg, jax.random.key(5))
+    assert st.weights.dtype == jnp.int8 and st.scales is not None
+    ref = evolve(cfg, st, generations=3, metrics=True)
+    got = evolve(cfg._replace(generation_impl="fused"), st, generations=3,
+                 metrics=True)
+    _leaves_equal(ref, got)
+
+
+def test_int8_fused_multisoup_bitwise():
+    """Heterogeneous int8 population: per-type quantized blocks through
+    the fused route, bitwise vs phases (scales ride per type)."""
+    mcfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(12, 12), attacking_rate=0.4,
+        learn_from_rate=0.3, learn_from_severity=1, train=1,
+        remove_divergent=True, remove_zero=True, layout="popmajor",
+        population_dtype="int8")
+    st = multisoup.seed_multi(mcfg, jax.random.key(2))
+    assert all(w.dtype == jnp.int8 for w in st.weights)
+    ref = multisoup.evolve_multi(mcfg, st, generations=3, metrics=True,
+                                 health=True)
+    got = multisoup.evolve_multi(mcfg._replace(generation_impl="fused"),
+                                 st, generations=3, metrics=True,
+                                 health=True)
+    _leaves_equal(ref, got)
+
+
+def test_int8_fused_sharded_twins_bitwise():
+    """Both sharded surfaces at int8: fused vs phases bitwise on the
+    same mesh (per-shard scales are per-particle, so sharding never
+    changes the quantization grid)."""
+    from srnn_tpu.parallel import make_sharded_state, soup_mesh
+    from srnn_tpu.parallel.sharded_multisoup import (
+        make_sharded_multi_state, sharded_evolve_multi)
+    from srnn_tpu.parallel.sharded_soup import sharded_evolve
+
+    mesh = soup_mesh()
+    d = mesh.devices.size
+    cfg = _full_dynamics(WW, size=d * 4, population_dtype="int8")
+    st = make_sharded_state(cfg, mesh, jax.random.key(3))
+    ref = sharded_evolve(cfg, mesh, st, generations=3, metrics=True)
+    got = sharded_evolve(cfg._replace(generation_impl="fused"), mesh, st,
+                         generations=3, metrics=True)
+    _leaves_equal(ref, got)
+
+    mcfg = multisoup.MultiSoupConfig(
+        topos=(WW, AGG), sizes=(2 * d, 2 * d), attacking_rate=0.4,
+        learn_from_rate=0.3, learn_from_severity=1, train=1,
+        remove_divergent=True, remove_zero=True, layout="popmajor",
+        population_dtype="int8")
+    mst = make_sharded_multi_state(mcfg, mesh, jax.random.key(4))
+    mref = sharded_evolve_multi(mcfg, mesh, mst, generations=2,
+                                metrics=True)
+    mgot = sharded_evolve_multi(mcfg._replace(generation_impl="fused"),
+                                mesh, mst, generations=2, metrics=True)
+    _leaves_equal(mref, mgot)
+
+
+def test_int8_integer_state_exact_and_per_gen_tolerance():
+    """100 generations of int8 full dynamics: integer state stays exact
+    int32 arithmetic (never quantized), and ONE generation from a shared
+    dequantized state stays within the PARITY.md per-generation bound
+    (rel L-inf < 2^-7; bound is half a step of the per-particle scale
+    amax/127 ~ 2^-8 per generation, measured ~3.9e-3 —
+    benchmarks/parity_sweep.py --rows int8 sweeps this)."""
+    from srnn_tpu.soup import _upcast
+
+    cfg8 = _full_dynamics(WW, size=64, train=2,
+                          generation_impl="fused",
+                          population_dtype="int8",
+                          respawn_draws="fused")
+    cfg32 = cfg8._replace(population_dtype="f32")
+    st8 = seed(cfg8, jax.random.key(7))
+    out = evolve(cfg8, st8, generations=100)
+    assert out.weights.dtype == jnp.int8
+    assert out.scales is not None
+    assert out.uids.dtype == jnp.int32
+    assert int(out.time) == 100
+    assert int(jnp.max(out.uids)) < int(out.next_uid)
+    assert int(out.next_uid) >= cfg8.size
+
+    def as_f32(st):
+        return st._replace(weights=_upcast(cfg8, st.weights, st.scales),
+                           scales=None)
+
+    worst = 0.0
+    for _ in range(5):
+        n32 = evolve(cfg32, as_f32(st8), generations=1)
+        st8 = evolve(cfg8, st8, generations=1)
+        np.testing.assert_array_equal(np.asarray(n32.uids),
+                                      np.asarray(st8.uids))
+        w32 = np.asarray(n32.weights, np.float32)
+        w8 = np.asarray(as_f32(st8).weights, np.float32)
+        fin = np.isfinite(w32).all(1) & np.isfinite(w8).all(1)
+        scale = max(float(np.abs(w32[fin]).max()), 1e-9)
+        worst = max(worst,
+                    float(np.abs(w32[fin] - w8[fin]).max()) / scale)
+    assert worst < 2 ** -7, worst
 
 
 def test_fused_kernel_glue_end_to_end(monkeypatch):
