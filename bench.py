@@ -1078,6 +1078,59 @@ def _regress_sentinel(result) -> None:
     result["regression"] = verdict
 
 
+#: bench-round archive next to the BENCH_*.json trajectory: every round
+#: joins the longitudinal history whether or not it gets committed, and
+#: ``regress.py --from-archive`` medians over ALL of them.  The row
+#: format ({"kind": "bench_round", "t": ..., "result": {...}}) is shared
+#: with regress.py's stdlib reader; telemetry.archive documents it.
+BENCH_ARCHIVE_NAME = "BENCH_archive.jsonl"
+BENCH_ARCHIVE_MAX_ROUNDS = 200
+
+
+def _archive_sentinel(result) -> None:
+    """Append the round to ``BENCH_archive.jsonl`` (bounded: compacted to
+    the newest rounds past the cap).  Pure stdlib INLINE — the parent's
+    un-wedgeable contract forbids importing srnn_tpu (and with it jax)
+    here, which is why this does not call telemetry.archive.  Advisory
+    like the regress sentinel: a failure costs a stage_log note, never
+    the round.  ``SRNN_BENCH_ARCHIVE=0`` opts out (tests and throwaway
+    runs keep the repo root clean)."""
+    stage_log = result.setdefault("stage_log", [])
+    att = {"stage": "archive", "attempt": 1}
+    if os.environ.get("SRNN_BENCH_ARCHIVE", "1") == "0":
+        att["outcome"] = "disabled"
+        stage_log.append(att)
+        return
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            BENCH_ARCHIVE_NAME)
+        with open(path, "a") as f:
+            f.write(json.dumps({"kind": "bench_round", "t": time.time(),
+                                "result": result}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(line)
+        if len(rows) > BENCH_ARCHIVE_MAX_ROUNDS:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write("\n".join(rows[-BENCH_ARCHIVE_MAX_ROUNDS:]) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            rows = rows[-BENCH_ARCHIVE_MAX_ROUNDS:]
+        att["outcome"] = "ok"
+        att["path"] = path
+        att["rounds"] = len(rows)
+    except Exception as e:  # advisory: never let the archive hurt the row
+        att["outcome"] = f"inconclusive: {type(e).__name__}"
+    stage_log.append(att)
+
+
 def main():
     result = {
         "metric": "self-applications/sec/chip",
@@ -1097,6 +1150,10 @@ def main():
         _regress_sentinel(result)
     except Exception:
         pass  # the one-JSON-line contract always wins
+    try:
+        _archive_sentinel(result)
+    except Exception:
+        pass
     print(json.dumps(result), flush=True)
 
 

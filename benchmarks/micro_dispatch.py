@@ -408,6 +408,50 @@ def row_export() -> dict:
         os.unlink(tmp.name)
 
 
+def row_archive() -> dict:
+    """Walltime of folding one cross-run-observatory ingest pass into the
+    per-chunk turn on top of the ``metered.health`` chunk (documented
+    bound <= ~5%, expected ~0%): once the store exists, a pass over an
+    unchanged results root is watermark ``stat`` calls only
+    (``telemetry.archive`` re-ingest is O(new bytes)) — the longitudinal
+    index stays off the hot path by construction.  Plain baseline
+    interleaved per the shared protocol."""
+    import shutil
+    import tempfile
+
+    from srnn_tpu.telemetry.archive import ingest
+
+    fns = _chunk_fns()
+    health = fns["health"]
+    root = tempfile.mkdtemp(prefix="srnn_micro_archive_")
+    run_dir = os.path.join(root, "exp-micro")
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "config.json"), "w") as f:
+        json.dump({"n": TELEMETRY_N, "seed": 0}, f)
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as f:
+        for i in range(64):
+            f.write(json.dumps({"kind": "heartbeat", "stage": "micro",
+                                "generation": i, "gens_per_sec": 100.0,
+                                "t": float(i)}) + "\n")
+    with open(os.path.join(run_dir, "meta.json"), "w") as f:
+        json.dump({"name": "micro", "seed": 0, "wall_seconds": 1.0,
+                   "error": None}, f)
+    ingest(root)  # build the store; later passes are watermark no-ops
+
+    def archive():
+        value = health()
+        ingest(root)
+        return value
+
+    try:
+        return _overhead_row("archive",
+                             {"plain": fns["plain"], "health": health,
+                              "archive": archive},
+                             base="health", feature="archive")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def row_trace() -> dict:
     """Walltime overhead of fleet trace-context propagation on top of
     the ``metered.health`` chunk (documented bound <= ~5%): the
@@ -697,11 +741,11 @@ def main(argv=None) -> int:
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
             row_telemetry(), row_health(), row_lineage(), row_spans(),
             row_export(), row_trace(), row_adaptive(), row_fused(),
-            row_int8(), row_autotune(), row_stacked()]
+            row_int8(), row_autotune(), row_archive(), row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        (c, d, m, t, h, l, sp, ex, tr, ad, fu, i8, au,
+        (c, d, m, t, h, l, sp, ex, tr, ad, fu, i8, au, ar,
          sk) = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
@@ -760,6 +804,10 @@ def main(argv=None) -> int:
               f"({au['overhead_pct']:+.1f}%); grid {au['grid_s']:.2f}s "
               f"= {au['amortized_over_run_pct']:.1f}% of a "
               f"{au['nominal_run_chunks']}-chunk run", file=sys.stderr)
+        print(f"# archive(N={ar['n']}, G={ar['generations']}): +re-ingest "
+              f"{ar['archive_ms_per_chunk']:.1f}ms vs metered.health "
+              f"{ar['health_ms_per_chunk']:.1f}ms per chunk "
+              f"({ar['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
         print(f"# stacked(K={sk['k']}, N={sk['n']}, G={sk['generations']}): "
               f"one stacked dispatch {sk['stacked_ms_per_chunk']:.1f}ms vs "
               f"8 solo dispatches {sk['solo8_ms_per_chunk']:.1f}ms "

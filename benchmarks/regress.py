@@ -3,6 +3,7 @@
     python benchmarks/regress.py BENCH_r06.json            # self-check
     python bench.py | python benchmarks/regress.py - --json
     python benchmarks/regress.py fresh.json --scale apps_per_chip=0.6
+    python benchmarks/regress.py fresh.json --from-archive  # + archived rounds
 
 Compares one fresh ``bench.py`` result (or a ``benchmarks/micro_dispatch``
 doc) against the committed ``BENCH_*.json`` trajectory: each comparable
@@ -120,7 +121,7 @@ MIN_ROUNDS = {"scan_apps_per_chip": 2, "tuned_apps_per_chip": 2}
 #: module docstring on session drift) on the documented <=5%-class rows
 MICRO_BOUND_PCT = 20.0
 MICRO_ROWS = ("telemetry", "health", "lineage", "spans", "export",
-              "adaptive", "int8", "autotune")
+              "adaptive", "int8", "autotune", "archive")
 
 
 def _get(doc, path):
@@ -160,6 +161,37 @@ def load_history(pattern: str, exclude_path: str = "") -> list:
             out.append((os.path.basename(path), load_result(path)))
         except (OSError, ValueError, json.JSONDecodeError):
             continue  # unreadable/foreign file: history degrades, never dies
+    return out
+
+
+#: the bench.py archive hook's sidecar (next to the BENCH_*.json
+#: trajectory); the row format is spelled inline in bench.py and here —
+#: neither process may import srnn_tpu (telemetry.archive documents the
+#: contract and carries the shared name)
+ARCHIVE_DEFAULT = os.path.join(REPO_ROOT, "BENCH_archive.jsonl")
+
+
+def load_archive_rounds(path: str) -> list:
+    """``[(label, result), ...]`` oldest-first from a ``BENCH_archive``
+    jsonl: ``{"kind": "bench_round", "result": {...}}`` rows, skip-
+    unparseable (a torn tail costs one round, never the sentinel)."""
+    out = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return out  # no archive yet: history degrades, never dies
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and row.get("kind") == "bench_round" \
+                and isinstance(row.get("result"), dict):
+            out.append((f"archive[{i}]", row["result"]))
     return out
 
 
@@ -295,6 +327,13 @@ def main(argv=None) -> int:
                                                      "BENCH_*.json"),
                    metavar="GLOB",
                    help="committed result trajectory to compare against")
+    p.add_argument("--from-archive", nargs="?", const=ARCHIVE_DEFAULT,
+                   default=None, metavar="PATH",
+                   help="ALSO median over the archived rounds in a "
+                        "BENCH_archive.jsonl (bench.py's archive hook "
+                        "appends every round there; default path is the "
+                        "repo-root sidecar) — the committed BENCH_*.json "
+                        "glob stays the baseline history either way")
     p.add_argument("--include-self", action="store_true",
                    help="keep the fresh file itself in the history set "
                         "(default: excluded when fresh is a file path, so "
@@ -331,6 +370,11 @@ def main(argv=None) -> int:
             args.history,
             exclude_path="" if (args.include_self or args.fresh == "-")
             else args.fresh)
+        if args.from_archive:
+            # archived rounds join AFTER the committed files, so the
+            # r0x names stay first in history_files for readability;
+            # the median is order-independent
+            history += load_archive_rounds(args.from_archive)
         verdict = compare(fresh, history)
         try:
             with open(os.path.join(REPO_ROOT, "BASELINE.json")) as f:

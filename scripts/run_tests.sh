@@ -827,6 +827,80 @@ fi
 rm -rf "$al_root"
 summary+=$(printf '%-34s %-4s %4ss' "alerts_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Run-archive smoke (PR 19, srnn_tpu/telemetry/archive): one clean smoke
+# run and one chaos-RECOVERED smoke run under a single results root, then
+# the cross-run observatory over it: `report --runs` must classify both
+# outcomes (clean + recovered) and group them into campaign rollups,
+# `report --compare` must render the pairwise diff, and a second ingest
+# pass must be a pure watermark no-op (zero rows appended) — the
+# longitudinal index drilled against REAL run dirs, not fixtures.
+t0=$SECONDS
+ar_root=$(mktemp -d)
+ar_ok=1
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups mega_soup --smoke \
+    --seed 7 --root "$ar_root/runs" > "$ar_root/out.log" 2>&1 || ar_ok=0
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups mega_soup --smoke \
+    --seed 11 --root "$ar_root/runs" --chaos "stall@2" \
+    --stall-timeout-s 5 --backoff-base-s 0.1 --backoff-max-s 1 \
+    --max-restarts 3 >> "$ar_root/out.log" 2>&1
+rc=$?
+if [ "$rc" -ne 3 ]; then
+    echo "archive_smoke: chaos run rc=$rc (want 3 recovered)" \
+        >> "$ar_root/out.log"
+    ar_ok=0
+fi
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+    "$ar_root/runs" --runs --json > "$ar_root/runs.json" \
+    2>>"$ar_root/out.log" || ar_ok=0
+python - "$ar_root/runs.json" "$ar_root" >> "$ar_root/out.log" 2>&1 <<'PY' || ar_ok=0
+import json, sys
+doc = json.load(open(sys.argv[1]))
+outcomes = {r["run"]: r["outcome"] for r in doc["runs"]}
+# the chaos run's FIRST attempt leaves its own dir (the stall fault on
+# its meta.json -> "failed") before the supervisor resumes into a new
+# one -> "recovered"; the table must carry the clean and recovered runs
+# either way
+assert {"clean", "recovered"} <= set(outcomes.values()), outcomes
+recovered = next(r for r in doc["runs"] if r["outcome"] == "recovered")
+assert recovered["restarts"] >= 1 and recovered["exit_code"] == 3, recovered
+# a --smoke seed sweep is ONE campaign: every dir under one fingerprint
+camps = doc["campaigns"]
+assert len(camps) == 1 and camps[0]["runs"] == len(doc["runs"]), camps
+# hand the driver the clean + recovered dirs for the --compare leg
+clean = next(r["dir"] for r in doc["runs"] if r["outcome"] == "clean")
+open(sys.argv[2] + "/dirs.txt", "w").write(
+    clean + "\n" + recovered["dir"])
+print("archive_smoke: run table outcomes + campaign rollup OK")
+PY
+if [ "$ar_ok" -eq 1 ]; then
+    ar_a=$(head -1 "$ar_root/dirs.txt")
+    ar_b=$(tail -1 "$ar_root/dirs.txt")
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+        "$ar_b" --compare "$ar_a" > "$ar_root/compare.txt" \
+        2>>"$ar_root/out.log" || ar_ok=0
+    grep -q 'same campaign' "$ar_root/compare.txt" || ar_ok=0
+    grep -q 'wall_seconds' "$ar_root/compare.txt" || ar_ok=0
+    # re-ingest of the untouched root: a watermark no-op, zero appends
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.archive \
+        ingest "$ar_root/runs" --json > "$ar_root/reingest.json" \
+        2>>"$ar_root/out.log" || ar_ok=0
+    python - "$ar_root/reingest.json" >> "$ar_root/out.log" 2>&1 <<'PY' || ar_ok=0
+import json, sys
+res = json.load(open(sys.argv[1]))
+assert res["ingested"] == [] and res["unchanged"] >= 2, res
+assert res["wrote"] is False, res
+print("archive_smoke: re-ingest watermark no-op OK")
+PY
+fi
+if [ "$ar_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("archive_smoke")
+    tail -n 40 "$ar_root/out.log"
+fi
+rm -rf "$ar_root"
+summary+=$(printf '%-34s %-4s %4ss' "archive_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 echo
 echo "=== run_tests.sh summary ==="
 printf '%s' "$summary"

@@ -26,6 +26,7 @@ ALLOWED_FILES = {
     "telemetry/report.py",   # CLI: renders the telemetry summary
     "telemetry/watch.py",    # CLI: the live watch console — stdout IS
                              # its product (snapshots + refresh frames)
+    "telemetry/archive.py",  # CLI: ingest/gc result lines + --json docs
     "analysis/__main__.py",  # CLI: this analyzer's own report output
     "serve/__main__.py",     # CLI: service startup line + stats JSON
     "serve/pool.py",         # CLI tier: the fleet front's [w<i>] worker

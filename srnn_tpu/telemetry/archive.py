@@ -1,0 +1,1040 @@
+"""Run archive & cross-run observatory: the longitudinal index.
+
+    python -m srnn_tpu.telemetry.archive ingest <results_root> [--json]
+    python -m srnn_tpu.telemetry.archive gc <results_root> --keep N
+    python -m srnn_tpu.telemetry.report <results_root> --runs [--json]
+    python -m srnn_tpu.telemetry.report --compare <run_a> <run_b>
+    python -m srnn_tpu.telemetry.watch <results_root> --archive
+
+Every telemetry surface before this one (spans, cost ledger, alerts,
+exemplars, fleet tracing) is scoped to ONE run dir, and ``regress.py``
+only reads bench JSONs — but the paper's questions (which basin a soup
+lands in, how often it diverges, how replication dynamics shift across
+configs) are *cross-run*, and the ROADMAP item 5 controller cannot exist
+without a queryable history of what ran, what it cost, and how it ended.
+This module is that history: an **incremental, read-only ingester** that
+scans a results root (mega run dirs and serve journal roots alike),
+folds each run's trail into one per-run summary row, and maintains an
+append-only indexed store.
+
+Store layout (``<root>/.archive/`` by default, ``--store`` overrides):
+
+  file            contract
+  --------------  ----------------------------------------------------
+  archive.jsonl   append-only: one ``{"kind":"run"}`` row per ingest of
+                  a run whose watermark moved, plus ``{"kind":"alert"}``
+                  rows for archive-drift latch transitions.  Appends are
+                  flushed + fsync'd; readers skip unparseable lines
+                  (the repo-wide jsonl contract).
+  index.json      the compacted view: latest row per run + per-run-dir
+                  watermarks + the drift latch.  Published atomically
+                  (``utils.atomicio``: tmp + fsync + rename), so a
+                  reader never sees a torn index.
+  archive.prom    ``soup_archive_*`` gauges (textfile exposition) so the
+                  node-exporter path that already scrapes run dirs can
+                  scrape the observatory too.
+
+Ingest discipline — the three properties everything else leans on:
+
+  * **Read-only over run dirs.**  Nothing under a run dir is ever
+    opened for writing, created, touched, or stat-mutated; the store
+    lives outside them.  Ingesting a LIVE run perturbs nothing (asserted
+    byte-for-byte in ``tests/test_archive.py``).
+  * **Watermarked: re-ingest is O(new bytes).**  Each run dir's
+    watermark is the ``(size, mtime_ns)`` vector of its folded files;
+    an unchanged run costs a handful of ``stat`` calls and zero reads.
+    The one exception is a run previously classified ``running`` — it is
+    re-folded even on an unchanged watermark, because its outcome can
+    decay to ``wedged`` by clock alone.
+  * **Bounded tail reads.**  Event lanes, metric history and lineage are
+    read through ``fleet.load_rows``-style bounded tails (the PR 12
+    discipline), so one week-long run dir cannot wedge the ingester.
+
+Outcome-classification ladder (first match wins; ``meta.json`` is the
+exit evidence — ``Experiment.__exit__`` writes it with ``error=None`` on
+a clean unwind, the fault's ``repr`` otherwise, and a SIGKILL leaves
+none at all):
+
+  evidence                                        outcome            exit
+  ----------------------------------------------  -----------------  ----
+  no meta.json, trail younger than ``stale_s``    running            —
+  no meta.json, trail stale                       wedged             137†
+  error=None, ``{"kind":"preempt"}`` row seen     preempted          75
+  error=None, ``{"kind":"restart"}`` row seen     recovered          3
+  error=None                                      clean              0
+  error ~ Preempted                               preempted          75
+  error ~ HostLost/CoordinatorTimeout             host-lost          71
+  error != None after restarts                    retries-exhausted  69
+  error != None, no restarts                      failed             1
+
+  † a SIGKILLed (or truly wedged) run is indistinguishable post-mortem
+    from any other meta-less death, so both land in ``wedged``; the
+    supervisor's exit-code vocabulary (resilience/supervisor.py) is the
+    source of the code column.
+
+Drift: the newest finished run of each campaign (= config fingerprint)
+is judged against the MEDIAN of its campaign history, per the tolerance
+table ``ARCHIVE_DRIFT_LEGS`` — the same discipline as ``regress.py``'s
+``LEGS``, including the minimum-history guard.  Breaches latch an
+``archive_drift`` alert (state persisted in index.json, transitions
+appended to archive.jsonl exactly once per edge — the ``AlertEngine``
+semantics, persisted because ingest is a CLI, not a process).
+
+Deliberately NOT archived: checkpoints, population arrays, triage
+bundles, exemplar payloads, full span streams — anything O(run length).
+The archive is the *card catalog*; the run dirs stay the library.
+
+Pure stdlib + intra-telemetry imports (no jax, no numpy): ingest of a
+dead fleet must work on a host with no backend at all.  The bench-round
+sidecar (``BENCH_archive.jsonl``) is the one piece NOT implemented here
+— bench.py's parent and regress.py are forbidden from importing
+srnn_tpu (their un-wedgeable contract), so both carry the trivial row
+format inline; :data:`BENCH_ARCHIVE_NAME` is the shared spelling.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+STORE_DIRNAME = ".archive"
+ARCHIVE_NAME = "archive.jsonl"
+INDEX_NAME = "index.json"
+PROM_NAME = "archive.prom"
+INDEX_VERSION = 1
+
+#: a meta-less run whose newest folded file is older than this is
+#: ``wedged``, younger is ``running`` (heartbeats flush every few
+#: seconds; 300s is > any legitimate gap between chunk finishers)
+DEFAULT_STALE_S = 300.0
+
+#: per-file tail bound for event lanes / metric history (the report
+#: summary's bound: ≈ thousands of rows — plenty for rates, restarts and
+#: alert trails; a week-long run's full trail is jq's job)
+TAIL_BYTES = 4 << 20
+#: lineage windows are wide rows; the census tail needs only the last few
+LINEAGE_TAIL_BYTES = 1 << 20
+
+#: config keys excluded from the campaign fingerprint: identity/location
+#: knobs that vary across the arms of ONE campaign (a sweep re-seeds and
+#: re-roots every arm; everything else changing means a different
+#: experiment)
+VOLATILE_CONFIG_KEYS = ("seed", "root", "run_dir", "resume", "socket",
+                        "out", "port")
+
+#: outcome -> supervisor exit code (resilience/supervisor.py vocabulary);
+#: ``wedged`` carries 137 as the *typical* evidence (SIGKILL), see the
+#: module-docstring ladder
+EXIT_FOR_OUTCOME = {"clean": 0, "recovered": 3, "retries-exhausted": 69,
+                    "host-lost": 71, "preempted": 75, "failed": 1,
+                    "wedged": 137}
+FINISHED_OUTCOMES = frozenset(EXIT_FOR_OUTCOME)
+
+#: the drift tolerance table — leg -> (summary-row path, direction,
+#: tolerance).  Same discipline as ``regress.py``'s LEGS: direction
+#: "down" = lower-is-regression on the fresh/median ratio, "up" = higher
+#: is; "up_abs" legs judge the absolute delta instead (nan-frac and
+#: restart medians are legitimately 0.0, where a ratio is undefined and
+#: any nonzero fresh value would scream).  Tolerances mirror the bench
+#: table's reasoning: rates drift with host load (generous 50%); a run
+#: 3x the campaign's median wall is a hang-class anomaly; >5% NaN above
+#: the campaign norm is the flight recorder's own trip class; +2
+#: restarts above the norm means the fault rate moved.
+ARCHIVE_DRIFT_LEGS = {
+    "gens_per_sec_p50": (("gens_per_sec", "p50"), "down", 0.50),
+    "wall_seconds": (("wall_seconds",), "up", 3.00),
+    "nan_frac_peak": (("nan_frac_peak",), "up_abs", 0.05),
+    "restarts": (("restarts",), "up_abs", 2.0),
+}
+#: a campaign arms drift only past this many FINISHED history runs — a
+#: 1-run "median" would whipsaw every verdict (regress.py's MIN_ROUNDS
+#: reasoning)
+MIN_DRIFT_HISTORY = 2
+
+#: bench-round sidecar (lives NEXT TO the BENCH_*.json trajectory, not
+#: in a results root): bench.py appends every round as a
+#: ``{"kind": "bench_round", "t": ..., "result": {...}}`` line and
+#: regress.py's ``--from-archive`` folds them into its history median.
+#: BOTH sides implement the row inline in pure stdlib — importing this
+#: module would pull the srnn_tpu package (and jax) into processes whose
+#: contract is to stay un-wedgeable — so this constant is the shared
+#: spelling, nothing more.
+BENCH_ARCHIVE_NAME = "BENCH_archive.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# discovery + watermark
+# ---------------------------------------------------------------------------
+
+
+def discover_run_dirs(root: str, skip: Tuple[str, ...] = ()) -> List[str]:
+    """Every run dir under ``root``: a dir holding ``events.jsonl``,
+    ``meta.json`` or ``journal.jsonl`` (the serve-pool front).  Run dirs
+    are not descended into — a pool's ``workers/w<i>/`` lanes fold into
+    their front's row (``fleet.event_paths`` owns that layout), and
+    ckpt/triage subtrees are payload, not runs.  Hidden dirs (the store
+    itself among them) are pruned."""
+    out: List[str] = []
+    skip_abs = {os.path.abspath(p) for p in skip}
+    for dirpath, dirnames, filenames in os.walk(root):
+        if os.path.abspath(dirpath) in skip_abs:
+            dirnames[:] = []
+            continue
+        names = set(filenames)
+        if "events.jsonl" in names or "meta.json" in names \
+                or "journal.jsonl" in names:
+            out.append(dirpath)
+            dirnames[:] = []
+            continue
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+    return sorted(out)
+
+
+def _fold_paths(run_dir: str) -> Dict[str, str]:
+    """relname -> abspath of every file one run's fold reads (and
+    therefore every file in its watermark).  Event lanes come from
+    ``fleet.event_paths`` — the ONE place the fleet file layout is
+    spelled — plus worker journals for serve pools."""
+    from .fleet import event_paths
+
+    out: Dict[str, str] = {}
+    for _proc, path in sorted(event_paths(run_dir).items()):
+        if os.path.exists(path):
+            out[os.path.relpath(path, run_dir)] = path
+    for name in ("config.json", "meta.json", "metrics.prom",
+                 "metrics_history.jsonl", "lineage.jsonl", "journal.jsonl"):
+        path = os.path.join(run_dir, name)
+        if os.path.exists(path):
+            out[name] = path
+    wdir = os.path.join(run_dir, "workers")
+    if os.path.isdir(wdir):
+        for w in sorted(os.listdir(wdir)):
+            jp = os.path.join(wdir, w, "journal.jsonl")
+            if os.path.exists(jp):
+                out[os.path.relpath(jp, run_dir)] = jp
+    return out
+
+
+def watermark(run_dir: str) -> Dict[str, List[int]]:
+    """``{relname: [size, mtime_ns]}`` over the fold set — equality with
+    the stored vector means re-ingest owes this run zero reads."""
+    wm: Dict[str, List[int]] = {}
+    for rel, path in _fold_paths(run_dir).items():
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        wm[rel] = [int(st.st_size), int(st.st_mtime_ns)]
+    return wm
+
+
+# ---------------------------------------------------------------------------
+# per-run fold
+# ---------------------------------------------------------------------------
+
+
+def _load_json(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def config_fingerprint(config: dict) -> str:
+    """Campaign identity: a stable digest of the config minus volatile
+    identity knobs (module constant), so a seed sweep's arms group while
+    any substantive knob change starts a new campaign."""
+    stable = {str(k): config[k] for k in sorted(config)
+              if str(k) not in VOLATILE_CONFIG_KEYS}
+    blob = json.dumps(stable, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def classify_outcome(meta: Optional[dict], restarts: int, preempts: int,
+                     age_s: Optional[float],
+                     stale_s: float = DEFAULT_STALE_S) -> str:
+    """The module-docstring ladder, as code (first match wins)."""
+    if not meta:
+        if age_s is not None and age_s < stale_s:
+            return "running"
+        return "wedged"
+    err = meta.get("error")
+    if err is None:
+        if preempts:
+            return "preempted"
+        if restarts:
+            return "recovered"
+        return "clean"
+    err = str(err)
+    if "Preempted" in err:
+        return "preempted"
+    if "HostLost" in err or "CoordinatorTimeout" in err:
+        return "host-lost"
+    if restarts:
+        return "retries-exhausted"
+    return "failed"
+
+
+def _nan_frac_peak(event_metric_rows: List[dict], history_rows: List[dict],
+                   prom: Dict[str, float]) -> Optional[float]:
+    """Peak NaN fraction across every surface that carries it: metric
+    flush rows (bare names), history rows (``srnn_``-prefixed) and the
+    final textfile.  ``None`` = the run never measured health."""
+    peak = None
+    for row in event_metric_rows + history_rows:
+        metrics = row.get("metrics")
+        if not isinstance(metrics, dict):
+            continue
+        for key, v in metrics.items():
+            if "soup_health_nan_frac" in key \
+                    and isinstance(v, (int, float)):
+                peak = v if peak is None else max(peak, v)
+    for key, v in prom.items():
+        if "soup_health_nan_frac" in key:
+            peak = v if peak is None else max(peak, v)
+    return peak
+
+
+def _census_tail(run_dir: str,
+                 tail_bytes: int = LINEAGE_TAIL_BYTES) -> Optional[dict]:
+    """Last basin census from ``lineage.jsonl``'s bounded tail: the run's
+    ending fixpoint population, the archive's fitness signal for the
+    ROADMAP item 5 controller.  Handles both the homogeneous
+    (``fixpoints``) and per-type (``fixpoints_by_type``) window shapes."""
+    from .fleet import load_rows
+
+    path = os.path.join(run_dir, "lineage.jsonl")
+    if not os.path.exists(path):
+        return None
+    rows, _skipped = load_rows(path, 0, tail_bytes=tail_bytes)
+    for row in reversed(rows):
+        docs = [(None, row["fixpoints"])] if isinstance(
+            row.get("fixpoints"), dict) else \
+            list(row.get("fixpoints_by_type", {}).items()) \
+            if isinstance(row.get("fixpoints_by_type"), dict) else []
+        census = {}
+        for tname, doc in docs:
+            c = doc.get("census") if isinstance(doc, dict) else None
+            if isinstance(c, dict):
+                if tname is None:
+                    census.update(c)
+                else:
+                    census[tname] = c
+        if census:
+            return {"gen": row.get("gen_end"), "census": census}
+    return None
+
+
+def fold_run_dir(run_dir: str, *, tail_bytes: int = TAIL_BYTES,
+                 stale_s: float = DEFAULT_STALE_S,
+                 now: Optional[float] = None) -> Optional[dict]:
+    """One run dir -> one summary row (the ``{"kind":"run"}`` archive row
+    minus store bookkeeping).  ``None`` when the dir holds none of the
+    run-dir marker files.  Strictly read-only; every stream read is
+    tail-bounded and skip-unparseable (torn tails counted in
+    ``skipped_lines``, never fatal)."""
+    from .fleet import event_paths, load_rows
+    from .metrics import quantile_from_times
+    from .timeseries import load_history_rows
+    from .watch import parse_prometheus
+
+    paths = _fold_paths(run_dir)
+    if not paths:
+        return None
+    now = time.time() if now is None else now
+
+    meta = _load_json(paths["meta.json"]) if "meta.json" in paths else None
+    config = _load_json(paths["config.json"]) if "config.json" in paths \
+        else {}
+
+    rows: List[dict] = []
+    skipped = 0
+    for _proc, path in sorted(event_paths(run_dir).items()):
+        if not os.path.exists(path):
+            continue
+        got, bad = load_rows(path, _proc, tail_bytes=tail_bytes)
+        rows.extend(got)
+        skipped += bad
+
+    beats = [r for r in rows if r.get("kind") == "heartbeat"]
+    beats.sort(key=lambda r: float(r.get("t", 0.0)))
+    gps = [float(r["gens_per_sec"]) for r in beats
+           if isinstance(r.get("gens_per_sec"), (int, float))]
+    last_beat = beats[-1] if beats else {}
+
+    restart_rows = [r for r in rows if r.get("kind") == "restart"]
+    restarts = max([int(r.get("restarts", 0)) for r in restart_rows]
+                   + [len(restart_rows)]) if restart_rows else 0
+    preempts = sum(1 for r in rows if r.get("kind") == "preempt")
+    watchdogs = sum(1 for r in rows if r.get("kind") == "watchdog")
+
+    # alert trail: fired counts + which rules ended latched-firing
+    alerts: Dict[str, int] = {}
+    last_state: Dict[str, str] = {}
+    for r in rows:
+        if r.get("kind") != "alert":
+            continue
+        rule = str(r.get("rule", "?"))
+        if r.get("state") == "firing":
+            alerts[rule] = alerts.get(rule, 0) + 1
+        last_state[rule] = str(r.get("state"))
+    alerts_active = sorted(r for r, s in last_state.items() if s == "firing")
+
+    # cost ledger evidence: every {"kind":"cost"} probe row the run
+    # emitted (telemetry.costs); flops are per-entry program costs
+    cost_rows = [r for r in rows if r.get("kind") == "cost"]
+    flops_total = sum(float(r["flops"]) for r in cost_rows
+                      if isinstance(r.get("flops"), (int, float)))
+
+    metric_rows = [r for r in rows if r.get("kind") == "metrics"]
+    history_rows = load_history_rows(
+        paths["metrics_history.jsonl"],
+        tail_bytes=tail_bytes) if "metrics_history.jsonl" in paths else []
+    prom: Dict[str, float] = {}
+    if "metrics.prom" in paths:
+        try:
+            with open(paths["metrics.prom"]) as f:
+                prom = parse_prometheus(f.read())
+        except OSError:
+            prom = {}
+
+    journal_rows = 0
+    for rel, path in paths.items():
+        if os.path.basename(rel) != "journal.jsonl":
+            continue
+        got, bad = load_rows(path, 0, tail_bytes=tail_bytes)
+        journal_rows += len(got)
+        skipped += bad
+
+    # trail age drives the running/wedged split for meta-less dirs: the
+    # newest mtime across the fold set is the last observable liveness
+    ages = []
+    for rel, path in paths.items():
+        try:
+            ages.append(now - os.stat(path).st_mtime)
+        except OSError:
+            pass
+    age_s = min(ages) if ages else None
+
+    outcome = classify_outcome(meta, restarts, preempts, age_s,
+                               stale_s=stale_s)
+    rate = {}
+    if gps:
+        rate = {"p50": round(quantile_from_times(gps, 0.5), 4),
+                "max": round(max(gps), 4), "last": round(gps[-1], 4)}
+
+    row = {
+        "kind": "run",
+        "dir": os.path.abspath(run_dir),
+        "run_kind": "serve" if "journal.jsonl" in paths else "mega",
+        "name": (meta or {}).get("name") or os.path.basename(run_dir),
+        "seed": (meta or {}).get("seed", config.get("seed")),
+        "outcome": outcome,
+        "exit_code": EXIT_FOR_OUTCOME.get(outcome),
+        "wall_seconds": (meta or {}).get("wall_seconds"),
+        "restarts": restarts,
+        "preempts": preempts,
+        "watchdog_trips": watchdogs,
+        "generation": {k: last_beat.get(k) for k in
+                       ("generation", "total_generations")
+                       if last_beat.get(k) is not None} or None,
+        "gens_per_sec": rate or None,
+        "nan_frac_peak": _nan_frac_peak(metric_rows, history_rows, prom),
+        "flops_total": flops_total,
+        "cost_entries": len(cost_rows),
+        "alerts": alerts,
+        "alerts_active": alerts_active,
+        "census_tail": _census_tail(run_dir, tail_bytes=LINEAGE_TAIL_BYTES),
+        "journal_rows": journal_rows,
+        "config_fingerprint": config_fingerprint(config),
+        "config": {k: v for k, v in sorted(config.items())
+                   if isinstance(v, (str, int, float, bool))
+                   or v is None},
+        "event_rows": len(rows),
+        "skipped_lines": skipped,
+        "age_s": round(age_s, 1) if age_s is not None else None,
+    }
+    return row
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def _empty_index() -> dict:
+    return {"version": INDEX_VERSION, "runs": {}, "watermarks": {},
+            "drift_alert": {"state": None}}
+
+
+def load_index(store: str) -> dict:
+    doc = _load_json(os.path.join(store, INDEX_NAME))
+    if doc.get("version") != INDEX_VERSION \
+            or not isinstance(doc.get("runs"), dict):
+        return _empty_index()
+    doc.setdefault("watermarks", {})
+    doc.setdefault("drift_alert", {"state": None})
+    return doc
+
+
+def _append_rows(store: str, rows: List[dict]) -> None:
+    """Append + flush + fsync — the jsonl contract every other journal in
+    the repo keeps (a torn tail costs one row to a skip-unparseable
+    reader, never the store)."""
+    if not rows:
+        return
+    path = os.path.join(store, ARCHIVE_NAME)
+    with open(path, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row, default=str) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _write_index(store: str, index: dict) -> None:
+    from ..utils.atomicio import atomic_write_text
+
+    atomic_write_text(os.path.join(store, INDEX_NAME),
+                      json.dumps(index, indent=1, default=str))
+
+
+def _write_prom(store: str, index: dict, ingested: int,
+                drift: dict) -> None:
+    """The ``soup_archive_*`` exposition (canonical names —
+    telemetry.names): observatory size, this pass's appends, and the
+    drift verdicts as labeled ratio gauges."""
+    from .metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.gauge("soup_archive_runs",
+              "runs in the longitudinal index").set(
+        len(index.get("runs", {})))
+    reg.counter("soup_archive_runs_ingested_total",
+                "run rows appended by this ingest pass").inc(ingested)
+    ratio_g = reg.gauge("soup_archive_drift_ratio",
+                        "newest finished run vs campaign history median, "
+                        "per drift leg (down-bad legs; up_abs legs carry "
+                        "the absolute delta)")
+    for fp, camp in sorted(drift.get("campaigns", {}).items()):
+        for leg, verdict in sorted(camp.get("legs", {}).items()):
+            val = verdict.get("ratio", verdict.get("delta"))
+            if isinstance(val, (int, float)):
+                ratio_g.set(val, leg=leg, campaign=fp)
+    reg.gauge("soup_archive_drift_legs",
+              "drift legs outside tolerance across all campaigns").set(
+        len(drift.get("findings", [])))
+    reg.write_textfile(os.path.join(store, PROM_NAME))
+
+
+# ---------------------------------------------------------------------------
+# drift: campaign medians + the persisted latch
+# ---------------------------------------------------------------------------
+
+
+def _get(doc: dict, path: Tuple[str, ...]):
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(key)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def compute_drift(runs: Dict[str, dict]) -> dict:
+    """Per-campaign drift verdicts: the newest FINISHED run of each
+    config fingerprint vs the median of its predecessors, per
+    ``ARCHIVE_DRIFT_LEGS``.  Returns ``{"campaigns": {fp: {...}},
+    "findings": [...]}`` — findings are the breaches (what the latch and
+    the ``soup_archive_drift_legs`` gauge count)."""
+    by_fp: Dict[str, List[Tuple[str, dict]]] = {}
+    for key in sorted(runs):
+        row = runs[key]
+        if row.get("outcome") not in FINISHED_OUTCOMES:
+            continue  # a live run has no final numbers to judge
+        by_fp.setdefault(str(row.get("config_fingerprint")), []).append(
+            (key, row))
+    campaigns: Dict[str, dict] = {}
+    findings: List[dict] = []
+    for fp, members in sorted(by_fp.items()):
+        members.sort(key=lambda kr: (kr[1].get("ingested_at") or 0,
+                                     kr[0]))
+        newest_key, newest = members[-1]
+        legs: Dict[str, dict] = {}
+        for leg, (path, direction, tol) in ARCHIVE_DRIFT_LEGS.items():
+            series = [(_k, _get(r, path)) for _k, r in members]
+            values = [(k, v) for k, v in series if v is not None]
+            fresh = _get(newest, path)
+            verdict: dict = {"fresh": fresh, "direction": direction,
+                             "tolerance": tol,
+                             "timeline": [v for _k, v in values]}
+            hist = [v for k, v in values if k != newest_key]
+            if fresh is None:
+                verdict["verdict"] = "no fresh value"
+            elif len(hist) < MIN_DRIFT_HISTORY:
+                verdict["verdict"] = \
+                    f"insufficient history (<{MIN_DRIFT_HISTORY} runs)"
+            else:
+                med = _median(hist)
+                verdict["median"] = round(med, 4)
+                if direction == "up_abs":
+                    delta = fresh - med
+                    verdict["delta"] = round(delta, 4)
+                    drifted = delta > tol
+                else:
+                    if med <= 0:
+                        verdict["verdict"] = "zero median"
+                        legs[leg] = verdict
+                        continue
+                    ratio = fresh / med
+                    verdict["ratio"] = round(ratio, 4)
+                    drifted = (ratio < 1.0 - tol) if direction == "down" \
+                        else (ratio > 1.0 + tol)
+                verdict["verdict"] = "DRIFT" if drifted else "ok"
+                if drifted:
+                    findings.append({
+                        "campaign": fp, "leg": leg, "run": newest_key,
+                        "fresh": fresh, "median": verdict["median"],
+                        "direction": direction, "tolerance": tol,
+                        "message": f"{newest_key}: {leg} {fresh:.4g} vs "
+                                   f"campaign {fp} median "
+                                   f"{verdict['median']:.4g} "
+                                   f"(tolerance {direction} {tol:g})"})
+            legs[leg] = verdict
+        campaigns[fp] = {"runs": len(members), "newest": newest_key,
+                         "legs": legs}
+    return {"campaigns": campaigns, "findings": findings}
+
+
+def _latch_drift(index: dict, drift: dict,
+                 now: float) -> List[dict]:
+    """The persisted drift latch: exactly one ``{"kind":"alert"}`` row
+    per firing/cleared EDGE (AlertEngine semantics; state survives in
+    index.json because each ingest is a fresh process)."""
+    state = index.setdefault("drift_alert", {"state": None})
+    firing = bool(drift.get("findings"))
+    transitions: List[dict] = []
+    if firing and state.get("state") != "firing":
+        state.update(state="firing", since=now)
+        transitions.append({
+            "kind": "alert", "rule": "archive_drift", "state": "firing",
+            "t": now,
+            "findings": [f["message"] for f in drift["findings"]]})
+    elif not firing and state.get("state") == "firing":
+        state.update(state="cleared", since=now)
+        transitions.append({"kind": "alert", "rule": "archive_drift",
+                            "state": "cleared", "t": now})
+    state["findings"] = len(drift.get("findings", []))
+    return transitions
+
+
+# ---------------------------------------------------------------------------
+# ingest / gc
+# ---------------------------------------------------------------------------
+
+
+def _run_key(run_dir: str, root: str) -> str:
+    key = os.path.relpath(run_dir, root)
+    return os.path.basename(os.path.abspath(run_dir)) if key == "." else key
+
+
+def ingest(root: str, store: Optional[str] = None, *,
+           stale_s: float = DEFAULT_STALE_S, tail_bytes: int = TAIL_BYTES,
+           now: Optional[float] = None) -> dict:
+    """One incremental ingest pass over ``root``.  Unchanged runs cost
+    stat calls only (watermark); a fully-unchanged pass with no drift
+    transition writes NOTHING (byte-identical store — the watermark
+    no-op the CI smoke asserts)."""
+    root = os.path.abspath(root)
+    store = os.path.abspath(store) if store \
+        else os.path.join(root, STORE_DIRNAME)
+    now = time.time() if now is None else now
+    index = load_index(store)
+    run_dirs = discover_run_dirs(root, skip=(store,))
+    appended: List[dict] = []
+    unchanged = 0
+    for run_dir in run_dirs:
+        key = _run_key(run_dir, root)
+        wm = watermark(run_dir)
+        prev = index["runs"].get(key)
+        # an unchanged 'running' row still re-folds: its outcome decays
+        # to 'wedged' by clock alone (no byte ever changes)
+        if prev is not None and index["watermarks"].get(key) == wm \
+                and prev.get("outcome") != "running":
+            unchanged += 1
+            continue
+        row = fold_run_dir(run_dir, tail_bytes=tail_bytes,
+                           stale_s=stale_s, now=now)
+        if row is None:
+            continue
+        row["run"] = key
+        row["ingested_at"] = now
+        if prev is not None and index["watermarks"].get(key) == wm \
+                and prev.get("outcome") == row["outcome"]:
+            unchanged += 1  # live run, still live, nothing new on disk
+            continue
+        index["runs"][key] = row
+        index["watermarks"][key] = wm
+        appended.append(row)
+    drift = compute_drift(index["runs"])
+    transitions = _latch_drift(index, drift, now)
+    appended.extend(transitions)
+    wrote = False
+    if appended or not os.path.exists(os.path.join(store, INDEX_NAME)):
+        os.makedirs(store, exist_ok=True)
+        _append_rows(store, appended)
+        _write_index(store, index)
+        _write_prom(store, index,
+                    sum(1 for r in appended if r.get("kind") == "run"),
+                    drift)
+        wrote = True
+    return {"root": root, "store": store,
+            "scanned": len(run_dirs),
+            "ingested": [r["run"] for r in appended
+                         if r.get("kind") == "run"],
+            "unchanged": unchanged,
+            "runs": len(index["runs"]),
+            "drift": drift,
+            "alert_transitions": transitions,
+            "wrote": wrote,
+            "no_data": not index["runs"]}
+
+
+def gc(root: str, store: Optional[str] = None, *, keep: Optional[int] = None,
+       max_age_days: Optional[float] = None,
+       now: Optional[float] = None) -> dict:
+    """Bounded retention over the STORE ONLY (run dirs are never
+    touched — deleting experiments is an operator decision, not a cache
+    policy): drop indexed runs beyond ``keep`` newest and/or older than
+    ``max_age_days`` since ingest, then compact ``archive.jsonl`` down
+    to one row per surviving run plus the alert-transition tail."""
+    from ..utils.atomicio import atomic_write_text
+
+    root = os.path.abspath(root)
+    store = os.path.abspath(store) if store \
+        else os.path.join(root, STORE_DIRNAME)
+    now = time.time() if now is None else now
+    index = load_index(store)
+    ordered = sorted(index["runs"],
+                     key=lambda k: (index["runs"][k].get("ingested_at")
+                                    or 0, k))
+    pruned: List[str] = []
+    if max_age_days is not None:
+        horizon = now - max_age_days * 86400.0
+        pruned += [k for k in ordered
+                   if (index["runs"][k].get("ingested_at") or 0) < horizon]
+    if keep is not None and keep >= 0:
+        survivors = [k for k in ordered if k not in set(pruned)]
+        if len(survivors) > keep:
+            pruned += survivors[:len(survivors) - keep]
+    for key in pruned:
+        index["runs"].pop(key, None)
+        index["watermarks"].pop(key, None)
+    # compact: surviving runs' latest rows + the recent alert trail (the
+    # full append history of pruned runs is exactly what gc retires)
+    alert_tail: List[dict] = []
+    path = os.path.join(store, ARCHIVE_NAME)
+    if os.path.exists(path):
+        from .fleet import load_rows
+
+        rows, _bad = load_rows(path, 0, tail_bytes=TAIL_BYTES)
+        alert_tail = [r for r in rows if r.get("kind") == "alert"][-100:]
+        for r in alert_tail:
+            r.pop("process", None)
+    lines = [json.dumps(index["runs"][k], default=str)
+             for k in sorted(index["runs"],
+                             key=lambda k: (index["runs"][k].get(
+                                 "ingested_at") or 0, k))]
+    lines += [json.dumps(r, default=str) for r in alert_tail]
+    os.makedirs(store, exist_ok=True)
+    atomic_write_text(path, "".join(line + "\n" for line in lines))
+    _write_index(store, index)
+    return {"store": store, "pruned": sorted(pruned),
+            "kept": len(index["runs"])}
+
+
+# ---------------------------------------------------------------------------
+# cross-run views: run table, campaign rollups, compare
+# ---------------------------------------------------------------------------
+
+
+def campaign_rollups(runs: Dict[str, dict]) -> List[dict]:
+    """Group the indexed runs by config fingerprint: outcome histogram,
+    rate median, summed flops, the seeds swept — the sortable campaign
+    table under ``report --runs``."""
+    by_fp: Dict[str, List[dict]] = {}
+    for key in sorted(runs):
+        row = runs[key]
+        by_fp.setdefault(str(row.get("config_fingerprint")), []).append(row)
+    out = []
+    for fp, members in sorted(by_fp.items()):
+        outcomes: Dict[str, int] = {}
+        rates, seeds = [], []
+        flops = 0.0
+        for r in members:
+            outcomes[str(r.get("outcome"))] = \
+                outcomes.get(str(r.get("outcome")), 0) + 1
+            v = _get(r, ("gens_per_sec", "p50"))
+            if v is not None:
+                rates.append(v)
+            if r.get("seed") is not None:
+                seeds.append(r["seed"])
+            flops += float(r.get("flops_total") or 0.0)
+        # the knobs shared by EVERY member — what defines the campaign
+        shared = None
+        for r in members:
+            cfg = {k: v for k, v in (r.get("config") or {}).items()
+                   if k not in VOLATILE_CONFIG_KEYS}
+            shared = cfg if shared is None else \
+                {k: v for k, v in shared.items()
+                 if k in cfg and cfg[k] == v}
+        out.append({"fingerprint": fp, "runs": len(members),
+                    "outcomes": outcomes,
+                    "gens_per_sec_p50_median":
+                        round(_median(rates), 4) if rates else None,
+                    "flops_total": flops,
+                    "seeds": sorted(set(seeds), key=str),
+                    "config": shared or {}})
+    return out
+
+
+def runs_doc(root: str, store: Optional[str] = None, *,
+             stale_s: float = DEFAULT_STALE_S,
+             tail_bytes: int = TAIL_BYTES,
+             now: Optional[float] = None) -> dict:
+    """Ingest + build the ``report --runs`` document (the machine
+    contract ROADMAP item 5's controller consumes): the sorted run
+    table, campaign rollups, drift verdicts and the latch state."""
+    res = ingest(root, store, stale_s=stale_s, tail_bytes=tail_bytes,
+                 now=now)
+    index = load_index(res["store"])
+    runs = [index["runs"][k] for k in sorted(index["runs"])]
+    return {"root": res["root"], "store": res["store"],
+            "no_data": not runs,
+            "runs": runs,
+            "campaigns": campaign_rollups(index["runs"]),
+            "drift": res["drift"],
+            "drift_alert": index.get("drift_alert", {}),
+            "ingest": {"scanned": res["scanned"],
+                       "ingested": res["ingested"],
+                       "unchanged": res["unchanged"]}}
+
+
+#: numeric summary fields --compare reports deltas on
+_COMPARE_FIELDS = ("wall_seconds", "restarts", "preempts",
+                   "watchdog_trips", "flops_total", "nan_frac_peak",
+                   "event_rows", "journal_rows")
+
+
+def compare_runs(a_dir: str, b_dir: str, *,
+                 tail_bytes: int = TAIL_BYTES,
+                 stale_s: float = DEFAULT_STALE_S,
+                 now: Optional[float] = None) -> Optional[dict]:
+    """``report --compare``'s document: config diff + metric/census
+    deltas between two run dirs (folded directly — no store needed).
+    ``None`` when either dir is not a run dir (the no-data contract)."""
+    a = fold_run_dir(a_dir, tail_bytes=tail_bytes, stale_s=stale_s,
+                     now=now)
+    b = fold_run_dir(b_dir, tail_bytes=tail_bytes, stale_s=stale_s,
+                     now=now)
+    if a is None or b is None:
+        return None
+    ca, cb = a.get("config") or {}, b.get("config") or {}
+    config_diff = {
+        "only_a": {k: ca[k] for k in sorted(set(ca) - set(cb))},
+        "only_b": {k: cb[k] for k in sorted(set(cb) - set(ca))},
+        "changed": {k: [ca[k], cb[k]]
+                    for k in sorted(set(ca) & set(cb)) if ca[k] != cb[k]},
+        "same_campaign":
+            a["config_fingerprint"] == b["config_fingerprint"]}
+    deltas: Dict[str, dict] = {}
+    for field in _COMPARE_FIELDS + ("gens_per_sec.p50", "gens_per_sec.max"):
+        path = tuple(field.split("."))
+        va, vb = _get(a, path), _get(b, path)
+        if va is None and vb is None:
+            continue
+        d: Dict[str, object] = {"a": va, "b": vb}
+        if va is not None and vb is not None:
+            d["delta"] = round(vb - va, 6)
+            if va:
+                d["ratio"] = round(vb / va, 4)
+        deltas[field] = d
+    census = None
+    ta, tb = a.get("census_tail"), b.get("census_tail")
+    if ta or tb:
+        cta = (ta or {}).get("census") or {}
+        ctb = (tb or {}).get("census") or {}
+        flat_a = {k: v for k, v in cta.items()
+                  if isinstance(v, (int, float))}
+        flat_b = {k: v for k, v in ctb.items()
+                  if isinstance(v, (int, float))}
+        census = {basin: {"a": flat_a.get(basin), "b": flat_b.get(basin),
+                          "delta": (flat_b.get(basin, 0)
+                                    - flat_a.get(basin, 0))}
+                  for basin in sorted(set(flat_a) | set(flat_b))}
+    return {"a": {"dir": a["dir"], "name": a["name"], "seed": a["seed"],
+                  "outcome": a["outcome"],
+                  "fingerprint": a["config_fingerprint"]},
+            "b": {"dir": b["dir"], "name": b["name"], "seed": b["seed"],
+                  "outcome": b["outcome"],
+                  "fingerprint": b["config_fingerprint"]},
+            "config_diff": config_diff,
+            "deltas": deltas,
+            "census": census}
+
+
+# ---------------------------------------------------------------------------
+# renderers (report --runs / --compare and watch --archive share these)
+# ---------------------------------------------------------------------------
+
+
+def render_table(doc: dict, out) -> None:
+    from .timeseries import sparkline
+
+    w = out.write
+    w(f"archive: {doc['root']} — {len(doc['runs'])} run(s), "
+      f"{len(doc['campaigns'])} campaign(s)  "
+      f"[+{len(doc['ingest']['ingested'])} ingested, "
+      f"{doc['ingest']['unchanged']} unchanged]\n")
+    w(f"  {'run':<28} {'outcome':<18} {'rc':>4} {'restarts':>8} "
+      f"{'gens/s p50':>11} {'nan peak':>9} {'campaign':<12}\n")
+    for r in doc["runs"]:
+        rate = _get(r, ("gens_per_sec", "p50"))
+        nan = r.get("nan_frac_peak")
+        w(f"  {str(r.get('run', r['name']))[:28]:<28} "
+          f"{r['outcome']:<18} "
+          f"{r['exit_code'] if r['exit_code'] is not None else '-':>4} "
+          f"{r['restarts']:>8} "
+          f"{rate if rate is not None else '-':>11} "
+          f"{f'{nan:.3f}' if nan is not None else '-':>9} "
+          f"{r['config_fingerprint']:<12}\n")
+        if r.get("alerts_active"):
+            w(f"      !! alerts latched firing: "
+              f"{', '.join(r['alerts_active'])}\n")
+    for c in doc["campaigns"]:
+        outcomes = " ".join(f"{k}={v}"
+                            for k, v in sorted(c["outcomes"].items()))
+        w(f"  campaign {c['fingerprint']}: {c['runs']} run(s)  "
+          f"[{outcomes}]  gens/s p50 median="
+          f"{c['gens_per_sec_p50_median']}  "
+          f"seeds={c['seeds']}\n")
+    drift = doc.get("drift") or {}
+    for fp, camp in sorted((drift.get("campaigns") or {}).items()):
+        for leg, v in sorted(camp["legs"].items()):
+            line = v.get("timeline") or []
+            verdict = v.get("verdict", "?")
+            if verdict in ("ok", "DRIFT"):
+                w(f"  drift {fp}/{leg:<18} {verdict:<6} "
+                  f"fresh={v.get('fresh')} median={v.get('median')} "
+                  f"{sparkline(line, width=24)}\n")
+    for f in (drift.get("findings") or []):
+        w(f"  !! drift: {f['message']}\n")
+    state = (doc.get("drift_alert") or {}).get("state")
+    if state == "firing":
+        w("  !! archive_drift alert LATCHED FIRING\n")
+
+
+def render_compare(doc: dict, out) -> None:
+    w = out.write
+    w(f"compare: {doc['a']['dir']}\n")
+    w(f"     vs: {doc['b']['dir']}\n")
+    w(f"  a: {doc['a']['name']} seed={doc['a']['seed']} "
+      f"outcome={doc['a']['outcome']} "
+      f"campaign={doc['a']['fingerprint']}\n")
+    w(f"  b: {doc['b']['name']} seed={doc['b']['seed']} "
+      f"outcome={doc['b']['outcome']} "
+      f"campaign={doc['b']['fingerprint']}\n")
+    cd = doc["config_diff"]
+    w(f"  config: {'same campaign' if cd['same_campaign'] else 'DIFFERENT campaigns'}\n")
+    for k, (va, vb) in sorted(cd["changed"].items()):
+        w(f"    {k}: {va} -> {vb}\n")
+    for side in ("only_a", "only_b"):
+        for k, v in sorted(cd[side].items()):
+            w(f"    {k}: {side.replace('_', ' ')} = {v}\n")
+    for field, d in sorted(doc["deltas"].items()):
+        extra = f"  ({d['ratio']}x)" if "ratio" in d else ""
+        w(f"  {field:<20} a={d['a']}  b={d['b']}"
+          + (f"  delta={d['delta']}{extra}" if "delta" in d else "")
+          + "\n")
+    if doc.get("census"):
+        w("  census tail deltas:\n")
+        for basin, d in doc["census"].items():
+            w(f"    {basin:<16} a={d['a']}  b={d['b']}  "
+              f"delta={d['delta']:+}\n")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pi = sub.add_parser("ingest", help="incremental ingest of a results "
+                                       "root into its archive store")
+    pi.add_argument("root")
+    pi.add_argument("--store", default=None,
+                    help=f"store dir (default <root>/{STORE_DIRNAME})")
+    pi.add_argument("--stale-s", type=float, default=DEFAULT_STALE_S,
+                    help="running/wedged staleness split for meta-less "
+                         "run dirs")
+    pi.add_argument("--json", action="store_true")
+    pg = sub.add_parser("gc", help="bounded retention over the STORE "
+                                   "(never touches run dirs)")
+    pg.add_argument("root")
+    pg.add_argument("--store", default=None)
+    pg.add_argument("--keep", type=int, default=None,
+                    help="keep only the newest N indexed runs")
+    pg.add_argument("--max-age-days", type=float, default=None,
+                    help="drop runs ingested longer ago than this")
+    pg.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"archive: {args.root}: not a directory", file=sys.stderr)
+        return 2
+    if args.cmd == "ingest":
+        res = ingest(args.root, args.store, stale_s=args.stale_s)
+        if args.json:
+            print(json.dumps(res, indent=1, default=str))
+        else:
+            print(f"archive: {res['store']}: {res['runs']} run(s) indexed "
+                  f"(+{len(res['ingested'])} ingested, "
+                  f"{res['unchanged']} unchanged)")
+            for t in res["alert_transitions"]:
+                print(f"  alert {t['rule']} -> {t['state']}")
+        if res["no_data"]:
+            print(f"archive: {args.root}: no run dirs found — nothing "
+                  "ingested", file=sys.stderr)
+            return 2
+        return 0
+    if args.cmd == "gc":
+        if args.keep is None and args.max_age_days is None:
+            print("archive gc: give --keep and/or --max-age-days",
+                  file=sys.stderr)
+            return 2
+        res = gc(args.root, args.store, keep=args.keep,
+                 max_age_days=args.max_age_days)
+        if args.json:
+            print(json.dumps(res, indent=1, default=str))
+        else:
+            print(f"archive gc: kept {res['kept']}, pruned "
+                  f"{len(res['pruned'])}")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,13 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_scrapes_total": "counter",
     "soup_alerts_total": "counter",
     "soup_alerts_active": "gauge",
+    # -- run archive & cross-run observatory (telemetry.archive: the
+    #    longitudinal store's textfile exposition, written to
+    #    <store>/archive.prom at each ingest pass) -----------------------
+    "soup_archive_runs": "gauge",
+    "soup_archive_runs_ingested_total": "counter",
+    "soup_archive_drift_ratio": "gauge",
+    "soup_archive_drift_legs": "gauge",
 }
 
 #: pre-convention names kept for dashboard compatibility (do not extend):

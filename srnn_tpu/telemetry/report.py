@@ -6,6 +6,8 @@
     python -m srnn_tpu.telemetry.report --trace-request <ticket> <run_dir>
     python -m srnn_tpu.telemetry.report --triage <bundle_dir> [--json]
     python -m srnn_tpu.telemetry.report --dynamics <run_dir> [--json]
+    python -m srnn_tpu.telemetry.report <results_root> --runs [--json]
+    python -m srnn_tpu.telemetry.report --compare <run_a> <run_b> [--json]
 
 Reads ``meta.json`` + ``events.jsonl`` (the ``Experiment`` channel the
 mega-run loops, heartbeats and metric flushes all write through) and
@@ -42,6 +44,16 @@ shapes/dtypes, and a pointer to the captured profiler trace.
 (``telemetry.genealogy`` over ``lineage.jsonl``): the dominant-lineage
 table, clone-survival stats, attack/imitation graph stats, the basin
 transition matrix and the fixpoint census trajectory.
+
+``--runs`` flips the positional to a RESULTS ROOT and renders the
+cross-run observatory (``telemetry.archive``): an incremental ingest of
+every run dir under the root, then the sortable run table (outcome,
+restarts, gens/sec, NaN peak), campaign rollups grouped by config
+fingerprint, and the drift timelines vs each campaign's history median.
+
+``--compare RUN_A RUN_B`` (RUN_B is the positional) renders the config
+diff and metric/census deltas between two run dirs — folded directly,
+no archive store needed.
 """
 
 import argparse
@@ -597,12 +609,65 @@ def main(argv=None) -> int:
     p.add_argument("--dynamics", action="store_true",
                    help="render the run's replication-dynamics trail "
                         "(lineage.jsonl via telemetry.genealogy)")
+    p.add_argument("--runs", action="store_true",
+                   help="treat the positional as a RESULTS ROOT and "
+                        "render the cross-run observatory: run table + "
+                        "campaign rollups + drift timelines "
+                        "(telemetry.archive; ingests incrementally into "
+                        "<root>/.archive)")
+    p.add_argument("--compare", metavar="RUN_A", default=None,
+                   help="compare RUN_A against the positional run dir: "
+                        "config diff + metric/census deltas "
+                        "(telemetry.archive; no store involved)")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable summary instead of text")
     args = p.parse_args(argv)
     if not os.path.isdir(args.run_dir):
         print(f"report: {args.run_dir}: not a directory", file=sys.stderr)
         return 2
+    if args.runs:
+        from .archive import render_table, runs_doc
+
+        doc = runs_doc(args.run_dir)
+        if doc["no_data"]:
+            # the no-data contract (exit 2, explicit flag, no dead
+            # artifact) — an empty results root must never produce an
+            # empty-but-valid table the controller would trust
+            if args.json:
+                print(json.dumps(doc, indent=1, default=str))
+            else:
+                print(f"report: {args.run_dir}: no data yet — no run "
+                      "dirs under this root to index", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc, indent=1, default=str))
+        else:
+            render_table(doc, sys.stdout)
+        return 0
+    if args.compare:
+        from .archive import compare_runs, render_compare
+
+        if not os.path.isdir(args.compare):
+            print(f"report: {args.compare}: not a directory",
+                  file=sys.stderr)
+            return 2
+        doc = compare_runs(args.compare, args.run_dir)
+        if doc is None:
+            # same no-data contract: one side holds no run-dir marker
+            # files, so there is nothing truthful to diff
+            if args.json:
+                print(json.dumps({"no_data": True, "a": args.compare,
+                                  "b": args.run_dir}, indent=1))
+            else:
+                print(f"report: --compare: {args.compare} or "
+                      f"{args.run_dir} is not a run dir (no events.jsonl/"
+                      "meta.json/journal.jsonl)", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc, indent=1, default=str))
+        else:
+            render_compare(doc, sys.stdout)
+        return 0
     if args.trace:
         from ..utils.atomicio import atomic_write_text
         from .fleet import perfetto_trace

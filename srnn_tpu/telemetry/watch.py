@@ -3,6 +3,7 @@
     python -m srnn_tpu.telemetry.watch <run_dir> [--interval S] [--once]
     python -m srnn_tpu.telemetry.watch --service SOCKET [--once]
     python -m srnn_tpu.telemetry.watch --url http://host:port [--once]
+    python -m srnn_tpu.telemetry.watch <results_root> --archive [--once]
 
 The operator view `tail`-ing heartbeat files by hand used to
 approximate: one refresh-loop screen of stage, generation, gens/sec,
@@ -25,8 +26,16 @@ the authority for liveness and active alerts (it reads the process's
 registry directly; files lag by up to one chunk) and renders first; the
 run-dir lanes view still follows for per-process detail.
 
+``--archive`` flips the positional to a RESULTS ROOT and renders the
+cross-run observatory live (``telemetry.archive``): each refresh runs
+one incremental ingest (watermarked — an unchanged root costs stat
+calls only) and redraws the run table, campaign rollups and drift
+verdicts.  This is the fleet-level panel: which arms finished, which
+wedged, which campaign is drifting — without attaching to any one run.
+
 Pure reader: file tails, one ``stats`` socket op, or one HTTP GET pair —
-attaching a watch to a live run can never perturb it.  Stdout is this
+attaching a watch to a live run can never perturb it (``--archive``
+writes only to the store dir OUTSIDE every run dir).  Stdout is this
 module's product (it is on the srnnlint prints allowlist).
 
 A JUST-CREATED run dir (no ``events.jsonl`` yet, zero-length or
@@ -383,6 +392,10 @@ def main(argv=None) -> int:
                         "liveness and active alerts (the registry is "
                         "the authority; files lag by up to one chunk) "
                         "and the run-dir lanes render after it")
+    p.add_argument("--archive", action="store_true",
+                   help="treat run_dir as a RESULTS ROOT and render the "
+                        "cross-run observatory (telemetry.archive): one "
+                        "incremental ingest + run table per refresh")
     p.add_argument("--interval", type=float, default=5.0, metavar="S",
                    help="refresh period of the watch loop")
     p.add_argument("--once", action="store_true",
@@ -397,9 +410,18 @@ def main(argv=None) -> int:
         print(f"watch: {args.run_dir}: not a directory", file=sys.stderr)
         return 2
 
+    if args.archive and not args.run_dir:
+        p.error("--archive needs a results-root positional")
+
     def take():
         snap = {}
-        if args.run_dir:
+        if args.archive:
+            # the root is a directory OF run dirs, not a run dir — the
+            # archive doc replaces the lanes view entirely
+            from .archive import runs_doc
+
+            snap["archive"] = runs_doc(args.run_dir)
+        elif args.run_dir:
             snap = snapshot(args.run_dir)
         if args.url:
             try:
@@ -427,7 +449,13 @@ def main(argv=None) -> int:
                     print(f"live: {live['error']}")
                 else:
                     render_url(live, sys.stdout)
-            if args.run_dir:
+            if args.archive:
+                from .archive import render_table
+
+                sys.stdout.write(time.strftime("-- watch %H:%M:%S "
+                                               "archive --\n"))
+                render_table(snap["archive"], sys.stdout)
+            elif args.run_dir:
                 render(snap, sys.stdout)
             svc = snap.get("service")
             if svc:

@@ -851,6 +851,58 @@ def test_metric_liveness_fires_on_declared_but_never_emitted(tmp_path):
     assert "serve_tenant_flops_total" in dead
 
 
+def test_metric_liveness_covers_archive_family(tmp_path):
+    """The ``soup_archive_*`` family (the cross-run observatory's
+    exposition) rides the same governance: every archive name is M005-
+    dead in a fixture repo that never spells it, goes live once ONE
+    module registers it, and a mis-kinded registration (the counter
+    declared as a gauge) fires M002."""
+    archive_names = ("soup_archive_runs", "soup_archive_runs_ingested_total",
+                     "soup_archive_drift_ratio", "soup_archive_drift_legs")
+    ctx = make_repo(tmp_path / "dead", {"srnn_tpu/mod.py": """
+        def f(registry):
+            registry.counter("soup_generations_total").inc(1)
+        """})
+    dead = {f.message.split("'")[1] for f in run_pass(ctx, "metric-names")
+            if f.code == "M005"}
+    assert set(archive_names) <= dead  # the gate SEES the new family
+
+    ctx = make_repo(tmp_path / "live", {"srnn_tpu/mod.py": """
+        def f(registry):
+            registry.gauge("soup_archive_runs").set(3)
+            registry.counter("soup_archive_runs_ingested_total").inc(1)
+            registry.gauge("soup_archive_drift_ratio").set(0.9)
+            registry.gauge("soup_archive_drift_legs").set(0)
+        """})
+    findings = run_pass(ctx, "metric-names")
+    dead = {f.message.split("'")[1] for f in findings if f.code == "M005"}
+    assert not dead & set(archive_names)
+    assert not [f for f in findings if f.code == "M002"]
+
+    ctx = make_repo(tmp_path / "miskind", {"srnn_tpu/mod.py": """
+        def f(registry):
+            registry.gauge("soup_archive_runs_ingested_total").set(1)
+        """})
+    bad = [f for f in run_pass(ctx, "metric-names") if f.code == "M002"]
+    assert len(bad) == 1
+    assert "soup_archive_runs_ingested_total" in bad[0].message
+
+
+def test_metric_references_cover_archive_names(tmp_path):
+    """M006 over the new family: a rule watching a typo'd archive name
+    fires; one watching the canonical spelling does not."""
+    ctx = make_repo(tmp_path, {"srnn_tpu/rules.py": """
+        def my_rules(Rule):
+            return [Rule(name="ok", metric="soup_archive_drift_legs",
+                         kind="threshold", value=1.0),
+                    Rule(name="bad", metric="soup_archive_drift_leg",
+                         kind="threshold", value=1.0)]
+        """})
+    refs = [f.message.split("'")[1]
+            for f in run_pass(ctx, "metric-names") if f.code == "M006"]
+    assert refs == ["soup_archive_drift_leg"]
+
+
 def test_metric_liveness_clean_on_real_repo(repo_ctx):
     """The real package has an emission site for every declared name
     (this is the gate that keeps names.py from accumulating dead

@@ -1,0 +1,605 @@
+"""The run archive & cross-run observatory (telemetry.archive): the
+longitudinal index over a results root.
+
+The load-bearing contracts drilled here:
+
+  * ingest is READ-ONLY over run dirs — archiving a LIVE run leaves it
+    byte-for-byte identical (the observatory can never perturb science);
+  * re-ingest is a watermark no-op — an unchanged root writes NOTHING to
+    the store (byte-identical store files), with the one documented
+    exception: a previously-``running`` run re-folds because its outcome
+    can decay to ``wedged`` by clock alone;
+  * the outcome ladder maps exit evidence (meta.json error reprs,
+    restart/preempt rows, trail staleness) onto the supervisor's exit
+    vocabulary (resilience/supervisor.py): 0/3/69/71/75/137;
+  * the no-data contract (exit 2 + explicit flag) holds for
+    ``report --runs`` and ``report --compare`` — an empty root never
+    renders an empty-but-valid table a controller would trust.
+
+Everything runs on hand-built run-dir fixtures with pinned mtimes and a
+pinned clock — no jax, no real runs.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from srnn_tpu.telemetry import archive, report, watch
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: pinned "wall clock" every ingest in this file runs against (ingest
+#: takes ``now=`` exactly so outcomes are deterministic under test)
+NOW = 1_700_000_000.0
+
+
+# ---------------------------------------------------------------------------
+# fixtures: hand-built run dirs
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, rows, torn_tail=None):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        if torn_tail is not None:
+            f.write(torn_tail)  # no newline: a clipped in-flight write
+
+
+def make_run(root, name, *, seed=0, config=None, error="__absent__",
+             gps=(100.0, 100.0, 100.0), restarts=0, preempts=0,
+             nan_peak=None, census=None, wall=5.0, age=30.0, flops=0.0,
+             alerts=(), torn_tail=None):
+    """One fake run dir.  ``error="__absent__"`` = no meta.json at all
+    (a SIGKILLed or still-running experiment); ``error=None`` = the
+    clean-unwind meta ``Experiment.__exit__`` writes; a string = the
+    fault's repr.  ``age`` pins every file's mtime to ``NOW - age``."""
+    run_dir = os.path.join(root, name)
+    os.makedirs(run_dir)
+    cfg = {"n": 2048, "generations": 100, "seed": seed}
+    cfg.update(config or {})
+    with open(os.path.join(run_dir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    t0 = NOW - age - 120.0
+    rows = []
+    for i, g in enumerate(gps):
+        rows.append({"kind": "heartbeat", "t": t0 + i,
+                     "generation": (i + 1) * 10, "total_generations": 100,
+                     "gens_per_sec": g})
+    for i in range(restarts):
+        rows.append({"kind": "restart", "t": t0 + 50 + i,
+                     "restarts": i + 1, "fault": "STALL",
+                     "reramped": True})
+    for i in range(preempts):
+        rows.append({"kind": "preempt", "t": t0 + 60 + i,
+                     "generation": 40})
+    for rule, state in alerts:
+        rows.append({"kind": "alert", "rule": rule, "state": state,
+                     "t": t0 + 70})
+    if flops:
+        rows.append({"kind": "cost", "t": t0 + 5, "entry": "chunk",
+                     "flops": flops})
+    if nan_peak is not None:
+        rows.append({"kind": "metrics", "t": t0 + 80,
+                     "metrics": {"soup_health_nan_frac": nan_peak}})
+    _write_jsonl(os.path.join(run_dir, "events.jsonl"), rows,
+                 torn_tail=torn_tail)
+    if census is not None:
+        _write_jsonl(os.path.join(run_dir, "lineage.jsonl"),
+                     [{"gen_end": 100,
+                       "fixpoints": {"census": census, "transitions": {}}}])
+    if error != "__absent__":
+        with open(os.path.join(run_dir, "meta.json"), "w") as f:
+            json.dump({"name": name, "id": "t", "iteration": 0,
+                       "seed": seed, "wall_seconds": wall,
+                       "error": error}, f)
+    ts = NOW - age
+    for fn in os.listdir(run_dir):
+        p = os.path.join(run_dir, fn)
+        if os.path.isfile(p):
+            os.utime(p, (ts, ts))
+    return run_dir
+
+
+def _store_bytes(store):
+    """{filename: bytes} of every store file — the byte-identity probe."""
+    out = {}
+    for fn in sorted(os.listdir(store)):
+        with open(os.path.join(store, fn), "rb") as f:
+            out[fn] = f.read()
+    return out
+
+
+def _tree_state(top):
+    """{relpath: (bytes, size, mtime_ns)} over a whole dir tree."""
+    out = {}
+    for dirpath, _dirs, files in os.walk(top):
+        for fn in files:
+            p = os.path.join(dirpath, fn)
+            st = os.stat(p)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, top)] = (f.read(), st.st_size,
+                                                st.st_mtime_ns)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# outcome classification
+# ---------------------------------------------------------------------------
+
+
+def test_classify_outcome_ladder_unit():
+    """The docstring ladder, row by row (first match wins)."""
+    c = archive.classify_outcome
+    assert c(None, 0, 0, age_s=10.0) == "running"
+    assert c(None, 0, 0, age_s=4000.0) == "wedged"
+    assert c(None, 0, 0, age_s=None) == "wedged"
+    assert c({"error": None}, 0, 1, age_s=1.0) == "preempted"
+    assert c({"error": None}, 2, 0, age_s=1.0) == "recovered"
+    assert c({"error": None}, 0, 0, age_s=1.0) == "clean"
+    assert c({"error": "Preempted('slice going away')"}, 3, 0,
+             age_s=1.0) == "preempted"
+    assert c({"error": "HostLost('worker 2')"}, 0, 0,
+             age_s=1.0) == "host-lost"
+    assert c({"error": "CoordinatorTimeout('barrier')"}, 1, 0,
+             age_s=1.0) == "host-lost"
+    assert c({"error": "StallError('no heartbeat')"}, 3, 0,
+             age_s=1.0) == "retries-exhausted"
+    assert c({"error": "ValueError('boom')"}, 0, 0, age_s=1.0) == "failed"
+
+
+def test_outcomes_and_exit_codes_over_run_dirs(tmp_path):
+    """End-to-end over hand-built dirs: every exit kind the supervisor
+    can produce lands on its documented outcome + exit code."""
+    root = str(tmp_path)
+    make_run(root, "r-clean", error=None)
+    make_run(root, "r-recovered", error=None, restarts=2)
+    make_run(root, "r-preempt-clean", error=None, preempts=1)
+    make_run(root, "r-preempt-fault",
+             error="Preempted('maintenance event')")
+    make_run(root, "r-hostlost", error="HostLost('worker 1 gone')")
+    make_run(root, "r-retries", error="StallError('wedged chunk')",
+             restarts=3)
+    make_run(root, "r-failed", error="ValueError('boom')")
+    make_run(root, "r-wedged", error="__absent__", age=4000.0)
+    make_run(root, "r-running", error="__absent__", age=30.0)
+
+    res = archive.ingest(root, now=NOW)
+    assert res["scanned"] == 9 and len(res["ingested"]) == 9
+    index = archive.load_index(res["store"])
+    got = {k: (r["outcome"], r["exit_code"])
+           for k, r in index["runs"].items()}
+    assert got == {
+        "r-clean": ("clean", 0),
+        "r-recovered": ("recovered", 3),
+        "r-preempt-clean": ("preempted", 75),
+        "r-preempt-fault": ("preempted", 75),
+        "r-hostlost": ("host-lost", 71),
+        "r-retries": ("retries-exhausted", 69),
+        "r-failed": ("failed", 1),
+        "r-wedged": ("wedged", 137),
+        "r-running": ("running", None),
+    }
+    # restart evidence folds as the max restart counter, not row count
+    assert index["runs"]["r-retries"]["restarts"] == 3
+
+
+def test_running_decays_to_wedged_by_clock_alone(tmp_path):
+    """The one watermark exception: a ``running`` run re-folds on an
+    unchanged watermark, because staleness is a clock fact, not a byte
+    fact."""
+    root = str(tmp_path)
+    make_run(root, "r-live", error="__absent__", age=30.0)
+    res1 = archive.ingest(root, now=NOW)
+    index = archive.load_index(res1["store"])
+    assert index["runs"]["r-live"]["outcome"] == "running"
+    # nothing on disk changes; only the clock moves past stale_s
+    res2 = archive.ingest(root, now=NOW + 1000.0)
+    index = archive.load_index(res2["store"])
+    assert index["runs"]["r-live"]["outcome"] == "wedged"
+    assert res2["ingested"] == ["r-live"]
+
+
+# ---------------------------------------------------------------------------
+# watermark / torn tail / live-run purity
+# ---------------------------------------------------------------------------
+
+
+def test_reingest_is_watermark_noop(tmp_path):
+    """Second pass over an unchanged root: zero rows appended, zero
+    bytes changed anywhere in the store."""
+    root = str(tmp_path)
+    make_run(root, "r-a", error=None, seed=0)
+    make_run(root, "r-b", error=None, seed=1)
+    res1 = archive.ingest(root, now=NOW)
+    assert len(res1["ingested"]) == 2 and res1["wrote"]
+    before = _store_bytes(res1["store"])
+    res2 = archive.ingest(root, now=NOW + 60.0)
+    assert res2["ingested"] == [] and res2["unchanged"] == 2
+    assert not res2["wrote"]
+    assert _store_bytes(res2["store"]) == before
+
+
+def test_new_bytes_reingest_only_the_changed_run(tmp_path):
+    """Incremental: appending to ONE run's events re-folds that run
+    only; the sibling stays a stat-call no-op."""
+    root = str(tmp_path)
+    make_run(root, "r-a", error=None, seed=0)
+    b = make_run(root, "r-b", error=None, seed=1)
+    archive.ingest(root, now=NOW)
+    with open(os.path.join(b, "events.jsonl"), "a") as f:
+        f.write(json.dumps({"kind": "heartbeat", "t": NOW,
+                            "generation": 99, "gens_per_sec": 50.0})
+                + "\n")
+    res = archive.ingest(root, now=NOW + 10.0)
+    assert res["ingested"] == ["r-b"] and res["unchanged"] == 1
+
+
+def test_torn_tail_counts_skipped_never_fatal(tmp_path):
+    """A clipped in-flight line (killed writer) costs skip counts, not
+    the fold: the repo-wide skip-unparseable jsonl contract."""
+    root = str(tmp_path)
+    make_run(root, "r-torn", error=None,
+             torn_tail='{"kind": "heartbeat", "t": 12')
+    res = archive.ingest(root, now=NOW)
+    row = archive.load_index(res["store"])["runs"]["r-torn"]
+    assert row["outcome"] == "clean"
+    assert row["skipped_lines"] >= 1
+    assert row["gens_per_sec"]["p50"] == 100.0  # intact rows still fold
+
+
+def test_live_run_ingest_is_byte_identical(tmp_path):
+    """THE purity contract: ingesting a live (meta-less, fresh) run
+    leaves every byte, size and mtime under the run dir untouched, and
+    the store lands outside it."""
+    root = str(tmp_path)
+    run_dir = make_run(root, "r-live", error="__absent__", age=5.0,
+                       nan_peak=0.01, census={"fix_a": 7})
+    before = _tree_state(run_dir)
+    res = archive.ingest(root, now=NOW)
+    assert _tree_state(run_dir) == before
+    assert not os.path.abspath(res["store"]).startswith(
+        os.path.abspath(run_dir) + os.sep)
+    row = archive.load_index(res["store"])["runs"]["r-live"]
+    assert row["outcome"] == "running"
+
+
+# ---------------------------------------------------------------------------
+# campaigns / rollups / compare
+# ---------------------------------------------------------------------------
+
+
+def test_campaign_fingerprint_groups_seeds_not_knobs(tmp_path):
+    """A seed sweep is ONE campaign (volatile keys excluded from the
+    fingerprint); a substantive knob change starts another."""
+    root = str(tmp_path)
+    make_run(root, "sweep-s0", error=None, seed=0)
+    make_run(root, "sweep-s1", error=None, seed=1)
+    make_run(root, "big-n", error=None, seed=0, config={"n": 4096})
+    doc = archive.runs_doc(root, now=NOW)
+    camps = {c["fingerprint"]: c for c in doc["campaigns"]}
+    assert len(camps) == 2
+    sweep = next(c for c in camps.values() if c["runs"] == 2)
+    assert sweep["seeds"] == [0, 1]
+    assert sweep["outcomes"] == {"clean": 2}
+    assert sweep["gens_per_sec_p50_median"] == 100.0
+    by_run = {r["run"]: r for r in doc["runs"]}
+    assert by_run["sweep-s0"]["config_fingerprint"] == \
+        by_run["sweep-s1"]["config_fingerprint"]
+    assert by_run["big-n"]["config_fingerprint"] != \
+        by_run["sweep-s0"]["config_fingerprint"]
+
+
+def test_compare_runs_deltas_against_fixtures(tmp_path):
+    root = str(tmp_path)
+    a = make_run(root, "r-a", error=None, seed=0, wall=5.0,
+                 gps=(100.0, 100.0), census={"fix_a": 10, "fix_b": 2})
+    b = make_run(root, "r-b", error=None, seed=1, wall=10.0,
+                 gps=(50.0, 50.0), config={"n": 4096},
+                 census={"fix_a": 4})
+    doc = archive.compare_runs(a, b, now=NOW)
+    assert doc["config_diff"]["changed"]["n"] == [2048, 4096]
+    assert doc["config_diff"]["same_campaign"] is False
+    w = doc["deltas"]["wall_seconds"]
+    assert (w["a"], w["b"], w["delta"], w["ratio"]) == (5.0, 10.0, 5.0,
+                                                        2.0)
+    p50 = doc["deltas"]["gens_per_sec.p50"]
+    assert (p50["a"], p50["b"]) == (100.0, 50.0)
+    assert doc["census"]["fix_a"] == {"a": 10, "b": 4, "delta": -6}
+    assert doc["census"]["fix_b"]["delta"] == -2
+    # either side not a run dir -> None (the no-data contract's source)
+    empty = os.path.join(root, "not-a-run")
+    os.makedirs(empty)
+    assert archive.compare_runs(a, empty, now=NOW) is None
+
+
+# ---------------------------------------------------------------------------
+# drift: campaign medians + the persisted latch
+# ---------------------------------------------------------------------------
+
+
+def test_drift_alert_fires_once_then_clears_once(tmp_path):
+    """A degraded newest arm breaches the rate leg, latches the
+    ``archive_drift`` alert (ONE firing row), stays latched across a
+    no-op re-ingest, and clears (ONE cleared row) when the run is
+    repaired."""
+    root = str(tmp_path)
+    make_run(root, "c-r1", error=None, seed=0)
+    make_run(root, "c-r2", error=None, seed=1)
+    r3 = make_run(root, "c-r3", error=None, seed=2,
+                  gps=(10.0, 10.0, 10.0))  # 10 vs median 100: breach
+    res = archive.ingest(root, now=NOW)
+    legs = {f["leg"] for f in res["drift"]["findings"]}
+    assert "gens_per_sec_p50" in legs
+    assert [t["state"] for t in res["alert_transitions"]] == ["firing"]
+    index = archive.load_index(res["store"])
+    assert index["drift_alert"]["state"] == "firing"
+
+    # latched: a second pass emits no duplicate firing edge
+    res2 = archive.ingest(root, now=NOW + 10.0)
+    assert res2["alert_transitions"] == []
+
+    # repair the degraded arm -> its watermark moves -> re-fold -> clear
+    rows = [{"kind": "heartbeat", "t": NOW + i, "generation": (i + 1) * 10,
+             "total_generations": 100, "gens_per_sec": 100.0}
+            for i in range(3)]
+    _write_jsonl(os.path.join(r3, "events.jsonl"), rows)
+    os.utime(os.path.join(r3, "events.jsonl"), (NOW + 20, NOW + 20))
+    res3 = archive.ingest(root, now=NOW + 30.0)
+    assert [t["state"] for t in res3["alert_transitions"]] == ["cleared"]
+    assert res3["drift"]["findings"] == []
+
+    # exactly one edge row each in the append-only trail
+    with open(os.path.join(res["store"], archive.ARCHIVE_NAME)) as f:
+        alert_rows = [json.loads(l) for l in f
+                      if '"kind": "alert"' in l]
+    assert [r["state"] for r in alert_rows] == ["firing", "cleared"]
+    assert all(r["rule"] == "archive_drift" for r in alert_rows)
+
+
+def test_drift_needs_minimum_history(tmp_path):
+    """One predecessor is not a median (MIN_DRIFT_HISTORY guard — the
+    regress.py MIN_ROUNDS reasoning): no finding, no latch."""
+    root = str(tmp_path)
+    make_run(root, "c-r1", error=None, seed=0)
+    make_run(root, "c-r2", error=None, seed=1, gps=(10.0, 10.0))
+    res = archive.ingest(root, now=NOW)
+    assert res["drift"]["findings"] == []
+    assert res["alert_transitions"] == []
+    camp = next(iter(res["drift"]["campaigns"].values()))
+    assert "insufficient history" in \
+        camp["legs"]["gens_per_sec_p50"]["verdict"]
+
+
+# ---------------------------------------------------------------------------
+# gc
+# ---------------------------------------------------------------------------
+
+
+def test_gc_keep_bound_compacts_store_never_run_dirs(tmp_path):
+    root = str(tmp_path)
+    dirs = [make_run(root, f"r-{i}", error=None, seed=i)
+            for i in range(4)]
+    res = archive.ingest(root, now=NOW)
+    before = {d: _tree_state(d) for d in dirs}
+    out = archive.gc(root, keep=2, now=NOW + 100.0)
+    assert out["kept"] == 2 and out["pruned"] == ["r-0", "r-1"]
+    index = archive.load_index(res["store"])
+    assert sorted(index["runs"]) == ["r-2", "r-3"]
+    with open(os.path.join(res["store"], archive.ARCHIVE_NAME)) as f:
+        rows = [json.loads(l) for l in f]
+    assert sorted(r["run"] for r in rows if r["kind"] == "run") == \
+        ["r-2", "r-3"]
+    # retention is a STORE policy: the experiments themselves survive
+    assert {d: _tree_state(d) for d in dirs} == before
+
+
+def test_gc_max_age_days(tmp_path):
+    root = str(tmp_path)
+    make_run(root, "r-old", error=None)
+    res = archive.ingest(root, now=NOW)
+    out = archive.gc(root, max_age_days=0.5, now=NOW + 86400.0)
+    assert out["pruned"] == ["r-old"] and out["kept"] == 0
+    assert archive.load_index(res["store"])["runs"] == {}
+
+
+# ---------------------------------------------------------------------------
+# CLI contracts: report --runs / --compare, watch --archive, archive main
+# ---------------------------------------------------------------------------
+
+
+def test_report_runs_json_contract(tmp_path, capsys):
+    root = str(tmp_path)
+    make_run(root, "r-clean", error=None)
+    make_run(root, "r-failed", error="ValueError('boom')", seed=1)
+    rc = report.main([root, "--runs", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["no_data"] is False
+    assert {r["run"]: r["outcome"] for r in doc["runs"]} == \
+        {"r-clean": "clean", "r-failed": "failed"}
+    assert doc["campaigns"] and doc["ingest"]["scanned"] == 2
+    # text mode renders the table (outcomes + campaign line)
+    rc = report.main([root, "--runs"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "r-failed" in out and "campaign" in out
+
+
+def test_report_runs_no_data_contract(tmp_path, capsys):
+    """Empty root: exit 2 + explicit ``no_data`` — never an
+    empty-but-valid table."""
+    root = str(tmp_path)
+    rc = report.main([root, "--runs", "--json"])
+    cap = capsys.readouterr()
+    assert rc == 2
+    assert json.loads(cap.out)["no_data"] is True
+    rc = report.main([root, "--runs"])
+    cap = capsys.readouterr()
+    assert rc == 2 and "no data yet" in cap.err
+
+
+def test_report_compare_cli(tmp_path, capsys):
+    root = str(tmp_path)
+    a = make_run(root, "r-a", error=None, wall=5.0)
+    b = make_run(root, "r-b", error=None, wall=10.0, seed=1)
+    rc = report.main([b, "--compare", a, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["a"]["name"] == "r-a" and doc["b"]["name"] == "r-b"
+    assert doc["config_diff"]["same_campaign"] is True
+    rc = report.main([b, "--compare", a])
+    assert rc == 0 and "wall_seconds" in capsys.readouterr().out
+    # one side not a run dir -> the no-data contract
+    empty = os.path.join(root, "empty")
+    os.makedirs(empty)
+    rc = report.main([empty, "--compare", a, "--json"])
+    cap = capsys.readouterr()
+    assert rc == 2 and json.loads(cap.out)["no_data"] is True
+
+
+def test_watch_archive_once(tmp_path, capsys):
+    root = str(tmp_path)
+    make_run(root, "r-clean", error=None)
+    rc = watch.main([root, "--archive", "--once"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert [r["run"] for r in doc["archive"]["runs"]] == ["r-clean"]
+
+
+def test_archive_cli_ingest_and_gc(tmp_path, capsys):
+    root = str(tmp_path)
+    make_run(root, "r-clean", error=None)
+    assert archive.main(["ingest", root, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ingested"] == ["r-clean"]
+    # second pass: still exit 0, explicit zero ingested
+    assert archive.main(["ingest", root, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["ingested"] == []
+    assert archive.main(["gc", root, "--keep", "0", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["pruned"] == ["r-clean"]
+    # gc without a bound is a usage error
+    assert archive.main(["gc", root]) == 2
+    capsys.readouterr()
+
+
+def test_archive_cli_empty_root_exit_2(tmp_path, capsys):
+    assert archive.main(["ingest", str(tmp_path)]) == 2
+    assert "no run dirs" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the soup_archive_* exposition
+# ---------------------------------------------------------------------------
+
+
+def test_store_prom_carries_canonical_archive_metrics(tmp_path):
+    from srnn_tpu.telemetry.names import CANONICAL_METRICS
+
+    root = str(tmp_path)
+    make_run(root, "c-r1", error=None, seed=0)
+    make_run(root, "c-r2", error=None, seed=1)
+    make_run(root, "c-r3", error=None, seed=2)
+    res = archive.ingest(root, now=NOW)
+    with open(os.path.join(res["store"], archive.PROM_NAME)) as f:
+        text = f.read()
+    gauges = watch.parse_prometheus(text)
+    assert gauges["srnn_soup_archive_runs"] == 3.0
+    assert gauges["srnn_soup_archive_runs_ingested_total"] == 3.0
+    assert gauges["srnn_soup_archive_drift_legs"] == 0.0
+    # drift ratio gauges carry leg+campaign labels, canonically named
+    assert any(k.startswith("srnn_soup_archive_drift_ratio{")
+               for k in gauges)
+    for name in ("soup_archive_runs", "soup_archive_runs_ingested_total",
+                 "soup_archive_drift_ratio", "soup_archive_drift_legs"):
+        assert name in CANONICAL_METRICS
+
+
+# ---------------------------------------------------------------------------
+# the bench sidecar: bench.py append hook + regress --from-archive
+# ---------------------------------------------------------------------------
+
+
+def _load_bench_module(tmp_path):
+    """Import a COPY of bench.py from tmp so its sidecar (written next
+    to ``__file__``) lands in the sandbox, not the repo root."""
+    path = os.path.join(str(tmp_path), "bench.py")
+    shutil.copy(os.path.join(REPO_ROOT, "bench.py"), path)
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_archive_sentinel_appends_and_bounds(tmp_path, monkeypatch):
+    monkeypatch.delenv("SRNN_BENCH_ARCHIVE", raising=False)
+    bench = _load_bench_module(tmp_path)
+    sidecar = os.path.join(str(tmp_path), bench.BENCH_ARCHIVE_NAME)
+    result = {"value": 1.0}
+    bench._archive_sentinel(result)
+    att = result["stage_log"][-1]
+    assert att["stage"] == "archive" and att["outcome"] == "ok"
+    assert att["rounds"] == 1
+    rows = [json.loads(l) for l in open(sidecar)]
+    assert rows[0]["kind"] == "bench_round"
+    assert rows[0]["result"]["value"] == 1.0
+    # bounded: the cap compacts to the newest rounds
+    for i in range(bench.BENCH_ARCHIVE_MAX_ROUNDS + 5):
+        bench._archive_sentinel({"value": float(i)})
+    rows = [json.loads(l) for l in open(sidecar)]
+    assert len(rows) == bench.BENCH_ARCHIVE_MAX_ROUNDS
+    assert rows[-1]["result"]["value"] == \
+        float(bench.BENCH_ARCHIVE_MAX_ROUNDS + 4)
+
+
+def test_bench_archive_sentinel_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRNN_BENCH_ARCHIVE", "0")
+    bench = _load_bench_module(tmp_path)
+    result = {"value": 1.0}
+    bench._archive_sentinel(result)
+    assert result["stage_log"][-1]["outcome"] == "disabled"
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           bench.BENCH_ARCHIVE_NAME))
+
+
+def test_regress_from_archive_feeds_history_median(tmp_path):
+    """Archived rounds join the committed-glob history: a fresh value
+    that regresses against the lone committed file alone is OK once the
+    archive's rounds move the median back."""
+    regress = os.path.join(REPO_ROOT, "benchmarks", "regress.py")
+    root = str(tmp_path)
+    committed = os.path.join(root, "BENCH_r01.json")
+    with open(committed, "w") as f:
+        json.dump({"backend": "cpu", "value": 200.0}, f)
+    sidecar = os.path.join(root, "BENCH_archive.jsonl")
+    _write_jsonl(sidecar,
+                 [{"kind": "bench_round", "t": 1.0,
+                   "result": {"backend": "cpu", "value": 100.0}},
+                  {"kind": "bench_round", "t": 2.0,
+                   "result": {"backend": "cpu", "value": 100.0}}])
+    fresh = os.path.join(root, "fresh.json")
+    with open(fresh, "w") as f:
+        json.dump({"backend": "cpu", "value": 100.0}, f)
+
+    def run(extra):
+        proc = subprocess.run(
+            [sys.executable, regress, fresh,
+             "--history", os.path.join(root, "BENCH_r*.json"), "--json"]
+            + extra,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60)
+        return proc.returncode, json.loads(proc.stdout.decode())
+
+    # committed history alone: 100 vs median 200 = -50% -> regression
+    rc, doc = run([])
+    assert rc == 1
+    assert any(f["leg"] == "apps_per_chip" for f in doc["regressions"])
+    # + archive rounds: median([200, 100, 100]) = 100 -> ok, and the
+    # archive labels show up in the judged history
+    rc, doc = run(["--from-archive", sidecar])
+    assert rc == 0
+    leg = next(l for l in doc["legs"] if l["leg"] == "apps_per_chip")
+    assert leg["verdict"] == "ok"
+    assert "archive[0]" in leg["history_rounds"]

@@ -34,6 +34,9 @@ def _run_bench(extra_env, timeout=600):
     # tiny pinned deadlines
     env.setdefault("SRNN_BENCH_SERVE_TIMEOUT_S", "0")
     env.setdefault("SRNN_BENCH_MULTIHOST_TIMEOUT_S", "0")
+    # throwaway rounds must not pollute the repo-root BENCH_archive
+    # sidecar (the archive hook's documented opt-out)
+    env.setdefault("SRNN_BENCH_ARCHIVE", "0")
     env.update(extra_env)
     proc = subprocess.run([sys.executable, BENCH], stdout=subprocess.PIPE,
                           stderr=subprocess.PIPE, timeout=timeout, env=env)
