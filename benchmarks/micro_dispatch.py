@@ -408,6 +408,36 @@ def row_export() -> dict:
         os.unlink(tmp.name)
 
 
+def row_profile() -> dict:
+    """Walltime overhead of the continuous profiling plane on the
+    ``metered.health`` chunk (documented bound <= ~5%): the 50Hz sampler
+    runs in ITS OWN daemon thread — the chunk pays only GIL contention
+    with the frame walks plus the per-chunk gauge fold, never the
+    sampling itself.  The profiled variant runs chunks with a live
+    sampler + per-chunk ``update_gauges``; the baseline is the same
+    chunk with no sampler thread."""
+    from srnn_tpu.telemetry.metrics import MetricsRegistry
+    from srnn_tpu.telemetry.profiler import SamplingProfiler
+
+    fns = _chunk_fns()
+    registry = MetricsRegistry()
+    prof = SamplingProfiler(hz=50.0, ring_s=5.0).start()
+    health = fns["health"]
+
+    def profiled():
+        value = health()
+        prof.update_gauges(registry)
+        return value
+
+    try:
+        return _overhead_row("profile",
+                             {"plain": fns["plain"], "health": health,
+                              "profile": profiled},
+                             base="health", feature="profile")
+    finally:
+        prof.stop()
+
+
 def row_archive() -> dict:
     """Walltime of folding one cross-run-observatory ingest pass into the
     per-chunk turn on top of the ``metered.health`` chunk (documented
@@ -740,12 +770,13 @@ def main(argv=None) -> int:
 
     rows = [row_compile(), row_dispatch(), row_memory(args.mega_size),
             row_telemetry(), row_health(), row_lineage(), row_spans(),
-            row_export(), row_trace(), row_adaptive(), row_fused(),
-            row_int8(), row_autotune(), row_archive(), row_stacked()]
+            row_export(), row_profile(), row_trace(), row_adaptive(),
+            row_fused(), row_int8(), row_autotune(), row_archive(),
+            row_stacked()]
     doc = {"bench": "micro_dispatch", "rows": rows}
     print(json.dumps(doc), flush=True)
     if not args.json_only:
-        (c, d, m, t, h, l, sp, ex, tr, ad, fu, i8, au, ar,
+        (c, d, m, t, h, l, sp, ex, pf, tr, ad, fu, i8, au, ar,
          sk) = rows
         print(f"# compile(N={c['n']}): cold {c['cold_compile_s']:.2f}s -> "
               f"warm {c['warm_compile_s']:.2f}s ({c['speedup']}x via "
@@ -779,6 +810,11 @@ def main(argv=None) -> int:
               f"{ex['export_ms_per_chunk']:.1f}ms vs metered.health "
               f"{ex['health_ms_per_chunk']:.1f}ms per chunk "
               f"({ex['overhead_pct']:+.1f}% overhead)", file=sys.stderr)
+        print(f"# profile(N={pf['n']}, G={pf['generations']}): +50Hz "
+              f"sampler {pf['profile_ms_per_chunk']:.1f}ms vs "
+              f"metered.health {pf['health_ms_per_chunk']:.1f}ms per "
+              f"chunk ({pf['overhead_pct']:+.1f}% overhead)",
+              file=sys.stderr)
         print(f"# trace(N={tr['n']}, G={tr['generations']}): +propagation "
               f"{tr['trace_ms_per_chunk']:.1f}ms vs metered.health "
               f"{tr['health_ms_per_chunk']:.1f}ms per chunk "

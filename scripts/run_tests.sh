@@ -901,6 +901,82 @@ fi
 rm -rf "$ar_root"
 summary+=$(printf '%-34s %-4s %4ss' "archive_smoke" "$status" "$((SECONDS-t0))")$'\n'
 
+# Continuous-profiling smoke (PR 20, srnn_tpu/telemetry/profiler): a
+# smoke run with a floor alert threshold (nan_frac >= -1.0 always
+# holds, so the rule fires on the first sample) must publish an
+# anomaly/<rule>-<seq>/ black-box bundle — non-empty folded samples,
+# thread dump, registry snapshot — plus the cumulative profile.folded
+# and the soup_profile_*/soup_utilization_* families in metrics.prom;
+# then `report --profile` must render the capture index, and the same
+# run WITH --no-profile must leave no profile artifacts at all.
+t0=$SECONDS
+pf_root=$(mktemp -d)
+pf_ok=1
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups mega_soup --smoke \
+    --seed 13 --root "$pf_root/run" --alert-nan-frac -1.0 \
+    > "$pf_root/out.log" 2>&1 || pf_ok=0
+pf_dir=$(ls -d "$pf_root"/run/exp-* 2>/dev/null | head -1)
+if [ -n "$pf_dir" ]; then
+    [ -s "$pf_dir/profile.folded" ] || { echo "profile_smoke: no \
+profile.folded" >> "$pf_root/out.log"; pf_ok=0; }
+    grep -Eq 'srnn_soup_profile_samples_total [1-9]' \
+        "$pf_dir/metrics.prom" || pf_ok=0
+    grep -q 'srnn_soup_utilization_device_busy' \
+        "$pf_dir/metrics.prom" || pf_ok=0
+    grep -q 'srnn_soup_anomaly_captures_total{rule="soup_nan_frac"} 1' \
+        "$pf_dir/metrics.prom" || pf_ok=0
+    pf_bundle=$(ls -d "$pf_dir"/anomaly/soup_nan_frac-* 2>/dev/null | head -1)
+    if [ -n "$pf_bundle" ]; then
+        [ -s "$pf_bundle/samples.jsonl" ] || pf_ok=0
+        grep -q '"stacks"' "$pf_bundle/samples.jsonl" || pf_ok=0
+        grep -q '"n_threads"' "$pf_bundle/threads.json" || pf_ok=0
+        grep -q '"rule": "soup_nan_frac"' "$pf_bundle/capture.json" || pf_ok=0
+        grep -q 'srnn_soup_health_nan_frac' "$pf_bundle/metrics.json" \
+            || pf_ok=0
+    else
+        echo "profile_smoke: no anomaly bundle published" \
+            >> "$pf_root/out.log"
+        pf_ok=0
+    fi
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+        --profile "$pf_dir" > "$pf_root/profile.txt" \
+        2>>"$pf_root/out.log" || pf_ok=0
+    grep -q '^  sampler: ' "$pf_root/profile.txt" || pf_ok=0
+    grep -q 'anomaly captures (1' "$pf_root/profile.txt" || pf_ok=0
+else
+    pf_ok=0
+fi
+SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.setups mega_soup --smoke \
+    --seed 13 --root "$pf_root/off" --no-profile \
+    >> "$pf_root/out.log" 2>&1 || pf_ok=0
+pf_off=$(ls -d "$pf_root"/off/exp-* 2>/dev/null | head -1)
+if [ -n "$pf_off" ]; then
+    if [ -e "$pf_off/profile.folded" ] || [ -e "$pf_off/anomaly" ]; then
+        echo "profile_smoke: --no-profile left profile artifacts" \
+            >> "$pf_root/out.log"
+        pf_ok=0
+    fi
+    # the no-data contract: a --no-profile run dir exits 2, not an
+    # empty-but-valid render
+    SRNN_SETUPS_PLATFORM=cpu python -m srnn_tpu.telemetry.report \
+        --profile "$pf_off" >> "$pf_root/out.log" 2>&1
+    if [ "$?" -ne 2 ]; then
+        echo "profile_smoke: report --profile on --no-profile run did \
+not exit 2" >> "$pf_root/out.log"
+        pf_ok=0
+    fi
+else
+    pf_ok=0
+fi
+if [ "$pf_ok" -eq 1 ]; then
+    status=ok; pass=$((pass+1))
+else
+    status=FAIL; fail=$((fail+1)); failed_groups+=("profile_smoke")
+    tail -n 40 "$pf_root/out.log"
+fi
+rm -rf "$pf_root"
+summary+=$(printf '%-34s %-4s %4ss' "profile_smoke" "$status" "$((SECONDS-t0))")$'\n'
+
 echo
 echo "=== run_tests.sh summary ==="
 printf '%s' "$summary"

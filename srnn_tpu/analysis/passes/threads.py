@@ -38,6 +38,10 @@ DAEMON_WHITELIST = {
     "telemetry/flightrec.py":
         "StallSentinel dead-man's switch: fires while the main thread "
         "hangs in a dead backend call",
+    "telemetry/profiler.py":
+        "SamplingProfiler forensic observer: samples threads that may "
+        "be wedged, owns no buffered I/O (flushes ride the run's "
+        "writer); non-daemon would hang exit on the wedge it observes",
 }
 
 

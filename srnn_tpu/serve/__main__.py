@@ -75,6 +75,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "queue depth and the active-alerts panel); 0 = "
                         "off.  The history rings + metrics_history.jsonl "
                         "+ alert rules run either way (host-side only)")
+    p.add_argument("--no-profile", action="store_true",
+                   help="drop the continuous profiling plane (host stack "
+                        "sampler, profile.folded, utilization gauges, "
+                        "anomaly capture); host-side only, so serve "
+                        "results are identical either way")
+    p.add_argument("--profile-hz", type=float, default=50.0, metavar="HZ",
+                   help="host stack-sampling rate of the continuous "
+                        "profiler (see telemetry.profiler)")
+    p.add_argument("--profile-ring-s", type=float, default=30.0,
+                   metavar="S",
+                   help="seconds of raw profiler samples kept for "
+                        "anomaly bundles (samples.jsonl)")
+    p.add_argument("--anomaly-captures", type=int, default=4, metavar="N",
+                   help="FIFO retention bound on anomaly/<rule>-<seq>/ "
+                        "bundles in the service root")
     p.add_argument("--chaos", default=None, metavar="SPEC",
                    help="serve-layer fault injection, e.g. "
                         "'serve_kill@1,serve_dispatch_fault@2:io,"
@@ -129,6 +144,11 @@ def main(argv=None) -> int:
                        "--results-ttl-s", str(args.results_ttl_s),
                        "--dispatch-retries", str(args.dispatch_retries),
                        "--retry-backoff-s", str(args.retry_backoff_s)]
+        worker_args += ["--profile-hz", str(args.profile_hz),
+                        "--profile-ring-s", str(args.profile_ring_s),
+                        "--anomaly-captures", str(args.anomaly_captures)]
+        if args.no_profile:
+            worker_args.append("--no-profile")
         if args.no_adaptive:
             worker_args.append("--no-adaptive")
         if args.warm_fixpoint_density:
@@ -178,7 +198,20 @@ def main(argv=None) -> int:
         path=os.path.join(args.root, "metrics_history.jsonl"))
     engine = AlertEngine(default_serve_rules(max_queue=args.max_queue),
                          service.registry, history)
-    service.attach_live(history, engine)
+    # continuous profiling plane: the stack sampler watches this
+    # process's dispatcher/writer/exporter threads; anomaly bundles land
+    # in the service root on each serve-rule firing edge
+    prof = capture = None
+    if not args.no_profile:
+        from ..telemetry.profiler import AnomalyCapture, SamplingProfiler
+
+        prof = SamplingProfiler(hz=args.profile_hz,
+                                ring_s=args.profile_ring_s).start()
+        capture = AnomalyCapture(args.root, profiler=prof,
+                                 registry=service.registry,
+                                 max_bundles=args.anomaly_captures,
+                                 ring_s=args.profile_ring_s)
+    service.attach_live(history, engine, capture=capture, profiler=prof)
     exporter = None
     if args.metrics_port:
         from ..telemetry.exporter import MetricsExporter, healthz_metrics
@@ -236,6 +269,10 @@ def main(argv=None) -> int:
         signal.signal(signal.SIGTERM, prev)
         if exporter is not None:
             exporter.close()
+        # halt sampling before close — service.close() writes the final
+        # profile.folded/.jsonl from the frozen tables
+        if prof is not None:
+            prof.stop()
         service.close()
     unfinished = service._self_healing_stats()["journal_unfinished"]
     if unfinished:

@@ -113,7 +113,7 @@ class ServicePool:
 
     def __init__(self, root: str, workers: List[WorkerHandle],
                  registry=None, max_queue: int = 0, history=None,
-                 engine=None):
+                 engine=None, capture=None, profiler=None):
         from ..telemetry.metrics import MetricsRegistry
 
         os.makedirs(root, exist_ok=True)
@@ -124,6 +124,12 @@ class ServicePool:
         self.journal = TicketJournal(root)
         self._history = history
         self._engine = engine
+        # continuous profiling plane, the front's half: the sampler
+        # watches the monitor/relay threads, anomaly bundles land in the
+        # FRONT root on pool-rule firing edges (worker bundles land in
+        # their own sub-roots)
+        self._capture = capture
+        self._profiler = profiler
         self._lock = threading.Lock()
         self._done_cv = threading.Condition(self._lock)
         #: front ticket -> {"kind","params","tenant","worker","key",
@@ -538,9 +544,20 @@ class ServicePool:
                 if self._history is not None:
                     try:
                         self._history.sample()
+                        transitions = []
                         if self._engine is not None:
                             for tr in self._engine.evaluate():
                                 self._event_row(kind="alert", **tr)
+                                transitions.append(tr)
+                        if self._capture is not None:
+                            self._capture.on_transitions(transitions)
+                        if self._profiler is not None:
+                            # monitor cadence doubles as the profile
+                            # flush cadence (inline — the front has no
+                            # background writer, and this IS its own
+                            # housekeeping thread)
+                            self._profiler.update_gauges(self.registry)
+                            self._profiler.write_files(self.root)
                     except Exception as e:  # pragma: no cover - defensive
                         print(f"serve pool: live telemetry sample failed:"
                               f" {type(e).__name__}: {e}",
@@ -672,6 +689,14 @@ class ServicePool:
             except (OSError, RuntimeError):
                 pass
         _reap([w.proc for w in self.workers], set())
+        if self._profiler is not None:
+            try:
+                self._profiler.update_gauges(self.registry)
+                self._profiler.write_files(self.root)
+            except OSError:
+                pass
+        if self._capture is not None:
+            self._capture.close()
         self.registry.write_textfile(os.path.join(self.root,
                                                   "metrics.prom"))
         self.journal.close()
@@ -828,9 +853,20 @@ def run_pool(args, worker_args: List[str]) -> int:
         default_serve_rules(max_queue=args.max_queue)
         + default_pool_rules(workers=args.workers),
         registry, history)
+    prof = capture = None
+    if not getattr(args, "no_profile", False):
+        from ..telemetry.profiler import AnomalyCapture, SamplingProfiler
+
+        prof = SamplingProfiler(
+            hz=getattr(args, "profile_hz", 50.0),
+            ring_s=getattr(args, "profile_ring_s", 30.0)).start()
+        capture = AnomalyCapture(
+            args.root, profiler=prof, registry=registry,
+            max_bundles=getattr(args, "anomaly_captures", 4),
+            ring_s=getattr(args, "profile_ring_s", 30.0))
     pool = ServicePool(args.root, workers, registry=registry,
                        max_queue=args.max_queue, history=history,
-                       engine=engine)
+                       engine=engine, capture=capture, profiler=prof)
     exporter = None
     if args.metrics_port:
         from ..telemetry.exporter import MetricsExporter
@@ -861,6 +897,8 @@ def run_pool(args, worker_args: List[str]) -> int:
         signal.signal(signal.SIGTERM, prev)
         if exporter is not None:
             exporter.close()
+        if prof is not None:
+            prof.stop()
         history.close()
     pending = pool.queue_depth()
     if pending:

@@ -263,6 +263,9 @@ class ExperimentService:
         # the top of every drain + the declarative alert engine
         self._live_history = None
         self._live_engine = None
+        self._live_capture = None
+        self._live_profiler = None
+        self._live_last_profile_flush = float("-inf")
         self._t0 = time.monotonic()
 
     # -- submission / results -------------------------------------------
@@ -530,7 +533,8 @@ class ExperimentService:
         self._controller = controller
         self.fair_tenants = bool(fair)
 
-    def attach_live(self, history, engine=None) -> None:
+    def attach_live(self, history, engine=None, capture=None,
+                    profiler=None) -> None:
         """Arm the live telemetry plane: ``history`` (a
         ``telemetry.timeseries.MetricHistory`` over this service's
         registry, its jsonl stream in the service root) is sampled at
@@ -539,10 +543,19 @@ class ExperimentService:
         queue-at-the-bound condition is observable — and ``engine`` (a
         ``telemetry.alerts.AlertEngine``) evaluates on the same cadence,
         each transition riding events.jsonl as a ``{"kind": "alert"}``
-        row.  Both close with the service."""
+        row.  ``capture`` (a ``telemetry.profiler.AnomalyCapture``)
+        publishes its black-box bundle on each firing edge, inline after
+        the alert rows that cite it; ``profiler`` (the owning process's
+        ``SamplingProfiler``) folds its gauges on the same cadence and
+        rides a throttled ``profile.folded``/``profile.jsonl`` rewrite
+        on the service writer.  All close with the service (the caller
+        keeps ownership of the profiler's ``stop()``)."""
         self._live_history = history
         self._live_engine = engine
+        self._live_capture = capture
+        self._live_profiler = profiler
         self._live_last_sample = float("-inf")
+        self._live_last_profile_flush = float("-inf")
 
     def _sample_live(self) -> None:
         """One live-plane turn, inline on the dispatch thread (the
@@ -554,9 +567,22 @@ class ExperimentService:
         try:
             self._live_last_sample = time.monotonic()
             self._live_history.sample()
+            transitions = []
             if self._live_engine is not None:
                 for transition in self._live_engine.evaluate():
                     self._event_row(kind="alert", **transition)
+                    transitions.append(transition)
+            if self._live_capture is not None:
+                self._live_capture.on_transitions(transitions)
+            if self._live_profiler is not None:
+                self._live_profiler.update_gauges(self.registry)
+                now = time.monotonic()
+                if now - self._live_last_profile_flush >= 10.0:
+                    # throttled cumulative rewrite: profile files need
+                    # not track every dispatch, just stay fresh
+                    self._live_last_profile_flush = now
+                    self.writer.submit(self._live_profiler.write_files,
+                                       self.root)
         except Exception as e:  # pragma: no cover - defensive
             import sys
 
@@ -1315,6 +1341,13 @@ class ExperimentService:
         if self._closed:
             return
         self._closed = True
+        if self._live_profiler is not None:
+            # final cumulative profile rewrite from the (frozen) tables
+            try:
+                self._live_profiler.update_gauges(self.registry)
+                self._live_profiler.write_files(self.root)
+            except OSError:
+                pass
         self.registry.write_textfile(os.path.join(self.root,
                                                   "metrics.prom"))
         if self._own_writer:
@@ -1330,6 +1363,8 @@ class ExperimentService:
             self._lineage.close()
         if self._live_history is not None:
             self._live_history.close()
+        if self._live_capture is not None:
+            self._live_capture.close()
 
     def __enter__(self) -> "ExperimentService":
         return self

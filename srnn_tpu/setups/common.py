@@ -513,14 +513,78 @@ def add_telemetry_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
     return p
 
 
-def make_live_plane(args, exp, registry, dist, stage: str):
+def add_profile_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """The continuous-profiling plane's CLI knobs shared by the mega-run
+    entry points and the serve tier (see ``telemetry.profiler``)."""
+    p.add_argument("--no-profile", action="store_true",
+                   help="drop the continuous profiling plane (50Hz host "
+                        "stack sampler, profile.folded/profile.jsonl, "
+                        "utilization gauges, anomaly capture); the plane "
+                        "is host-side, so results are bit-identical "
+                        "either way — the --no-spans-style A/B oracle "
+                        "for that claim")
+    p.add_argument("--profile-hz", type=float, default=50.0, metavar="HZ",
+                   help="host stack-sampling rate; each tick folds every "
+                        "named thread's stack into the bounded profile "
+                        "tables (overhead documented ≤5%% in "
+                        "micro_dispatch's profile row)")
+    p.add_argument("--profile-ring-s", type=float, default=30.0,
+                   metavar="S",
+                   help="seconds of raw per-tick samples kept in the "
+                        "rolling ring — the pre-anomaly window an "
+                        "anomaly bundle preserves as samples.jsonl")
+    p.add_argument("--anomaly-captures", type=int, default=4, metavar="N",
+                   help="FIFO retention bound on anomaly/<rule>-<seq>/ "
+                        "bundles: past N the oldest bundle is evicted "
+                        "(an alert storm tells its story in N bundles)")
+    return p
+
+
+def make_profiler(args, exp, registry, dist, stage: str):
+    """Build one process's continuous-profiling plane
+    (``telemetry.profiler``): the 50Hz stack sampler on EVERY process
+    (each worker's threads are its own forensic surface), the anomaly
+    capture primary-only — captures land in the run dir next to the
+    alert stream that triggers them, honoring the process-0 I/O contract
+    (DESIGN §16).  Returns ``(profiler, capture)``; ``--no-profile``
+    returns ``(None, None)`` — the bitwise A/B reference.  The capture
+    is handed to :func:`make_live_plane` so firing edges publish their
+    bundle from the same ordered writer job as the alert rows; per-chunk
+    ``profiler.flush(run_dir, writer, registry)`` calls stay inside the
+    finisher's primary-gated block like every other run artifact."""
+    if getattr(args, "no_profile", False):
+        return None, None
+    from ..telemetry.profiler import AnomalyCapture, SamplingProfiler
+
+    profiler = SamplingProfiler(
+        hz=getattr(args, "profile_hz", 50.0),
+        ring_s=getattr(args, "profile_ring_s", 30.0)).start()
+    active = dist is not None and dist.active
+    primary = dist.primary if active else True
+    capture = None
+    if primary:
+        capture = AnomalyCapture(
+            exp.dir, profiler=profiler, registry=registry,
+            max_bundles=getattr(args, "anomaly_captures", 4),
+            ring_s=getattr(args, "profile_ring_s", 30.0))
+    exp.log(f"profiler: sampling {profiler.hz:g}Hz "
+            f"(ring {profiler.ring_s:g}s"
+            + (f", anomaly captures ≤{capture.max_bundles}" if capture
+               else "") + ")")
+    return profiler, capture
+
+
+def make_live_plane(args, exp, registry, dist, stage: str, capture=None):
     """Build one process's live telemetry plane (``telemetry.exporter.
     LivePlane``): the history ring (jsonl stream process-0-gated like
     every run artifact), the alert engine (primary-only — one alert
     stream per run), and the HTTP exporter when ``--metrics-port`` is
     set (workers bind PORT+process_id).  ``--no-export`` returns ``None``
-    — the bitwise A/B reference.  Exporter bind failures are logged and
-    non-fatal: observability must never take down a run."""
+    — the bitwise A/B reference.  An :class:`AnomalyCapture` from
+    :func:`make_profiler` rides the plane's sample job so firing edges
+    publish their black-box bundle ordered against the alert rows.
+    Exporter bind failures are logged and non-fatal: observability must
+    never take down a run."""
     if getattr(args, "no_export", False):
         return None
     from ..telemetry.alerts import AlertEngine, default_run_rules
@@ -567,7 +631,8 @@ def make_live_plane(args, exp, registry, dist, stage: str):
         except OSError as e:
             exp.log(f"telemetry: exporter bind failed on :{port} "
                     f"({e}); continuing without the live endpoint")
-    return LivePlane(history=history, engine=engine, exporter=exporter)
+    return LivePlane(history=history, engine=engine, exporter=exporter,
+                     capture=capture if engine is not None else None)
 
 
 def update_fleet_gauges(registry, run_dir: str, dist) -> None:

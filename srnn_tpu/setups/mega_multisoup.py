@@ -46,18 +46,19 @@ from ..utils.pipeline import snapshot, submit_or_run
 from ..ops.predicates import CLASS_NAMES
 from ..topology import Topology
 from ..distributed import add_distributed_args
+from ..telemetry.profiler import update_utilization_gauges
 from .common import (add_dynamics_args, add_flightrec_args,
-                     add_pipeline_args, add_resilience_args,
-                     add_telemetry_args, base_parser, build_soup_mesh,
-                     chunk_boundary_faults, close_spans,
+                     add_pipeline_args, add_profile_args,
+                     add_resilience_args, add_telemetry_args, base_parser,
+                     build_soup_mesh, chunk_boundary_faults, close_spans,
                      emit_chunk_spans, fetch_for_checkpoint,
                      finish_pipeline, flush_lineage_probe,
                      flush_lineage_window, init_distributed,
                      latest_checkpoint, make_flightrec, make_lineage,
                      make_live_plane, make_on_stall, make_pipeline,
-                     make_spans, load_run_config, note_restart, open_run,
-                     probe_run_costs, register, save_run_config,
-                     set_distributed_gauges, stage_label,
+                     make_profiler, make_spans, load_run_config,
+                     note_restart, open_run, probe_run_costs, register,
+                     save_run_config, set_distributed_gauges, stage_label,
                      update_fleet_gauges, watchdog_chunk)
 
 
@@ -104,6 +105,7 @@ def build_parser():
                         "devices (shard_map data parallel)")
     add_pipeline_args(p)
     add_telemetry_args(p)
+    add_profile_args(p)
     add_flightrec_args(p)
     add_dynamics_args(p)
     add_resilience_args(p)
@@ -346,7 +348,7 @@ def _run_once(args, ctx=None):
     if lineage_on and lin_writer is not None:
         exp.log(f"lineage: epoch {lin_writer.epoch}, "
                 f"{lincap} edge rows/window -> lineage.jsonl")
-    stores = writer = live = None
+    stores = writer = live = prof = capture = None
     import time as _time
     try:
         # writer spawns INSIDE the try (see mega_soup): a crash in this
@@ -364,8 +366,13 @@ def _run_once(args, ctx=None):
                            "mega_multisoup")
         # live telemetry plane (--no-export = the bitwise A/B oracle;
         # see mega_soup / telemetry.exporter)
+        # continuous profiling plane (--no-profile = its bitwise A/B
+        # oracle) + anomaly capture on the alert firing edge, riding the
+        # live plane's ordered sample job — see mega_soup
+        prof, capture = make_profiler(args, exp, registry, dist,
+                                      "mega_multisoup")
         live = make_live_plane(args, exp, registry, dist,
-                               "mega_multisoup")
+                               "mega_multisoup", capture=capture)
         hb = Heartbeat(exp, stage=stage_label("mega_multisoup", dist),
                        total_generations=args.generations,
                        registry=registry,
@@ -522,6 +529,15 @@ def _run_once(args, ctx=None):
                         # with this chunk's registry mutations (see
                         # mega_soup)
                         live.sample(exp, writer, generation=gen)
+                    if prof is not None:
+                        if primary:
+                            # profile gauges + cumulative folded rewrite
+                            # ahead of this chunk's flush_events
+                            prof.flush(exp.dir, writer, registry)
+                        else:
+                            # workers fold gauges only (DESIGN §16)
+                            submit_or_run(writer, prof.update_gauges,
+                                          registry)
                     # run-dir artifacts are process-0-gated (DESIGN §16)
                     if primary:
                         if dist.active:
@@ -542,6 +558,11 @@ def _run_once(args, ctx=None):
                                               f"ckpt-gen{gen:08d}"),
                                           ckpt_state)
                 row["pipeline"] = meter.chunk_done(dt)
+                if prof is not None:
+                    # utilization decomposition inline after chunk_done —
+                    # see mega_soup
+                    row["utilization"] = update_utilization_gauges(
+                        registry, row["pipeline"])
                 # chunk span family reusing the attribution just computed
                 emit_chunk_spans(spans, "mega_multisoup", gen, chunk,
                                  row["pipeline"])
@@ -634,6 +655,12 @@ def _run_once(args, ctx=None):
         # meta.json guaranteed
         if watchdog is not None:
             watchdog.stop_trace()
+        # stop the sampler + close any armed anomaly trace window before
+        # the writer drains (see mega_soup)
+        if prof is not None:
+            prof.stop()
+        if capture is not None:
+            capture.close()
         # clear the hostio span sink before this attempt's writer goes
         # down (see mega_soup)
         close_spans()

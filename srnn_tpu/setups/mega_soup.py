@@ -38,18 +38,19 @@ from ..utils.aot import ensure_compilation_cache
 from ..utils.pipeline import snapshot, submit_or_run
 from ..topology import Topology
 from ..distributed import add_distributed_args
+from ..telemetry.profiler import update_utilization_gauges
 from .common import (add_dynamics_args, add_flightrec_args,
-                     add_pipeline_args, add_resilience_args,
-                     add_telemetry_args, base_parser, build_soup_mesh,
-                     chunk_boundary_faults, close_spans,
+                     add_pipeline_args, add_profile_args,
+                     add_resilience_args, add_telemetry_args, base_parser,
+                     build_soup_mesh, chunk_boundary_faults, close_spans,
                      emit_chunk_spans, fetch_for_checkpoint,
                      finish_pipeline, flush_lineage_probe,
                      flush_lineage_window, init_distributed,
                      latest_checkpoint, load_run_config, make_flightrec,
                      make_lineage, make_live_plane, make_on_stall,
-                     make_pipeline, make_spans, note_restart, open_run,
-                     probe_run_costs, register, save_run_config,
-                     set_distributed_gauges, stage_label,
+                     make_pipeline, make_profiler, make_spans,
+                     note_restart, open_run, probe_run_costs, register,
+                     save_run_config, set_distributed_gauges, stage_label,
                      update_fleet_gauges, watchdog_chunk)
 
 
@@ -108,6 +109,7 @@ def build_parser():
                         "merged offline by read_sharded_store")
     add_pipeline_args(p)
     add_telemetry_args(p)
+    add_profile_args(p)
     add_flightrec_args(p)
     add_dynamics_args(p)
     add_resilience_args(p)
@@ -283,7 +285,7 @@ def _run_once(args, ctx=None):
     if lineage_on and lin_writer is not None:
         exp.log(f"lineage: epoch {lin_writer.epoch}, "
                 f"{lincap} edge rows/window -> lineage.jsonl")
-    store = writer = live = None
+    store = writer = live = prof = capture = None
     import time as _time
     try:
         # the writer's non-daemon worker spawns INSIDE the try: any
@@ -304,7 +306,14 @@ def _run_once(args, ctx=None):
         # history rings + metrics_history.jsonl + alert engine, sampled
         # once per chunk in the finisher; /metrics + /healthz HTTP
         # endpoint when --metrics-port is set
-        live = make_live_plane(args, exp, registry, dist, "mega_soup")
+        # continuous profiling plane (--no-profile = its bitwise A/B
+        # oracle): the 50Hz host stack sampler on every process, the
+        # anomaly capture primary-only, hooked on the alert engine's
+        # firing edge through the live plane's ordered sample job
+        prof, capture = make_profiler(args, exp, registry, dist,
+                                      "mega_soup")
+        live = make_live_plane(args, exp, registry, dist, "mega_soup",
+                               capture=capture)
         hb = Heartbeat(exp, stage=stage_label("mega_soup", dist),
                        total_generations=args.generations,
                        registry=registry,
@@ -465,6 +474,17 @@ def _run_once(args, ctx=None):
                         # BEFORE its flush_events, so an alert row can
                         # never cite registry state newer than its chunk
                         live.sample(exp, writer, generation=gen)
+                    if prof is not None:
+                        if primary:
+                            # fold the profiler gauges, then ride the
+                            # cumulative profile.folded/.jsonl rewrite on
+                            # the writer ahead of this chunk's flush_events
+                            prof.flush(exp.dir, writer, registry)
+                        else:
+                            # workers fold their own gauges only — run-dir
+                            # artifacts are process-0's (DESIGN §16)
+                            submit_or_run(writer, prof.update_gauges,
+                                          registry)
                     # run-dir artifacts are process-0-gated (DESIGN §16):
                     # workers contribute through the collective shard
                     # boundaries, never through these sinks
@@ -490,6 +510,12 @@ def _run_once(args, ctx=None):
                                               f"ckpt-gen{gen:08d}"),
                                           ckpt_state)
                 row["pipeline"] = meter.chunk_done(dt)
+                if prof is not None:
+                    # utilization decomposition of the chunk just
+                    # attributed: soup_utilization_* gauges inline (the
+                    # chunk_done discipline) + the flight-recorder copy
+                    row["utilization"] = update_utilization_gauges(
+                        registry, row["pipeline"])
                 # chunk span family (root + device_wait/host_io children)
                 # reusing the attribution just computed above
                 emit_chunk_spans(spans, "mega_soup", gen, chunk,
@@ -618,6 +644,13 @@ def _run_once(args, ctx=None):
         # (e.g. disk full).
         if watchdog is not None:
             watchdog.stop_trace()
+        # stop the profiler's sampler thread and close any armed anomaly
+        # trace window before the writer drains — queued flush jobs read
+        # the frozen tables (stop() only halts sampling)
+        if prof is not None:
+            prof.stop()
+        if capture is not None:
+            capture.close()
         # the hostio span sink closes over this attempt's writer; clear it
         # before the writer goes down (a restart installs a fresh one)
         close_spans()

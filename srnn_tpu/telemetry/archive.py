@@ -255,6 +255,18 @@ def config_fingerprint(config: dict) -> str:
     return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
 
 
+def _count_anomaly_captures(run_dir: str) -> int:
+    """Published ``anomaly/<rule>-<seq>/`` bundle count (PR 20) — a
+    bare dir listing, dot-tmp assembly dirs excluded by construction."""
+    root = os.path.join(run_dir, "anomaly")
+    try:
+        return sum(1 for d in os.listdir(root)
+                   if not d.startswith(".")
+                   and os.path.isdir(os.path.join(root, d)))
+    except OSError:
+        return 0
+
+
 def classify_outcome(meta: Optional[dict], restarts: int, preempts: int,
                      age_s: Optional[float],
                      stale_s: float = DEFAULT_STALE_S) -> str:
@@ -428,6 +440,7 @@ def fold_run_dir(run_dir: str, *, tail_bytes: int = TAIL_BYTES,
         rate = {"p50": round(quantile_from_times(gps, 0.5), 4),
                 "max": round(max(gps), 4), "last": round(gps[-1], 4)}
 
+    captures = _count_anomaly_captures(run_dir)
     row = {
         "kind": "run",
         "dir": os.path.abspath(run_dir),
@@ -449,6 +462,10 @@ def fold_run_dir(run_dir: str, *, tail_bytes: int = TAIL_BYTES,
         "cost_entries": len(cost_rows),
         "alerts": alerts,
         "alerts_active": alerts_active,
+        # anomaly black-box presence (PR 20): published bundle count —
+        # a cheap dir listing; the bundles themselves stay in the run
+        # dir and render via report --profile
+        "anomaly_captures": captures,
         "census_tail": _census_tail(run_dir, tail_bytes=LINEAGE_TAIL_BYTES),
         "journal_rows": journal_rows,
         "config_fingerprint": config_fingerprint(config),
@@ -833,7 +850,7 @@ def runs_doc(root: str, store: Optional[str] = None, *,
 #: numeric summary fields --compare reports deltas on
 _COMPARE_FIELDS = ("wall_seconds", "restarts", "preempts",
                    "watchdog_trips", "flops_total", "nan_frac_peak",
-                   "event_rows", "journal_rows")
+                   "anomaly_captures", "event_rows", "journal_rows")
 
 
 def compare_runs(a_dir: str, b_dir: str, *,

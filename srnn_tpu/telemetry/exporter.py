@@ -230,12 +230,17 @@ class LivePlane:
     once-per-dispatch) turn: ring + jsonl row, then rule evaluation,
     with every transition emitted as a ``{"kind": "alert"}`` event row —
     all as ONE ordered job on the run's BackgroundWriter, so an alert
-    can never cite registry state newer than its chunk."""
+    can never cite registry state newer than its chunk.  An optional
+    :class:`~srnn_tpu.telemetry.profiler.AnomalyCapture` rides the same
+    job: firing edges publish their black-box bundle from the writer
+    thread, ordered against the alert rows that cite them."""
 
-    def __init__(self, history=None, engine=None, exporter=None):
+    def __init__(self, history=None, engine=None, exporter=None,
+                 capture=None):
         self.history = history
         self.engine = engine
         self.exporter = exporter
+        self.capture = capture
 
     def sample(self, exp, writer=None, **context) -> None:
         from ..utils.pipeline import submit_or_run
@@ -243,9 +248,13 @@ class LivePlane:
         def job():
             if self.history is not None:
                 self.history.sample()
+            transitions = []
             if self.engine is not None:
                 for transition in self.engine.evaluate():
                     exp.event(kind="alert", **context, **transition)
+                    transitions.append(transition)
+            if self.capture is not None:
+                self.capture.on_transitions(transitions, **context)
 
         submit_or_run(writer, job)
 
@@ -260,3 +269,5 @@ class LivePlane:
             self.exporter.close()
         if self.history is not None:
             self.history.close()
+        if self.capture is not None:
+            self.capture.close()

@@ -404,10 +404,14 @@ _TID_EVENTS = 3
 def profiler_trace_dirs(run_dir: str) -> List[str]:
     """Device-trace directories linked from this run: the armed
     ``jax.profiler`` traces inside the run's flight-recorder triage
-    bundles (``triage-*/trace``, non-empty only).  A wedged TPU attempt's
-    bundle thereby joins the same export instead of rotting unfound."""
+    bundles (``triage-*/trace``) and anomaly-capture bundles
+    (``anomaly/<rule>-<seq>/trace``), non-empty only.  A wedged TPU
+    attempt's bundle thereby joins the same export instead of rotting
+    unfound."""
     out = []
-    for bundle in sorted(glob.glob(os.path.join(run_dir, "triage-*"))):
+    for bundle in sorted(glob.glob(os.path.join(run_dir, "triage-*"))
+                         + glob.glob(os.path.join(run_dir, "anomaly",
+                                                  "*"))):
         trace = os.path.join(bundle, "trace")
         try:
             if os.path.isdir(trace) and any(os.scandir(trace)):
@@ -494,7 +498,9 @@ def perfetto_trace(run_dir: str) -> dict:
     trace document (``chrome://tracing`` / ui.perfetto.dev JSON object
     format): one ``pid`` group per process with named lanes — host spans,
     serve-ticket slices — plus gens/sec counter tracks from the
-    heartbeats and instant markers for restarts/watchdog trips/preempts.
+    heartbeats, utilization counter tracks (device-busy / host-blocked /
+    idle fractions) from each flushed metrics row, and instant markers
+    for restarts/watchdog trips/preempts.
     Timestamps are the run-relative monotonic seconds every process
     already stamps (microseconds in the export, per the trace format).
 
@@ -524,6 +530,21 @@ def perfetto_trace(run_dir: str) -> dict:
                     "name": "gens_per_sec", "ph": "C", "cat": "heartbeat",
                     "ts": round(float(t) * 1e6, 1), "pid": pid,
                     "args": {"gens_per_sec": float(row["gens_per_sec"])}})
+        elif kind == "metrics":
+            # the profiling plane's utilization decomposition as ONE
+            # stacked counter track per process: device_busy /
+            # host_blocked / idle fractions of each flushed chunk
+            t = row.get("t")
+            m = row.get("metrics") or {}
+            util = {k[len("srnn_soup_utilization_"):]: float(v)
+                    for k, v in m.items()
+                    if k.startswith("srnn_soup_utilization_")}
+            if util and isinstance(t, (int, float)):
+                pids.add(pid)
+                events.append({
+                    "name": "utilization", "ph": "C", "cat": "profile",
+                    "ts": round(float(t) * 1e6, 1), "pid": pid,
+                    "args": util})
         elif kind in ("restart", "watchdog", "preempt", "cost", "alert"):
             t = row.get("t")
             if isinstance(t, (int, float)):

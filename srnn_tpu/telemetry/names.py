@@ -166,6 +166,18 @@ CANONICAL_METRICS: Dict[str, str] = {
     "soup_archive_runs_ingested_total": "counter",
     "soup_archive_drift_ratio": "gauge",
     "soup_archive_drift_legs": "gauge",
+    # -- continuous profiling plane (telemetry.profiler: the 50Hz host
+    #    sampler's own accounting, the per-chunk utilization
+    #    decomposition, and the anomaly black-box capture counter) -------
+    "soup_profile_samples_total": "counter",
+    "soup_profile_overruns_total": "counter",
+    "soup_profile_stacks_dropped_total": "counter",
+    "soup_profile_threads": "gauge",
+    "soup_profile_stacks": "gauge",
+    "soup_utilization_device_busy": "gauge",
+    "soup_utilization_host_blocked": "gauge",
+    "soup_utilization_idle": "gauge",
+    "soup_anomaly_captures_total": "counter",
 }
 
 #: pre-convention names kept for dashboard compatibility (do not extend):

@@ -1,0 +1,608 @@
+"""Continuous profiling plane: always-on host sampling, utilization
+decomposition, and anomaly-triggered black-box capture.
+
+The paper's central phenomena — divergence to NaN, zero-collapse, basin
+escapes — are transient: by the time an operator reads the alert trail
+(PR 15) the moment is gone, and every wedged TPU attempt in BENCH history
+died with no record of where host time was going.  This module is the
+layer that turns "alert fired" into "here is the stack and the device
+state when it did":
+
+  * :class:`SamplingProfiler` — a stdlib sampling profiler: one daemon
+    thread walks ``sys._current_frames()`` at ``--profile-hz`` (~50Hz)
+    and folds each thread's stack into a bounded per-thread counter
+    table, keyed by the thread names the ``spawn_thread`` registry
+    assigned (loop / ``<stage>-io`` writer / dispatcher / exporter).  A
+    rolling ring keeps the last ``ring_s`` seconds of raw per-tick
+    samples for forensic bundles.  ``flush()`` publishes cumulative
+    folded output (``profile.folded`` — flamegraph-ready ``stack count``
+    lines — and ``profile.jsonl``) through the run's BackgroundWriter,
+    so profile I/O obeys the same ordered-host-job discipline as every
+    other sink.  The whole plane is host-side: ``--no-profile`` never
+    builds it and results are bitwise-identical either way — the
+    ``--no-spans``/``--no-costs``/``--no-export`` A/B oracle family.
+  * :func:`utilization_from_pipeline` — per-chunk device-busy /
+    host-blocked / idle fractions derived from the OverlapMeter's
+    attribution row (the ``soup_utilization_*`` gauges): device-busy is
+    the device-wait share of the chunk wall (a lower bound on device
+    busyness — the host can only observe its own blocking), host-blocked
+    is the host-I/O share NOT hidden behind device compute, and idle is
+    the remainder.  Rendered in ``watch``/``report`` and exported as a
+    Perfetto counter track by ``fleet.perfetto_trace``.
+  * :class:`AnomalyCapture` — the black box: hooked on the AlertEngine's
+    FIRING edge (rules latch, so one storm = one capture), it atomically
+    publishes a bounded ``anomaly/<rule>-<seq>/`` bundle — the sample
+    ring's last seconds, a full thread dump (every live thread's current
+    stack + registry accounting), a cumulative registry snapshot, the
+    recent request exemplars, and an armed ``jax.profiler`` device trace
+    on accelerator backends — with FIFO retention (oldest bundle evicted
+    past ``max_bundles``).  ``report --profile <run_dir>`` renders top
+    stacks + utilization + the capture index.
+
+Daemon-ness of the sampler thread is deliberate (whitelisted in the
+thread-hygiene gate): it is a forensic observer of threads that may be
+wedged, owns no buffered I/O (flushes ride the run's writer), and a
+non-daemon spelling would hang interpreter exit on the very wedge the
+profiler exists to explain.
+
+Deliberately NOT captured: population arrays (the watchdog's triage
+bundles own state snapshots), per-sample timestamps finer than the tick,
+and anything requiring a device round-trip — a capture must cost
+milliseconds even when the device is the thing that is broken.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Dict, List, Optional
+
+#: run-dir artifact names (cumulative, atomically rewritten per flush)
+PROFILE_FOLDED_NAME = "profile.folded"
+PROFILE_JSONL_NAME = "profile.jsonl"
+#: bundle subdirectory under the run dir
+ANOMALY_DIR = "anomaly"
+
+
+def _frame_token(frame) -> str:
+    """One fold-stable frame label: ``file.func`` (no line numbers —
+    they churn the bounded tables; the thread dump keeps them)."""
+    code = frame.f_code
+    base = os.path.basename(code.co_filename)
+    if base.endswith(".py"):
+        base = base[:-3]
+    return f"{base}.{code.co_name}"
+
+
+def _fold_stack(frame, max_depth: int) -> str:
+    """Root-first ``;``-joined folded stack of one frame chain, deeper
+    chains truncated root-side (the leaf frames are the interesting
+    half) behind a ``...`` marker."""
+    tokens: List[str] = []
+    while frame is not None:
+        tokens.append(_frame_token(frame))
+        frame = frame.f_back
+    tokens.reverse()  # root first, flamegraph convention
+    if len(tokens) > max_depth:
+        tokens = ["..."] + tokens[-max_depth:]
+    return ";".join(tokens)
+
+
+def _raw_stack(frame) -> List[str]:
+    """Leaf-first frame list WITH file:line — the thread-dump view."""
+    out: List[str] = []
+    while frame is not None:
+        code = frame.f_code
+        out.append(f"{code.co_name} "
+                   f"({os.path.basename(code.co_filename)}:"
+                   f"{frame.f_lineno})")
+        frame = frame.f_back
+    return out
+
+
+def thread_dump() -> Dict[str, Any]:
+    """Full point-in-time dump of every live thread: current stack
+    (leaf-first, with file:line), daemon-ness, and whether the thread is
+    accounted for in the ``spawn_thread`` join-on-exit registry.  Pure
+    host reads — callable even mid-wedge, from any thread."""
+    from ..utils.pipeline import live_threads
+
+    registered = {id(t) for t in live_threads()}
+    frames = sys._current_frames()
+    threads = []
+    for t in threading.enumerate():
+        threads.append({
+            "name": t.name,
+            "ident": t.ident,
+            "daemon": t.daemon,
+            "alive": t.is_alive(),
+            "registered": id(t) in registered,
+            "stack": _raw_stack(frames.get(t.ident)),
+        })
+    return {"t": round(time.time(), 3), "n_threads": len(threads),
+            "threads": sorted(threads, key=lambda d: d["name"])}
+
+
+class SamplingProfiler:
+    """The always-on host sampler.
+
+    >>> prof = SamplingProfiler(hz=50.0, ring_s=30.0)
+    >>> prof.start()
+    >>> ...                      # run; tables fold in the background
+    >>> prof.flush(run_dir, writer)   # cumulative folded output
+    >>> prof.stop()
+
+    Bounds: each thread's fold table holds at most ``max_stacks``
+    distinct stacks — overflow folds into an ``<overflow>`` bucket and
+    counts ``stacks_dropped`` (the profile degrades to a coarser view,
+    never grows without bound).  The raw-sample ring holds
+    ``hz * ring_s`` ticks (one row per tick, all threads folded in).
+    """
+
+    #: the sampler never profiles itself or other srnn observer threads
+    #: whose steady-state is a timed wait (pure noise in the tables)
+    THREAD_NAME = "srnn-profiler"
+
+    def __init__(self, hz: float = 50.0, ring_s: float = 30.0,
+                 max_stacks: int = 512, max_depth: int = 48):
+        self.hz = max(1.0, float(hz))
+        self.ring_s = max(1.0, float(ring_s))
+        self.max_stacks = max(16, int(max_stacks))
+        self.max_depth = max(4, int(max_depth))
+        self._interval = 1.0 / self.hz
+        self._lock = threading.Lock()
+        self._tables: Dict[str, Counter] = {}
+        self._ring: "deque[dict]" = deque(
+            maxlen=max(1, int(self.hz * self.ring_s)))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = time.time()
+        self.samples = 0          # ticks taken
+        self.overruns = 0         # ticks that missed their deadline
+        self.stacks_dropped = 0   # folds past the per-thread bound
+        # counter-delta bookkeeping: update_gauges advances the registry
+        # counters by delta so repeated folds stay monotone
+        self._counted = {"samples": 0, "overruns": 0, "stacks_dropped": 0}
+
+    # -- the sampling loop ------------------------------------------------
+
+    def start(self) -> "SamplingProfiler":
+        """Spawn the sampler daemon thread (idempotent)."""
+        if self._thread is not None:
+            return self
+        from ..utils.pipeline import spawn_thread
+
+        # daemon by design: this thread observes threads that may be
+        # wedged and owns no buffered I/O — see the module docstring and
+        # the thread-hygiene whitelist entry
+        self._thread = spawn_thread(self._run, name=self.THREAD_NAME,
+                                    daemon=True)
+        return self
+
+    def _run(self) -> None:
+        own = threading.get_ident()
+        next_tick = time.perf_counter()
+        while not self._stop.is_set():
+            next_tick += self._interval
+            self._sample_once(own)
+            delay = next_tick - time.perf_counter()
+            if delay <= 0:
+                # the tick overran its budget (a long frame walk under a
+                # contended GIL); resynchronize instead of spiraling
+                with self._lock:
+                    self.overruns += 1
+                next_tick = time.perf_counter()
+                continue
+            self._stop.wait(delay)
+
+    def _sample_once(self, own_ident: int) -> None:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        frames = sys._current_frames()
+        stacks: Dict[str, str] = {}
+        for ident, frame in frames.items():
+            if ident == own_ident:
+                continue
+            name = names.get(ident, f"thread-{ident}")
+            stacks[name] = _fold_stack(frame, self.max_depth)
+        row = {"t": round(time.time(), 4), "stacks": stacks}
+        with self._lock:
+            self.samples += 1
+            self._ring.append(row)
+            for name, folded in stacks.items():
+                table = self._tables.setdefault(name, Counter())
+                if folded not in table and len(table) >= self.max_stacks:
+                    self.stacks_dropped += 1
+                    table["<overflow>"] += 1
+                else:
+                    table[folded] += 1
+
+    def stop(self) -> None:
+        """Stop sampling and join the sampler thread (idempotent; the
+        join is bounded — a daemon observer must never block teardown)."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=max(1.0, 4 * self._interval))
+
+    def __enter__(self) -> "SamplingProfiler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- reads ------------------------------------------------------------
+
+    def tables(self) -> Dict[str, Dict[str, int]]:
+        """Per-thread folded-stack counts (copies, safe to mutate)."""
+        with self._lock:
+            return {name: dict(c) for name, c in self._tables.items()}
+
+    def ring_tail(self, seconds: Optional[float] = None) -> List[dict]:
+        """Raw tick rows of the last ``seconds`` (default: the whole
+        ring), oldest first."""
+        with self._lock:
+            rows = list(self._ring)
+        if seconds is None:
+            return rows
+        cutoff = time.time() - max(0.0, float(seconds))
+        return [r for r in rows if r["t"] >= cutoff]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "hz": self.hz,
+                "uptime_s": round(time.time() - self._t0, 3),
+                "samples": self.samples,
+                "overruns": self.overruns,
+                "stacks_dropped": self.stacks_dropped,
+                "threads": len(self._tables),
+                "stacks": sum(len(c) for c in self._tables.values()),
+                "ring_rows": len(self._ring),
+            }
+
+    # -- metrics + flushes ------------------------------------------------
+
+    def update_gauges(self, registry) -> None:
+        """Fold the sampler's own accounting into a run registry (the
+        ``soup_profile_*`` family).  Counters advance by delta so
+        repeated folds stay monotone; the counters are registered
+        eagerly (inc 0) so a quiet profiler still exposes the family."""
+        s = self.stats()
+        for key, name, help_ in (
+                ("samples", "soup_profile_samples_total",
+                 "profiler stack-sample ticks taken"),
+                ("overruns", "soup_profile_overruns_total",
+                 "sampler ticks that missed their deadline"),
+                ("stacks_dropped", "soup_profile_stacks_dropped_total",
+                 "stack folds past the bounded per-thread table")):
+            delta = s[key] - self._counted[key]
+            self._counted[key] = s[key]
+            registry.counter(name, help=help_).inc(max(0, delta))
+        registry.gauge("soup_profile_threads",
+                       help="threads with folded-stack tables").set(
+                           s["threads"])
+        registry.gauge("soup_profile_stacks",
+                       help="distinct folded stacks tracked").set(
+                           s["stacks"])
+
+    def folded_lines(self) -> List[str]:
+        """The flamegraph exchange format: ``thread;frame;... count``."""
+        lines = []
+        for name, table in sorted(self.tables().items()):
+            for folded, n in sorted(table.items(),
+                                    key=lambda kv: (-kv[1], kv[0])):
+                lines.append(f"{name};{folded} {n}")
+        return lines
+
+    def write_files(self, run_dir: str) -> None:
+        """Atomically (re)write the cumulative profile artifacts — the
+        job :meth:`flush` routes through the run's writer."""
+        from ..utils.atomicio import atomic_write_text
+
+        atomic_write_text(os.path.join(run_dir, PROFILE_FOLDED_NAME),
+                          "\n".join(self.folded_lines()) + "\n")
+        rows = [json.dumps({"kind": "profile_meta", **self.stats()})]
+        for name, table in sorted(self.tables().items()):
+            for folded, n in sorted(table.items(),
+                                    key=lambda kv: (-kv[1], kv[0])):
+                rows.append(json.dumps(
+                    {"thread": name, "stack": folded, "count": n}))
+        atomic_write_text(os.path.join(run_dir, PROFILE_JSONL_NAME),
+                          "\n".join(rows) + "\n")
+
+    def flush(self, run_dir: str, writer=None, registry=None) -> None:
+        """One flush turn: fold the profiler gauges (inline — registry
+        mutations are lock-per-metric) and ride the artifact rewrite on
+        the run's writer in submission order."""
+        from ..utils.pipeline import submit_or_run
+
+        if registry is not None:
+            self.update_gauges(registry)
+        submit_or_run(writer, self.write_files, run_dir)
+
+
+# ---------------------------------------------------------------------------
+# utilization decomposition
+# ---------------------------------------------------------------------------
+
+
+def utilization_from_pipeline(row: Dict[str, Any]) -> Dict[str, float]:
+    """Device-busy / host-blocked / idle fractions of one chunk, from
+    the OverlapMeter attribution row (``wall_s``/``device_wait_s``/
+    ``host_io_s``).
+
+    Formula (documented in DESIGN §25): ``device_busy`` is the
+    device-wait share of the wall — the host-observable LOWER bound on
+    device busyness; ``host_blocked`` is the host-I/O share that could
+    NOT have been hidden behind device compute
+    (``min(host_io, wall - device_wait) / wall``); ``idle`` is the
+    remainder — an upper bound on true device idleness.  All three sum
+    to 1 (clamped)."""
+    wall = float(row.get("wall_s", 0.0) or 0.0)
+    if wall <= 0:
+        return {"device_busy": 0.0, "host_blocked": 0.0, "idle": 0.0}
+    wait = max(0.0, float(row.get("device_wait_s", 0.0) or 0.0))
+    io = max(0.0, float(row.get("host_io_s", 0.0) or 0.0))
+    busy = min(1.0, wait / wall)
+    blocked = min(min(io, max(0.0, wall - wait)) / wall, 1.0 - busy)
+    idle = max(0.0, 1.0 - busy - blocked)
+    return {"device_busy": round(busy, 4),
+            "host_blocked": round(blocked, 4),
+            "idle": round(idle, 4)}
+
+
+def update_utilization_gauges(registry,
+                              pipeline_row: Dict[str, Any]
+                              ) -> Dict[str, float]:
+    """Export one chunk's utilization decomposition as the
+    ``soup_utilization_*`` gauges (unlabeled — a run dir is one stage)
+    and return the fractions (the chunk row / Perfetto counter-track
+    source)."""
+    u = utilization_from_pipeline(pipeline_row)
+    g = registry.gauge
+    g("soup_utilization_device_busy",
+      help="device-busy fraction of the last chunk (host-observed "
+           "lower bound: device-wait share of wall)").set(
+          u["device_busy"])
+    g("soup_utilization_host_blocked",
+      help="host-blocked fraction of the last chunk (host I/O not "
+           "hidden behind device compute)").set(u["host_blocked"])
+    g("soup_utilization_idle",
+      help="idle fraction of the last chunk (upper bound on device "
+           "idleness: 1 - busy - blocked)").set(u["idle"])
+    return u
+
+
+# ---------------------------------------------------------------------------
+# anomaly-triggered capture
+# ---------------------------------------------------------------------------
+
+
+class AnomalyCapture:
+    """Black-box capture on the alert engine's firing edge.
+
+    Hooked wherever transitions surface (``LivePlane.sample``'s writer
+    job in the mega loops, ``ExperimentService._sample_live`` in the
+    serve tier): each ``state == "firing"`` transition publishes one
+    bounded bundle under ``<run_dir>/anomaly/<rule>-<seq>/``:
+
+    * ``capture.json`` — the transition, profiler stats, backend
+      metadata (always lands; everything else is best-effort with
+      errors recorded here).
+    * ``samples.jsonl`` — the profiler ring's last ``ring_s`` seconds.
+    * ``threads.json`` — :func:`thread_dump` at the edge.
+    * ``metrics.json`` — cumulative registry snapshot.
+    * ``exemplars.jsonl`` — copy of the run's recent request exemplars.
+    * ``trace/`` — an armed ``jax.profiler`` device trace on
+      accelerator backends, covering roughly the interval to the NEXT
+      sample turn (:meth:`turn` closes it, the watchdog's window
+      discipline).
+
+    Publication is atomic: the bundle is assembled in a dot-tmp sibling
+    and ``os.rename``d into place, so a concurrent ``report --profile``
+    never reads a half-written bundle.  Retention is FIFO: past
+    ``max_bundles`` the oldest bundle is evicted (an alert storm tells
+    its story in N bundles, not N thousand).  Fail-soft throughout —
+    capture must never take down the run it is explaining."""
+
+    def __init__(self, run_dir: str, profiler: Optional[SamplingProfiler]
+                 = None, registry=None, max_bundles: int = 4,
+                 ring_s: float = 30.0, device_trace: bool = True):
+        self.run_dir = run_dir
+        self.profiler = profiler
+        self.registry = registry
+        self.max_bundles = max(1, int(max_bundles))
+        self.ring_s = float(ring_s)
+        self.device_trace = bool(device_trace)
+        self.captures: List[str] = []
+        self.errors = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._trace_active = False
+
+    # -- the hook ---------------------------------------------------------
+
+    def on_transitions(self, transitions: List[dict], **context) -> None:
+        """One sample turn's worth of alert transitions: close any trace
+        window armed by the previous firing edge, then capture each new
+        firing edge (rules latch upstream, so a sustained condition
+        captures exactly once)."""
+        self.turn()
+        for t in transitions or []:
+            if t.get("state") == "firing":
+                try:
+                    self.capture(t, **context)
+                except Exception as e:  # forensic, never load-bearing
+                    self.errors += 1
+                    print(f"anomaly capture failed for "
+                          f"{t.get('rule')}: {type(e).__name__}: {e}",
+                          file=sys.stderr, flush=True)
+
+    def capture(self, transition: dict, **context) -> str:
+        """Publish one bundle for a firing transition; returns its path."""
+        rule = str(transition.get("rule", "anomaly")).replace("/", "_")
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        root = os.path.join(self.run_dir, ANOMALY_DIR)
+        os.makedirs(root, exist_ok=True)
+        final = os.path.join(root, f"{rule}-{seq:04d}")
+        while os.path.exists(final):  # a restarted attempt resumes seq
+            with self._lock:
+                seq = self._seq
+                self._seq += 1
+            final = os.path.join(root, f"{rule}-{seq:04d}")
+        tmp = os.path.join(root, f".tmp-{rule}-{seq:04d}-{os.getpid()}")
+        os.makedirs(tmp)
+
+        doc: Dict[str, Any] = {
+            "rule": rule,
+            "seq": seq,
+            "time": round(time.time(), 3),
+            "transition": dict(transition),
+            "context": {k: v for k, v in context.items() if v is not None},
+            "ring_s": self.ring_s,
+        }
+        errors: Dict[str, str] = {}
+        if self.profiler is not None:
+            doc["profiler"] = self.profiler.stats()
+            try:
+                with open(os.path.join(tmp, "samples.jsonl"), "w") as f:
+                    for row in self.profiler.ring_tail(self.ring_s):
+                        f.write(json.dumps(row) + "\n")
+            except OSError as e:
+                errors["samples"] = str(e)
+        try:
+            with open(os.path.join(tmp, "threads.json"), "w") as f:
+                json.dump(thread_dump(), f, indent=1)
+        except Exception as e:
+            errors["threads"] = f"{type(e).__name__}: {e}"
+        if self.registry is not None:
+            try:
+                with open(os.path.join(tmp, "metrics.json"), "w") as f:
+                    json.dump(self.registry.rows(), f, indent=1,
+                              sort_keys=True)
+            except Exception as e:
+                errors["metrics"] = f"{type(e).__name__}: {e}"
+        from .exemplars import EXEMPLARS_NAME
+
+        ex_src = os.path.join(self.run_dir, EXEMPLARS_NAME)
+        if os.path.exists(ex_src):
+            try:
+                shutil.copy(ex_src, os.path.join(tmp, EXEMPLARS_NAME))
+            except OSError as e:
+                errors["exemplars"] = str(e)
+        from .flightrec import _backend_metadata
+
+        doc["backend"] = _backend_metadata()
+        if errors:
+            doc["errors"] = errors
+        with open(os.path.join(tmp, "capture.json"), "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.rename(tmp, final)  # atomic publish
+
+        self.captures.append(final)
+        if self.registry is not None:
+            self.registry.counter(
+                "soup_anomaly_captures_total",
+                help="anomaly bundles captured on alert firing "
+                     "edges").inc(1, rule=rule)
+        self._arm_trace(os.path.join(final, "trace"),
+                        doc["backend"].get("backend"))
+        self._retain(root)
+        return final
+
+    def _retain(self, root: str) -> None:
+        """FIFO eviction past the bundle bound (oldest by mtime)."""
+        try:
+            dirs = [os.path.join(root, d) for d in os.listdir(root)
+                    if not d.startswith(".")
+                    and os.path.isdir(os.path.join(root, d))]
+        except OSError:
+            return
+        if len(dirs) <= self.max_bundles:
+            return
+        dirs.sort(key=lambda p: os.path.getmtime(p))
+        for victim in dirs[:len(dirs) - self.max_bundles]:
+            try:
+                shutil.rmtree(victim)
+            except OSError:
+                pass
+
+    # -- the armed device-trace window ------------------------------------
+
+    def _arm_trace(self, path: str, backend: Optional[str]) -> None:
+        """Arm a ``jax.profiler`` trace into the bundle on accelerator
+        backends (a CPU trace is all host anyway — the sampler already
+        has that).  One window at a time; :meth:`turn` closes it."""
+        if not self.device_trace or self._trace_active:
+            return
+        if backend in (None, "cpu"):
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(path)
+            self._trace_active = True
+        except Exception:
+            pass  # a broken profiler must never break the run
+
+    def turn(self) -> None:
+        """Close a trace window armed by the previous firing edge (the
+        sample cadence bounds the window — the watchdog's
+        ``chunk_boundary`` discipline)."""
+        self.stop_trace()
+
+    def stop_trace(self) -> None:
+        if not self._trace_active:
+            return
+        self._trace_active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        """Teardown: close any armed trace window (idempotent)."""
+        self.stop_trace()
+
+
+def capture_index(run_dir: str) -> List[Dict[str, Any]]:
+    """The run's published anomaly bundles, oldest first: bundle name,
+    rule/seq/time from capture.json, and which artifacts landed.  Used
+    by ``report --profile`` and the archive ingester (presence joins the
+    run summary row).  Dot-tmp assembly dirs are invisible by
+    construction."""
+    root = os.path.join(run_dir, ANOMALY_DIR)
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        if name.startswith(".") or not os.path.isdir(path):
+            continue
+        entry: Dict[str, Any] = {"name": name, "path": os.path.abspath(path)}
+        try:
+            with open(os.path.join(path, "capture.json")) as f:
+                doc = json.load(f)
+            entry.update({k: doc.get(k) for k in
+                          ("rule", "seq", "time", "context")})
+            entry["profiler"] = doc.get("profiler")
+        except (OSError, json.JSONDecodeError):
+            entry["unreadable"] = True
+        for artifact in ("samples.jsonl", "threads.json", "metrics.json",
+                         "exemplars.jsonl"):
+            entry[artifact.split(".")[0]] = os.path.exists(
+                os.path.join(path, artifact))
+        trace_dir = os.path.join(path, "trace")
+        entry["trace"] = os.path.isdir(trace_dir) \
+            and any(os.scandir(trace_dir))
+        out.append(entry)
+    return sorted(out, key=lambda e: (e.get("time") or 0, e["name"]))

@@ -6,6 +6,7 @@
     python -m srnn_tpu.telemetry.report --trace-request <ticket> <run_dir>
     python -m srnn_tpu.telemetry.report --triage <bundle_dir> [--json]
     python -m srnn_tpu.telemetry.report --dynamics <run_dir> [--json]
+    python -m srnn_tpu.telemetry.report --profile <run_dir> [--json]
     python -m srnn_tpu.telemetry.report <results_root> --runs [--json]
     python -m srnn_tpu.telemetry.report --compare <run_a> <run_b> [--json]
 
@@ -44,6 +45,11 @@ shapes/dtypes, and a pointer to the captured profiler trace.
 (``telemetry.genealogy`` over ``lineage.jsonl``): the dominant-lineage
 table, clone-survival stats, attack/imitation graph stats, the basin
 transition matrix and the fixpoint census trajectory.
+
+``--profile`` renders the continuous-profiling plane (``telemetry.
+profiler``): the sampler's meta row, the top folded stacks per thread,
+the last chunk's device-busy / host-blocked / idle decomposition, and
+the index of anomaly-capture bundles with what each one holds.
 
 ``--runs`` flips the positional to a RESULTS ROOT and renders the
 cross-run observatory (``telemetry.archive``): an incremental ingest of
@@ -417,6 +423,125 @@ def summarize_triage(bundle_dir: str) -> dict:
     }
 
 
+def summarize_profile(run_dir: str) -> dict:
+    """Machine-readable summary of a run's continuous-profiling plane
+    (the ``--profile --json`` output): the sampler's meta row, the
+    top folded stacks per thread (from ``profile.folded``), the last
+    chunk's utilization decomposition (from ``metrics.prom``), and the
+    anomaly-capture index."""
+    from .profiler import (PROFILE_FOLDED_NAME, PROFILE_JSONL_NAME,
+                           capture_index)
+
+    meta = None
+    jsonl_path = os.path.join(run_dir, PROFILE_JSONL_NAME)
+    if os.path.exists(jsonl_path):
+        try:
+            with open(jsonl_path) as f:
+                first = json.loads(f.readline())
+            if first.get("kind") == "profile_meta":
+                meta = {k: v for k, v in first.items() if k != "kind"}
+        except (OSError, json.JSONDecodeError, ValueError):
+            pass
+    # per-thread top stacks from the folded exchange format
+    # (``thread;frame;... count``); totals normalize the percentages
+    by_thread: Dict[str, List[tuple]] = {}
+    totals: Dict[str, int] = {}
+    folded_path = os.path.join(run_dir, PROFILE_FOLDED_NAME)
+    if os.path.exists(folded_path):
+        try:
+            with open(folded_path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if not line or " " not in line:
+                        continue
+                    stack, _, count = line.rpartition(" ")
+                    thread, _, frames = stack.partition(";")
+                    try:
+                        n = int(count)
+                    except ValueError:
+                        continue
+                    totals[thread] = totals.get(thread, 0) + n
+                    by_thread.setdefault(thread, []).append((frames, n))
+        except OSError:
+            pass
+    top_stacks = {}
+    for thread, stacks in sorted(by_thread.items()):
+        stacks.sort(key=lambda sn: (-sn[1], sn[0]))
+        total = totals[thread] or 1
+        top_stacks[thread] = [
+            {"stack": frames, "count": n,
+             "share": round(n / total, 4)}
+            for frames, n in stacks[:5]]
+    utilization = {}
+    prom_path = os.path.join(run_dir, "metrics.prom")
+    if os.path.exists(prom_path):
+        try:
+            with open(prom_path) as f:
+                for line in f:
+                    if not line.startswith("srnn_soup_utilization_"):
+                        continue
+                    name, _, value = line.strip().rpartition(" ")
+                    try:
+                        utilization[name[len("srnn_soup_utilization_"):]] \
+                            = float(value)
+                    except ValueError:
+                        pass
+        except OSError:
+            pass
+    captures = capture_index(run_dir)
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "meta": meta,
+        "samples_by_thread": totals,
+        "top_stacks": top_stacks,
+        "utilization": utilization or None,
+        "captures": captures,
+        # the no-data contract's flag: a run that never profiled (or a
+        # --no-profile run) has no folded tables AND no capture bundles
+        "no_data": meta is None and not top_stacks and not captures,
+    }
+
+
+def _render_profile(s: dict, out) -> None:
+    w = out.write
+    w(f"profile: {s['run_dir']}\n")
+    meta = s.get("meta")
+    if meta:
+        w(f"  sampler: {meta.get('hz')}Hz, {meta.get('samples')} samples "
+          f"over {meta.get('uptime_s')}s, {meta.get('threads')} threads, "
+          f"{meta.get('stacks')} stacks "
+          f"({meta.get('overruns')} overruns, "
+          f"{meta.get('stacks_dropped')} dropped)\n")
+    util = s.get("utilization")
+    if util:
+        cells = "  ".join(f"{k}={100 * v:.1f}%"
+                          for k, v in sorted(util.items()))
+        w(f"utilization (last chunk): {cells}\n")
+    if s["top_stacks"]:
+        w("top stacks:\n")
+        for thread, stacks in s["top_stacks"].items():
+            w(f"  {thread} ({s['samples_by_thread'].get(thread, 0)} "
+              "samples):\n")
+            for st in stacks:
+                # leaf-most frames are the story; keep the tail
+                frames = st["stack"].split(";")
+                shown = ";".join(frames[-3:])
+                if len(frames) > 3:
+                    shown = "...;" + shown
+                w(f"    {100 * st['share']:5.1f}%  {shown}\n")
+    caps = s.get("captures") or []
+    if caps:
+        w(f"anomaly captures ({len(caps)}, oldest first):\n")
+        for c in caps:
+            have = [k for k in ("samples", "threads", "metrics",
+                                "exemplars", "trace") if c.get(k)]
+            w(f"  {c['name']}: " + (", ".join(have) or "capture.json only")
+              + "\n")
+    else:
+        w("anomaly captures: none (no alert fired, or captures "
+          "evicted)\n")
+
+
 def _fmt_frac(v) -> str:
     return f"{v:.4f}" if isinstance(v, (int, float)) else "-"
 
@@ -609,6 +734,12 @@ def main(argv=None) -> int:
     p.add_argument("--dynamics", action="store_true",
                    help="render the run's replication-dynamics trail "
                         "(lineage.jsonl via telemetry.genealogy)")
+    p.add_argument("--profile", action="store_true",
+                   help="render the run's continuous-profiling plane: "
+                        "sampler meta, top folded stacks per thread, "
+                        "the last chunk's utilization decomposition and "
+                        "the anomaly-capture index "
+                        "(telemetry.profiler)")
     p.add_argument("--runs", action="store_true",
                    help="treat the positional as a RESULTS ROOT and "
                         "render the cross-run observatory: run table + "
@@ -731,6 +862,25 @@ def main(argv=None) -> int:
             print(json.dumps(s, indent=1, default=str))
         else:
             _render_triage(s, sys.stdout)
+        return 0
+    if args.profile:
+        s = summarize_profile(args.run_dir)
+        if s["no_data"]:
+            # the no-data contract: a --no-profile run (or a run dir
+            # that never profiled) must never render an empty-but-valid
+            # profile an operator would misread as "nothing was hot"
+            if args.json:
+                print(json.dumps(s, indent=1, default=str))
+            else:
+                print(f"report: {args.run_dir}: no profiling data — no "
+                      "profile.folded/profile.jsonl and no anomaly "
+                      "bundles (run without --no-profile)",
+                      file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(s, indent=1, default=str))
+        else:
+            _render_profile(s, sys.stdout)
         return 0
     if args.dynamics:
         from .genealogy import summarize_dynamics

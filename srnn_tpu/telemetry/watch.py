@@ -55,6 +55,7 @@ import time
 from .fleet import event_paths, fleet_summary, load_rows
 
 _HEALTH_PREFIX = "srnn_soup_health_"
+_UTIL_PREFIX = "srnn_soup_utilization_"
 
 #: the health/alert scan only needs the LAST rows, which sit within a
 #: handful of rows of the file's end — a bounded tail read keeps the
@@ -128,13 +129,22 @@ def snapshot(run_dir: str) -> dict:
     rows, _bad = load_rows(os.path.join(run_dir, "events.jsonl"), 0,
                            tail_bytes=_HEALTH_TAIL_BYTES)
     s["health"] = None
+    s["utilization"] = None
     for row in reversed(rows):
         if row.get("kind") == "metrics":
+            metrics = row.get("metrics") or {}
             health = {k[len(_HEALTH_PREFIX):]: v
-                      for k, v in (row.get("metrics") or {}).items()
+                      for k, v in metrics.items()
                       if k.startswith(_HEALTH_PREFIX)}
             if health:
                 s["health"] = health
+            # the profiling plane's per-chunk decomposition (PR 20):
+            # device-busy / host-blocked / idle fractions of the last
+            # flushed chunk
+            util = {k[len(_UTIL_PREFIX):]: v for k, v in metrics.items()
+                    if k.startswith(_UTIL_PREFIX)}
+            if util:
+                s["utilization"] = util
             break
     # alert rows are primary-only (one alert stream per run) — full
     # line-filtered scan of events.jsonl, NOT the health tail above
@@ -159,6 +169,11 @@ def render(s: dict, out) -> None:
     if health:
         cells = "  ".join(f"{k}={v}" for k, v in sorted(health.items()))
         out.write(f"health: {cells}\n")
+    util = s.get("utilization")
+    if util:
+        cells = "  ".join(f"{k}={round(100 * v, 1)}%"
+                          for k, v in sorted(util.items()))
+        out.write(f"utilization: {cells}\n")
     render_alerts(s.get("alerts"), out)
     hist = s.get("history")
     if hist and hist.get("series"):

@@ -1,0 +1,403 @@
+"""Continuous profiling plane (PR 20): the sampling profiler's fold
+tables against a crafted busy thread, ring/table bounds, monotone
+counter deltas, the utilization decomposition formula, anomaly-capture
+fire-once + atomic publish + FIFO retention, the thread-dump schema,
+``report --profile``'s no-data contract (exit 2), the Perfetto
+utilization counter track, and the ``--no-profile`` bitwise A/B oracle
+on both mega loops."""
+
+import json
+import os
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from srnn_tpu.setups import REGISTRY
+from srnn_tpu.telemetry.metrics import MetricsRegistry
+from srnn_tpu.telemetry.profiler import (AnomalyCapture, SamplingProfiler,
+                                         capture_index, thread_dump,
+                                         utilization_from_pipeline,
+                                         update_utilization_gauges)
+from srnn_tpu.utils.pipeline import spawn_thread
+
+
+# ---------------------------------------------------------------------------
+# the sampler: fold correctness, bounds, monotone gauges
+# ---------------------------------------------------------------------------
+
+
+def _busy_spin(stop):
+    """A distinctively named hot loop the sampler must attribute."""
+    while not stop.is_set():
+        sum(i * i for i in range(200))
+
+
+def test_sampler_folds_busy_thread():
+    """A thread spinning in ``_busy_spin`` dominates its fold table, and
+    the folded token is the fold-stable ``file.func`` form (no line
+    numbers)."""
+    stop = threading.Event()
+    t = spawn_thread(_busy_spin, name="busy-test", args=(stop,))
+    prof = SamplingProfiler(hz=200.0, ring_s=2.0)
+    try:
+        with prof:
+            time.sleep(0.4)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    tables = prof.tables()
+    assert "busy-test" in tables
+    folded, n = max(tables["busy-test"].items(), key=lambda kv: kv[1])
+    assert n >= 1
+    assert "test_profiler._busy_spin" in folded
+    assert ";" in folded          # root-first chain, not a single frame
+    assert ":" not in folded      # no file:line churn in the fold key
+    # the sampler never profiles itself
+    assert SamplingProfiler.THREAD_NAME not in tables
+    s = prof.stats()
+    assert s["samples"] >= 10 and s["threads"] >= 1
+    # stop() is idempotent and bounded
+    prof.stop()
+
+
+def test_sampler_ring_and_table_bounds():
+    """The raw-sample ring holds exactly ``hz * ring_s`` ticks, and a
+    fold table past ``max_stacks`` degrades into ``<overflow>`` instead
+    of growing without bound."""
+    prof = SamplingProfiler(hz=10.0, ring_s=1.0, max_stacks=16)
+    # drive ticks synchronously — no sampler thread, no timing in play
+    for _ in range(50):
+        prof._sample_once(own_ident=-1)
+    assert prof.samples == 50
+    ring = prof.ring_tail()
+    assert len(ring) == 10        # maxlen = int(10 * 1.0)
+    assert all(set(r) == {"t", "stacks"} for r in ring)
+    # prefill one thread's table to the bound: the next real fold drops
+    name = threading.current_thread().name
+    prof._tables[name] = Counter({f"synthetic;s{i}": 1 for i in range(16)})
+    prof._sample_once(own_ident=-1)
+    assert prof.stacks_dropped >= 1
+    assert prof._tables[name]["<overflow>"] >= 1
+    assert len(prof._tables[name]) == 17   # 16 distinct + <overflow>
+
+
+def test_update_gauges_counters_advance_by_delta():
+    """Repeated folds are monotone: two flushes of the same sampler
+    state leave the counters at the true totals, not doubled."""
+    prof = SamplingProfiler(hz=50.0, ring_s=1.0)
+    for _ in range(7):
+        prof._sample_once(own_ident=-1)
+    reg = MetricsRegistry()
+    prof.update_gauges(reg)
+    prof.update_gauges(reg)       # second fold with no new ticks
+    rows = reg.rows()
+    assert rows["srnn_soup_profile_samples_total"] == 7
+    assert rows["srnn_soup_profile_overruns_total"] == 0
+    assert rows["srnn_soup_profile_stacks_dropped_total"] == 0
+    assert rows["srnn_soup_profile_threads"] >= 1
+    prof._sample_once(own_ident=-1)
+    prof.update_gauges(reg)
+    assert reg.rows()["srnn_soup_profile_samples_total"] == 8
+
+
+def test_write_files_artifacts(tmp_path):
+    """``write_files`` lands the folded exchange format and a jsonl
+    stream whose first row is the meta row."""
+    prof = SamplingProfiler(hz=50.0, ring_s=1.0)
+    for _ in range(5):
+        prof._sample_once(own_ident=-1)
+    prof.write_files(str(tmp_path))
+    folded = (tmp_path / "profile.folded").read_text().splitlines()
+    assert folded
+    for line in folded:
+        stack, _, count = line.rpartition(" ")
+        assert int(count) >= 1 and ";" in stack
+    rows = [json.loads(x) for x in
+            (tmp_path / "profile.jsonl").read_text().splitlines()]
+    assert rows[0]["kind"] == "profile_meta" and rows[0]["samples"] == 5
+    assert all({"thread", "stack", "count"} <= set(r) for r in rows[1:])
+
+
+# ---------------------------------------------------------------------------
+# thread dump
+# ---------------------------------------------------------------------------
+
+
+def test_thread_dump_schema():
+    dump = thread_dump()
+    assert set(dump) == {"t", "n_threads", "threads"}
+    assert dump["n_threads"] == len(dump["threads"]) >= 1
+    by_name = {d["name"]: d for d in dump["threads"]}
+    me = by_name[threading.current_thread().name]
+    assert set(me) == {"name", "ident", "daemon", "alive", "registered",
+                       "stack"}
+    assert me["alive"] is True
+    # the dump keeps file:line (the fold tables deliberately do not)
+    assert any("test_profiler.py:" in fr for fr in me["stack"])
+    # sorted by name for a stable diffable artifact
+    names = [d["name"] for d in dump["threads"]]
+    assert names == sorted(names)
+
+
+# ---------------------------------------------------------------------------
+# utilization decomposition
+# ---------------------------------------------------------------------------
+
+
+def test_utilization_formula():
+    u = utilization_from_pipeline(
+        {"wall_s": 10.0, "device_wait_s": 4.0, "host_io_s": 3.0})
+    assert u == {"device_busy": 0.4, "host_blocked": 0.3, "idle": 0.3}
+    # host I/O hidden behind device compute never exceeds the gap
+    u = utilization_from_pipeline(
+        {"wall_s": 10.0, "device_wait_s": 8.0, "host_io_s": 5.0})
+    assert u == {"device_busy": 0.8, "host_blocked": 0.2, "idle": 0.0}
+    # degenerate chunks are all-zero, never NaN
+    assert utilization_from_pipeline({"wall_s": 0.0}) == \
+        {"device_busy": 0.0, "host_blocked": 0.0, "idle": 0.0}
+    # fractions clamp even when the meter over-reports
+    u = utilization_from_pipeline(
+        {"wall_s": 1.0, "device_wait_s": 5.0, "host_io_s": 5.0})
+    assert u["device_busy"] == 1.0 and u["host_blocked"] == 0.0
+    assert u["idle"] == 0.0
+
+
+def test_update_utilization_gauges():
+    reg = MetricsRegistry()
+    u = update_utilization_gauges(
+        reg, {"wall_s": 10.0, "device_wait_s": 4.0, "host_io_s": 3.0})
+    rows = reg.rows()
+    assert rows["srnn_soup_utilization_device_busy"] == u["device_busy"]
+    assert rows["srnn_soup_utilization_host_blocked"] == 0.3
+    assert rows["srnn_soup_utilization_idle"] == 0.3
+
+
+# ---------------------------------------------------------------------------
+# anomaly capture: fire-once, atomic publish, FIFO retention
+# ---------------------------------------------------------------------------
+
+
+def _firing(rule, value=1.0):
+    return {"rule": rule, "state": "firing", "value": value}
+
+
+def test_capture_bundle_contents_and_fire_once(tmp_path):
+    run = str(tmp_path)
+    (tmp_path / "exemplars.jsonl").write_text(
+        json.dumps({"kind": "exemplar", "lat_ms": 3.0}) + "\n")
+    prof = SamplingProfiler(hz=50.0, ring_s=5.0)
+    for _ in range(3):
+        prof._sample_once(own_ident=-1)
+    reg = MetricsRegistry()
+    reg.gauge("soup_nan_frac", help="n").set(0.5)
+    cap = AnomalyCapture(run, profiler=prof, registry=reg, max_bundles=4,
+                         ring_s=5.0, device_trace=False)
+    cap.on_transitions([_firing("soup_nan_frac")], generation=12)
+    # a sustained condition latches upstream: later turns carry no
+    # firing edge and must not re-capture
+    cap.on_transitions([])
+    cap.on_transitions([{"rule": "soup_nan_frac", "state": "cleared"}])
+    bundles = sorted(os.listdir(tmp_path / "anomaly"))
+    assert bundles == ["soup_nan_frac-0000"]   # no .tmp- residue either
+    bdir = tmp_path / "anomaly" / "soup_nan_frac-0000"
+    doc = json.loads((bdir / "capture.json").read_text())
+    assert doc["rule"] == "soup_nan_frac" and doc["seq"] == 0
+    assert doc["transition"]["state"] == "firing"
+    assert doc["context"] == {"generation": 12}
+    assert doc["profiler"]["samples"] == 3
+    assert "backend" in doc and "errors" not in doc
+    samples = [json.loads(x) for x in
+               (bdir / "samples.jsonl").read_text().splitlines()]
+    assert len(samples) == 3 and all("stacks" in r for r in samples)
+    threads = json.loads((bdir / "threads.json").read_text())
+    assert threads["n_threads"] >= 1
+    metrics = json.loads((bdir / "metrics.json").read_text())
+    assert metrics["srnn_soup_nan_frac"] == 0.5
+    assert (bdir / "exemplars.jsonl").exists()
+    assert reg.rows()[
+        'srnn_soup_anomaly_captures_total{rule="soup_nan_frac"}'] == 1
+
+    idx = capture_index(run)
+    assert [e["name"] for e in idx] == ["soup_nan_frac-0000"]
+    e = idx[0]
+    assert e["rule"] == "soup_nan_frac" and e["seq"] == 0
+    assert e["samples"] and e["threads"] and e["metrics"]
+    assert e["exemplars"] and not e["trace"]
+
+
+def test_capture_fifo_retention_and_seq_resume(tmp_path):
+    run = str(tmp_path)
+    cap = AnomalyCapture(run, max_bundles=2, device_trace=False)
+    stamp = time.time() - 100
+    for i, rule in enumerate(["a", "b", "c", "d"]):
+        path = cap.capture(_firing(rule))
+        # deterministic FIFO ordering regardless of fs mtime resolution
+        os.utime(path, (stamp + i, stamp + i))
+    names = sorted(os.listdir(tmp_path / "anomaly"))
+    assert names == ["c-0002", "d-0003"]      # oldest two evicted
+    # a restarted attempt never clobbers a published bundle: a fresh
+    # capturer's seq bumps past any name collision
+    cap2 = AnomalyCapture(run, max_bundles=4, device_trace=False)
+    os.makedirs(tmp_path / "anomaly" / "d-0000")
+    cap2.capture(_firing("d"))
+    assert "d-0001" in os.listdir(tmp_path / "anomaly")
+    assert not os.listdir(tmp_path / "anomaly" / "d-0000")   # untouched
+
+
+def test_capture_is_fail_soft(tmp_path, capsys):
+    """A broken capture must never take down the run: the hook eats the
+    exception, counts it, and says so on stderr."""
+    cap = AnomalyCapture(str(tmp_path / "missing" / "x" / "y"),
+                         device_trace=False)
+    cap.run_dir = "\0invalid"      # force an OSError inside capture()
+    cap.on_transitions([_firing("soup_nan_frac")])
+    assert cap.errors == 1 and cap.captures == []
+    assert "anomaly capture failed" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# report --profile: render + the no-data contract
+# ---------------------------------------------------------------------------
+
+
+def test_report_profile_renders(tmp_path, capsys):
+    from srnn_tpu.telemetry import report
+
+    prof = SamplingProfiler(hz=50.0, ring_s=1.0)
+    for _ in range(4):
+        prof._sample_once(own_ident=-1)
+    prof.write_files(str(tmp_path))
+    reg = MetricsRegistry()
+    update_utilization_gauges(
+        reg, {"wall_s": 10.0, "device_wait_s": 4.0, "host_io_s": 3.0})
+    reg.write_textfile(str(tmp_path / "metrics.prom"))
+    AnomalyCapture(str(tmp_path), profiler=prof,
+                   device_trace=False).capture(_firing("soup_nan_frac"))
+
+    s = report.summarize_profile(str(tmp_path))
+    assert not s["no_data"]
+    assert s["meta"]["samples"] == 4
+    assert s["utilization"] == {"device_busy": 0.4, "host_blocked": 0.3,
+                                "idle": 0.3}
+    thread = next(iter(s["top_stacks"]))
+    top = s["top_stacks"][thread][0]
+    assert top["count"] >= 1 and 0 < top["share"] <= 1
+
+    assert report.main(["--profile", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "sampler: 50.0Hz, 4 samples" in out
+    assert "device_busy=40.0%" in out
+    assert "soup_nan_frac-0000" in out
+
+
+def test_report_profile_no_data_exit2(tmp_path, capsys):
+    """A --no-profile run dir must exit 2, never render an
+    empty-but-valid profile an operator would misread as 'nothing was
+    hot'."""
+    from srnn_tpu.telemetry import report
+
+    assert report.main(["--profile", str(tmp_path)]) == 2
+    assert "no profiling data" in capsys.readouterr().err
+    assert report.main(["--profile", "--json", str(tmp_path)]) == 2
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["no_data"] is True
+
+
+# ---------------------------------------------------------------------------
+# Perfetto: the utilization counter track
+# ---------------------------------------------------------------------------
+
+
+def test_perfetto_utilization_counter_track(tmp_path):
+    from srnn_tpu.telemetry.fleet import perfetto_trace
+
+    rows = [
+        {"kind": "metrics", "t": 1.5, "metrics": {
+            "srnn_soup_utilization_device_busy": 0.4,
+            "srnn_soup_utilization_host_blocked": 0.3,
+            "srnn_soup_utilization_idle": 0.3,
+            "srnn_soup_generations_total": 6.0}},
+        # a metrics row without utilization gauges emits no track
+        {"kind": "metrics", "t": 2.0, "metrics": {
+            "srnn_soup_generations_total": 8.0}},
+    ]
+    with open(tmp_path / "events.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    doc = perfetto_trace(str(tmp_path))
+    util = [e for e in doc["traceEvents"] if e["name"] == "utilization"]
+    assert len(util) == 1
+    ev = util[0]
+    assert ev["ph"] == "C" and ev["cat"] == "profile"
+    assert ev["ts"] == 1.5e6
+    assert ev["args"] == {"device_busy": 0.4, "host_blocked": 0.3,
+                          "idle": 0.3}
+
+
+# ---------------------------------------------------------------------------
+# the oracle: the whole plane is host-side
+# ---------------------------------------------------------------------------
+
+
+def _assert_bitwise_equal(a, b):
+    import jax
+
+    np.testing.assert_array_equal(np.asarray(a.weights),
+                                  np.asarray(b.weights))
+    np.testing.assert_array_equal(np.asarray(a.uids), np.asarray(b.uids))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(a.key)),
+        np.asarray(jax.random.key_data(b.key)))
+
+
+def test_no_profile_bitwise_ab_mega_soup(tmp_path):
+    """mega_soup with the profiler (default) vs --no-profile:
+    weights/uids/PRNG bitwise-identical; the profile artifacts exist
+    only in the profiled run."""
+    from srnn_tpu.experiment import restore_checkpoint
+
+    with_prof = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "47", "--root", str(tmp_path / "a")])
+    without = REGISTRY["mega_soup"](
+        ["--smoke", "--seed", "47", "--no-profile",
+         "--root", str(tmp_path / "b")])
+    _assert_bitwise_equal(
+        restore_checkpoint(os.path.join(with_prof, "ckpt-gen00000006")),
+        restore_checkpoint(os.path.join(without, "ckpt-gen00000006")))
+    assert os.path.exists(os.path.join(with_prof, "profile.folded"))
+    assert os.path.exists(os.path.join(with_prof, "profile.jsonl"))
+    assert not os.path.exists(os.path.join(without, "profile.folded"))
+    assert not os.path.exists(os.path.join(without, "profile.jsonl"))
+    # no alert fired in a healthy smoke: no anomaly bundles either way
+    assert not os.path.exists(os.path.join(without, "anomaly"))
+    prom = open(os.path.join(with_prof, "metrics.prom")).read()
+    assert "srnn_soup_profile_samples_total" in prom
+    assert "srnn_soup_utilization_device_busy" in prom
+    assert "srnn_soup_profile" not in open(
+        os.path.join(without, "metrics.prom")).read()
+
+
+def test_no_profile_bitwise_ab_mega_multisoup(tmp_path):
+    from srnn_tpu.experiment import restore_multi_checkpoint
+
+    with_prof = REGISTRY["mega_multisoup"](
+        ["--smoke", "--seed", "47", "--root", str(tmp_path / "a")])
+    without = REGISTRY["mega_multisoup"](
+        ["--smoke", "--seed", "47", "--no-profile",
+         "--root", str(tmp_path / "b")])
+    a = restore_multi_checkpoint(os.path.join(with_prof,
+                                              "ckpt-gen00000006"))
+    b = restore_multi_checkpoint(os.path.join(without,
+                                              "ckpt-gen00000006"))
+    for wa, wb in zip(a.weights, b.weights):
+        np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+    import jax
+
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(a.key)),
+        np.asarray(jax.random.key_data(b.key)))
+    assert os.path.exists(os.path.join(with_prof, "profile.folded"))
+    assert not os.path.exists(os.path.join(without, "profile.folded"))
